@@ -1,0 +1,37 @@
+"""Test harness: 8 fake CPU devices exercise the same pjit/ppermute code
+paths as a real TPU mesh (SURVEY.md §4 item 3).  Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# the axon TPU-tunnel plugin overrides JAX_PLATFORMS; force CPU explicitly
+jax.config.update("jax_platforms", "cpu")
+
+import warnings
+
+# buffer donation is a no-op on the CPU backend; the warning is expected
+warnings.filterwarnings(
+    "ignore", message=".*[Dd]onat.*", category=UserWarning
+)
+
+import numpy as np
+import pytest
+
+from tpu_life.models.patterns import random_board
+
+
+@pytest.fixture
+def rng_board():
+    def make(h, w, density=0.5, states=2, seed=0):
+        return random_board(h, w, density, states=states, seed=seed)
+
+    return make

@@ -1,0 +1,75 @@
+"""Snapshot / resume tests (SURVEY.md §5 checkpoint row)."""
+
+import numpy as np
+
+from tpu_life.config import RunConfig
+from tpu_life.io.codec import write_board, write_config
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.runtime.checkpoint import latest_snapshot, load_resume, save_snapshot
+from tpu_life.runtime.driver import run
+
+
+def test_save_and_latest(tmp_path, rng_board):
+    b = rng_board(8, 9)
+    save_snapshot(tmp_path / "snaps", 5, b, rule="B3/S23")
+    save_snapshot(tmp_path / "snaps", 15, b, rule="B3/S23")
+    step, path = latest_snapshot(tmp_path / "snaps")
+    assert step == 15 and path.name == "board_000000015.txt"
+    board, got_step = load_resume(tmp_path / "snaps", 8, 9)
+    assert got_step == 15
+    np.testing.assert_array_equal(board, b)
+
+
+def test_driver_snapshots_and_resume(tmp_path, rng_board):
+    board = random_board(40, 33, seed=31)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "cfg.txt", 40, 33, 10)
+
+    base = dict(
+        config_file=str(tmp_path / "cfg.txt"),
+        input_file=str(tmp_path / "data.txt"),
+        backend="numpy",
+        snapshot_dir=str(tmp_path / "snaps"),
+    )
+    res = run(
+        RunConfig(
+            output_file=str(tmp_path / "out_full.txt"),
+            snapshot_every=4,
+            **base,
+        )
+    )
+    expect = run_np(board, get_rule("conway"), 10)
+    np.testing.assert_array_equal(res.board, expect)
+    # snapshots at 4 and 8 exist
+    assert latest_snapshot(tmp_path / "snaps")[0] == 8
+
+    # wipe output; resume from latest snapshot and finish the run
+    res2 = run(
+        RunConfig(
+            output_file=str(tmp_path / "out_resumed.txt"),
+            resume=str(tmp_path / "snaps"),
+            **base,
+        )
+    )
+    assert res2.steps_run == 2
+    np.testing.assert_array_equal(res2.board, expect)
+
+
+def test_metrics_recorded(tmp_path):
+    board = random_board(16, 16, seed=32)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "cfg.txt", 16, 16, 6)
+    res = run(
+        RunConfig(
+            config_file=str(tmp_path / "cfg.txt"),
+            input_file=str(tmp_path / "data.txt"),
+            output_file=str(tmp_path / "out.txt"),
+            backend="numpy",
+            metrics=True,
+            sync_every=2,
+        )
+    )
+    assert [m["step"] for m in res.metrics] == [2, 4, 6]
+    assert all(m["live_cells"] >= 0 for m in res.metrics)

@@ -1,0 +1,97 @@
+"""End-to-end CLI tests: the reference's full I/O contract (SURVEY.md §6a).
+
+`python -m tpu_life run` with zero flags must behave exactly like launching
+the (fixed) reference binary: read grid_size_data.txt + data.txt from cwd,
+write output.txt, print `Total time = <s>`.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.cli import main
+from tpu_life.io.codec import read_board, write_board, write_config
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+
+
+@pytest.fixture
+def workload(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    board = random_board(60, 37, seed=21)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "grid_size_data.txt", 60, 37, 12)
+    return tmp_path, board
+
+
+def test_default_contract_run(workload, capsys):
+    tmp, board = workload
+    assert main([]) == 0
+    out = capsys.readouterr().out
+    assert "Total time = " in out
+    got = read_board(tmp / "output.txt", 60, 37)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 12))
+    # byte-exact size: h * (w + 1)
+    assert (tmp / "output.txt").stat().st_size == 60 * 38
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax", "sharded"])
+def test_backends_bit_identical(workload, backend):
+    tmp, board = workload
+    assert main(["run", "--backend", backend, "--output-file", f"out_{backend}.txt"]) == 0
+    got = read_board(tmp / f"out_{backend}.txt", 60, 37)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 12))
+
+
+def test_flag_overrides(workload):
+    tmp, board = workload
+    assert (
+        main(["run", "--steps", "3", "--rule", "highlife", "--backend", "numpy"])
+        == 0
+    )
+    got = read_board(tmp / "output.txt", 60, 37)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("highlife"), 3))
+
+
+def test_bug_compat_mode(workload):
+    tmp, board = workload
+    assert main(["run", "--bug-compat", "--backend", "numpy", "--steps", "4"]) == 0
+    got = read_board(tmp / "output.txt", 60, 37)
+    np.testing.assert_array_equal(
+        got, run_np(board, get_rule("reference_bug_compat"), 4)
+    )
+
+
+def test_gen_then_run(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    assert main(["gen", "--height", "20", "--width", "30", "--steps", "5"]) == 0
+    assert main(["run", "--backend", "jax"]) == 0
+    b = read_board(tmp_path / "output.txt", 20, 30)
+    assert b.shape == (20, 30)
+
+
+def test_info(capsys):
+    assert main(["info"]) == 0
+    out = capsys.readouterr().out
+    assert "tpu-life" in out and "conway" in out
+
+
+def test_output_resume_roundtrip(workload):
+    # output format == input format: resume-from-output works by construction
+    tmp, board = workload
+    assert main(["run", "--backend", "numpy", "--steps", "6"]) == 0
+    assert main(
+        [
+            "run",
+            "--backend",
+            "numpy",
+            "--steps",
+            "6",
+            "--resume",
+            "output.txt",
+            "--output-file",
+            "out2.txt",
+        ]
+    ) == 0
+    got = read_board(tmp / "out2.txt", 60, 37)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 12))

@@ -1,0 +1,99 @@
+"""Codec golden-byte tests — the I/O contract (SURVEY.md §6a items 1-2)."""
+
+import numpy as np
+import pytest
+
+from tpu_life.io.codec import (
+    decode_board,
+    encode_board,
+    read_board,
+    read_config,
+    row_stride,
+    write_board,
+    write_config,
+)
+from tpu_life.io.sharded import read_stripe, stripe_bounds, write_stripe
+
+
+def test_row_stride():
+    assert row_stride(500) == 501
+
+
+def test_decode_golden_bytes():
+    buf = b"010\n111\n000\n"
+    b = decode_board(buf, 3, 3)
+    assert b.dtype == np.int8
+    np.testing.assert_array_equal(
+        b, [[0, 1, 0], [1, 1, 1], [0, 0, 0]]
+    )
+
+
+def test_encode_golden_bytes():
+    b = np.array([[0, 1], [1, 0]], dtype=np.int8)
+    assert encode_board(b) == b"01\n10\n"
+
+
+def test_roundtrip_random(rng_board):
+    for states in (2, 4):
+        b = rng_board(37, 53, states=states, seed=3)
+        assert (decode_board(encode_board(b), 37, 53) == b).all()
+
+
+def test_decode_validates_length():
+    with pytest.raises(ValueError, match="byte length"):
+        decode_board(b"01\n", 2, 2)
+
+
+def test_decode_validates_newlines():
+    with pytest.raises(ValueError, match="row 0"):
+        decode_board(b"000000", 2, 2)  # right length, no newlines
+
+
+def test_decode_validates_alphabet():
+    with pytest.raises(ValueError, match="alphabet|outside"):
+        decode_board(b"0x\n00\n", 2, 2)
+
+
+def test_file_roundtrip(tmp_path, rng_board):
+    b = rng_board(10, 7)
+    p = tmp_path / "b.txt"
+    write_board(p, b)
+    # exact byte size: h * (w + 1), reference contract
+    assert p.stat().st_size == 10 * 8
+    assert (read_board(p, 10, 7) == b).all()
+
+
+def test_config_roundtrip(tmp_path):
+    p = tmp_path / "grid_size_data.txt"
+    write_config(p, 1500, 500, 100)
+    # the reference's config has no trailing newline (SURVEY.md §2.1)
+    assert p.read_bytes() == b"1500 500 100"
+    assert read_config(p) == (1500, 500, 100)
+
+
+def test_config_validates(tmp_path):
+    p = tmp_path / "c.txt"
+    p.write_text("1 2")
+    with pytest.raises(ValueError):
+        read_config(p)
+    p.write_text("0 5 5")
+    with pytest.raises(ValueError):
+        read_config(p)
+
+
+def test_stripe_bounds_balanced():
+    bounds = stripe_bounds(10, 4)
+    assert bounds == [(0, 3), (3, 6), (6, 8), (8, 10)]
+    assert stripe_bounds(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+
+
+def test_stripe_io(tmp_path, rng_board):
+    b = rng_board(23, 11, seed=7)
+    p = tmp_path / "board.txt"
+    # write out-of-order stripes, then read back both whole and striped
+    for start, stop in reversed(stripe_bounds(23, 5)):
+        write_stripe(p, start, b[start:stop], total_rows=23)
+    assert (read_board(p, 23, 11) == b).all()
+    for start, stop in stripe_bounds(23, 3):
+        s = read_stripe(p, start, stop - start, 11)
+        assert (s == b[start:stop]).all()

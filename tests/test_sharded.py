@@ -1,0 +1,84 @@
+"""Sharded-vs-single equivalence on a fake 8-device CPU mesh.
+
+This is the shard-count-invariance property the reference *aims* at and
+breaks via its discarded-recv bug (Parallel_Life_MPI.cpp:111,127; SURVEY.md
+§4): results must be independent of device count, block depth, and
+partitioning mode.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_life.backends.sharded_backend import ShardedBackend
+from tpu_life.models.rules import get_rule, parse_rule
+from tpu_life.ops.reference import run_np
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs multi-device (fake CPU) platform"
+)
+
+
+@pytest.mark.parametrize("num_devices", [1, 2, 8])
+def test_invariant_under_device_count(num_devices, rng_board):
+    rule = get_rule("conway")
+    b = rng_board(64, 48, seed=11)
+    expect = run_np(b, rule, 10)
+    be = ShardedBackend(num_devices=num_devices)
+    np.testing.assert_array_equal(be.run(b, rule, 10), expect)
+
+
+@pytest.mark.parametrize("block_steps", [1, 2, 5])
+def test_deep_halo_blocking(block_steps, rng_board):
+    rule = get_rule("conway")
+    b = rng_board(80, 40, seed=12)
+    expect = run_np(b, rule, 11)  # 11 = 2*5+1 exercises the remainder path
+    be = ShardedBackend(num_devices=8, block_steps=block_steps)
+    np.testing.assert_array_equal(be.run(b, rule, 11), expect)
+
+
+def test_uneven_height(rng_board):
+    # height not divisible by devices -> physical padding rows must stay dead
+    rule = get_rule("conway")
+    b = rng_board(59, 37, seed=13)
+    expect = run_np(b, rule, 8)
+    be = ShardedBackend(num_devices=8)
+    np.testing.assert_array_equal(be.run(b, rule, 8), expect)
+
+
+def test_radius2_rule_sharded(rng_board):
+    rule = parse_rule("R2,C2,S8..12,B7..8")
+    b = rng_board(64, 32, seed=14)
+    expect = run_np(b, rule, 6)
+    be = ShardedBackend(num_devices=4, block_steps=2)
+    np.testing.assert_array_equal(be.run(b, rule, 6), expect)
+
+
+def test_generations_rule_sharded(rng_board):
+    rule = get_rule("star_wars")
+    b = rng_board(48, 40, states=4, seed=15)
+    expect = run_np(b, rule, 9)
+    be = ShardedBackend(num_devices=8, block_steps=3)
+    np.testing.assert_array_equal(be.run(b, rule, 9), expect)
+
+
+def test_gspmd_mode_matches(rng_board):
+    rule = get_rule("conway")
+    b = rng_board(64, 33, seed=16)
+    expect = run_np(b, rule, 7)
+    be = ShardedBackend(num_devices=8, partition_mode="gspmd")
+    np.testing.assert_array_equal(be.run(b, rule, 7), expect)
+
+
+def test_callback_chunking(rng_board):
+    rule = get_rule("conway")
+    b = rng_board(64, 30, seed=17)
+    seen = []
+    be = ShardedBackend(num_devices=4, block_steps=2)
+    out = be.run(
+        b, rule, 10, chunk_steps=4, callback=lambda s, g: seen.append((s, g()))
+    )
+    assert [s for s, _ in seen] == [4, 8, 10]
+    np.testing.assert_array_equal(seen[-1][1], out)
+    np.testing.assert_array_equal(out, run_np(b, rule, 10))

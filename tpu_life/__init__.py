@@ -1,0 +1,27 @@
+"""tpu-life: a TPU-native cellular-automaton framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+krutovsky-danya/mpi-game-of-life (reference: /root/reference/Parallel_Life_MPI.cpp):
+stripe-decomposed synchronous cellular automata with halo exchange and
+parallel file I/O — built TPU-first rather than ported from MPI C++.
+
+Mapping of the reference's layers (SURVEY.md §1) onto this package:
+
+- L0 communication  -> XLA collectives (``lax.ppermute``) over a
+  ``jax.sharding.Mesh``  (``tpu_life.parallel``)
+- L1 decomposition  -> ``NamedSharding(P('rows', None))`` stripe sharding
+  (``tpu_life.parallel.mesh``)
+- L2 halo exchange  -> non-periodic ``ppermute`` ring inside ``shard_map``
+  (``tpu_life.parallel.halo``)
+- L3 compute kernel -> separable shift-add stencil / Pallas kernel
+  (``tpu_life.ops``)
+- L4 storage / I/O  -> byte-exact board codec + per-shard offset I/O
+  (``tpu_life.io``)
+- L5 driver / CLI   -> ``tpu_life.runtime.driver`` + ``tpu_life.cli``
+"""
+
+from tpu_life.version import __version__
+from tpu_life.models.rules import Rule, parse_rule, get_rule
+from tpu_life.config import RunConfig
+
+__all__ = ["__version__", "Rule", "parse_rule", "get_rule", "RunConfig"]

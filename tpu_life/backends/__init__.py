@@ -1,0 +1,3 @@
+from tpu_life.backends.base import Backend, get_backend, BACKENDS
+
+__all__ = ["Backend", "get_backend", "BACKENDS"]

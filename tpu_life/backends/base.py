@@ -1,0 +1,88 @@
+"""The Backend interface — the seam the reference never factored.
+
+The reference fuses decomposition, exchange, kernel and driver into one
+``main`` (Parallel_Life_MPI.cpp:190-240).  Here a backend is one object with
+one method: advance a board ``steps`` steps.  All backends are bit-identical
+on the same (board, rule, steps) — that invariant *is* the test strategy
+(SURVEY.md §4) — and differ only in where and how the work runs:
+
+- ``numpy``   pure-NumPy truth executor, single process
+- ``jax``     single-device XLA (TPU when present), fused scan epoch loop
+- ``sharded`` row-sharded over a device mesh, ppermute halos
+- ``stripes`` CPU stripe-decomposition simulator mirroring the reference's
+              rank structure (explicit halos, MPI-lineage shape)
+- ``pallas``  single-device Pallas TPU stencil kernel
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+import numpy as np
+
+from tpu_life.models.rules import Rule
+
+# callback(step_index, get_board) where get_board() lazily materializes the
+# current board as np.int8 — laziness keeps device->host transfers out of the
+# hot loop unless a subscriber (snapshots, metrics, verbose dump) asks.
+ChunkCallback = Callable[[int, Callable[[], np.ndarray]], None]
+
+
+class Backend(Protocol):
+    name: str
+
+    def run(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        steps: int,
+        *,
+        chunk_steps: int = 0,
+        callback: ChunkCallback | None = None,
+    ) -> np.ndarray: ...
+
+
+BACKENDS: dict[str, Callable[..., Backend]] = {}
+
+
+def register_backend(name: str):
+    def deco(factory):
+        BACKENDS[name] = factory
+        return factory
+
+    return deco
+
+
+def get_backend(name: str, **kwargs) -> Backend:
+    """Instantiate a backend by name; ``auto`` prefers accelerated paths."""
+    # import for registration side effects
+    from tpu_life.backends import numpy_backend, jax_backend, sharded_backend  # noqa: F401
+
+    if name == "auto":
+        import jax
+
+        n = len(jax.devices())
+        name = "sharded" if n > 1 else "jax"
+    if name not in BACKENDS:
+        try:
+            if name == "pallas":
+                from tpu_life.backends import pallas_backend  # noqa: F401
+            elif name in ("stripes", "mpi"):
+                from tpu_life.backends import stripes_backend  # noqa: F401
+        except ImportError as e:
+            raise ValueError(f"backend {name!r} is unavailable: {e}") from e
+    if name not in BACKENDS:
+        raise ValueError(f"unknown backend {name!r}; available: {sorted(BACKENDS)}")
+    return BACKENDS[name](**kwargs)
+
+
+def chunk_sizes(steps: int, chunk_steps: int) -> list[int]:
+    """Split ``steps`` into host-sync chunks (0 or >= steps -> one chunk)."""
+    if steps <= 0:
+        return []
+    if chunk_steps <= 0 or chunk_steps >= steps:
+        return [steps]
+    out = [chunk_steps] * (steps // chunk_steps)
+    if steps % chunk_steps:
+        out.append(steps % chunk_steps)
+    return out

@@ -1,0 +1,148 @@
+"""Command-line interface.
+
+The reference takes zero CLI arguments — config is a 3-int file and
+filenames are hard-coded (Parallel_Life_MPI.cpp:195, :201, :63).  Running
+``python -m tpu_life run`` with no flags reproduces exactly that contract
+(reads ``grid_size_data.txt`` + ``data.txt``, writes ``output.txt``, prints
+``Total time = <s>``); every flag is an override on top.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_life.config import RunConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_life", description="TPU-native cellular-automaton framework"
+    )
+    sub = p.add_subparsers(dest="command")
+
+    r = sub.add_parser("run", help="run a simulation (default command)")
+    _add_run_args(r)
+
+    info = sub.add_parser("info", help="show devices, rules and version")
+    info.set_defaults(command="info")
+
+    g = sub.add_parser("gen", help="generate a random board + config")
+    g.add_argument("--height", type=int, required=True)
+    g.add_argument("--width", type=int, required=True)
+    g.add_argument("--steps", type=int, default=100)
+    g.add_argument("--density", type=float, default=0.5)
+    g.add_argument("--states", type=int, default=2)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--input-file", default="data.txt")
+    g.add_argument("--config-file", default="grid_size_data.txt")
+
+    return p
+
+
+def _add_run_args(r: argparse.ArgumentParser) -> None:
+    r.add_argument("--config-file", default="grid_size_data.txt")
+    r.add_argument("--input-file", default="data.txt")
+    r.add_argument("--output-file", default="output.txt")
+    r.add_argument("--height", type=int, default=None)
+    r.add_argument("--width", type=int, default=None)
+    r.add_argument("--steps", type=int, default=None)
+    r.add_argument("--rule", default="conway", help="name or B/S / LtL spec")
+    r.add_argument(
+        "--bug-compat",
+        action="store_true",
+        help="replicate the reference binary's effective (buggy) B/S2 rule",
+    )
+    r.add_argument(
+        "--backend",
+        default="auto",
+        choices=["auto", "numpy", "jax", "sharded", "stripes", "pallas"],
+    )
+    r.add_argument("--num-devices", type=int, default=None)
+    r.add_argument("--block-steps", type=int, default=1)
+    r.add_argument(
+        "--partition-mode", default="shard_map", choices=["shard_map", "gspmd"]
+    )
+    r.add_argument("--sync-every", type=int, default=0)
+    r.add_argument("--no-pad-lanes", action="store_true")
+    r.add_argument("--snapshot-every", type=int, default=0)
+    r.add_argument("--snapshot-dir", default="snapshots")
+    r.add_argument("--resume", default=None)
+    r.add_argument("--profile", default=None, metavar="TRACE_DIR")
+    r.add_argument("--metrics", action="store_true")
+    r.add_argument("--verbose", "-v", action="store_true")
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = build_parser()
+    if not argv or argv[0].startswith("-"):
+        argv = ["run", *argv]  # default command
+    args = parser.parse_args(argv)
+
+    if args.command == "info":
+        return _info()
+    if args.command == "gen":
+        return _gen(args)
+
+    cfg = RunConfig(
+        height=args.height,
+        width=args.width,
+        steps=args.steps,
+        config_file=args.config_file,
+        input_file=args.input_file,
+        output_file=args.output_file,
+        rule=args.rule,
+        bug_compat=args.bug_compat,
+        backend=args.backend,
+        num_devices=args.num_devices,
+        block_steps=args.block_steps,
+        partition_mode=args.partition_mode,
+        sync_every=args.sync_every,
+        pad_lanes=not args.no_pad_lanes,
+        snapshot_every=args.snapshot_every,
+        snapshot_dir=args.snapshot_dir,
+        resume=args.resume,
+        profile=args.profile,
+        metrics=args.metrics,
+        verbose=args.verbose,
+    )
+    from tpu_life.runtime.driver import run
+
+    run(cfg)
+    return 0
+
+
+def _info() -> int:
+    import jax
+
+    from tpu_life.models.rules import RULE_REGISTRY
+    from tpu_life.version import __version__
+
+    print(f"tpu-life {__version__}")
+    print(f"jax {jax.__version__} backend={jax.default_backend()}")
+    for d in jax.devices():
+        print(f"  device: {d}")
+    print("rules:", ", ".join(sorted(RULE_REGISTRY)))
+    return 0
+
+
+def _gen(args) -> int:
+    from tpu_life.io.codec import write_board, write_config
+    from tpu_life.models.patterns import random_board
+
+    board = random_board(
+        args.height,
+        args.width,
+        args.density,
+        states=args.states,
+        seed=args.seed,
+    )
+    write_board(args.input_file, board)
+    write_config(args.config_file, args.height, args.width, args.steps)
+    print(f"wrote {args.input_file} ({args.height}x{args.width}) and {args.config_file}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,66 @@
+"""Run configuration: the reference's 3-int config file plus a real flag system.
+
+The reference's entire config surface is ``grid_size_data.txt`` = ``h w
+epochs`` read by every rank, with hard-coded filenames and zero CLI arguments
+(Parallel_Life_MPI.cpp:201-209, :63, :166).  That file remains the default
+source of truth (bit-compat mode); everything else is a flag that overrides
+it (SURVEY.md §5 "Config / flag system").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tpu_life.io.codec import read_config
+
+
+@dataclass
+class RunConfig:
+    # board geometry + steps; None -> taken from config_file
+    height: int | None = None
+    width: int | None = None
+    steps: int | None = None
+
+    # I/O contract files (reference defaults: Parallel_Life_MPI.cpp:63, :201, :170)
+    config_file: str = "grid_size_data.txt"
+    input_file: str = "data.txt"
+    output_file: str = "output.txt"
+
+    # rule + semantics
+    rule: str = "conway"
+    bug_compat: bool = False  # replicate the shipped binary's effective B/S2 rule
+
+    # execution
+    backend: str = "auto"  # auto | numpy | jax | sharded | stripes | mpi
+    num_devices: int | None = None
+    block_steps: int = 1  # CA steps per halo exchange (deep halos)
+    partition_mode: str = "shard_map"  # shard_map | gspmd
+    sync_every: int = 0  # steps per host sync chunk; 0 = one fused run
+    pad_lanes: bool = True  # pad width to the 128-lane TPU tile
+
+    # aux subsystems
+    snapshot_every: int = 0
+    snapshot_dir: str = "snapshots"
+    resume: str | None = None
+    profile: str | None = None  # jax.profiler trace directory
+    verbose: bool = False
+    metrics: bool = False  # per-chunk live-cell counts + throughput
+
+    def resolved_geometry(self) -> tuple[int, int, int]:
+        """(height, width, steps), reading the config file for any None."""
+        h, w, s = self.height, self.width, self.steps
+        if h is None or w is None or s is None:
+            if not Path(self.config_file).exists():
+                raise FileNotFoundError(
+                    f"config file {self.config_file!r} not found and geometry "
+                    f"not fully specified by flags"
+                )
+            fh, fw, fs = read_config(self.config_file)
+            h = fh if h is None else h
+            w = fw if w is None else w
+            s = fs if s is None else s
+        return h, w, s
+
+    def effective_rule(self) -> str:
+        return "reference_bug_compat" if self.bug_compat else self.rule

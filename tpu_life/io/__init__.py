@@ -1,0 +1,23 @@
+from tpu_life.io.codec import (
+    decode_board,
+    encode_board,
+    read_board,
+    write_board,
+    read_config,
+    write_config,
+    row_stride,
+)
+from tpu_life.io.sharded import read_stripe, write_stripe, stripe_bounds
+
+__all__ = [
+    "decode_board",
+    "encode_board",
+    "read_board",
+    "write_board",
+    "read_config",
+    "write_config",
+    "row_stride",
+    "read_stripe",
+    "write_stripe",
+    "stripe_bounds",
+]

@@ -1,0 +1,17 @@
+from tpu_life.models.rules import (
+    Rule,
+    parse_rule,
+    get_rule,
+    register_rule,
+    RULE_REGISTRY,
+)
+from tpu_life.models import patterns
+
+__all__ = [
+    "Rule",
+    "parse_rule",
+    "get_rule",
+    "register_rule",
+    "RULE_REGISTRY",
+    "patterns",
+]

@@ -1,0 +1,52 @@
+"""Well-known CA patterns, for tests and demos.
+
+The reference ships only a ~50%-density random board (data.txt, SURVEY.md
+§2.1).  Known patterns with hand-checkable evolution are the unit-test
+vocabulary the reference lacks (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _p(rows: list[str]) -> np.ndarray:
+    return np.array([[int(c) for c in r] for r in rows], dtype=np.int8)
+
+
+BLOCK = _p(["11", "11"])  # still life
+BLINKER = _p(["111"])  # period-2 oscillator
+TOAD = _p(["0111", "1110"])  # period-2 oscillator
+BEACON = _p(["1100", "1100", "0011", "0011"])  # period-2 oscillator
+GLIDER = _p(["010", "001", "111"])  # moves (+1, +1) every 4 steps
+LWSS = _p(["01111", "10001", "00001", "10010"])  # lightweight spaceship
+R_PENTOMINO = _p(["011", "110", "010"])  # methuselah
+
+
+def place(board: np.ndarray, pattern: np.ndarray, top: int, left: int) -> np.ndarray:
+    """Return a copy of ``board`` with ``pattern`` stamped at (top, left)."""
+    out = board.copy()
+    h, w = pattern.shape
+    out[top : top + h, left : left + w] = pattern
+    return out
+
+
+def empty(height: int, width: int) -> np.ndarray:
+    return np.zeros((height, width), dtype=np.int8)
+
+
+def random_board(
+    height: int,
+    width: int,
+    density: float = 0.5,
+    *,
+    states: int = 2,
+    seed: int = 0,
+) -> np.ndarray:
+    """Random board matching the reference's ~50%-density uniform init."""
+    rng = np.random.default_rng(seed)
+    alive = rng.random((height, width)) < density
+    if states == 2:
+        return alive.astype(np.int8)
+    state = rng.integers(1, states, size=(height, width), dtype=np.int8)
+    return np.where(alive, state, 0).astype(np.int8)

@@ -1,0 +1,10 @@
+from tpu_life.ops.reference import neighbor_counts_np, step_np
+from tpu_life.ops.stencil import make_step, neighbor_counts, validity_mask
+
+__all__ = [
+    "neighbor_counts_np",
+    "step_np",
+    "make_step",
+    "neighbor_counts",
+    "validity_mask",
+]

@@ -1,0 +1,51 @@
+"""Pure-NumPy reference executor — the framework's ground truth.
+
+Plays the role the reference's nested-loop kernel plays
+(Parallel_Life_MPI.cpp:16-54), but implements the *intended* B3/S23-family
+semantics (the shipped binary's unconditional rule-overwrite makes its birth branch dead
+code — SURVEY.md §2.2).  Every other executor (XLA stencil, sharded shard_map
+step, Pallas kernel) is tested bit-identical against this one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_life.models.rules import Rule
+
+
+def neighbor_counts_np(
+    board: np.ndarray, radius: int = 1, include_center: bool = False
+) -> np.ndarray:
+    """Live-neighbor counts in the (2r+1)^2 Moore box, clamped dead boundary.
+
+    Separable: one pass of (2r+1) row shifts, one of (2r+1) column shifts —
+    O(r) work per cell instead of the reference's O(r^2) inner scan
+    (Parallel_Life_MPI.cpp:19-31).
+    """
+    h, w = board.shape
+    alive = (board == 1).astype(np.int32)
+    k = 2 * radius + 1
+    padded = np.zeros((h + 2 * radius, w + 2 * radius), dtype=np.int32)
+    padded[radius : radius + h, radius : radius + w] = alive
+    rows = np.zeros((h, w + 2 * radius), dtype=np.int32)
+    for dy in range(k):
+        rows += padded[dy : dy + h, :]
+    counts = np.zeros((h, w), dtype=np.int32)
+    for dx in range(k):
+        counts += rows[:, dx : dx + w]
+    if not include_center:
+        counts -= alive
+    return counts
+
+
+def step_np(board: np.ndarray, rule: Rule) -> np.ndarray:
+    """One synchronous CA step via the rule's full transition LUT."""
+    counts = neighbor_counts_np(board, rule.radius, rule.include_center)
+    return rule.transition_table[board.astype(np.int64), counts]
+
+
+def run_np(board: np.ndarray, rule: Rule, steps: int) -> np.ndarray:
+    for _ in range(steps):
+        board = step_np(board, rule)
+    return board

@@ -1,0 +1,156 @@
+"""The XLA stencil op: one CA step as a fused, branch-free jaxpr.
+
+This is what the reference's entire compute hot loop
+(``updateGrid`` + ``countNeighbours``, Parallel_Life_MPI.cpp:16-54)
+collapses into on TPU:
+
+- the 8-neighbor count becomes a *separable* box sum — (2r+1) static row
+  shifts then (2r+1) static column shifts over a zero-padded array.  Static
+  slices of a pad are exactly what XLA fuses into a single VPU loop; zero
+  padding *is* the reference's clamped non-periodic boundary
+  (Parallel_Life_MPI.cpp:21-27).
+- the rule becomes compare/select chains generated from the static
+  birth/survive sets (see ``tpu_life.models.rules``): no gathers, no
+  data-dependent control flow, nothing XLA can't fuse into the same loop.
+
+All intermediates are int32 (VPU-native lane width; exact for counts up to
+(2r+1)^2); the board itself stays int8 in HBM, so the op is one int8 read +
+one int8 write per cell per step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from tpu_life.models.rules import Rule
+from tpu_life.ops.common import contiguous_ranges
+
+
+def neighbor_counts(
+    board: jax.Array, radius: int = 1, include_center: bool = False
+) -> jax.Array:
+    """int32 live-neighbor counts; clamped (dead) outside the array."""
+    h, w = board.shape
+    k = 2 * radius + 1
+    alive = (board == 1).astype(jnp.int32)
+    padded = jnp.pad(alive, radius)
+    rows = padded[0:h, :]
+    for dy in range(1, k):
+        rows = rows + padded[dy : dy + h, :]
+    counts = rows[:, 0:w]
+    for dx in range(1, k):
+        counts = counts + rows[:, dx : dx + w]
+    if not include_center:
+        counts = counts - alive
+    return counts
+
+
+def _membership(counts: jax.Array, values: frozenset) -> jax.Array:
+    """Branch-free ``counts in values`` as fused range compares."""
+    m = jnp.zeros(counts.shape, dtype=jnp.bool_)
+    for lo, hi in contiguous_ranges(values):
+        if lo == hi:
+            m = m | (counts == lo)
+        else:
+            m = m | ((counts >= lo) & (counts <= hi))
+    return m
+
+
+def apply_rule(board: jax.Array, counts: jax.Array, rule: Rule) -> jax.Array:
+    """Next state from (state, count) — the LUT as compare/selects."""
+    born = _membership(counts, rule.birth)
+    survives = _membership(counts, rule.survive)
+    one = jnp.int8(1)
+    zero = jnp.int8(0)
+    if rule.states == 2:
+        alive = board == 1
+        return jnp.where(alive, jnp.where(survives, one, zero),
+                         jnp.where(born, one, zero))
+    dying_next = jnp.where(
+        board >= rule.states - 1, zero, (board + one).astype(jnp.int8)
+    )
+    nxt = jnp.where(
+        board == 0,
+        jnp.where(born, one, zero),
+        jnp.where(
+            board == 1,
+            jnp.where(survives, one, jnp.int8(2)),
+            dying_next,
+        ),
+    )
+    return nxt.astype(jnp.int8)
+
+
+def validity_mask(
+    shape: tuple[int, int],
+    logical_shape: tuple[int, int],
+    row_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Bool mask of cells that exist on the *logical* board.
+
+    TPU layouts want the physical array padded (rows to the shard count,
+    columns toward the 128-lane width).  Padding cells must stay dead forever
+    — a cell outside the logical board that flips alive would leak births
+    back across the boundary, violating the reference's clamped-edge
+    semantics.  ``row_offset`` is the global row index of physical row 0
+    (traced, for use inside shard_map).
+    """
+    h, w = shape
+    lh, lw = logical_shape
+    grow = row_offset + jnp.arange(h)
+    return ((grow >= 0) & (grow < lh))[:, None] & (jnp.arange(w) < lw)[None, :]
+
+
+def make_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
+    """One full-array CA step ``int8[h, w] -> int8[h, w]``."""
+
+    def step(board: jax.Array) -> jax.Array:
+        counts = neighbor_counts(board, rule.radius, rule.include_center)
+        return apply_rule(board, counts, rule)
+
+    return step
+
+
+def make_masked_step(
+    rule: Rule, logical_shape: tuple[int, int]
+) -> Callable[[jax.Array], jax.Array]:
+    """A step that also pins physical padding cells dead (see validity_mask)."""
+    step = make_step(rule)
+
+    def masked(board: jax.Array, row_offset: jax.Array | int = 0) -> jax.Array:
+        mask = validity_mask(board.shape, logical_shape, row_offset)
+        return jnp.where(mask, step(board), jnp.int8(0))
+
+    return masked
+
+
+@partial(
+    jax.jit,
+    static_argnames=("rule", "steps", "logical_shape"),
+    donate_argnums=0,
+)
+def multi_step(
+    board: jax.Array,
+    *,
+    rule: Rule,
+    steps: int,
+    logical_shape: tuple[int, int] | None = None,
+) -> jax.Array:
+    """``steps`` fused CA steps under one jit via ``lax.scan``.
+
+    The epoch loop lives on-device — the analogue of the reference's
+    update/exchange/barrier loop (Parallel_Life_MPI.cpp:215-221) with the
+    barrier dissolved into dataflow.
+    """
+    if logical_shape is None or tuple(logical_shape) == tuple(board.shape):
+        step = make_step(rule)
+        body = lambda b, _: (step(b), None)
+    else:
+        masked = make_masked_step(rule, tuple(logical_shape))
+        body = lambda b, _: (masked(b), None)
+    out, _ = jax.lax.scan(body, board, None, length=steps)
+    return out

@@ -1,0 +1,10 @@
+from tpu_life.parallel.mesh import make_mesh, board_sharding, init_distributed
+from tpu_life.parallel.halo import make_sharded_run, halo_depth
+
+__all__ = [
+    "make_mesh",
+    "board_sharding",
+    "init_distributed",
+    "make_sharded_run",
+    "halo_depth",
+]

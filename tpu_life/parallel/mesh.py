@@ -1,0 +1,59 @@
+"""Device mesh + sharding construction — the runtime the reference gets from MPI.
+
+``MPI_Init``/``Comm_size``/``Comm_rank`` (Parallel_Life_MPI.cpp:195-197)
+become ``jax.distributed.initialize`` + a 1-D ``jax.sharding.Mesh`` whose
+axis, named ``"rows"``, carries the stripe decomposition
+(README.md:6 "Devide field to stripes").  Rank and size are recovered inside
+``shard_map`` via ``lax.axis_index`` — never stored in globals.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROW_AXIS = "rows"
+
+
+def init_distributed() -> None:
+    """Join a multi-host JAX job if the environment describes one.
+
+    The analogue of ``MPI_Init`` (Parallel_Life_MPI.cpp:195).  Controlled by
+    the standard JAX cluster-environment variables; a plain single-process
+    run is a no-op so the same entry point serves laptop and pod.
+    """
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    ):
+        jax.distributed.initialize()
+
+
+def make_mesh(num_devices: int | None = None, *, devices=None, axis: str = ROW_AXIS) -> Mesh:
+    """A 1-D mesh over ``num_devices`` (default: all) devices.
+
+    On a TPU slice the device order follows ICI topology, so the
+    nearest-neighbor ``ppermute`` ring in ``tpu_life.parallel.halo`` rides
+    ICI links, not DCN.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def board_sharding(mesh: Mesh, axis: str = ROW_AXIS) -> NamedSharding:
+    """Stripe sharding: rows split across the mesh, columns replicated.
+
+    The TPU-native form of the reference's block-row decomposition
+    (Parallel_Life_MPI.cpp:70-81).
+    """
+    return NamedSharding(mesh, P(axis, None))

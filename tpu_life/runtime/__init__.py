@@ -1,0 +1,3 @@
+from tpu_life.runtime.driver import run, RunResult
+
+__all__ = ["run", "RunResult"]

@@ -1,0 +1,92 @@
+"""Checkpoint / resume.
+
+The reference has none mid-run; its terminal ``output.txt`` doubles as a
+restartable board because output format == input format
+(Parallel_Life_MPI.cpp:10-11, :161-163; SURVEY.md §5).  We make that design
+first-class: snapshots *are* board files in the contract codec, plus a tiny
+JSON sidecar recording step/rule/geometry, so ``--resume`` works on any
+snapshot — or on a bare ``output.txt`` from any backend or the reference
+binary itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+
+import numpy as np
+
+from tpu_life.io.codec import read_board, write_board
+
+_SNAP_RE = re.compile(r"^board_(\d+)\.txt$")
+
+
+def snapshot_path(directory: str | os.PathLike, step: int) -> Path:
+    return Path(directory) / f"board_{step:09d}.txt"
+
+
+def save_snapshot(
+    directory: str | os.PathLike,
+    step: int,
+    board: np.ndarray,
+    *,
+    rule: str,
+) -> Path:
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    p = snapshot_path(d, step)
+    write_board(p, board)
+    meta = {
+        "step": step,
+        "rule": rule,
+        "height": int(board.shape[0]),
+        "width": int(board.shape[1]),
+    }
+    p.with_suffix(".json").write_text(json.dumps(meta))
+    return p
+
+
+def latest_snapshot(directory: str | os.PathLike) -> tuple[int, Path] | None:
+    d = Path(directory)
+    if not d.is_dir():
+        return None
+    best: tuple[int, Path] | None = None
+    for f in d.iterdir():
+        m = _SNAP_RE.match(f.name)
+        if m:
+            step = int(m.group(1))
+            if best is None or step > best[0]:
+                best = (step, f)
+    return best
+
+
+def load_resume(
+    path: str | os.PathLike, height: int, width: int
+) -> tuple[np.ndarray, int]:
+    """Load a board to resume from; returns (board, completed_steps).
+
+    ``path`` may be a snapshot (step recovered from its sidecar/filename), a
+    snapshot *directory* (latest snapshot wins), or any contract-format board
+    file (completed_steps = 0 unless a sidecar says otherwise).
+    """
+    p = Path(path)
+    if p.is_dir():
+        found = latest_snapshot(p)
+        if found is None:
+            raise FileNotFoundError(f"no snapshots in {p}")
+        step, p = found
+        return read_board(p, height, width), step
+    step = 0
+    sidecar = p.with_suffix(".json")
+    if sidecar.exists():
+        meta = json.loads(sidecar.read_text())
+        step = int(meta.get("step", 0))
+        height = int(meta.get("height", height))
+        width = int(meta.get("width", width))
+    else:
+        m = _SNAP_RE.match(p.name)
+        if m:
+            step = int(m.group(1))
+    return read_board(p, height, width), step

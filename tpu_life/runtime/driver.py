@@ -1,0 +1,127 @@
+"""The driver: what the reference's ``main`` (Parallel_Life_MPI.cpp:190-240)
+becomes once the layers are factored.
+
+Sequence (mirrors §3.1 of SURVEY.md, with the barriers dissolved):
+read config -> load board (or resume) -> pick backend -> fused epoch
+loop with optional snapshot/metric chunking -> write output -> report
+``Total time = <s>`` from the lead process.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+from tpu_life.backends.base import get_backend
+from tpu_life.config import RunConfig
+from tpu_life.io.codec import read_board, write_board
+from tpu_life.models.rules import get_rule
+from tpu_life.runtime import checkpoint as ckpt
+from tpu_life.runtime.metrics import MetricsRecorder, configure_logging, dump_board, log
+from tpu_life.runtime.profiling import maybe_profile
+from tpu_life.utils.timing import Timer
+
+
+@dataclass
+class RunResult:
+    board: np.ndarray
+    steps_run: int
+    elapsed_s: float
+    backend: str
+    rule: str
+    metrics: list[dict] = field(default_factory=list)
+
+
+def run(cfg: RunConfig) -> RunResult:
+    configure_logging(cfg.verbose)
+    height, width, steps = cfg.resolved_geometry()
+    rule = get_rule(cfg.effective_rule())
+
+    timer = Timer()  # spans I/O too, like the reference's Wtime bracket
+
+    start_step = 0
+    if cfg.resume:
+        board, start_step = ckpt.load_resume(cfg.resume, height, width)
+        log.info("resumed from %s at step %d", cfg.resume, start_step)
+    else:
+        board = read_board(cfg.input_file, height, width)
+    if board.shape != (height, width):
+        raise ValueError(
+            f"board shape {board.shape} != configured ({height}, {width})"
+        )
+
+    backend = get_backend(
+        cfg.backend,
+        num_devices=cfg.num_devices,
+        block_steps=cfg.block_steps,
+        partition_mode=cfg.partition_mode,
+        pad_lanes=cfg.pad_lanes,
+    )
+
+    remaining = max(0, steps - start_step)
+    recorder = MetricsRecorder(
+        height * width, cfg.metrics or cfg.verbose, start_step=start_step
+    )
+
+    chunk = cfg.sync_every
+    if cfg.snapshot_every > 0:
+        chunk = (
+            cfg.snapshot_every
+            if chunk <= 0
+            else min(chunk, cfg.snapshot_every)
+        )
+
+    last_snap = 0  # crossing detection: snapshot at the first sync point
+    # at-or-past each snapshot_every multiple, so sync_every and
+    # snapshot_every need not divide each other
+
+    def on_chunk(done_local: int, get_board) -> None:
+        nonlocal last_snap
+        done = start_step + done_local
+        board_np = get_board()  # one device->host transfer per chunk
+        recorder.record_chunk(done, timer.elapsed, board_np)
+        if (
+            cfg.snapshot_every > 0
+            and done_local // cfg.snapshot_every > last_snap // cfg.snapshot_every
+        ):
+            last_snap = done_local
+            p = ckpt.save_snapshot(
+                cfg.snapshot_dir, done, board_np, rule=rule.name
+            )
+            log.info("snapshot step=%d -> %s", done, p)
+        if cfg.verbose:
+            log.debug("board at step %d:\n%s", done, dump_board(board_np))
+
+    callback = (
+        on_chunk
+        if (cfg.snapshot_every > 0 or cfg.metrics or cfg.verbose)
+        else None
+    )
+
+    with maybe_profile(cfg.profile):
+        board = backend.run(
+            board,
+            rule,
+            remaining,
+            chunk_steps=chunk,
+            callback=callback,
+        )
+
+    if cfg.output_file:
+        Path(cfg.output_file).parent.mkdir(parents=True, exist_ok=True)
+        write_board(cfg.output_file, board)
+
+    elapsed = timer.elapsed
+    # Contract parity: the reference's lead-rank report
+    # (Parallel_Life_MPI.cpp:234-236).
+    print(f"Total time = {elapsed}")
+    return RunResult(
+        board=board,
+        steps_run=remaining,
+        elapsed_s=elapsed,
+        backend=getattr(backend, "name", cfg.backend),
+        rule=rule.name,
+        metrics=recorder.records,
+    )

@@ -1,0 +1,65 @@
+"""Structured run metrics — what the reference's stdout prints grow up into.
+
+The reference's observability is per-rank write confirmations and one
+``Total time`` line (Parallel_Life_MPI.cpp:179, :234-236).  Here: a logger
+emitting step index, live-cell count, steps/sec and cell-updates/sec at each
+host-sync chunk, plus the same final ``Total time = <s>`` line for contract
+parity (SURVEY.md §6a item 5).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import numpy as np
+
+log = logging.getLogger("tpu_life")
+
+
+def configure_logging(verbose: bool) -> None:
+    if not log.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(message)s"))
+        log.addHandler(h)
+    log.setLevel(logging.DEBUG if verbose else logging.INFO)
+
+
+class MetricsRecorder:
+    def __init__(self, cell_count: int, enabled: bool, start_step: int = 0):
+        self.cell_count = cell_count
+        self.enabled = enabled
+        self.start_step = start_step  # rates count only this run's steps
+        self.records: list[dict] = []
+
+    def record_chunk(self, step: int, elapsed: float, board: np.ndarray) -> None:
+        if not self.enabled:
+            return
+        live = int(np.count_nonzero(board == 1))
+        done = step - self.start_step
+        rec = {
+            "step": step,
+            "elapsed_s": elapsed,
+            "live_cells": live,
+            "steps_per_sec": done / elapsed if elapsed > 0 else float("nan"),
+            "cell_updates_per_sec": done * self.cell_count / elapsed
+            if elapsed > 0
+            else float("nan"),
+        }
+        self.records.append(rec)
+        log.info(
+            "step=%d live=%d steps/s=%.2f cells/s=%.3e",
+            step,
+            live,
+            rec["steps_per_sec"],
+            rec["cell_updates_per_sec"],
+        )
+
+
+def dump_board(board: np.ndarray, max_size: int = 64) -> str:
+    """Small-board ASCII dump — the reference's commented-out debug print
+    (Parallel_Life_MPI.cpp:223-229), resurrected behind --verbose."""
+    h, w = board.shape
+    if h > max_size or w > max_size:
+        return f"<board {h}x{w} too large to dump>"
+    return "\n".join("".join(str(int(c)) for c in row) for row in board)

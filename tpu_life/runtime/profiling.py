@@ -1,0 +1,22 @@
+"""Profiling hooks (SURVEY.md §5 "Tracing / profiling").
+
+``--profile DIR`` wraps the run in a ``jax.profiler`` trace viewable in
+XProf/Perfetto — the per-phase breakdown the reference's single
+``MPI_Wtime`` bracket (Parallel_Life_MPI.cpp:199,233) can't give.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager, nullcontext
+
+
+@contextmanager
+def _trace(trace_dir: str):
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def maybe_profile(trace_dir: str | None):
+    return _trace(trace_dir) if trace_dir else nullcontext()

@@ -1,0 +1,4 @@
+from tpu_life.utils.padding import ceil_to, pad_board
+from tpu_life.utils.timing import Timer
+
+__all__ = ["ceil_to", "pad_board", "Timer"]

@@ -1,0 +1,27 @@
+"""Physical-layout padding helpers.
+
+TPU vector lanes are 128 wide and shard_map needs the sharded axis evenly
+divisible by the mesh; we pad the physical array and pin padding cells dead
+via ``tpu_life.ops.stencil.validity_mask`` instead of fighting XLA with
+ragged shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LANE = 128
+
+
+def ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m if m > 1 else x
+
+
+def pad_board(board: np.ndarray, h_pad: int, w_pad: int) -> np.ndarray:
+    """Zero-pad ``board`` to physical shape ``(h_pad, w_pad)``."""
+    h, w = board.shape
+    if (h, w) == (h_pad, w_pad):
+        return np.ascontiguousarray(board, dtype=np.int8)
+    out = np.zeros((h_pad, w_pad), dtype=np.int8)
+    out[:h, :w] = board
+    return out

@@ -1,0 +1,27 @@
+"""Wall-clock timing.
+
+The reference brackets the whole run with ``MPI_Wtime``
+(Parallel_Life_MPI.cpp:199,233).  ``Timer`` does the same with
+``perf_counter``; accelerated backends call ``block_until_ready`` before
+reading it so async dispatch can't fake a fast run.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __init__(self):
+        self.start = time.perf_counter()
+        self.laps: list[float] = []
+
+    def lap(self) -> float:
+        now = time.perf_counter()
+        prev = self.start + sum(self.laps)
+        self.laps.append(now - prev)
+        return self.laps[-1]
+
+    @property
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.start
