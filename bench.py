@@ -1,0 +1,83 @@
+"""Headline benchmark: cell-updates/sec/chip, Conway B3/S23, 16384^2.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is value / 1e11 — the north-star per-chip target from
+BASELINE.json (the reference publishes no numbers of its own; SURVEY.md §6).
+
+Flags: --size N --steps N --rule R --backend B --block-steps K (all optional).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+TARGET = 1e11  # cell-updates/sec/chip north-star (BASELINE.json)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=16384)
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--warmup-steps", type=int, default=20)
+    p.add_argument("--rule", default="conway")
+    p.add_argument("--backend", default="jax", choices=["jax", "sharded", "pallas"])
+    p.add_argument("--block-steps", type=int, default=1)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--no-bitpack", action="store_true")
+    args = p.parse_args()
+
+    from tpu_life.utils.platform import ensure_platform
+
+    ensure_platform(args.platform)
+
+    import jax
+
+    from tpu_life.backends.base import get_backend
+    from tpu_life.models.rules import get_rule
+
+    rule = get_rule(args.rule)
+    n = args.size
+    rng = np.random.default_rng(0)
+    if rule.states == 2:
+        board = rng.integers(0, 2, size=(n, n), dtype=np.int8)
+    else:
+        board = (
+            rng.integers(0, rule.states, size=(n, n), dtype=np.int8)
+            * rng.integers(0, 2, size=(n, n), dtype=np.int8)
+        )
+
+    backend = get_backend(
+        args.backend, block_steps=args.block_steps, bitpack=not args.no_bitpack
+    )
+
+    # warmup: compile + first dispatch
+    backend.run(board, rule, args.warmup_steps)
+
+    best = 0.0
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        backend.run(board, rule, args.steps)
+        dt = time.perf_counter() - t0
+        best = max(best, args.steps * n * n / dt)
+
+    n_chips = 1 if args.backend in ("jax", "pallas") else len(jax.devices())
+    per_chip = best / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "cell_updates_per_sec_per_chip",
+                "value": per_chip,
+                "unit": "cells/s/chip",
+                "vs_baseline": per_chip / TARGET,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

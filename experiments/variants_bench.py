@@ -1,0 +1,173 @@
+"""Micro-bench of neighbor-count/step formulations on the real chip.
+
+Not part of the package: measurement scaffolding for picking the fastest
+TPU formulation of the Conway step (results feed tpu_life/ops design).
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from functools import partial
+
+N = 8192
+STEPS = 50
+rng = np.random.default_rng(0)
+board0 = rng.integers(0, 2, size=(N, N), dtype=np.int8)
+
+
+def timeit(name, fn, x_host):
+    fn_j = jax.jit(fn, static_argnames="steps", donate_argnums=0)
+    y = fn_j(jax.device_put(x_host), steps=2)  # compile
+    y.block_until_ready()
+    t0 = time.perf_counter()
+    y = fn_j(jax.device_put(x_host), steps=STEPS)
+    y.block_until_ready()
+    dt = time.perf_counter() - t0
+    rate = STEPS * N * N / dt
+    print(f"{name:28s} {dt/STEPS*1e3:8.2f} ms/step  {rate:.3e} cells/s")
+    return y
+
+
+# --- variant 1: current int8->int32 shift-add ---------------------------------
+def rule_i32(board, counts):
+    born = counts == 3
+    surv = (counts == 2) | (counts == 3)
+    return jnp.where(board == 1, surv, born).astype(jnp.int8)
+
+
+def v1(board, *, steps):
+    def step(b, _):
+        a = (b == 1).astype(jnp.int32)
+        p = jnp.pad(a, 1)
+        rows = p[0:N, :] + p[1 : N + 1, :] + p[2 : N + 2, :]
+        c = rows[:, 0:N] + rows[:, 1 : N + 1] + rows[:, 2 : N + 2] - a
+        return rule_i32(b, c), None
+
+    out, _ = lax.scan(step, board, None, length=steps)
+    return out
+
+
+# --- variant 2: all-bf16 shift-add --------------------------------------------
+def v2(board, *, steps):
+    def step(b, _):
+        p = jnp.pad(b, 1)
+        rows = p[0:N, :] + p[1 : N + 1, :] + p[2 : N + 2, :]
+        c = rows[:, 0:N] + rows[:, 1 : N + 1] + rows[:, 2 : N + 2] - b
+        born = c == 3.0
+        surv = (c == 2.0) | (c == 3.0)
+        return jnp.where(b == 1.0, surv, born).astype(jnp.bfloat16), None
+
+    out, _ = lax.scan(step, board, None, length=steps)
+    return out
+
+
+# --- variant 3: bf16 conv (3x3 ones) ------------------------------------------
+KERN = jnp.ones((1, 1, 3, 3), jnp.bfloat16)
+
+
+def v3(board, *, steps):
+    def step(b, _):
+        x = b[None, None]
+        c = lax.conv_general_dilated(
+            x, KERN, (1, 1), ((1, 1), (1, 1)),
+            preferred_element_type=jnp.float32,
+        )[0, 0] - b.astype(jnp.float32)
+        born = c == 3.0
+        surv = (c == 2.0) | (c == 3.0)
+        return jnp.where(b == 1.0, surv, born).astype(jnp.bfloat16), None
+
+    out, _ = lax.scan(step, board, None, length=steps)
+    return out
+
+
+# --- variant 4: reduce_window int32 -------------------------------------------
+def v4(board, *, steps):
+    def step(b, _):
+        a = b.astype(jnp.int32)
+        c = lax.reduce_window(a, 0, lax.add, (3, 3), (1, 1), "SAME") - a
+        return rule_i32(b, c), None
+
+    out, _ = lax.scan(step, board, None, length=steps)
+    return out
+
+
+# --- variant 5: matmul shifts (Ising-paper style), bf16 on MXU ----------------
+# column-neighbor sum: X @ T_w where T_w tridiagonal(1,1,1) minus... we want
+# sum of left+center+right: X @ T where T[i,j]=1 if |i-j|<=1.
+# row sum: T_h @ X.  counts = T_h @ X @ T_w - X.
+def make_tri(n, dtype):
+    i = np.arange(n)
+    t = (np.abs(i[:, None] - i[None, :]) <= 1).astype(np.float32)
+    return jnp.asarray(t, dtype)
+
+
+T = make_tri(N, jnp.bfloat16)
+
+
+def v5(board, *, steps):
+    def step(b, _):
+        c = (T @ b @ T) - b  # bf16 matmuls, exact for small ints
+        born = c == 3.0
+        surv = (c == 2.0) | (c == 3.0)
+        return jnp.where(b == 1.0, surv, born).astype(jnp.bfloat16), None
+
+    out, _ = lax.scan(step, board, None, length=steps)
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+
+    which = sys.argv[1:] or ["1", "2", "3", "4"]
+    outs = {}
+    if "1" in which:
+        outs["1"] = np.asarray(timeit("int8/int32 shift-add", v1, board0))
+    if "2" in which:
+        b16 = board0.astype(np.float32)
+        outs["2"] = np.asarray(
+            timeit("bf16 shift-add", v2, np.asarray(jnp.asarray(b16, jnp.bfloat16)))
+        ).astype(np.int8)
+    if "3" in which:
+        b16 = board0.astype(np.float32)
+        outs["3"] = np.asarray(
+            timeit("bf16 conv3x3", v3, np.asarray(jnp.asarray(b16, jnp.bfloat16)))
+        ).astype(np.int8)
+    if "4" in which:
+        outs["4"] = np.asarray(timeit("reduce_window i32", v4, board0))
+    if "5" in which:
+        b16 = board0.astype(np.float32)
+        outs["5"] = np.asarray(
+            timeit("matmul-shift bf16 (MXU)", v5, np.asarray(jnp.asarray(b16, jnp.bfloat16)))
+        ).astype(np.int8)
+    ref = None
+    for k, v in outs.items():
+        if ref is None:
+            ref = v
+        else:
+            same = np.array_equal(ref.astype(np.int8), v.astype(np.int8))
+            print(f"variant {k} matches variant {list(outs)[0]}: {same}")
+
+
+# --- variant 6: bit-sliced uint32 bitboard ------------------------------------
+def v6(packed, *, steps):
+    from tpu_life.ops import bitlife
+    from tpu_life.models.rules import get_rule
+
+    step = bitlife.make_packed_step(get_rule("conway"))
+
+    def body(x, _):
+        return step(x), None
+
+    out, _ = lax.scan(body, packed, None, length=steps)
+    return out
+
+
+def run_v6():
+    from tpu_life.ops import bitlife
+
+    packed_host = np.asarray(bitlife.pack(jnp.asarray(board0)))
+    y = timeit("bit-sliced uint32", v6, packed_host)
+    return np.asarray(bitlife.unpack(y, N))

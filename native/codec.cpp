@@ -1,0 +1,198 @@
+// tpu-life native I/O runtime: board codec + threaded stripe file I/O.
+//
+// The reference's native layer is its C++ parser and MPI-IO calls
+// (Parallel_Life_MPI.cpp:56-102 read/parse, :147-188 write).  This library
+// is the TPU framework's equivalent: a validating ASCII<->int8 board codec
+// and pread/pwrite stripe I/O at the same byte offsets the reference uses
+// (row stride = width + 1), parallelized with POSIX threads instead of MPI
+// ranks.  Exposed to Python via ctypes (tpu_life/io/native.py); NumPy
+// remains the portable fallback.
+//
+// Error codes: 0 ok; -1 io error; -2 bad geometry/length; -3 bad byte.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+constexpr unsigned char kZero = '0';
+constexpr unsigned char kNewline = '\n';
+
+struct DecodeTask {
+  const unsigned char* buf;
+  int8_t* out;
+  long w;
+  long row_begin;
+  long row_end;
+  int rc;
+};
+
+void* decode_rows(void* arg) {
+  auto* t = static_cast<DecodeTask*>(arg);
+  const long stride = t->w + 1;
+  for (long r = t->row_begin; r < t->row_end; ++r) {
+    const unsigned char* src = t->buf + r * stride;
+    int8_t* dst = t->out + r * t->w;
+    if (src[t->w] != kNewline) {
+      t->rc = -2;
+      return nullptr;
+    }
+    for (long c = 0; c < t->w; ++c) {
+      unsigned char b = src[c];
+      if (b < kZero || b > kZero + 9) {
+        t->rc = -3;
+        return nullptr;
+      }
+      dst[c] = static_cast<int8_t>(b - kZero);
+    }
+  }
+  t->rc = 0;
+  return nullptr;
+}
+
+struct EncodeTask {
+  const int8_t* in;
+  unsigned char* out;
+  long w;
+  long row_begin;
+  long row_end;
+};
+
+void* encode_rows(void* arg) {
+  auto* t = static_cast<EncodeTask*>(arg);
+  const long stride = t->w + 1;
+  for (long r = t->row_begin; r < t->row_end; ++r) {
+    const int8_t* src = t->in + r * t->w;
+    unsigned char* dst = t->out + r * stride;
+    for (long c = 0; c < t->w; ++c) dst[c] = static_cast<unsigned char>(src[c] + kZero);
+    dst[t->w] = kNewline;
+  }
+  return nullptr;
+}
+
+int run_threaded(long rows, int nthreads,
+                 void* (*fn)(void*), void* tasks, size_t task_size,
+                 long* begins, long* ends) {
+  std::vector<pthread_t> tids(nthreads);
+  for (int i = 0; i < nthreads; ++i) {
+    pthread_create(&tids[i], nullptr, fn,
+                   static_cast<char*>(tasks) + i * task_size);
+  }
+  for (int i = 0; i < nthreads; ++i) pthread_join(tids[i], nullptr);
+  return 0;
+}
+
+int clamp_threads(long rows, int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  long max_useful = std::max(1L, rows / 64);
+  return static_cast<int>(std::min<long>(nthreads, max_useful));
+}
+
+// read exactly n bytes at offset (loops over short reads)
+int pread_all(int fd, unsigned char* buf, long n, long off) {
+  long done = 0;
+  while (done < n) {
+    ssize_t got = pread(fd, buf + done, n - done, off + done);
+    if (got <= 0) return -1;
+    done += got;
+  }
+  return 0;
+}
+
+int pwrite_all(int fd, const unsigned char* buf, long n, long off) {
+  long done = 0;
+  while (done < n) {
+    ssize_t put = pwrite(fd, buf + done, n - done, off + done);
+    if (put <= 0) return -1;
+    done += put;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tl_decode(const unsigned char* buf, long nbytes, long h, long w,
+              int8_t* out, int nthreads) {
+  if (h <= 0 || w <= 0 || nbytes != h * (w + 1)) return -2;
+  nthreads = clamp_threads(h, nthreads);
+  std::vector<DecodeTask> tasks(nthreads);
+  long per = (h + nthreads - 1) / nthreads;
+  for (int i = 0; i < nthreads; ++i) {
+    tasks[i] = {buf, out, w, std::min<long>(i * per, h),
+                std::min<long>((i + 1) * per, h), 0};
+  }
+  run_threaded(h, nthreads, decode_rows, tasks.data(), sizeof(DecodeTask),
+               nullptr, nullptr);
+  for (auto& t : tasks)
+    if (t.rc != 0) return t.rc;
+  return 0;
+}
+
+int tl_encode(const int8_t* in, long h, long w, unsigned char* out,
+              int nthreads) {
+  if (h <= 0 || w <= 0) return -2;
+  nthreads = clamp_threads(h, nthreads);
+  std::vector<EncodeTask> tasks(nthreads);
+  long per = (h + nthreads - 1) / nthreads;
+  for (int i = 0; i < nthreads; ++i) {
+    tasks[i] = {in, out, w, std::min<long>(i * per, h),
+                std::min<long>((i + 1) * per, h)};
+  }
+  run_threaded(h, nthreads, encode_rows, tasks.data(), sizeof(EncodeTask),
+               nullptr, nullptr);
+  return 0;
+}
+
+// Read rows [row_start, row_start+nrows) of a board file into int8 cells.
+// The direct analogue of MPI_File_read_at (Parallel_Life_MPI.cpp:85).
+int tl_read_stripe(const char* path, long row_start, long nrows, long w,
+                   int8_t* out, int nthreads) {
+  if (nrows <= 0 || w <= 0 || row_start < 0) return -2;
+  const long stride = w + 1;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  std::vector<unsigned char> buf(static_cast<size_t>(nrows) * stride);
+  int rc = pread_all(fd, buf.data(), nrows * stride, row_start * stride);
+  close(fd);
+  if (rc != 0) return -1;
+  return tl_decode(buf.data(), nrows * stride, nrows, w, out, nthreads);
+}
+
+// Write a stripe at its byte offset, pre-sizing the file to total_rows —
+// the analogue of MPI_File_write_at_all (Parallel_Life_MPI.cpp:175).
+int tl_write_stripe(const char* path, long row_start, long nrows, long w,
+                    long total_rows, const int8_t* in, int nthreads) {
+  if (nrows <= 0 || w <= 0 || row_start < 0 || total_rows < row_start + nrows)
+    return -2;
+  const long stride = w + 1;
+  std::vector<unsigned char> buf(static_cast<size_t>(nrows) * stride);
+  tl_encode(in, nrows, w, buf.data(), nthreads);
+  int fd = open(path, O_WRONLY | O_CREAT, 0644);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (st.st_size != total_rows * stride &&
+      ftruncate(fd, total_rows * stride) != 0) {
+    close(fd);
+    return -1;
+  }
+  int rc = pwrite_all(fd, buf.data(), nrows * stride, row_start * stride);
+  close(fd);
+  return rc;
+}
+
+}  // extern "C"

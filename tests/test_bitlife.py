@@ -1,0 +1,79 @@
+"""Bit-sliced Life path vs NumPy truth."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_life.models.rules import get_rule, parse_rule
+from tpu_life.ops import bitlife
+from tpu_life.ops.reference import run_np, step_np
+
+LIFELIKE = ["conway", "highlife", "daynight", "seeds", "life_without_death", "anneal"]
+
+
+def test_pack_unpack_roundtrip(rng_board):
+    for w in (32, 64, 37, 100, 1):  # exact, multiple, ragged, tiny
+        b = rng_board(13, w, seed=w)
+        packed = bitlife.pack(jnp.asarray(b))
+        assert packed.shape == (13, bitlife.packed_width(w))
+        out = np.asarray(bitlife.unpack(packed, w))
+        np.testing.assert_array_equal(out, b)
+
+
+def test_supports():
+    assert bitlife.supports(get_rule("conway"))
+    assert not bitlife.supports(get_rule("brians_brain"))  # states > 2
+    assert not bitlife.supports(parse_rule("R2,C2,S8..12,B7..8"))  # radius > 1
+    with pytest.raises(ValueError):
+        bitlife.make_packed_step(get_rule("brians_brain"))
+
+
+@pytest.mark.parametrize("rule_name", LIFELIKE)
+def test_packed_step_matches_numpy(rule_name, rng_board):
+    rule = get_rule(rule_name)
+    b = rng_board(48, 96, seed=42)
+    step = bitlife.make_packed_step(rule)
+    got = np.asarray(bitlife.unpack(step(bitlife.pack(jnp.asarray(b))), 96))
+    np.testing.assert_array_equal(got, step_np(b, rule))
+
+
+def test_packed_step_ragged_width(rng_board):
+    # width not a multiple of 32: the pad bits start dead; a single masked
+    # step must keep them dead and match the logical board exactly
+    rule = get_rule("conway")
+    b = rng_board(30, 45, seed=43)
+    masked = bitlife.make_masked_packed_step(rule, (30, 45))
+    got_packed = masked(bitlife.pack(jnp.asarray(b)))
+    np.testing.assert_array_equal(
+        np.asarray(bitlife.unpack(got_packed, 45)), step_np(b, rule)
+    )
+    # pad bits beyond column 45 stay zero
+    wp = bitlife.packed_width(45)
+    pad_bits = np.asarray(bitlife.unpack(got_packed, wp * 32))[:, 45:]
+    assert (pad_bits == 0).all()
+
+
+def test_masked_multi_step_iterated(rng_board):
+    rule = get_rule("highlife")
+    b = rng_board(40, 70, seed=44)
+    masked = bitlife.make_masked_packed_step(rule, (40, 70))
+    x = bitlife.pack(jnp.asarray(b))
+    for _ in range(6):
+        x = masked(x)
+    np.testing.assert_array_equal(
+        np.asarray(bitlife.unpack(x, 70)), run_np(b, rule, 6)
+    )
+
+
+def test_masked_row_offset(rng_board):
+    # physical rows 4..9 of a 10-row logical board, offset addressing
+    rule = get_rule("conway")
+    b = rng_board(12, 40, seed=45)
+    masked = bitlife.make_masked_packed_step(rule, (10, 40))
+    # physical board is 12 rows with offset -1: rows -1 and 10, 11 are out
+    x = bitlife.pack(jnp.asarray(np.vstack([np.zeros((1, 40), np.int8), b[:10], np.zeros((1, 40), np.int8)])))
+    got = np.asarray(bitlife.unpack(masked(x, row_offset=-1), 40))
+    expect = step_np(b[:10], rule)
+    np.testing.assert_array_equal(got[1:11], expect)
+    assert (got[0] == 0).all() and (got[11] == 0).all()

@@ -73,3 +73,23 @@ def test_metrics_recorded(tmp_path):
     )
     assert [m["step"] for m in res.metrics] == [2, 4, 6]
     assert all(m["live_cells"] >= 0 for m in res.metrics)
+
+
+def test_driver_rejects_out_of_range_states(tmp_path):
+    # a '2' cell under a 2-state rule must be a clean error, not silent
+    # divergence between backends (bitpack would mask it, numpy would crash)
+    board = np.zeros((8, 8), np.int8)
+    board[3, 3] = 2
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "cfg.txt", 8, 8, 3)
+    import pytest
+
+    with pytest.raises(ValueError, match="state 2.*only 2 states"):
+        run(
+            RunConfig(
+                config_file=str(tmp_path / "cfg.txt"),
+                input_file=str(tmp_path / "data.txt"),
+                output_file=str(tmp_path / "out.txt"),
+                backend="numpy",
+            )
+        )

@@ -20,30 +20,33 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+@pytest.mark.parametrize("bitpack", [True, False])
 @pytest.mark.parametrize("num_devices", [1, 2, 8])
-def test_invariant_under_device_count(num_devices, rng_board):
+def test_invariant_under_device_count(num_devices, bitpack, rng_board):
     rule = get_rule("conway")
     b = rng_board(64, 48, seed=11)
     expect = run_np(b, rule, 10)
-    be = ShardedBackend(num_devices=num_devices)
+    be = ShardedBackend(num_devices=num_devices, bitpack=bitpack)
     np.testing.assert_array_equal(be.run(b, rule, 10), expect)
 
 
+@pytest.mark.parametrize("bitpack", [True, False])
 @pytest.mark.parametrize("block_steps", [1, 2, 5])
-def test_deep_halo_blocking(block_steps, rng_board):
+def test_deep_halo_blocking(block_steps, bitpack, rng_board):
     rule = get_rule("conway")
     b = rng_board(80, 40, seed=12)
     expect = run_np(b, rule, 11)  # 11 = 2*5+1 exercises the remainder path
-    be = ShardedBackend(num_devices=8, block_steps=block_steps)
+    be = ShardedBackend(num_devices=8, block_steps=block_steps, bitpack=bitpack)
     np.testing.assert_array_equal(be.run(b, rule, 11), expect)
 
 
-def test_uneven_height(rng_board):
+@pytest.mark.parametrize("bitpack", [True, False])
+def test_uneven_height(bitpack, rng_board):
     # height not divisible by devices -> physical padding rows must stay dead
     rule = get_rule("conway")
     b = rng_board(59, 37, seed=13)
     expect = run_np(b, rule, 8)
-    be = ShardedBackend(num_devices=8)
+    be = ShardedBackend(num_devices=8, bitpack=bitpack)
     np.testing.assert_array_equal(be.run(b, rule, 8), expect)
 
 
@@ -63,11 +66,12 @@ def test_generations_rule_sharded(rng_board):
     np.testing.assert_array_equal(be.run(b, rule, 9), expect)
 
 
-def test_gspmd_mode_matches(rng_board):
+@pytest.mark.parametrize("bitpack", [True, False])
+def test_gspmd_mode_matches(bitpack, rng_board):
     rule = get_rule("conway")
     b = rng_board(64, 33, seed=16)
     expect = run_np(b, rule, 7)
-    be = ShardedBackend(num_devices=8, partition_mode="gspmd")
+    be = ShardedBackend(num_devices=8, partition_mode="gspmd", bitpack=bitpack)
     np.testing.assert_array_equal(be.run(b, rule, 7), expect)
 
 

@@ -1,0 +1,57 @@
+"""Stripe-decomposition (MPI-lineage) backend vs truth.
+
+The decomposition-invariance property the reference intends but breaks
+(Parallel_Life_MPI.cpp:111,127): results must not depend on rank count.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.backends.base import get_backend
+from tpu_life.backends.stripes_backend import StripesBackend
+from tpu_life.models.rules import get_rule, parse_rule
+from tpu_life.ops.reference import run_np
+
+
+@pytest.mark.parametrize("ranks", [1, 2, 3, 7])
+def test_rank_count_invariance(ranks, rng_board):
+    rule = get_rule("conway")
+    b = rng_board(50, 36, seed=51)
+    expect = run_np(b, rule, 9)
+    be = StripesBackend(num_devices=ranks)
+    np.testing.assert_array_equal(be.run(b, rule, 9), expect)
+
+
+def test_radius2_rule(rng_board):
+    rule = parse_rule("R2,C2,S8..12,B7..8")
+    b = rng_board(40, 30, seed=52)
+    expect = run_np(b, rule, 5)
+    be = StripesBackend(num_devices=5)
+    np.testing.assert_array_equal(be.run(b, rule, 5), expect)
+
+
+def test_generations_rule(rng_board):
+    rule = get_rule("brians_brain")
+    b = rng_board(30, 30, states=3, seed=53)
+    expect = run_np(b, rule, 6)
+    be = StripesBackend(num_devices=3)
+    np.testing.assert_array_equal(be.run(b, rule, 6), expect)
+
+
+def test_more_ranks_than_sensible_is_clamped(rng_board):
+    # 100 requested ranks on a 12-row board: backend clamps rank count
+    rule = get_rule("conway")
+    b = rng_board(12, 20, seed=54)
+    be = StripesBackend(num_devices=100)
+    np.testing.assert_array_equal(be.run(b, rule, 4), run_np(b, rule, 4))
+
+
+def test_mpi_backend_errors_helpfully_without_mpi4py():
+    try:
+        import mpi4py  # noqa: F401
+
+        pytest.skip("mpi4py installed; error path not reachable")
+    except ImportError:
+        pass
+    with pytest.raises(ValueError, match="unavailable.*mpi4py|mpi4py"):
+        get_backend("mpi")

@@ -73,7 +73,10 @@ def get_backend(name: str, **kwargs) -> Backend:
             raise ValueError(f"backend {name!r} is unavailable: {e}") from e
     if name not in BACKENDS:
         raise ValueError(f"unknown backend {name!r}; available: {sorted(BACKENDS)}")
-    return BACKENDS[name](**kwargs)
+    try:
+        return BACKENDS[name](**kwargs)
+    except ImportError as e:
+        raise ValueError(f"backend {name!r} is unavailable: {e}") from e
 
 
 def chunk_sizes(steps: int, chunk_steps: int) -> list[int]:

@@ -1,8 +1,10 @@
 """Row-sharded multi-device backend — the framework's distributed core.
 
-The board lives as one global ``int8`` array stripe-sharded over a 1-D mesh
+The board lives as one global array stripe-sharded over a 1-D mesh
 (``NamedSharding(P('rows', None))``); halos move over ICI via ``ppermute``
-(``tpu_life.parallel.halo``).  Two partitioning modes:
+(``tpu_life.parallel.halo``).  Life-like rules run bit-sliced (uint32
+bitboard, 32 cells/lane — ``tpu_life.ops.bitlife``), which also shrinks
+each halo exchange 32x.  Two partitioning modes:
 
 - ``shard_map``: explicit SPMD — hand-written halo exchange with deep-halo
   blocking (``block_steps``), the analogue of the reference's explicit
@@ -24,14 +26,14 @@ from functools import partial
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from tpu_life.backends.base import ChunkCallback, chunk_sizes, register_backend
 from tpu_life.models.rules import Rule
+from tpu_life.ops import bitlife
 from tpu_life.ops.stencil import make_masked_step
 from tpu_life.parallel.halo import make_sharded_run
 from tpu_life.parallel.mesh import ROW_AXIS, board_sharding, make_mesh
-from tpu_life.utils.padding import LANE, ceil_to, pad_board
+from tpu_life.utils.padding import LANE, ceil_to
 
 
 @register_backend("sharded")
@@ -45,6 +47,7 @@ class ShardedBackend:
         block_steps: int = 1,
         partition_mode: str = "shard_map",
         pad_lanes: bool = True,
+        bitpack: bool = True,
         mesh=None,
         **_,
     ):
@@ -55,10 +58,16 @@ class ShardedBackend:
             raise ValueError(f"unknown partition_mode {partition_mode!r}")
         self.partition_mode = partition_mode
         self.pad_lanes = pad_lanes
+        self.bitpack = bitpack
 
-    def _device_put_sharded(self, board: np.ndarray, h_pad: int, w_pad: int):
+    def _device_put_sharded(self, host: np.ndarray, h_pad: int, w_pad: int):
+        """Shard a host array onto the mesh, zero-padding to (h_pad, w_pad).
+
+        Each device's block is materialized independently — on a multi-host
+        job every process only builds its addressable shards.
+        """
         sharding = board_sharding(self.mesh)
-        h, w = board.shape
+        h, w = host.shape
 
         def cb(index):
             rows, cols = index
@@ -66,9 +75,9 @@ class ShardedBackend:
             r1 = rows.stop if rows.stop is not None else h_pad
             c0 = cols.start or 0
             c1 = cols.stop if cols.stop is not None else w_pad
-            block = np.zeros((r1 - r0, c1 - c0), dtype=np.int8)
+            block = np.zeros((r1 - r0, c1 - c0), dtype=host.dtype)
             if r0 < h and c0 < w:
-                src = board[r0 : min(r1, h), c0 : min(c1, w)]
+                src = host[r0 : min(r1, h), c0 : min(c1, w)]
                 block[: src.shape[0], : src.shape[1]] = src
             return block
 
@@ -84,48 +93,62 @@ class ShardedBackend:
         callback: ChunkCallback | None = None,
     ) -> np.ndarray:
         h, w = board.shape
+        logical = (h, w)
+        use_bits = self.bitpack and bitlife.supports(rule)
+
         # shard height must divide evenly; keep sublane (8) alignment per shard
         h_pad = ceil_to(h, self.n * 8)
-        w_pad = ceil_to(w, LANE) if self.pad_lanes else w
-        block_steps = self.block_steps
         shard_h = h_pad // self.n
-        # deep halos cannot exceed the shard height
-        block_steps = max(1, min(block_steps, shard_h // rule.radius))
-        x = self._device_put_sharded(board, h_pad, w_pad)
+        block_steps = max(1, min(self.block_steps, shard_h // rule.radius))
 
-        if self.partition_mode == "gspmd":
-            run_chunk = self._gspmd_run(rule, (h, w))
+        if use_bits:
+            host = bitlife.pack_np(np.asarray(board, np.int8))
+            w_phys = host.shape[1]
+            to_np = lambda x: bitlife.unpack_np(np.asarray(x)[:h], w)
         else:
-            run_chunk = None
+            host = np.asarray(board, np.int8)
+            w_phys = ceil_to(w, LANE) if self.pad_lanes else w
+            to_np = lambda x: np.asarray(x)[:h, :w]
+        x = self._device_put_sharded(host, h_pad, w_phys)
+
+        runs: dict[int, object] = {}
+
+        def get_run(bs: int):
+            if bs not in runs:
+                runs[bs] = make_sharded_run(
+                    rule, self.mesh, logical, block_steps=bs, packed=use_bits
+                )
+            return runs[bs]
+
+        gspmd_run = (
+            self._gspmd_run(rule, logical, use_bits)
+            if self.partition_mode == "gspmd"
+            else None
+        )
 
         done = 0
-        runs: dict[int, object] = {}
         for n_steps in chunk_sizes(steps, chunk_steps):
-            if self.partition_mode == "gspmd":
-                x = run_chunk(x, steps=n_steps)
+            if gspmd_run is not None:
+                x = gspmd_run(x, steps=n_steps)
             else:
                 num_blocks, rem = divmod(n_steps, block_steps)
                 if num_blocks:
-                    if block_steps not in runs:
-                        runs[block_steps] = make_sharded_run(
-                            rule, self.mesh, (h, w), block_steps=block_steps
-                        )
-                    x = runs[block_steps](x, num_blocks)
+                    x = get_run(block_steps)(x, num_blocks)
                 if rem:
-                    if rem not in runs:
-                        runs[rem] = make_sharded_run(
-                            rule, self.mesh, (h, w), block_steps=rem
-                        )
-                    x = runs[rem](x, 1)
+                    x = get_run(rem)(x, 1)
             done += n_steps
             if callback is not None:
-                callback(done, lambda x=x: np.asarray(x)[:h, :w])
+                callback(done, lambda x=x: to_np(x))
         x.block_until_ready()
-        return np.asarray(x)[:h, :w]
+        return to_np(x)
 
-    def _gspmd_run(self, rule: Rule, logical_shape):
+    def _gspmd_run(self, rule: Rule, logical_shape, use_bits: bool):
         sharding = board_sharding(self.mesh)
-        masked = make_masked_step(rule, logical_shape)
+        masked = (
+            bitlife.make_masked_packed_step(rule, logical_shape)
+            if use_bits
+            else make_masked_step(rule, logical_shape)
+        )
 
         @partial(
             jax.jit,

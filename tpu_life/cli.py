@@ -56,15 +56,25 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument(
         "--backend",
         default="auto",
-        choices=["auto", "numpy", "jax", "sharded", "stripes", "pallas"],
+        choices=["auto", "numpy", "jax", "sharded", "stripes", "mpi", "pallas"],
     )
     r.add_argument("--num-devices", type=int, default=None)
+    r.add_argument(
+        "--platform",
+        default=None,
+        help="force a JAX platform (cpu/tpu); also via TPU_LIFE_PLATFORM env",
+    )
     r.add_argument("--block-steps", type=int, default=1)
     r.add_argument(
         "--partition-mode", default="shard_map", choices=["shard_map", "gspmd"]
     )
     r.add_argument("--sync-every", type=int, default=0)
     r.add_argument("--no-pad-lanes", action="store_true")
+    r.add_argument(
+        "--no-bitpack",
+        action="store_true",
+        help="disable the bit-sliced fast path for life-like rules",
+    )
     r.add_argument("--snapshot-every", type=int, default=0)
     r.add_argument("--snapshot-dir", default="snapshots")
     r.add_argument("--resume", default=None)
@@ -85,6 +95,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "gen":
         return _gen(args)
 
+    from tpu_life.utils.platform import ensure_platform
+
+    ensure_platform(getattr(args, "platform", None))
     cfg = RunConfig(
         height=args.height,
         width=args.width,
@@ -100,6 +113,7 @@ def main(argv: list[str] | None = None) -> int:
         partition_mode=args.partition_mode,
         sync_every=args.sync_every,
         pad_lanes=not args.no_pad_lanes,
+        bitpack=not args.no_bitpack,
         snapshot_every=args.snapshot_every,
         snapshot_dir=args.snapshot_dir,
         resume=args.resume,

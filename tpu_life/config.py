@@ -38,6 +38,7 @@ class RunConfig:
     partition_mode: str = "shard_map"  # shard_map | gspmd
     sync_every: int = 0  # steps per host sync chunk; 0 = one fused run
     pad_lanes: bool = True  # pad width to the 128-lane TPU tile
+    bitpack: bool = True  # bit-sliced fast path for life-like rules
 
     # aux subsystems
     snapshot_every: int = 0

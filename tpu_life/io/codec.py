@@ -31,11 +31,25 @@ def row_stride(width: int) -> int:
     return width + 1
 
 
+_NATIVE_THRESHOLD = 1 << 20  # cells; below this NumPy wins on call overhead
+
+
+def _native():
+    from tpu_life.io import native
+
+    return native if native.available() else None
+
+
 def decode_board(buf: bytes | bytearray | memoryview, height: int, width: int) -> np.ndarray:
     """Parse board bytes into an ``int8`` array of shape ``(height, width)``.
 
-    Validates the newline grid structure and cell alphabet.
+    Validates the newline grid structure and cell alphabet.  Dispatches to
+    the threaded C++ codec (native/codec.cpp) for large boards when built.
     """
+    if height * width >= _NATIVE_THRESHOLD:
+        nat = _native()
+        if nat is not None and len(buf) == height * row_stride(width):
+            return nat.decode_board(bytes(buf), height, width)
     stride = row_stride(width)
     expected = height * stride
     if len(buf) != expected:
@@ -59,6 +73,10 @@ def encode_board(board: np.ndarray) -> bytes:
     if board.ndim != 2:
         raise ValueError(f"board must be 2-D, got shape {board.shape}")
     h, w = board.shape
+    if h * w >= _NATIVE_THRESHOLD:
+        nat = _native()
+        if nat is not None:
+            return nat.encode_board(board)
     out = np.empty((h, w + 1), dtype=np.uint8)
     out[:, :w] = board.astype(np.uint8) + ASCII_ZERO
     out[:, w] = NEWLINE

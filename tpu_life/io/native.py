@@ -1,0 +1,137 @@
+"""ctypes binding to the native I/O runtime (native/codec.cpp).
+
+Loads ``libtpulife_io.so`` if present (build with ``make -C native``); all
+entry points fall back to the pure-NumPy codec when the library is missing,
+so the framework never *requires* a compiler.  ``TPU_LIFE_NATIVE=0``
+disables the native path outright.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_NAME = "libtpulife_io.so"
+
+_ERRORS = {
+    -1: "I/O error",
+    -2: "bad geometry or byte length",
+    -3: "byte outside '0'..'9'",
+}
+
+
+def _default_threads() -> int:
+    return min(16, os.cpu_count() or 1)
+
+
+def _load() -> ctypes.CDLL | None:
+    if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
+        return None
+    candidates = [
+        Path(os.environ.get("TPU_LIFE_NATIVE_LIB", "")),
+        _NATIVE_DIR / _LIB_NAME,
+    ]
+    for p in candidates:
+        if p and p.is_file():
+            try:
+                lib = ctypes.CDLL(str(p))
+            except OSError:
+                continue
+            lib.tl_decode.restype = ctypes.c_int
+            lib.tl_encode.restype = ctypes.c_int
+            lib.tl_read_stripe.restype = ctypes.c_int
+            lib.tl_write_stripe.restype = ctypes.c_int
+            return lib
+    return None
+
+
+_lib = _load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library in-tree (requires g++); returns success."""
+    global _lib
+    if _lib is not None and not force:
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR), _LIB_NAME],
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    _lib = _load()
+    return _lib is not None
+
+
+def _check(rc: int, what: str) -> None:
+    if rc != 0:
+        raise ValueError(f"native {what} failed: {_ERRORS.get(rc, rc)}")
+
+
+def decode_board(buf: bytes, height: int, width: int) -> np.ndarray:
+    out = np.empty((height, width), dtype=np.int8)
+    rc = _lib.tl_decode(
+        buf,
+        ctypes.c_long(len(buf)),
+        ctypes.c_long(height),
+        ctypes.c_long(width),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int(_default_threads()),
+    )
+    _check(rc, "decode")
+    return out
+
+
+def encode_board(board: np.ndarray) -> bytes:
+    board = np.ascontiguousarray(board, dtype=np.int8)
+    h, w = board.shape
+    out = ctypes.create_string_buffer(h * (w + 1))
+    rc = _lib.tl_encode(
+        board.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_long(h),
+        ctypes.c_long(w),
+        out,
+        ctypes.c_int(_default_threads()),
+    )
+    _check(rc, "encode")
+    return out.raw
+
+
+def read_stripe(path, row_start: int, num_rows: int, width: int) -> np.ndarray:
+    out = np.empty((num_rows, width), dtype=np.int8)
+    rc = _lib.tl_read_stripe(
+        os.fspath(path).encode(),
+        ctypes.c_long(row_start),
+        ctypes.c_long(num_rows),
+        ctypes.c_long(width),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int(_default_threads()),
+    )
+    _check(rc, "read_stripe")
+    return out
+
+
+def write_stripe(path, row_start: int, stripe: np.ndarray, *, total_rows: int) -> None:
+    stripe = np.ascontiguousarray(stripe, dtype=np.int8)
+    h, w = stripe.shape
+    rc = _lib.tl_write_stripe(
+        os.fspath(path).encode(),
+        ctypes.c_long(row_start),
+        ctypes.c_long(h),
+        ctypes.c_long(w),
+        ctypes.c_long(total_rows),
+        stripe.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int(_default_threads()),
+    )
+    _check(rc, "write_stripe")

@@ -48,6 +48,11 @@ def read_stripe(
     path: str | os.PathLike, row_start: int, num_rows: int, width: int
 ) -> np.ndarray:
     """Read rows ``[row_start, row_start + num_rows)`` of a board file."""
+    from tpu_life.io import codec
+
+    nat = codec._native()
+    if nat is not None and num_rows * width >= codec._NATIVE_THRESHOLD:
+        return nat.read_stripe(path, row_start, num_rows, width)
     stride = row_stride(width)
     with open(path, "rb") as f:
         f.seek(row_start * stride)
@@ -64,8 +69,14 @@ def write_stripe(
     their stripes in any order — the collective-write analogue of
     ``MPI_File_write_at_all`` (Parallel_Life_MPI.cpp:175).
     """
+    from tpu_life.io import codec
+
     stripe = np.asarray(stripe)
     h, w = stripe.shape
+    nat = codec._native()
+    if nat is not None and h * w >= codec._NATIVE_THRESHOLD:
+        nat.write_stripe(path, row_start, stripe, total_rows=total_rows)
+        return
     stride = row_stride(w)
     total = total_rows * stride
     # O_CREAT without truncation so concurrent stripe writers don't clobber

@@ -1,0 +1,218 @@
+"""Bit-sliced Life: 32 cells per uint32 lane, neighbor counts as bitplanes.
+
+The fast path for 2-state radius-1 (life-like) rules — the family the
+reference implements (Parallel_Life_MPI.cpp:37-54).  Where the reference
+spends ~9 branchy reads per cell (`countNeighbours`, :16-35) and the plain
+XLA stencil spends int32 vector adds per cell, this path packs 32 cells into
+each uint32 and computes all eight neighbor contributions with bitwise
+full-adders — ~1.3 VPU bit-ops per cell per step, and 8x less HBM traffic
+(1 bit/cell instead of 1 byte).
+
+Layout: board row of W cells -> ceil(W/32) uint32 words; cell at column
+``c = 32*j + b`` is bit ``b`` (LSB-first) of word ``j``.  Horizontal
+neighbor access is a 1-bit word shift plus a carry bit from the adjacent
+word — the adjacent-word fetch is a lane shift of an array 32x smaller than
+the board, which is what makes this fast on TPU where unaligned lane
+accesses on the full board are the bottleneck.
+
+Counting (classic bit-slicing, cf. the public "Life in bitplanes" trick):
+vertical 3-row sums as (ones, twos) bitplanes via carry-save adders, then a
+horizontal 3-column add of those planes giving total-sum bitplanes
+b0,b1,b2,b3 (total = center + 8 neighbors, range 0..9).  A life-like rule
+membership test then becomes an OR over 4-bit equality masks:
+``alive' = OR_{v in B} [~alive & total==v]  |  OR_{v in S} [alive & total==v+1]``
+(+1 because the total includes the center for alive cells).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_life.models.rules import Rule
+
+WORD = 32
+_U1 = np.uint32(1)
+
+
+def packed_width(width: int) -> int:
+    return -(-width // WORD)
+
+
+def supports(rule: Rule) -> bool:
+    """The bit path covers exactly the reference's rule family."""
+    return rule.states == 2 and rule.radius == 1 and not rule.include_center
+
+
+# --- pack / unpack ------------------------------------------------------------
+
+def pack_np(board: np.ndarray) -> np.ndarray:
+    """Host-side pack: int8[H, W] -> uint32[H, ceil(W/32)] (LSB-first).
+
+    Packs *alive* (== 1) bits; any other state would corrupt word sums, so
+    it is masked here and rejected earlier by the driver's state validation.
+    """
+    h, w = board.shape
+    alive = (board == 1)
+    wp = packed_width(w) * WORD
+    if wp != w:
+        alive = np.pad(alive, ((0, 0), (0, wp - w)))
+    bits = alive.astype(np.uint32).reshape(h, wp // WORD, WORD)
+    weights = (_U1 << np.arange(WORD, dtype=np.uint32)).astype(np.uint32)
+    return (bits * weights).sum(axis=-1, dtype=np.uint32)
+
+
+def unpack_np(packed: np.ndarray, width: int) -> np.ndarray:
+    """Host-side unpack: uint32[H, Wp] -> int8[H, width]."""
+    h, wp = packed.shape
+    shifts = np.arange(WORD, dtype=np.uint32)
+    bits = (packed[:, :, None] >> shifts[None, None, :]) & _U1
+    return bits.reshape(h, wp * WORD)[:, :width].astype(np.int8)
+
+
+def pack(board: jax.Array) -> jax.Array:
+    """int8[H, W] -> uint32[H, ceil(W/32)] bitboard of the alive (==1) bits."""
+    h, w = board.shape
+    board = (board == 1).astype(jnp.uint32)
+    wp = packed_width(w) * WORD
+    if wp != w:
+        board = jnp.pad(board, ((0, 0), (0, wp - w)))
+    bits = board.reshape(h, wp // WORD, WORD)
+    weights = (_U1 << np.arange(WORD, dtype=np.uint32)).astype(np.uint32)
+    return (bits * weights).sum(axis=-1, dtype=jnp.uint32)
+
+
+def unpack(packed: jax.Array, width: int) -> jax.Array:
+    """uint32[H, Wp] bitboard -> int8[H, width]."""
+    h, wp = packed.shape
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    bits = (packed[:, :, None] >> shifts[None, None, :]) & _U1
+    return bits.reshape(h, wp * WORD)[:, :width].astype(jnp.int8)
+
+
+# --- the step -----------------------------------------------------------------
+
+def _hshift_left(x: jax.Array) -> jax.Array:
+    """Plane of left neighbors: L[c] = x[c-1]; clamped zero at column 0."""
+    carry = jnp.pad(x[:, :-1], ((0, 0), (1, 0)))  # word j-1, zeros at j=0
+    return (x << _U1) | (carry >> np.uint32(WORD - 1))
+
+
+def _hshift_right(x: jax.Array) -> jax.Array:
+    """Plane of right neighbors: R[c] = x[c+1]; clamped zero at last column."""
+    carry = jnp.pad(x[:, 1:], ((0, 0), (0, 1)))
+    return (x >> _U1) | (carry << np.uint32(WORD - 1))
+
+
+def _vshift(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(up, down) row-neighbor planes, clamped zero at board edges."""
+    zero = jnp.zeros_like(x[:1])
+    up = jnp.concatenate([x[1:], zero], axis=0)  # U[r] = x[r+1]
+    down = jnp.concatenate([zero, x[:-1]], axis=0)  # D[r] = x[r-1]
+    return up, down
+
+
+def _csa(a, b, c):
+    """Carry-save adder: a+b+c -> (sum bit, carry bit)."""
+    ab = a ^ b
+    return ab ^ c, (a & b) | (ab & c)
+
+
+def _total_planes(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Bitplanes (b0, b1, b2, b3) of total = center + 8 neighbors (0..9)."""
+    up, down = _vshift(x)
+    ones, twos = _csa(up, x, down)  # vertical 3-sum per column, 2-bit
+    o_l, o_r = _hshift_left(ones), _hshift_right(ones)
+    t_l, t_r = _hshift_left(twos), _hshift_right(twos)
+    b0, c1 = _csa(o_l, ones, o_r)  # ones-plane horizontal sum
+    s1, c2 = _csa(t_l, twos, t_r)  # twos-plane horizontal sum (weight 2)
+    b1 = c1 ^ s1  # weight-2 bits
+    u2 = c1 & s1  # carry into weight 4
+    b2 = c2 ^ u2
+    b3 = c2 & u2  # weight 8 (totals 8, 9)
+    return b0, b1, b2, b3
+
+
+def _eq_mask(planes, value: int) -> jax.Array:
+    """Bitmask of cells whose 4-bit total equals ``value``."""
+    b0, b1, b2, b3 = planes
+    m = b0 if value & 1 else ~b0
+    m = m & (b1 if value & 2 else ~b1)
+    m = m & (b2 if value & 4 else ~b2)
+    m = m & (b3 if value & 8 else ~b3)
+    return m
+
+
+def make_packed_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
+    """One life-like CA step on a packed bitboard (clamped boundary)."""
+    if not supports(rule):
+        raise ValueError(f"bit-sliced path supports life-like rules only, got {rule}")
+    birth = sorted(rule.birth)
+    survive = sorted(rule.survive)
+
+    def step(x: jax.Array) -> jax.Array:
+        planes = _total_planes(x)
+        born = jnp.zeros_like(x)
+        for v in birth:
+            born = born | _eq_mask(planes, v)  # dead: total == count
+        surv = jnp.zeros_like(x)
+        for v in survive:
+            surv = surv | _eq_mask(planes, v + 1)  # alive: total == count+1
+        return (~x & born) | (x & surv)
+
+    return step
+
+
+def col_mask(width: int, wp: int) -> np.ndarray:
+    """uint32[wp] mask of in-board bits (pads the last partial word)."""
+    full, rem = divmod(width, WORD)
+    m = np.zeros(wp, dtype=np.uint32)
+    m[:full] = np.uint32(0xFFFFFFFF)
+    if rem:
+        m[full] = np.uint32((1 << rem) - 1)
+    return m
+
+
+def make_masked_packed_step(
+    rule: Rule, logical_shape: tuple[int, int]
+) -> Callable[[jax.Array, jax.Array | int], jax.Array]:
+    """Packed step that pins cells outside the logical board dead.
+
+    ``row_offset`` is the global row of packed row 0 (traced inside
+    shard_map); column padding bits are masked via ``col_mask``.
+    """
+    step = make_packed_step(rule)
+    lh, lw = logical_shape
+
+    def masked(x: jax.Array, row_offset: jax.Array | int = 0) -> jax.Array:
+        h, wp = x.shape
+        rows = row_offset + jnp.arange(h)
+        row_ok = ((rows >= 0) & (rows < lh)).astype(jnp.uint32)[:, None]
+        cmask = jnp.asarray(col_mask(lw, wp))[None, :]
+        return step(x) & (row_ok * cmask)
+
+    return masked
+
+
+from functools import partial as _partial
+
+
+@_partial(
+    jax.jit,
+    static_argnames=("rule", "steps", "logical_shape"),
+    donate_argnums=0,
+)
+def multi_step_packed(
+    x: jax.Array,
+    *,
+    rule: Rule,
+    steps: int,
+    logical_shape: tuple[int, int],
+) -> jax.Array:
+    """``steps`` fused bit-sliced CA steps under one jit (packed domain)."""
+    masked = make_masked_packed_step(rule, tuple(logical_shape))
+    out, _ = jax.lax.scan(lambda b, _: (masked(b), None), x, None, length=steps)
+    return out

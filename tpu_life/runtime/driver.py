@@ -51,6 +51,12 @@ def run(cfg: RunConfig) -> RunResult:
         raise ValueError(
             f"board shape {board.shape} != configured ({height}, {width})"
         )
+    max_state = int(board.max(initial=0))
+    if max_state >= rule.states:
+        raise ValueError(
+            f"board contains state {max_state} but rule {rule.name!r} has "
+            f"only {rule.states} states (0..{rule.states - 1})"
+        )
 
     backend = get_backend(
         cfg.backend,
@@ -58,6 +64,7 @@ def run(cfg: RunConfig) -> RunResult:
         block_steps=cfg.block_steps,
         partition_mode=cfg.partition_mode,
         pad_lanes=cfg.pad_lanes,
+        bitpack=cfg.bitpack,
     )
 
     remaining = max(0, steps - start_step)

@@ -1,0 +1,21 @@
+"""Platform selection.
+
+Some TPU plugin environments force themselves as the default JAX platform
+regardless of ``JAX_PLATFORMS`` (observed with tunneled-TPU plugins).
+``ensure_platform`` applies an explicit override via ``jax.config`` — which
+does win — from a flag or the ``TPU_LIFE_PLATFORM`` env var.  Must run
+before the first device query.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_platform(platform: str | None = None) -> None:
+    platform = platform or os.environ.get("TPU_LIFE_PLATFORM")
+    if not platform:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platform)
