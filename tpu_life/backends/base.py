@@ -28,6 +28,49 @@ from tpu_life.models.rules import Rule
 ChunkCallback = Callable[[int, Callable[[], np.ndarray]], None]
 
 
+class Runner(Protocol):
+    """Device-resident run handle: state stays on device between advances.
+
+    This is the seam the benchmark times — ``advance`` queues work with no
+    host round-trip; ``sync`` forces completion (a 1-element readback, which
+    defeats async completion reporting on tunneled devices); ``fetch``
+    materializes the board on host.
+    """
+
+    def advance(self, steps: int) -> None: ...
+
+    def sync(self) -> None: ...
+
+    def fetch(self) -> np.ndarray: ...
+
+    def snapshot(self) -> Callable[[], np.ndarray]:
+        """A ``get_board`` thunk bound to the *current* state (not late-bound
+        to whatever the runner holds when the thunk finally runs)."""
+        ...
+
+
+class HostRunner:
+    """Fallback Runner for host backends (numpy / stripes): state is a
+    host array and ``advance`` just calls ``backend.run`` on it."""
+
+    def __init__(self, backend: "Backend", board: np.ndarray, rule: Rule):
+        self.backend = backend
+        self.board = np.asarray(board, np.int8)
+        self.rule = rule
+
+    def advance(self, steps: int) -> None:
+        self.board = self.backend.run(self.board, self.rule, steps)
+
+    def sync(self) -> None:
+        pass
+
+    def fetch(self) -> np.ndarray:
+        return self.board
+
+    def snapshot(self) -> Callable[[], np.ndarray]:
+        return lambda board=self.board: board
+
+
 class Backend(Protocol):
     name: str
 
@@ -40,6 +83,42 @@ class Backend(Protocol):
         chunk_steps: int = 0,
         callback: ChunkCallback | None = None,
     ) -> np.ndarray: ...
+
+def make_runner(backend: "Backend", board: np.ndarray, rule: Rule) -> Runner:
+    """Stage ``board`` on the backend's devices and return a Runner.
+
+    Backends with device-resident state implement ``prepare``; host
+    backends fall back to ``HostRunner``.
+    """
+    prep = getattr(backend, "prepare", None)
+    if prep is not None:
+        return prep(board, rule)
+    return HostRunner(backend, board, rule)
+
+
+def run_with_runner(
+    backend: "Backend",
+    board: np.ndarray,
+    rule: Rule,
+    steps: int,
+    *,
+    chunk_steps: int = 0,
+    callback: ChunkCallback | None = None,
+) -> np.ndarray:
+    """The shared chunked ``run`` loop over a Runner.
+
+    Each chunk's ``get_board`` thunk is bound to that chunk's state
+    (``Runner.snapshot``), so subscribers may defer materialization.
+    """
+    r = make_runner(backend, board, rule)
+    done = 0
+    for n in chunk_sizes(steps, chunk_steps):
+        r.advance(n)
+        done += n
+        if callback is not None:
+            callback(done, r.snapshot())
+    r.sync()
+    return r.fetch()
 
 
 BACKENDS: dict[str, Callable[..., Backend]] = {}

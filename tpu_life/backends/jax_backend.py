@@ -18,11 +18,45 @@ import numpy as np
 
 import jax
 
-from tpu_life.backends.base import ChunkCallback, chunk_sizes, register_backend
+from tpu_life.backends.base import (
+    ChunkCallback,
+    Runner,
+    register_backend,
+    run_with_runner,
+)
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
 from tpu_life.ops.stencil import multi_step
 from tpu_life.utils.padding import LANE, ceil_to, pad_board
+
+
+class DeviceRunner:
+    """Runner over a device-resident board: ``advance`` dispatches fused
+    scans with no host round-trip; ``sync`` forces completion via a
+    1-element readback (``block_until_ready`` alone can return before the
+    device finishes on async tunneled platforms)."""
+
+    def __init__(self, x: jax.Array, advance, to_np):
+        self.x = x
+        self._advance = advance
+        self._to_np = to_np
+
+    def advance(self, steps: int) -> None:
+        if steps > 0:
+            self.x = self._advance(self.x, steps)
+
+    def sync(self) -> None:
+        jax.block_until_ready(self.x)
+        np.asarray(self.x[:1, :1])
+
+    def fetch(self) -> np.ndarray:
+        return self._to_np(self.x)
+
+    def snapshot(self):
+        """Thunk bound to the current device array.  Valid until the next
+        ``advance`` donates that buffer — i.e. materialize within the
+        chunk callback, matching the driver's synchronous use."""
+        return lambda x=self.x: self._to_np(x)
 
 
 @register_backend("jax")
@@ -34,15 +68,7 @@ class JaxBackend:
         self.pad_lanes = pad_lanes
         self.bitpack = bitpack
 
-    def run(
-        self,
-        board: np.ndarray,
-        rule: Rule,
-        steps: int,
-        *,
-        chunk_steps: int = 0,
-        callback: ChunkCallback | None = None,
-    ) -> np.ndarray:
+    def prepare(self, board: np.ndarray, rule: Rule) -> Runner:
         h, w = board.shape
         logical = (h, w)
         use_bits = self.bitpack and bitlife.supports(rule)
@@ -59,12 +85,17 @@ class JaxBackend:
                 x, rule=rule, steps=n, logical_shape=logical
             )
             to_np = lambda x: np.asarray(x)[:h, :w]
+        return DeviceRunner(x, advance, to_np)
 
-        done = 0
-        for n in chunk_sizes(steps, chunk_steps):
-            x = advance(x, n)
-            done += n
-            if callback is not None:
-                callback(done, lambda x=x: to_np(x))
-        x.block_until_ready()
-        return to_np(x)
+    def run(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        steps: int,
+        *,
+        chunk_steps: int = 0,
+        callback: ChunkCallback | None = None,
+    ) -> np.ndarray:
+        return run_with_runner(
+            self, board, rule, steps, chunk_steps=chunk_steps, callback=callback
+        )

@@ -27,7 +27,7 @@ import numpy as np
 
 import jax
 
-from tpu_life.backends.base import ChunkCallback, chunk_sizes, register_backend
+from tpu_life.backends.base import ChunkCallback, register_backend, run_with_runner
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
 from tpu_life.ops.stencil import make_masked_step
@@ -83,15 +83,7 @@ class ShardedBackend:
 
         return jax.make_array_from_callback((h_pad, w_pad), sharding, cb)
 
-    def run(
-        self,
-        board: np.ndarray,
-        rule: Rule,
-        steps: int,
-        *,
-        chunk_steps: int = 0,
-        callback: ChunkCallback | None = None,
-    ) -> np.ndarray:
+    def prepare(self, board: np.ndarray, rule: Rule):
         h, w = board.shape
         logical = (h, w)
         use_bits = self.bitpack and bitlife.supports(rule)
@@ -126,21 +118,32 @@ class ShardedBackend:
             else None
         )
 
-        done = 0
-        for n_steps in chunk_sizes(steps, chunk_steps):
+        def advance(x, n_steps: int):
             if gspmd_run is not None:
-                x = gspmd_run(x, steps=n_steps)
-            else:
-                num_blocks, rem = divmod(n_steps, block_steps)
-                if num_blocks:
-                    x = get_run(block_steps)(x, num_blocks)
-                if rem:
-                    x = get_run(rem)(x, 1)
-            done += n_steps
-            if callback is not None:
-                callback(done, lambda x=x: to_np(x))
-        x.block_until_ready()
-        return to_np(x)
+                return gspmd_run(x, steps=n_steps)
+            num_blocks, rem = divmod(n_steps, block_steps)
+            if num_blocks:
+                x = get_run(block_steps)(x, num_blocks)
+            if rem:
+                x = get_run(rem)(x, 1)
+            return x
+
+        from tpu_life.backends.jax_backend import DeviceRunner
+
+        return DeviceRunner(x, advance, to_np)
+
+    def run(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        steps: int,
+        *,
+        chunk_steps: int = 0,
+        callback: ChunkCallback | None = None,
+    ) -> np.ndarray:
+        return run_with_runner(
+            self, board, rule, steps, chunk_steps=chunk_steps, callback=callback
+        )
 
     def _gspmd_run(self, rule: Rule, logical_shape, use_bits: bool):
         sharding = board_sharding(self.mesh)

@@ -26,6 +26,7 @@ membership test then becomes an OR over 4-bit equality masks:
 
 from __future__ import annotations
 
+import sys
 from typing import Callable
 
 import jax
@@ -36,6 +37,7 @@ from tpu_life.models.rules import Rule
 
 WORD = 32
 _U1 = np.uint32(1)
+_LITTLE = sys.byteorder == "little"
 
 
 def packed_width(width: int) -> int:
@@ -54,12 +56,19 @@ def pack_np(board: np.ndarray) -> np.ndarray:
 
     Packs *alive* (== 1) bits; any other state would corrupt word sums, so
     it is masked here and rejected earlier by the driver's state validation.
+
+    Uses ``np.packbits`` (C loop) — the byte layout of LSB-first bytes read
+    as native little-endian uint32 is exactly the LSB-first word layout.  On
+    a big-endian host falls back to the explicit weighted-sum pack.
     """
     h, w = board.shape
-    alive = (board == 1)
+    alive = board == 1
     wp = packed_width(w) * WORD
     if wp != w:
         alive = np.pad(alive, ((0, 0), (0, wp - w)))
+    if _LITTLE:
+        by = np.packbits(alive, axis=1, bitorder="little")
+        return np.ascontiguousarray(by).view(np.uint32)
     bits = alive.astype(np.uint32).reshape(h, wp // WORD, WORD)
     weights = (_U1 << np.arange(WORD, dtype=np.uint32)).astype(np.uint32)
     return (bits * weights).sum(axis=-1, dtype=np.uint32)
@@ -68,6 +77,10 @@ def pack_np(board: np.ndarray) -> np.ndarray:
 def unpack_np(packed: np.ndarray, width: int) -> np.ndarray:
     """Host-side unpack: uint32[H, Wp] -> int8[H, width]."""
     h, wp = packed.shape
+    if _LITTLE:
+        by = np.ascontiguousarray(packed).view(np.uint8)
+        bits = np.unpackbits(by, axis=1, bitorder="little")
+        return bits[:, :width].astype(np.int8)
     shifts = np.arange(WORD, dtype=np.uint32)
     bits = (packed[:, :, None] >> shifts[None, None, :]) & _U1
     return bits.reshape(h, wp * WORD)[:, :width].astype(np.int8)
