@@ -35,7 +35,12 @@ def main() -> None:
     p.add_argument(
         "--backend", default="jax", choices=["jax", "sharded", "pallas", "numpy"]
     )
-    p.add_argument("--block-steps", type=int, default=1)
+    p.add_argument(
+        "--block-steps",
+        type=int,
+        default=None,
+        help="steps per halo exchange / HBM pass; unset keeps each backend's default",
+    )
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--platform", default=None)
     p.add_argument("--no-bitpack", action="store_true")
@@ -63,9 +68,10 @@ def main() -> None:
             * rng.integers(0, 2, size=(n, n), dtype=np.int8)
         )
 
-    backend = get_backend(
-        args.backend, block_steps=args.block_steps, bitpack=not args.no_bitpack
-    )
+    kwargs = {"bitpack": not args.no_bitpack}
+    if args.block_steps is not None:
+        kwargs["block_steps"] = args.block_steps
+    backend = get_backend(args.backend, **kwargs)
     runner = make_runner(backend, board, rule)
 
     def timed(steps: int) -> float:

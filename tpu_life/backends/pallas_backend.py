@@ -1,0 +1,284 @@
+"""Pallas TPU stencil backend: k CA steps per HBM pass.
+
+The XLA stencil (``tpu_life.ops.stencil``) is one HBM read + one HBM write
+per cell per step — XLA cannot multi-step a stencil inside one fusion
+because each step's halo depends on the previous step's neighbors.  This
+backend breaks that wall the TPU way: a Pallas kernel grids over 2-D tiles,
+DMAs each tile *plus a deep halo* (``block_steps * radius`` cells per side)
+from HBM into VMEM, advances ``block_steps`` whole CA steps on the VPU, and
+writes the tile back — HBM traffic drops ~``block_steps``-fold.  It is the
+single-chip twin of the sharded backend's deep-halo communication blocking
+(``tpu_life.parallel.halo``): the same compute/communication trade, over
+VMEM<->HBM instead of ICI.
+
+Layout trick: the board is stored in HBM *with the halo frame baked in* — a
+zero border of ``halo`` cells on all four sides.  Every tile then DMAs one
+static-size, always-in-bounds window (no edge special-casing in the kernel),
+and the zero frame *is* the reference's clamped dead boundary
+(Parallel_Life_MPI.cpp:21-27).  The frame is re-zeroed by four cheap strip
+updates between kernel calls, since each call writes a fresh output buffer.
+
+This is the wide-radius path SURVEY.md §7.6 calls for: at Larger-than-Life
+radius 5 the separable box sum does 22 shifted adds per cell per step, so
+keeping the working set in VMEM across steps matters far more than for
+Conway.  The rule application reuses the same branch-free compare/select
+chains as the XLA stencil (one rule engine, three executors — cf.
+``Rule.transition_table``).  The reference's analogue of all of this is the
+nested per-cell loop at Parallel_Life_MPI.cpp:16-54.
+
+On non-TPU platforms the kernel runs in Pallas interpret mode (exact same
+code path, Python-speed) — that is how CI exercises it without a chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_life.backends.base import (
+    ChunkCallback,
+    Runner,
+    register_backend,
+    run_with_runner,
+)
+from tpu_life.backends.jax_backend import DeviceRunner
+from tpu_life.models.rules import Rule
+from tpu_life.ops.stencil import apply_rule, multi_step
+from tpu_life.utils.padding import LANE, SUBLANE, ceil_to, pad_board
+
+
+def _vmem_counts(x: jax.Array, rule: Rule) -> jax.Array:
+    """int32 live-neighbor box counts on a VMEM-resident tile.
+
+    Separable (2r+1)-box sum; vertical shifts are sublane concats, horizontal
+    shifts are lane rotations (``pltpu.roll``).  Roll wraparound and concat
+    zero-fill only corrupt the outer ``radius * step`` fringe of the tile's
+    halo, which is discarded — interior cells only ever see true neighbors
+    because the halo is ``block_steps * radius`` deep.
+    """
+    r = rule.radius
+    a = (x == 1).astype(jnp.int32)
+    zeros = jnp.zeros_like(a)
+    # vertical box sum: acc[i] = sum_{|d|<=r} a[i+d]
+    acc = a
+    for d in range(1, r + 1):
+        up = jnp.concatenate([a[d:], zeros[:d]], axis=0)  # a[i+d]
+        down = jnp.concatenate([zeros[:d], a[:-d]], axis=0)  # a[i-d]
+        acc = acc + up + down
+    # horizontal box sum over acc
+    w = x.shape[1]
+    tot = acc
+    for d in range(1, r + 1):
+        tot = tot + pltpu.roll(acc, d, axis=1) + pltpu.roll(acc, w - d, axis=1)
+    if not rule.include_center:
+        tot = tot - a
+    return tot
+
+
+def make_pallas_multi_step(
+    rule: Rule,
+    padded_shape: tuple[int, int],
+    logical: tuple[int, int],
+    frame: tuple[int, int],
+    *,
+    block_rows: int,
+    block_cols: int,
+    block_steps: int,
+    interpret: bool = False,
+) -> Callable[[jax.Array], jax.Array]:
+    """``block_steps`` CA steps as one pallas_call over 2-D tiles.
+
+    ``padded_shape`` = interior tiles + a ``frame = (fr, fc)`` zero border;
+    interior rows/cols are tiled exactly by ``block_rows x block_cols``.
+    The output's frame is left unwritten — callers must re-zero it before
+    the next call (see ``_zero_frame``).
+    """
+    hp, wp = padded_shape
+    fr, fc = frame
+    lh, lw = logical
+    nb_r = (hp - 2 * fr) // block_rows
+    nb_c = (wp - 2 * fc) // block_cols
+    # each tile DMAs the full frame depth (fr >= halo, fc >= halo) so every
+    # window offset is a tile-size multiple — sublane/lane-aligned for free
+    ext_r = block_rows + 2 * fr
+    ext_c = block_cols + 2 * fc
+
+    def kernel(x_hbm, out_hbm, scratch, in_sem, out_sem):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        r0 = i * block_rows  # padded-array row of scratch row 0
+        c0 = j * block_cols
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(r0, ext_r), pl.ds(c0, ext_c)], scratch, in_sem
+        )
+        cp.start()
+        cp.wait()
+
+        # validity on the *logical* board: the zero frame and any padding
+        # must stay dead after every substep
+        row_ids = lax.broadcasted_iota(jnp.int32, (ext_r, ext_c), 0) + (r0 - fr)
+        col_ids = lax.broadcasted_iota(jnp.int32, (ext_r, ext_c), 1) + (c0 - fc)
+        valid = (row_ids >= 0) & (row_ids < lh) & (col_ids >= 0) & (col_ids < lw)
+
+        def body(_, x):
+            counts = _vmem_counts(x, rule)
+            return jnp.where(valid, apply_rule(x, counts, rule), jnp.int8(0))
+
+        scratch[:] = lax.fori_loop(0, block_steps, body, scratch[:])
+
+        wr = pltpu.make_async_copy(
+            scratch.at[pl.ds(fr, block_rows), pl.ds(fc, block_cols)],
+            out_hbm.at[
+                pl.ds(i * block_rows + fr, block_rows),
+                pl.ds(j * block_cols + fc, block_cols),
+            ],
+            out_sem,
+        )
+        wr.start()
+        wr.wait()
+
+    grid_step = pl.pallas_call(
+        kernel,
+        grid=(nb_r, nb_c),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((hp, wp), jnp.int8),
+        scratch_shapes=[
+            pltpu.VMEM((ext_r, ext_c), jnp.int8),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )
+
+    def step_then_zero_frame(x: jax.Array) -> jax.Array:
+        y = grid_step(x)
+        return _zero_frame(y, fr, fc)
+
+    return step_then_zero_frame
+
+
+def _zero_frame(y: jax.Array, fr: int, fc: int) -> jax.Array:
+    """Re-zero the halo frame (the kernel writes interior tiles only)."""
+    hp, wp = y.shape
+    z8 = jnp.int8(0)
+    y = lax.dynamic_update_slice(y, jnp.full((fr, wp), z8), (0, 0))
+    y = lax.dynamic_update_slice(y, jnp.full((fr, wp), z8), (hp - fr, 0))
+    y = lax.dynamic_update_slice(y, jnp.full((hp, fc), z8), (0, 0))
+    y = lax.dynamic_update_slice(y, jnp.full((hp, fc), z8), (0, wp - fc))
+    return y
+
+
+@register_backend("pallas")
+class PallasBackend:
+    """Single-device Pallas deep-halo 2-D-tiled stencil backend.
+
+    ``block_rows x block_cols`` is the VMEM tile (the working set is the
+    tile plus a ``block_steps * radius`` halo, in int8 plus a few int32
+    temporaries — sized to fit VMEM comfortably at the defaults);
+    ``block_steps`` is how many CA steps each HBM pass advances.
+    ``interpret=None`` picks compiled on TPU, interpret elsewhere.
+    """
+
+    name = "pallas"
+
+    def __init__(
+        self,
+        *,
+        device=None,
+        block_rows: int = 256,
+        block_cols: int = 512,
+        block_steps: int = 8,
+        interpret: bool | None = None,
+        **_,
+    ):
+        self.device = device if device is not None else jax.devices()[0]
+        self.block_rows = ceil_to(block_rows, SUBLANE)
+        self.block_cols = ceil_to(block_cols, LANE)
+        self.block_steps = max(1, block_steps)
+        if interpret is None:
+            interpret = self.device.platform != "tpu"
+        self.interpret = interpret
+
+    def prepare(self, board: np.ndarray, rule: Rule) -> Runner:
+        h, w = board.shape
+        logical = (h, w)
+        # clamp so the halo stays a minor fraction of the tile: deeper than
+        # this and the redundant fringe compute outweighs the HBM savings
+        block_steps = max(
+            1, min(self.block_steps, min(self.block_rows, self.block_cols) // (4 * rule.radius))
+        )
+        halo = rule.radius * block_steps
+        if h < self.block_rows or w < self.block_cols:
+            # small board: the fused XLA scan is already VMEM-resident there
+            wp = ceil_to(w, LANE)
+            x = jax.device_put(pad_board(board, h, wp), self.device)
+            advance = lambda x, n: multi_step(x, rule=rule, steps=n, logical_shape=logical)
+            return DeviceRunner(x, advance, lambda x: np.asarray(x)[:h, :w])
+
+        # zero frame: `halo` deep, aligned so DMA window offsets stay on
+        # sublane/lane boundaries (fr - halo multiple of 8, fc - halo of 128)
+        fr = ceil_to(halo, SUBLANE)
+        fc = ceil_to(halo, LANE)
+        hp = fr + ceil_to(h, self.block_rows) + fr
+        wp = fc + ceil_to(w, self.block_cols) + fc
+        host = np.zeros((hp, wp), dtype=np.int8)
+        host[fr : fr + h, fc : fc + w] = board
+        x = jax.device_put(host, self.device)
+        padded_shape = (hp, wp)
+        frame = (fr, fc)
+
+        steppers: dict[int, Callable] = {}
+
+        def get_stepper(k: int):
+            if k not in steppers:
+                steppers[k] = make_pallas_multi_step(
+                    rule,
+                    padded_shape,
+                    logical,
+                    frame,
+                    block_rows=self.block_rows,
+                    block_cols=self.block_cols,
+                    block_steps=k,
+                    interpret=self.interpret,
+                )
+            return steppers[k]
+
+        @partial(jax.jit, static_argnames=("blocks", "k"), donate_argnums=0)
+        def run_blocks(x, *, blocks: int, k: int):
+            step_k = get_stepper(k)
+            out, _ = lax.scan(lambda b, _: (step_k(b), None), x, None, length=blocks)
+            return out
+
+        def advance(x, steps: int):
+            blocks, rem = divmod(steps, block_steps)
+            if blocks:
+                x = run_blocks(x, blocks=blocks, k=block_steps)
+            if rem:
+                x = run_blocks(x, blocks=1, k=rem)
+            return x
+
+        return DeviceRunner(
+            x, advance, lambda x: np.asarray(x)[fr : fr + h, fc : fc + w]
+        )
+
+    def run(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        steps: int,
+        *,
+        chunk_steps: int = 0,
+        callback: ChunkCallback | None = None,
+    ) -> np.ndarray:
+        return run_with_runner(
+            self, board, rule, steps, chunk_steps=chunk_steps, callback=callback
+        )

@@ -64,7 +64,12 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
         default=None,
         help="force a JAX platform (cpu/tpu); also via TPU_LIFE_PLATFORM env",
     )
-    r.add_argument("--block-steps", type=int, default=1)
+    r.add_argument(
+        "--block-steps",
+        type=int,
+        default=None,
+        help="CA steps per halo exchange / HBM pass; unset keeps the backend default",
+    )
     r.add_argument(
         "--partition-mode", default="shard_map", choices=["shard_map", "gspmd"]
     )

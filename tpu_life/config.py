@@ -34,7 +34,9 @@ class RunConfig:
     # execution
     backend: str = "auto"  # auto | numpy | jax | sharded | stripes | mpi
     num_devices: int | None = None
-    block_steps: int = 1  # CA steps per halo exchange (deep halos)
+    # CA steps per halo exchange / HBM pass (deep halos); None keeps each
+    # backend's own default (sharded: 1, pallas: 8)
+    block_steps: int | None = None
     partition_mode: str = "shard_map"  # shard_map | gspmd
     sync_every: int = 0  # steps per host sync chunk; 0 = one fused run
     pad_lanes: bool = True  # pad width to the 128-lane TPU tile

@@ -58,14 +58,15 @@ def run(cfg: RunConfig) -> RunResult:
             f"only {rule.states} states (0..{rule.states - 1})"
         )
 
-    backend = get_backend(
-        cfg.backend,
+    backend_kwargs = dict(
         num_devices=cfg.num_devices,
-        block_steps=cfg.block_steps,
         partition_mode=cfg.partition_mode,
         pad_lanes=cfg.pad_lanes,
         bitpack=cfg.bitpack,
     )
+    if cfg.block_steps is not None:
+        backend_kwargs["block_steps"] = cfg.block_steps
+    backend = get_backend(cfg.backend, **backend_kwargs)
 
     remaining = max(0, steps - start_step)
     recorder = MetricsRecorder(

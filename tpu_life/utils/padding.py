@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 LANE = 128
+SUBLANE = 8
 
 
 def ceil_to(x: int, m: int) -> int:
