@@ -33,7 +33,11 @@ def main() -> None:
     p.add_argument("--base-steps", type=int, default=100)
     p.add_argument("--rule", default="conway")
     p.add_argument(
-        "--backend", default="jax", choices=["jax", "sharded", "pallas", "numpy"]
+        "--backend",
+        default=None,
+        choices=["jax", "sharded", "pallas", "numpy"],
+        help="default: pallas on TPU (fastest single-chip path), jax elsewhere "
+        "(pallas off-TPU would run in Python interpret mode)",
     )
     p.add_argument(
         "--block-steps",
@@ -67,6 +71,9 @@ def main() -> None:
             rng.integers(0, rule.states, size=(n, n), dtype=np.int8)
             * rng.integers(0, 2, size=(n, n), dtype=np.int8)
         )
+
+    if args.backend is None:
+        args.backend = "pallas" if jax.devices()[0].platform == "tpu" else "jax"
 
     kwargs = {"bitpack": not args.no_bitpack}
     if args.block_steps is not None:

@@ -47,18 +47,49 @@ def _backend(**kw):
 def test_matches_reference(rule_name, shape, steps):
     rng = np.random.default_rng(42)
     rule = get_rule(rule_name)
-    be = _backend()
+    be = _backend(bitpack=False)  # force the int8 2-D-tiled kernel
     b = _board(rng, shape, rule)
     np.testing.assert_array_equal(be.run(b, rule, steps), run_np(b, rule, steps))
 
 
-def test_remainder_steps_split():
+@pytest.mark.parametrize(
+    "rule_name,shape,steps",
+    [
+        ("conway", (70, 150), 9),  # uneven rows + partial last word
+        ("conway", (64, 64), 8),  # width an exact word multiple: wrap-carry mask
+        ("highlife", (40, 257), 7),  # one bit into a new word
+        ("day_and_night", (33, 96), 6),  # dense rule, all 32 bits of last word
+    ],
+)
+def test_packed_matches_reference(rule_name, shape, steps):
+    # life-like rules route to the bit-sliced stripe kernel when tall enough
+    rng = np.random.default_rng(7)
+    rule = get_rule(rule_name)
+    be = _backend(block_rows=16, block_steps=4)
+    b = _board(rng, shape, rule)
+    np.testing.assert_array_equal(be.run(b, rule, steps), run_np(b, rule, steps))
+
+
+@pytest.mark.parametrize("bitpack", [True, False])
+def test_remainder_steps_split(bitpack):
     # steps not divisible by block_steps exercises the remainder stepper
     rng = np.random.default_rng(3)
     rule = get_rule("conway")
-    be = _backend()
+    be = _backend(bitpack=bitpack)
     b = rng.integers(0, 2, size=(48, 256), dtype=np.int8)
     np.testing.assert_array_equal(be.run(b, rule, 7), run_np(b, rule, 7))
+
+
+def test_wide_board_falls_back_to_int8_tiles():
+    # a board too wide for a full-width packed stripe under the VMEM budget
+    # must route to the column-tiled int8 kernel, not fail to compile
+    rng = np.random.default_rng(11)
+    rule = get_rule("conway")
+    be = _backend(block_rows=16, block_cols=128, block_steps=2)
+    be.MAX_PACKED_TILE_BYTES = 4096  # force the budget miss at test scale
+    assert be._packed_tiling(48, 600) is None
+    b = rng.integers(0, 2, size=(48, 600), dtype=np.int8)
+    np.testing.assert_array_equal(be.run(b, rule, 5), run_np(b, rule, 5))
 
 
 def test_small_board_falls_back_to_xla():
@@ -69,20 +100,22 @@ def test_small_board_falls_back_to_xla():
     np.testing.assert_array_equal(be.run(b, rule, 12), run_np(b, rule, 12))
 
 
-def test_single_tile_grid():
+@pytest.mark.parametrize("bitpack", [True, False])
+def test_single_tile_grid(bitpack):
     # exactly one tile in each grid dimension
     rng = np.random.default_rng(5)
     rule = get_rule("conway")
-    be = _backend(block_rows=32, block_cols=128, block_steps=2)
+    be = _backend(block_rows=32, block_cols=128, block_steps=2, bitpack=bitpack)
     b = rng.integers(0, 2, size=(32, 128), dtype=np.int8)
     np.testing.assert_array_equal(be.run(b, rule, 6), run_np(b, rule, 6))
 
 
-def test_multi_chunk_run_with_callback():
+@pytest.mark.parametrize("bitpack", [True, False])
+def test_multi_chunk_run_with_callback(bitpack):
     # chunked run: frame re-zeroing must hold across separate dispatches
     rng = np.random.default_rng(6)
     rule = get_rule("conway")
-    be = _backend()
+    be = _backend(bitpack=bitpack)
     b = rng.integers(0, 2, size=(48, 256), dtype=np.int8)
     seen = []
     out = be.run(b, rule, 8, chunk_steps=3, callback=lambda s, g: seen.append(s))

@@ -140,8 +140,21 @@ def get_backend(name: str, **kwargs) -> Backend:
     if name == "auto":
         import jax
 
-        n = len(jax.devices())
-        name = "sharded" if n > 1 else "jax"
+        devices = jax.devices()
+        if len(devices) > 1:
+            name = "sharded"
+        elif devices[0].platform == "tpu":
+            # the Pallas deep-halo kernels are the fastest single-chip path
+            # (and fall back to the fused XLA scan on small boards); keep
+            # "auto" infallible if pallas itself cannot import
+            try:
+                from tpu_life.backends import pallas_backend  # noqa: F401
+
+                name = "pallas"
+            except ImportError:
+                name = "jax"
+        else:
+            name = "jax"
     if name not in BACKENDS:
         try:
             if name == "pallas":

@@ -134,19 +134,34 @@ def _csa(a, b, c):
     return ab ^ c, (a & b) | (ab & c)
 
 
-def _total_planes(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Bitplanes (b0, b1, b2, b3) of total = center + 8 neighbors (0..9)."""
-    up, down = _vshift(x)
-    ones, twos = _csa(up, x, down)  # vertical 3-sum per column, 2-bit
-    o_l, o_r = _hshift_left(ones), _hshift_right(ones)
-    t_l, t_r = _hshift_left(twos), _hshift_right(twos)
-    b0, c1 = _csa(o_l, ones, o_r)  # ones-plane horizontal sum
-    s1, c2 = _csa(t_l, twos, t_r)  # twos-plane horizontal sum (weight 2)
-    b1 = c1 ^ s1  # weight-2 bits
-    u2 = c1 & s1  # carry into weight 4
-    b2 = c2 ^ u2
-    b3 = c2 & u2  # weight 8 (totals 8, 9)
-    return b0, b1, b2, b3
+def make_total_planes(
+    hshift_left: Callable, hshift_right: Callable, vshift: Callable
+) -> Callable:
+    """Build the bitplane counter over pluggable neighbor-plane shifts.
+
+    The XLA step shifts via pad/concat (below); the Pallas kernel substitutes
+    ``pltpu.roll``-based lane shifts with the board-edge carries masked —
+    same adder tree, two executors.
+    """
+
+    def total_planes(x: jax.Array) -> tuple[jax.Array, ...]:
+        """Bitplanes (b0, b1, b2, b3) of total = center + 8 neighbors (0..9)."""
+        up, down = vshift(x)
+        ones, twos = _csa(up, x, down)  # vertical 3-sum per column, 2-bit
+        o_l, o_r = hshift_left(ones), hshift_right(ones)
+        t_l, t_r = hshift_left(twos), hshift_right(twos)
+        b0, c1 = _csa(o_l, ones, o_r)  # ones-plane horizontal sum
+        s1, c2 = _csa(t_l, twos, t_r)  # twos-plane horizontal sum (weight 2)
+        b1 = c1 ^ s1  # weight-2 bits
+        u2 = c1 & s1  # carry into weight 4
+        b2 = c2 ^ u2
+        b3 = c2 & u2  # weight 8 (totals 8, 9)
+        return b0, b1, b2, b3
+
+    return total_planes
+
+
+_total_planes = make_total_planes(_hshift_left, _hshift_right, _vshift)
 
 
 def _eq_mask(planes, value: int) -> jax.Array:
@@ -159,15 +174,23 @@ def _eq_mask(planes, value: int) -> jax.Array:
     return m
 
 
-def make_packed_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
-    """One life-like CA step on a packed bitboard (clamped boundary)."""
+def make_packed_step(
+    rule: Rule, total_planes: Callable | None = None
+) -> Callable[[jax.Array], jax.Array]:
+    """One life-like CA step on a packed bitboard (clamped boundary).
+
+    ``total_planes`` swaps in an alternative bitplane counter (the Pallas
+    kernel's roll-based one); default is the XLA pad/concat version.
+    """
     if not supports(rule):
         raise ValueError(f"bit-sliced path supports life-like rules only, got {rule}")
+    if total_planes is None:
+        total_planes = _total_planes
     birth = sorted(rule.birth)
     survive = sorted(rule.survive)
 
     def step(x: jax.Array) -> jax.Array:
-        planes = _total_planes(x)
+        planes = total_planes(x)
         born = jnp.zeros_like(x)
         for v in birth:
             born = born | _eq_mask(planes, v)  # dead: total == count

@@ -61,28 +61,35 @@ def _membership(counts: jax.Array, values: frozenset) -> jax.Array:
 
 
 def apply_rule(board: jax.Array, counts: jax.Array, rule: Rule) -> jax.Array:
-    """Next state from (state, count) — the LUT as compare/selects."""
+    """Next state from (state, count) — the LUT as compare/selects.
+
+    Generic over ``board.dtype``: the XLA path runs it on int8 (HBM-resident
+    boards), the Pallas kernel on int32 (keeping every select operand in the
+    VPU-native 32-bit tile layout — Mosaic rejects selects that mix int8- and
+    int32-derived mask layouts).
+    """
+    dt = board.dtype
     born = _membership(counts, rule.birth)
     survives = _membership(counts, rule.survive)
-    one = jnp.int8(1)
-    zero = jnp.int8(0)
+    one = jnp.asarray(1, dt)
+    zero = jnp.asarray(0, dt)
     if rule.states == 2:
         alive = board == 1
         return jnp.where(alive, jnp.where(survives, one, zero),
                          jnp.where(born, one, zero))
     dying_next = jnp.where(
-        board >= rule.states - 1, zero, (board + one).astype(jnp.int8)
+        board >= rule.states - 1, zero, (board + one).astype(dt)
     )
     nxt = jnp.where(
         board == 0,
         jnp.where(born, one, zero),
         jnp.where(
             board == 1,
-            jnp.where(survives, one, jnp.int8(2)),
+            jnp.where(survives, one, jnp.asarray(2, dt)),
             dying_next,
         ),
     )
-    return nxt.astype(jnp.int8)
+    return nxt.astype(dt)
 
 
 def validity_mask(
