@@ -100,6 +100,18 @@ def test_small_board_falls_back_to_xla():
     np.testing.assert_array_equal(be.run(b, rule, 12), run_np(b, rule, 12))
 
 
+def test_small_board_fallback_stays_bitpacked():
+    # short-wide life-like board below the stripe-tiling threshold must take
+    # the packed XLA scan (uint32 planes), not the int8 stencil
+    rng = np.random.default_rng(6)
+    rule = get_rule("conway")
+    be = _backend(block_rows=256, block_cols=512)
+    b = rng.integers(0, 2, size=(40, 200), dtype=np.int8)
+    runner = be.prepare(b, rule)
+    assert np.asarray(runner.x).dtype == np.uint32
+    np.testing.assert_array_equal(be.run(b, rule, 12), run_np(b, rule, 12))
+
+
 @pytest.mark.parametrize("bitpack", [True, False])
 def test_single_tile_grid(bitpack):
     # exactly one tile in each grid dimension

@@ -419,7 +419,19 @@ class PallasBackend:
         )
         halo = rule.radius * block_steps
         if h < self.block_rows or w < self.block_cols:
-            # small board: the fused XLA scan is already VMEM-resident there
+            # small board: the fused XLA scan is already VMEM-resident there;
+            # keep the bit-sliced fast path when the rule allows it, exactly
+            # as JaxBackend does
+            if self.bitpack and bitlife.supports(rule):
+                x = jax.device_put(
+                    bitlife.pack_np(np.asarray(board, np.int8)), self.device
+                )
+                advance = lambda x, n: bitlife.multi_step_packed(
+                    x, rule=rule, steps=n, logical_shape=logical
+                )
+                return DeviceRunner(
+                    x, advance, lambda x: bitlife.unpack_np(np.asarray(x), w)
+                )
             wp = ceil_to(w, LANE)
             x = jax.device_put(pad_board(board, h, wp), self.device)
             advance = lambda x, n: multi_step(x, rule=rule, steps=n, logical_shape=logical)
