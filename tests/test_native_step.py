@@ -1,0 +1,93 @@
+"""Native C++ threaded stepper vs the NumPy truth executor.
+
+Bit-identical on every (board, rule, steps) — the cross-backend invariant
+that is the framework's test strategy (SURVEY.md §4).  Builds
+native/libtpulife_step.so once per session; skips if no compiler.
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from tpu_life.models.rules import get_rule, parse_rule
+from tpu_life.ops import native_step
+from tpu_life.ops.reference import run_np
+
+pytestmark = pytest.mark.skipif(
+    not native_step.build(), reason="native step library unavailable"
+)
+
+
+def _board(rng, shape, rule):
+    if rule.states == 2:
+        return rng.integers(0, 2, size=shape, dtype=np.int8)
+    return (
+        rng.integers(0, rule.states, size=shape, dtype=np.int8)
+        * rng.integers(0, 2, size=shape, dtype=np.int8)
+    )
+
+
+@pytest.mark.parametrize(
+    "spec,shape,steps",
+    [
+        ("conway", (97, 130), 9),
+        ("highlife", (64, 64), 6),
+        ("daynight", (50, 81), 5),
+        ("brians-brain", (60, 60), 8),  # Generations decay states
+        ("R5,C2,M0,S34..58,B34..45", (80, 90), 3),  # LtL radius 5 (Bugs)
+        ("R2,C2,M1,S5..10,B5..8", (40, 40), 4),  # include_center variant
+    ],
+)
+def test_matches_reference(spec, shape, steps):
+    rng = np.random.default_rng(zlib.crc32(spec.encode()))
+    try:
+        rule = get_rule(spec)
+    except KeyError:
+        rule = parse_rule(spec)
+    b = _board(rng, shape, rule)
+    np.testing.assert_array_equal(
+        native_step.run_native(b, rule, steps), run_np(b, rule, steps)
+    )
+
+
+def test_thread_count_invariance():
+    # same answer at 1, 2, and 7 threads (uneven row split)
+    rng = np.random.default_rng(7)
+    rule = get_rule("conway")
+    b = rng.integers(0, 2, size=(101, 67), dtype=np.int8)
+    outs = [native_step.run_native(b, rule, 11, threads=t) for t in (1, 2, 7)]
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[0], outs[2])
+    np.testing.assert_array_equal(outs[0], run_np(b, rule, 11))
+
+
+def test_tiny_boards_and_zero_steps():
+    rule = get_rule("conway")
+    b = np.ones((1, 1), dtype=np.int8)
+    np.testing.assert_array_equal(native_step.run_native(b, rule, 3), run_np(b, rule, 3))
+    np.testing.assert_array_equal(native_step.run_native(b, rule, 0), b)
+    b2 = np.ones((2, 3), dtype=np.int8)
+    np.testing.assert_array_equal(native_step.run_native(b2, rule, 5), run_np(b2, rule, 5))
+
+
+def test_input_not_mutated():
+    rng = np.random.default_rng(8)
+    rule = get_rule("conway")
+    b = rng.integers(0, 2, size=(30, 30), dtype=np.int8)
+    keep = b.copy()
+    native_step.run_native(b, rule, 4)
+    np.testing.assert_array_equal(b, keep)
+
+
+def test_backend_registered_and_chunked():
+    from tpu_life.backends.base import get_backend
+
+    be = get_backend("native")
+    rng = np.random.default_rng(9)
+    rule = get_rule("conway")
+    b = rng.integers(0, 2, size=(64, 64), dtype=np.int8)
+    seen = []
+    out = be.run(b, rule, 10, chunk_steps=4, callback=lambda s, g: seen.append(s))
+    np.testing.assert_array_equal(out, run_np(b, rule, 10))
+    assert seen == [4, 8, 10]

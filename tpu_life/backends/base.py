@@ -161,6 +161,8 @@ def get_backend(name: str, **kwargs) -> Backend:
                 from tpu_life.backends import pallas_backend  # noqa: F401
             elif name in ("stripes", "mpi"):
                 from tpu_life.backends import stripes_backend  # noqa: F401
+            elif name == "native":
+                from tpu_life.backends import native_backend  # noqa: F401
         except ImportError as e:
             raise ValueError(f"backend {name!r} is unavailable: {e}") from e
     if name not in BACKENDS:

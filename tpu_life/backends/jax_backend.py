@@ -59,6 +59,18 @@ class DeviceRunner:
         return lambda x=self.x: self._to_np(x)
 
 
+def packed_device_runner(board: np.ndarray, rule: Rule, device) -> DeviceRunner:
+    """DeviceRunner over the bit-sliced board representation (life-like
+    rules): 32 cells per uint32 lane, fused packed scan.  Shared by the
+    ``jax`` backend and the ``pallas`` backend's small-board fallback."""
+    h, w = board.shape
+    x = jax.device_put(bitlife.pack_np(np.asarray(board, np.int8)), device)
+    advance = lambda x, n: bitlife.multi_step_packed(
+        x, rule=rule, steps=n, logical_shape=(h, w)
+    )
+    return DeviceRunner(x, advance, lambda x: bitlife.unpack_np(np.asarray(x), w))
+
+
 @register_backend("jax")
 class JaxBackend:
     name = "jax"
@@ -71,21 +83,14 @@ class JaxBackend:
     def prepare(self, board: np.ndarray, rule: Rule) -> Runner:
         h, w = board.shape
         logical = (h, w)
-        use_bits = self.bitpack and bitlife.supports(rule)
-        if use_bits:
-            x = jax.device_put(bitlife.pack_np(np.asarray(board, np.int8)), self.device)
-            advance = lambda x, n: bitlife.multi_step_packed(
-                x, rule=rule, steps=n, logical_shape=logical
-            )
-            to_np = lambda x: bitlife.unpack_np(np.asarray(x), w)
-        else:
-            w_pad = ceil_to(w, LANE) if self.pad_lanes else w
-            x = jax.device_put(pad_board(board, h, w_pad), self.device)
-            advance = lambda x, n: multi_step(
-                x, rule=rule, steps=n, logical_shape=logical
-            )
-            to_np = lambda x: np.asarray(x)[:h, :w]
-        return DeviceRunner(x, advance, to_np)
+        if self.bitpack and bitlife.supports(rule):
+            return packed_device_runner(board, rule, self.device)
+        w_pad = ceil_to(w, LANE) if self.pad_lanes else w
+        x = jax.device_put(pad_board(board, h, w_pad), self.device)
+        advance = lambda x, n: multi_step(
+            x, rule=rule, steps=n, logical_shape=logical
+        )
+        return DeviceRunner(x, advance, lambda x: np.asarray(x)[:h, :w])
 
     def run(
         self,

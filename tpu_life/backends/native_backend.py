@@ -1,0 +1,55 @@
+"""Native C++ threaded CPU backend.
+
+The reference's compute path is native C++ (Parallel_Life_MPI.cpp:16-54);
+this backend is the framework's native CPU lineage of it — the pthread
+stripe-parallel LUT stencil in native/life.cpp — sitting beside the NumPy
+truth executor and the JAX device backends, bit-identical to both on every
+(board, rule, steps).  Builds the library on first use when a compiler is
+present; refuses cleanly otherwise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_life.backends.base import ChunkCallback, chunk_sizes, register_backend
+from tpu_life.models.rules import Rule
+from tpu_life.ops import native_step
+
+
+@register_backend("native")
+class NativeBackend:
+    name = "native"
+
+    def __init__(self, *, threads: int | None = None, **_):
+        if not native_step.available() and not native_step.build():
+            import os
+
+            if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
+                raise RuntimeError(
+                    "native backend unavailable: disabled by TPU_LIFE_NATIVE=0"
+                )
+            raise RuntimeError(
+                "native backend unavailable: libtpulife_step.so not built "
+                "and no working compiler (make -C native)"
+            )
+        self.threads = threads
+
+    def run(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        steps: int,
+        *,
+        chunk_steps: int = 0,
+        callback: ChunkCallback | None = None,
+    ) -> np.ndarray:
+        board = np.asarray(board, dtype=np.int8)
+        done = 0
+        for n in chunk_sizes(steps, chunk_steps):
+            board = native_step.run_native(board, rule, n, threads=self.threads)
+            done += n
+            if callback is not None:
+                b = board
+                callback(done, lambda b=b: b)
+        return board

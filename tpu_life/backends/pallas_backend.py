@@ -49,7 +49,7 @@ from tpu_life.backends.base import (
     register_backend,
     run_with_runner,
 )
-from tpu_life.backends.jax_backend import DeviceRunner
+from tpu_life.backends.jax_backend import DeviceRunner, packed_device_runner
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
 from tpu_life.ops.stencil import apply_rule, multi_step
@@ -420,18 +420,9 @@ class PallasBackend:
         halo = rule.radius * block_steps
         if h < self.block_rows or w < self.block_cols:
             # small board: the fused XLA scan is already VMEM-resident there;
-            # keep the bit-sliced fast path when the rule allows it, exactly
-            # as JaxBackend does
+            # keep the bit-sliced fast path when the rule allows it
             if self.bitpack and bitlife.supports(rule):
-                x = jax.device_put(
-                    bitlife.pack_np(np.asarray(board, np.int8)), self.device
-                )
-                advance = lambda x, n: bitlife.multi_step_packed(
-                    x, rule=rule, steps=n, logical_shape=logical
-                )
-                return DeviceRunner(
-                    x, advance, lambda x: bitlife.unpack_np(np.asarray(x), w)
-                )
+                return packed_device_runner(board, rule, self.device)
             wp = ceil_to(w, LANE)
             x = jax.device_put(pad_board(board, h, wp), self.device)
             advance = lambda x, n: multi_step(x, rule=rule, steps=n, logical_shape=logical)

@@ -56,7 +56,7 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument(
         "--backend",
         default="auto",
-        choices=["auto", "numpy", "jax", "sharded", "stripes", "mpi", "pallas"],
+        choices=["auto", "numpy", "native", "jax", "sharded", "stripes", "mpi", "pallas"],
     )
     r.add_argument("--num-devices", type=int, default=None)
     r.add_argument(

@@ -60,6 +60,8 @@ def available() -> bool:
 def build(force: bool = False) -> bool:
     """Compile the native library in-tree (requires g++); returns success."""
     global _lib
+    if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
+        return False  # explicitly disabled — don't compile behind the user's back
     if _lib is not None and not force:
         return True
     try:

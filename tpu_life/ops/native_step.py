@@ -1,0 +1,100 @@
+"""ctypes binding to the native compute runtime (native/life.cpp).
+
+The native CPU counterpart of the reference's `countNeighbours`/`updateGrid`
+hot loop (Parallel_Life_MPI.cpp:16-54): a pthread-parallel sliding-window
+box-sum stencil driven by the same transition LUT the XLA and Pallas kernels
+index.  Loads ``libtpulife_step.so`` if present (``make -C native``); callers
+check :func:`available` and fall back to the NumPy executor when the library
+is missing.  ``TPU_LIFE_NATIVE=0`` disables the native path outright.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from tpu_life.models.rules import Rule
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_NAME = "libtpulife_step.so"
+
+
+def _default_threads() -> int:
+    return min(16, os.cpu_count() or 1)
+
+
+def _load() -> ctypes.CDLL | None:
+    if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
+        return None
+    candidates = [
+        Path(os.environ.get("TPU_LIFE_NATIVE_STEP_LIB", "")),
+        _NATIVE_DIR / _LIB_NAME,
+    ]
+    for p in candidates:
+        if p and p.is_file():
+            try:
+                lib = ctypes.CDLL(str(p))
+            except OSError:
+                continue
+            lib.tl_run.restype = ctypes.c_int
+            return lib
+    return None
+
+
+_lib = _load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library in-tree (requires g++); returns success."""
+    global _lib
+    if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
+        return False  # explicitly disabled — don't compile behind the user's back
+    if _lib is not None and not force:
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR), _LIB_NAME],
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    _lib = _load()
+    return _lib is not None
+
+
+def run_native(
+    board: np.ndarray, rule: Rule, steps: int, *, threads: int | None = None
+) -> np.ndarray:
+    """Advance ``board`` ``steps`` generations on the native threaded stepper.
+
+    Returns a new array; the input is not modified.
+    """
+    if _lib is None:
+        raise RuntimeError("native step library not loaded (make -C native)")
+    out = np.ascontiguousarray(board, dtype=np.int8).copy()
+    h, w = out.shape
+    lut = np.ascontiguousarray(rule.transition_table, dtype=np.int8)
+    rc = _lib.tl_run(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_long(h),
+        ctypes.c_long(w),
+        lut.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int(rule.states),
+        ctypes.c_int(rule.max_count),
+        ctypes.c_int(rule.radius),
+        ctypes.c_int(1 if rule.include_center else 0),
+        ctypes.c_long(steps),
+        ctypes.c_int(threads or _default_threads()),
+    )
+    if rc != 0:
+        raise ValueError(f"native step failed: rc={rc}")
+    return out
