@@ -1,0 +1,95 @@
+"""Weak-scaling harness: efficiency of the sharded backend vs device count.
+
+Weak scaling in the reference's sense: board height grows with the shard
+count (each rank keeps a constant stripe, README.md:6), so perfect scaling
+is constant time per step.  Efficiency(n) = T(1) / T(n) with per-device
+work held fixed.
+
+On a real TPU slice this measures the ppermute/ICI overhead directly
+(the BASELINE.md >= 90% v4-8 -> v4-64 target).  On this single-chip dev box
+run it over N virtual CPU devices to validate the *shape* of the scaling
+path — the collective schedule is identical, only the interconnect is fake:
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python experiments/scaling_bench.py --rows-per-device 1024 --width 1024
+
+Prints one JSON line per device count: {n, seconds_per_step, efficiency}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows-per-device", type=int, default=1024)
+    p.add_argument("--width", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--warmup-steps", type=int, default=8)
+    p.add_argument("--rule", default="conway")
+    p.add_argument("--block-steps", type=int, default=4)
+    p.add_argument("--no-bitpack", action="store_true")
+    p.add_argument("--devices", type=int, nargs="*", default=None,
+                   help="device counts to sweep; default 1,2,4,...,len(jax.devices())")
+    args = p.parse_args()
+
+    import jax
+
+    from tpu_life.backends.base import make_runner
+    from tpu_life.backends.sharded_backend import ShardedBackend
+    from tpu_life.models.rules import get_rule
+    from tpu_life.parallel.mesh import make_mesh
+
+    rule = get_rule(args.rule)
+    avail = len(jax.devices())
+    counts = args.devices
+    if not counts:
+        counts, n = [], 1
+        while n <= avail:
+            counts.append(n)
+            n *= 2
+
+    t1 = None
+    for n in counts:
+        h = args.rows_per_device * n
+        rng = np.random.default_rng(0)
+        board = rng.integers(0, 2, size=(h, args.width), dtype=np.int8)
+        backend = ShardedBackend(
+            mesh=make_mesh(n),
+            block_steps=args.block_steps,
+            bitpack=not args.no_bitpack,
+        )
+        runner = make_runner(backend, board, rule)
+        runner.advance(args.warmup_steps)  # compile + warm
+        runner.sync()
+        t0 = time.perf_counter()
+        runner.advance(args.steps)
+        runner.sync()
+        dt = (time.perf_counter() - t0) / args.steps
+        if t1 is None:
+            t1, baseline_n = dt, n
+        print(
+            json.dumps(
+                {
+                    "n_devices": n,
+                    "board": [h, args.width],
+                    "seconds_per_step": round(dt, 6),
+                    "cells_per_sec": round(h * args.width / dt, 1),
+                    # T(baseline)/T(n); equals the docstring's Efficiency(n)
+                    # only when the sweep starts at n=1
+                    "efficiency": round(t1 / dt, 4),
+                    "baseline_n": baseline_n,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

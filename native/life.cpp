@@ -139,7 +139,7 @@ int tl_run(int8_t* grid, long h, long w, const int8_t* lut, int states,
            int max_count, int radius, int include_center, long steps,
            int threads) {
   if (h <= 0 || w <= 0 || states < 2 || radius < 1 || steps < 0) return -2;
-  if (max_count + 1 < (2 * radius + 1) * (2 * radius + 1) - !include_center)
+  if (max_count < (2 * radius + 1) * (2 * radius + 1) - !include_center)
     return -2;
   if (steps == 0) return 0;
 

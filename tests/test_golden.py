@@ -1,0 +1,49 @@
+"""Golden-hash anchors: pin the exact evolution of fixed workloads.
+
+The reference's only verification affordance is its deterministic I/O
+contract (SURVEY.md §4); these hashes are that contract distilled — any
+semantic drift in the rule engine, stencil, packing, or codec shows up as a
+hash change, independent of the cross-backend equality tests (which would
+pass if every backend drifted together).  Hand-verified anchors for the
+small patterns live in test_rules.py; these pin larger random boards.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from tpu_life.backends.base import get_backend
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+
+GOLDEN = {
+    # (rule, h, w, density, states, seed, steps) -> sha256 of final int8 board
+    ("conway", 96, 130, 0.5, 2, 2026, 64): (
+        "17bdd8b44932bba546ae3ed088160002340c2a61a3a42a5c5b750be0a7c534ac"
+    ),
+    ("highlife", 96, 130, 0.5, 2, 2026, 64): (
+        "6a844058f06820cdb945542f641da99a859ff1ed41be16c5d3043d41bf124e8d"
+    ),
+    ("brians-brain", 80, 80, 0.3, 3, 7, 40): (
+        "7806419713eb4d223ff596a76e4556ba38dad272cc53a0c99108f5e23c9c1b5f"
+    ),
+}
+
+
+@pytest.mark.parametrize("key,digest", sorted(GOLDEN.items()))
+def test_numpy_golden(key, digest):
+    rule_name, h, w, density, states, seed, steps = key
+    b = random_board(h, w, density, states=states, seed=seed)
+    out = run_np(b, get_rule(rule_name), steps)
+    assert hashlib.sha256(out.tobytes()).hexdigest() == digest
+
+
+@pytest.mark.parametrize("backend", ["jax", "sharded"])
+def test_device_backends_hit_golden(backend):
+    key = ("conway", 96, 130, 0.5, 2, 2026, 64)
+    rule_name, h, w, density, states, seed, steps = key
+    b = random_board(h, w, density, states=states, seed=seed)
+    out = get_backend(backend).run(b, get_rule(rule_name), steps)
+    assert hashlib.sha256(np.asarray(out, np.int8).tobytes()).hexdigest() == GOLDEN[key]

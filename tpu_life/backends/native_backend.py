@@ -44,7 +44,9 @@ class NativeBackend:
         chunk_steps: int = 0,
         callback: ChunkCallback | None = None,
     ) -> np.ndarray:
-        board = np.asarray(board, dtype=np.int8)
+        # fresh array even for steps=0 — every backend returns a board the
+        # caller may mutate without aliasing the input
+        board = np.array(board, dtype=np.int8)
         done = 0
         for n in chunk_sizes(steps, chunk_steps):
             board = native_step.run_native(board, rule, n, threads=self.threads)

@@ -10,12 +10,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-from pathlib import Path
 
 import numpy as np
 
-_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+from tpu_life.utils import nativelib
+from tpu_life.utils.nativelib import default_threads as _default_threads
+
 _LIB_NAME = "libtpulife_io.so"
 
 _ERRORS = {
@@ -25,29 +25,12 @@ _ERRORS = {
 }
 
 
-def _default_threads() -> int:
-    return min(16, os.cpu_count() or 1)
-
-
 def _load() -> ctypes.CDLL | None:
-    if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
-        return None
-    candidates = [
-        Path(os.environ.get("TPU_LIFE_NATIVE_LIB", "")),
-        _NATIVE_DIR / _LIB_NAME,
-    ]
-    for p in candidates:
-        if p and p.is_file():
-            try:
-                lib = ctypes.CDLL(str(p))
-            except OSError:
-                continue
-            lib.tl_decode.restype = ctypes.c_int
-            lib.tl_encode.restype = ctypes.c_int
-            lib.tl_read_stripe.restype = ctypes.c_int
-            lib.tl_write_stripe.restype = ctypes.c_int
-            return lib
-    return None
+    return nativelib.load_library(
+        _LIB_NAME,
+        env_override="TPU_LIFE_NATIVE_LIB",
+        int_functions=["tl_decode", "tl_encode", "tl_read_stripe", "tl_write_stripe"],
+    )
 
 
 _lib = _load()
@@ -60,17 +43,9 @@ def available() -> bool:
 def build(force: bool = False) -> bool:
     """Compile the native library in-tree (requires g++); returns success."""
     global _lib
-    if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
-        return False  # explicitly disabled — don't compile behind the user's back
     if _lib is not None and not force:
         return True
-    try:
-        subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR), _LIB_NAME],
-            check=True,
-            capture_output=True,
-        )
-    except (subprocess.CalledProcessError, FileNotFoundError):
+    if not nativelib.build_library(_LIB_NAME):
         return False
     _lib = _load()
     return _lib is not None

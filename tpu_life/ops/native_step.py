@@ -11,38 +11,21 @@ is missing.  ``TPU_LIFE_NATIVE=0`` disables the native path outright.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-from pathlib import Path
 
 import numpy as np
 
 from tpu_life.models.rules import Rule
+from tpu_life.utils import nativelib
 
-_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
 _LIB_NAME = "libtpulife_step.so"
 
 
-def _default_threads() -> int:
-    return min(16, os.cpu_count() or 1)
-
-
 def _load() -> ctypes.CDLL | None:
-    if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
-        return None
-    candidates = [
-        Path(os.environ.get("TPU_LIFE_NATIVE_STEP_LIB", "")),
-        _NATIVE_DIR / _LIB_NAME,
-    ]
-    for p in candidates:
-        if p and p.is_file():
-            try:
-                lib = ctypes.CDLL(str(p))
-            except OSError:
-                continue
-            lib.tl_run.restype = ctypes.c_int
-            return lib
-    return None
+    return nativelib.load_library(
+        _LIB_NAME,
+        env_override="TPU_LIFE_NATIVE_STEP_LIB",
+        int_functions=["tl_run"],
+    )
 
 
 _lib = _load()
@@ -55,17 +38,9 @@ def available() -> bool:
 def build(force: bool = False) -> bool:
     """Compile the native library in-tree (requires g++); returns success."""
     global _lib
-    if os.environ.get("TPU_LIFE_NATIVE", "1") == "0":
-        return False  # explicitly disabled — don't compile behind the user's back
     if _lib is not None and not force:
         return True
-    try:
-        subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR), _LIB_NAME],
-            check=True,
-            capture_output=True,
-        )
-    except (subprocess.CalledProcessError, FileNotFoundError):
+    if not nativelib.build_library(_LIB_NAME):
         return False
     _lib = _load()
     return _lib is not None
@@ -80,7 +55,7 @@ def run_native(
     """
     if _lib is None:
         raise RuntimeError("native step library not loaded (make -C native)")
-    out = np.ascontiguousarray(board, dtype=np.int8).copy()
+    out = np.array(board, dtype=np.int8, order="C")  # exactly one fresh copy
     h, w = out.shape
     lut = np.ascontiguousarray(rule.transition_table, dtype=np.int8)
     rc = _lib.tl_run(
@@ -93,7 +68,7 @@ def run_native(
         ctypes.c_int(rule.radius),
         ctypes.c_int(1 if rule.include_center else 0),
         ctypes.c_long(steps),
-        ctypes.c_int(threads or _default_threads()),
+        ctypes.c_int(threads or nativelib.default_threads()),
     )
     if rc != 0:
         raise ValueError(f"native step failed: rc={rc}")

@@ -1,0 +1,64 @@
+"""Shared ctypes loader for the in-tree native libraries (native/*.cpp).
+
+Both native modules — the I/O codec (tpu_life/io/native.py) and the compute
+stepper (tpu_life/ops/native_step.py) — load a shared object from
+``native/``, honor the same ``TPU_LIFE_NATIVE=0`` kill switch, and build
+in-tree via ``make`` on demand.  This module is that loader, once.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+
+
+def disabled() -> bool:
+    return os.environ.get("TPU_LIFE_NATIVE", "1") == "0"
+
+
+def default_threads() -> int:
+    return min(16, os.cpu_count() or 1)
+
+
+def load_library(
+    lib_name: str, *, env_override: str, int_functions: list[str]
+) -> ctypes.CDLL | None:
+    """Load ``native/<lib_name>`` (or the ``env_override`` path), marking
+    each named entry point as returning ``int``.  None when disabled,
+    missing, or unloadable."""
+    if disabled():
+        return None
+    candidates = [
+        Path(os.environ.get(env_override, "")),
+        NATIVE_DIR / lib_name,
+    ]
+    for p in candidates:
+        if p and p.is_file():
+            try:
+                lib = ctypes.CDLL(str(p))
+            except OSError:
+                continue
+            for fn in int_functions:
+                getattr(lib, fn).restype = ctypes.c_int
+            return lib
+    return None
+
+
+def build_library(lib_name: str) -> bool:
+    """``make -C native <lib_name>``; False when disabled or the build
+    fails (no compiler, make missing)."""
+    if disabled():
+        return False  # explicitly disabled — don't compile behind the user's back
+    try:
+        subprocess.run(
+            ["make", "-C", str(NATIVE_DIR), lib_name],
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    return True
