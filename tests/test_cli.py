@@ -95,3 +95,36 @@ def test_output_resume_roundtrip(workload):
     ) == 0
     got = read_board(tmp / "out2.txt", 60, 37)
     np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 12))
+
+
+def test_mesh_shape_2d(workload):
+    tmp, board = workload
+    assert (
+        main(
+            ["run", "--backend", "sharded", "--mesh-shape", "2,4",
+             "--output-file", "out_2d.txt"]
+        )
+        == 0
+    )
+    got = read_board(tmp / "out_2d.txt", 60, 37)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 12))
+
+
+def test_mesh_shape_rejects_garbage(workload, capsys):
+    with pytest.raises(SystemExit):
+        main(["run", "--mesh-shape", "2x4"])
+    assert "--mesh-shape" in capsys.readouterr().err
+
+
+def test_mesh_shape_forces_sharded_backend(workload):
+    # `auto` + --mesh-shape must resolve to the sharded backend, not silently
+    # drop the mesh on a single-device default path
+    tmp, board = workload
+    assert main(["run", "--mesh-shape", "2,4", "--output-file", "out_auto2d.txt"]) == 0
+    got = read_board(tmp / "out_auto2d.txt", 60, 37)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 12))
+
+
+def test_mesh_shape_rejects_other_backends(workload):
+    with pytest.raises(ValueError, match="mesh-shape requires"):
+        main(["run", "--backend", "numpy", "--mesh-shape", "2,4"])

@@ -86,3 +86,39 @@ def test_callback_chunking(rng_board):
     assert [s for s, _ in seen] == [4, 8, 10]
     np.testing.assert_array_equal(seen[-1][1], out)
     np.testing.assert_array_equal(out, run_np(b, rule, 10))
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2), (2, 2), (1, 8)])
+def test_2d_mesh_matches_reference(mesh_shape, rng_board):
+    rule = get_rule("conway")
+    b = rng_board(70, 150, seed=21)  # uneven in both axes
+    expect = run_np(b, rule, 9)
+    be = ShardedBackend(mesh_shape=mesh_shape)
+    np.testing.assert_array_equal(be.run(b, rule, 9), expect)
+
+
+@pytest.mark.parametrize("block_steps", [1, 3])
+def test_2d_mesh_deep_halo(block_steps, rng_board):
+    # deep halos in both axes: corners must propagate through the two-phase
+    # (rows then row-extended cols) exchange
+    rule = get_rule("conway")
+    b = rng_board(64, 160, seed=22)
+    expect = run_np(b, rule, 12)
+    be = ShardedBackend(mesh_shape=(2, 4), block_steps=block_steps)
+    np.testing.assert_array_equal(be.run(b, rule, 12), expect)
+
+
+def test_2d_mesh_radius2(rng_board):
+    rule = parse_rule("R2,C2,M0,S8..13,B10..12")
+    b = rng_board(48, 140, seed=23)
+    expect = run_np(b, rule, 5)
+    be = ShardedBackend(mesh_shape=(2, 2), block_steps=2)
+    np.testing.assert_array_equal(be.run(b, rule, 5), expect)
+
+
+def test_2d_gspmd_matches(rng_board):
+    rule = get_rule("conway")
+    b = rng_board(40, 130, seed=24)
+    expect = run_np(b, rule, 7)
+    be = ShardedBackend(mesh_shape=(2, 2), partition_mode="gspmd")
+    np.testing.assert_array_equal(be.run(b, rule, 7), expect)

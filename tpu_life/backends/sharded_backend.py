@@ -1,7 +1,10 @@
 """Row-sharded multi-device backend — the framework's distributed core.
 
 The board lives as one global array stripe-sharded over a 1-D mesh
-(``NamedSharding(P('rows', None))``); halos move over ICI via ``ppermute``
+(``NamedSharding(P('rows', None))``) — or block-sharded over a 2-D
+rows × cols mesh (``mesh_shape=(r, c)``), which goes beyond the reference's
+stripe decomposition and keeps halo traffic proportional to the shard
+perimeter; halos move over ICI via ``ppermute``
 (``tpu_life.parallel.halo``).  Life-like rules run bit-sliced (uint32
 bitboard, 32 cells/lane — ``tpu_life.ops.bitlife``), which also shrinks
 each halo exchange 32x.  Two partitioning modes:
@@ -31,8 +34,14 @@ from tpu_life.backends.base import ChunkCallback, register_backend, run_with_run
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
 from tpu_life.ops.stencil import make_masked_step
-from tpu_life.parallel.halo import make_sharded_run
-from tpu_life.parallel.mesh import ROW_AXIS, board_sharding, make_mesh
+from tpu_life.parallel.halo import make_sharded_run, make_sharded_run_2d
+from tpu_life.parallel.mesh import (
+    COL_AXIS,
+    ROW_AXIS,
+    board_sharding,
+    make_mesh,
+    make_mesh_2d,
+)
 from tpu_life.utils.padding import LANE, ceil_to
 
 
@@ -49,10 +58,26 @@ class ShardedBackend:
         pad_lanes: bool = True,
         bitpack: bool = True,
         mesh=None,
+        mesh_shape: tuple[int, int] | None = None,
         **_,
     ):
-        self.mesh = mesh if mesh is not None else make_mesh(num_devices)
+        if mesh_shape is not None and num_devices is not None:
+            r, c = mesh_shape
+            if r * c != num_devices:
+                raise ValueError(
+                    f"mesh_shape {mesh_shape} ({r * c} devices) contradicts "
+                    f"num_devices={num_devices}"
+                )
+        if mesh is not None:
+            self.mesh = mesh
+        elif mesh_shape is not None and mesh_shape[1] > 1:
+            self.mesh = make_mesh_2d(tuple(mesh_shape))
+        elif mesh_shape is not None:
+            self.mesh = make_mesh(mesh_shape[0])
+        else:
+            self.mesh = make_mesh(num_devices)
         self.n = self.mesh.shape[ROW_AXIS]
+        self.n_cols = self.mesh.shape.get(COL_AXIS, 1)
         self.block_steps = max(1, block_steps)
         if partition_mode not in ("shard_map", "gspmd"):
             raise ValueError(f"unknown partition_mode {partition_mode!r}")
@@ -86,7 +111,8 @@ class ShardedBackend:
     def prepare(self, board: np.ndarray, rule: Rule):
         h, w = board.shape
         logical = (h, w)
-        use_bits = self.bitpack and bitlife.supports(rule)
+        # the packed bitboard stays 1-D: a column split would land mid-word
+        use_bits = self.bitpack and self.n_cols == 1 and bitlife.supports(rule)
 
         # shard height must divide evenly; keep sublane (8) alignment per shard
         h_pad = ceil_to(h, self.n * 8)
@@ -99,17 +125,26 @@ class ShardedBackend:
             to_np = lambda x: bitlife.unpack_np(np.asarray(x)[:h], w)
         else:
             host = np.asarray(board, np.int8)
-            w_phys = ceil_to(w, LANE) if self.pad_lanes else w
+            unit = LANE if self.pad_lanes else 1
+            w_phys = ceil_to(w, self.n_cols * unit)
             to_np = lambda x: np.asarray(x)[:h, :w]
+        if self.n_cols > 1:
+            shard_w = w_phys // self.n_cols
+            block_steps = max(1, min(block_steps, shard_w // rule.radius))
         x = self._device_put_sharded(host, h_pad, w_phys)
 
         runs: dict[int, object] = {}
 
         def get_run(bs: int):
             if bs not in runs:
-                runs[bs] = make_sharded_run(
-                    rule, self.mesh, logical, block_steps=bs, packed=use_bits
-                )
+                if self.n_cols > 1:
+                    runs[bs] = make_sharded_run_2d(
+                        rule, self.mesh, logical, block_steps=bs
+                    )
+                else:
+                    runs[bs] = make_sharded_run(
+                        rule, self.mesh, logical, block_steps=bs, packed=use_bits
+                    )
             return runs[bs]
 
         gspmd_run = (

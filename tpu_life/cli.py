@@ -60,6 +60,13 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     )
     r.add_argument("--num-devices", type=int, default=None)
     r.add_argument(
+        "--mesh-shape",
+        default=None,
+        metavar="R,C",
+        help="2-D rows,cols device mesh for the sharded backend "
+        "(block decomposition; halo traffic ~ shard perimeter)",
+    )
+    r.add_argument(
         "--platform",
         default=None,
         help="force a JAX platform (cpu/tpu); also via TPU_LIFE_PLATFORM env",
@@ -88,6 +95,18 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument("--verbose", "-v", action="store_true")
 
 
+def _parse_mesh_shape(parser, spec: str | None) -> tuple[int, int] | None:
+    if spec is None:
+        return None
+    try:
+        parts = tuple(int(v) for v in spec.split(","))
+    except ValueError:
+        parts = ()
+    if len(parts) != 2 or min(parts) < 1:
+        parser.error(f"--mesh-shape must be two positive ints 'R,C', got {spec!r}")
+    return parts
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
@@ -114,6 +133,7 @@ def main(argv: list[str] | None = None) -> int:
         bug_compat=args.bug_compat,
         backend=args.backend,
         num_devices=args.num_devices,
+        mesh_shape=_parse_mesh_shape(parser, args.mesh_shape),
         block_steps=args.block_steps,
         partition_mode=args.partition_mode,
         sync_every=args.sync_every,

@@ -32,8 +32,9 @@ class RunConfig:
     bug_compat: bool = False  # replicate the shipped binary's effective B/S2 rule
 
     # execution
-    backend: str = "auto"  # auto | numpy | jax | sharded | stripes | mpi
+    backend: str = "auto"  # auto | numpy | native | jax | sharded | stripes | mpi | pallas
     num_devices: int | None = None
+    mesh_shape: tuple[int, int] | None = None  # 2-D rows x cols mesh (sharded)
     # CA steps per halo exchange / HBM pass (deep halos); None keeps each
     # backend's own default (sharded: 1, pallas: 8)
     block_steps: int | None = None

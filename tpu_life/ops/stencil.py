@@ -96,6 +96,7 @@ def validity_mask(
     shape: tuple[int, int],
     logical_shape: tuple[int, int],
     row_offset: jax.Array | int = 0,
+    col_offset: jax.Array | int = 0,
 ) -> jax.Array:
     """Bool mask of cells that exist on the *logical* board.
 
@@ -103,13 +104,17 @@ def validity_mask(
     columns toward the 128-lane width).  Padding cells must stay dead forever
     — a cell outside the logical board that flips alive would leak births
     back across the boundary, violating the reference's clamped-edge
-    semantics.  ``row_offset`` is the global row index of physical row 0
-    (traced, for use inside shard_map).
+    semantics.  ``row_offset``/``col_offset`` are the global indices of
+    physical cell (0, 0) (traced, for use inside shard_map; ``col_offset``
+    matters on 2-D meshes where columns are sharded too).
     """
     h, w = shape
     lh, lw = logical_shape
     grow = row_offset + jnp.arange(h)
-    return ((grow >= 0) & (grow < lh))[:, None] & (jnp.arange(w) < lw)[None, :]
+    gcol = col_offset + jnp.arange(w)
+    return ((grow >= 0) & (grow < lh))[:, None] & (
+        (gcol >= 0) & (gcol < lw)
+    )[None, :]
 
 
 def make_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
@@ -128,8 +133,12 @@ def make_masked_step(
     """A step that also pins physical padding cells dead (see validity_mask)."""
     step = make_step(rule)
 
-    def masked(board: jax.Array, row_offset: jax.Array | int = 0) -> jax.Array:
-        mask = validity_mask(board.shape, logical_shape, row_offset)
+    def masked(
+        board: jax.Array,
+        row_offset: jax.Array | int = 0,
+        col_offset: jax.Array | int = 0,
+    ) -> jax.Array:
+        mask = validity_mask(board.shape, logical_shape, row_offset, col_offset)
         return jnp.where(mask, step(board), jnp.int8(0))
 
     return masked

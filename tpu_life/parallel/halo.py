@@ -41,7 +41,7 @@ except ImportError:  # pragma: no cover
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
 from tpu_life.ops.stencil import make_masked_step
-from tpu_life.parallel.mesh import ROW_AXIS
+from tpu_life.parallel.mesh import COL_AXIS, ROW_AXIS
 
 
 def halo_depth(rule: Rule, block_steps: int) -> int:
@@ -67,13 +67,16 @@ def make_sharded_run(
     a uint32 bitboard (``tpu_life.ops.bitlife``) — the ring exchange is
     identical, just 32x narrower.
     """
+    if not packed:
+        # the unpacked 1-D stripe is the n_cols=1 special case of the 2-D
+        # block decomposition — one builder, one halo/scan/jit scaffold
+        return make_sharded_run_2d(
+            rule, mesh, logical_shape, row_axis=axis, block_steps=block_steps
+        )
+
     n = mesh.shape[axis]
     pad = halo_depth(rule, block_steps)
-    masked_step = (
-        bitlife.make_masked_packed_step(rule, tuple(logical_shape))
-        if packed
-        else make_masked_step(rule, tuple(logical_shape))
-    )
+    masked_step = bitlife.make_masked_packed_step(rule, tuple(logical_shape))
     fwd = [(i, i + 1) for i in range(n - 1)]  # shard i's bottom rows -> i+1's top halo
     bwd = [(i + 1, i) for i in range(n - 1)]  # shard i's top rows -> i-1's bottom halo
 
@@ -106,6 +109,83 @@ def make_sharded_run(
             mesh=mesh,
             in_specs=P(axis, None),
             out_specs=P(axis, None),
+        )(board)
+
+    return run
+
+
+def make_sharded_run_2d(
+    rule: Rule,
+    mesh: Mesh,
+    logical_shape: tuple[int, int],
+    *,
+    row_axis: str = ROW_AXIS,
+    col_axis: str = COL_AXIS,
+    block_steps: int = 1,
+) -> Callable[[jax.Array, int], jax.Array]:
+    """2-D block decomposition: halos exchanged along BOTH mesh axes.
+
+    Beyond the reference (which only stripes rows): per-block halo traffic
+    scales with the shard perimeter, the right shape for large meshes.
+    Corners need no dedicated diagonal sends — rows are exchanged first,
+    then the *row-extended* edge columns, so the corner cells ride the
+    column exchange transitively (two hops, same as a 2-D MPI Cart shift
+    would do, but expressed as two ``ppermute`` pairs XLA pipelines over
+    ICI).  int8 path only; the packed bitboard stays 1-D where a column
+    split would land mid-word.  On a mesh without a ``col_axis`` (or with
+    one shard along it) the column phase drops out and this *is* the
+    unpacked 1-D stripe run.
+    """
+    n_r = mesh.shape[row_axis]
+    split_cols = col_axis in mesh.shape and mesh.shape[col_axis] > 1
+    n_c = mesh.shape[col_axis] if split_cols else 1
+    pad = halo_depth(rule, block_steps)
+    masked_step = make_masked_step(rule, tuple(logical_shape))
+    fwd_r = [(i, i + 1) for i in range(n_r - 1)]
+    bwd_r = [(i + 1, i) for i in range(n_r - 1)]
+    fwd_c = [(i, i + 1) for i in range(n_c - 1)]
+    bwd_c = [(i + 1, i) for i in range(n_c - 1)]
+
+    def local_block(chunk: jax.Array) -> jax.Array:
+        hl, wl = chunk.shape
+        ri = lax.axis_index(row_axis)
+        top = lax.ppermute(chunk[hl - pad :, :], row_axis, fwd_r)
+        bot = lax.ppermute(chunk[:pad, :], row_axis, bwd_r)
+        ext = jnp.concatenate([top, chunk, bot], axis=0)
+        row_offset = ri * hl - pad
+        if split_cols:
+            ci = lax.axis_index(col_axis)
+            left = lax.ppermute(ext[:, wl - pad :], col_axis, fwd_c)
+            right = lax.ppermute(ext[:, :pad], col_axis, bwd_c)
+            ext = jnp.concatenate([left, ext, right], axis=1)
+            col_offset = ci * wl - pad
+        else:
+            col_offset = 0
+        for _ in range(block_steps):
+            ext = masked_step(ext, row_offset, col_offset)
+        col0 = pad if split_cols else 0
+        return ext[pad : pad + hl, col0 : col0 + wl]
+
+    def local_run(chunk: jax.Array, num_blocks: int) -> jax.Array:
+        if chunk.shape[0] < pad or (split_cols and chunk.shape[1] < pad):
+            raise ValueError(
+                f"shard {chunk.shape} smaller than halo depth {pad}; "
+                f"lower block_steps or use a smaller mesh"
+            )
+        out, _ = lax.scan(
+            lambda c, _: (local_block(c), None), chunk, None, length=num_blocks
+        )
+        return out
+
+    spec = P(row_axis, col_axis if split_cols else None)
+
+    @partial(jax.jit, static_argnames="num_blocks", donate_argnums=0)
+    def run(board: jax.Array, num_blocks: int) -> jax.Array:
+        return shard_map(
+            partial(local_run, num_blocks=num_blocks),
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
         )(board)
 
     return run

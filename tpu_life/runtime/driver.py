@@ -58,15 +58,26 @@ def run(cfg: RunConfig) -> RunResult:
             f"only {rule.states} states (0..{rule.states - 1})"
         )
 
+    backend_name = cfg.backend
+    if cfg.mesh_shape is not None:
+        # a mesh shape only means something to the sharded backend — don't
+        # let `auto` resolve elsewhere and silently ignore it
+        if backend_name == "auto":
+            backend_name = "sharded"
+        elif backend_name != "sharded":
+            raise ValueError(
+                f"--mesh-shape requires the sharded backend, got {backend_name!r}"
+            )
     backend_kwargs = dict(
         num_devices=cfg.num_devices,
+        mesh_shape=cfg.mesh_shape,
         partition_mode=cfg.partition_mode,
         pad_lanes=cfg.pad_lanes,
         bitpack=cfg.bitpack,
     )
     if cfg.block_steps is not None:
         backend_kwargs["block_steps"] = cfg.block_steps
-    backend = get_backend(cfg.backend, **backend_kwargs)
+    backend = get_backend(backend_name, **backend_kwargs)
 
     remaining = max(0, steps - start_step)
     recorder = MetricsRecorder(
