@@ -68,6 +68,8 @@ class ShardedBackend:
                     f"mesh_shape {mesh_shape} ({r * c} devices) contradicts "
                     f"num_devices={num_devices}"
                 )
+        if mesh is not None and mesh_shape is not None:
+            raise ValueError("pass either mesh or mesh_shape, not both")
         if mesh is not None:
             self.mesh = mesh
         elif mesh_shape is not None and mesh_shape[1] > 1:

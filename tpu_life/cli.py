@@ -162,6 +162,33 @@ def _info() -> int:
     print(f"jax {jax.__version__} backend={jax.default_backend()}")
     for d in jax.devices():
         print(f"  device: {d}")
+    from tpu_life.io import native as native_io
+    from tpu_life.ops import native_step
+
+    avail = {
+        "numpy": "ok",
+        "jax": "ok",
+        "sharded": f"ok ({len(jax.devices())} devices)",
+        "stripes": "ok",
+        "mpi": "ok",
+        "native": "ok" if native_step.available() else "needs `make -C native`",
+        "pallas": "ok",
+    }
+    try:
+        from tpu_life.backends import pallas_backend  # noqa: F401
+    except ImportError as e:
+        avail["pallas"] = f"unavailable ({e})"
+    try:
+        from mpi4py import MPI  # noqa: F401
+    except ImportError:
+        avail["mpi"] = "unavailable (needs mpi4py)"
+    print("backends:")
+    for name in sorted(avail):
+        print(f"  {name}: {avail[name]}")
+    print(
+        "native io codec:",
+        "ok" if native_io.available() else "numpy fallback (make -C native)",
+    )
     print("rules:", ", ".join(sorted(RULE_REGISTRY)))
     return 0
 
