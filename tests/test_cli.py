@@ -128,3 +128,14 @@ def test_mesh_shape_forces_sharded_backend(workload):
 def test_mesh_shape_rejects_other_backends(workload):
     with pytest.raises(ValueError, match="mesh-shape requires"):
         main(["run", "--backend", "numpy", "--mesh-shape", "2,4"])
+
+
+def test_profile_flag_writes_trace(workload, tmp_path):
+    tmp, board = workload
+    trace_dir = tmp / "trace"
+    assert (
+        main(["run", "--backend", "numpy", "--steps", "2", "--profile", str(trace_dir)])
+        == 0
+    )
+    # jax.profiler.trace writes a plugins/profile/<ts>/ tree
+    assert trace_dir.exists() and any(trace_dir.rglob("*"))

@@ -1,0 +1,93 @@
+"""Per-shard streaming file I/O: file -> device shards -> file with no
+whole-board host materialization (the 65536^2 path, SURVEY.md §7).
+
+Equality bar: a streamed run's output bytes must equal the host-path run's
+bytes — which already equal the NumPy truth (test_cli.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_life.backends.sharded_backend import ShardedBackend
+from tpu_life.cli import main
+from tpu_life.io.codec import read_board, write_board, write_config
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs multi-device (fake CPU) platform"
+)
+
+
+@pytest.fixture
+def workload(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    board = random_board(100, 67, seed=31)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "grid_size_data.txt", 100, 67, 10)
+    return tmp_path, board
+
+
+@pytest.mark.parametrize("bitpack", [True, False])
+def test_streamed_run_matches_truth(workload, bitpack, tmp_path):
+    tmp, board = workload
+    rule = get_rule("conway")
+    be = ShardedBackend(bitpack=bitpack)
+    runner = be.prepare_from_file(tmp / "data.txt", 100, 67, rule)
+    runner.advance(10)
+    be.write_runner_to_file(runner, tmp / "streamed.txt", 100, 67, rule)
+    got = read_board(tmp / "streamed.txt", 100, 67)
+    np.testing.assert_array_equal(got, run_np(board, rule, 10))
+    assert (tmp / "streamed.txt").stat().st_size == 100 * 68
+
+
+def test_cli_stream_io_flag(workload):
+    tmp, board = workload
+    assert (
+        main(["run", "--backend", "sharded", "--stream-io",
+              "--output-file", "out_stream.txt"])
+        == 0
+    )
+    got = read_board(tmp / "out_stream.txt", 100, 67)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 10))
+
+
+def test_cli_stream_io_resume(workload):
+    tmp, board = workload
+    assert (
+        main(["run", "--backend", "sharded", "--stream-io",
+              "--snapshot-every", "4", "--output-file", "out_a.txt"])
+        == 0
+    )
+    assert (
+        main(["run", "--backend", "sharded", "--stream-io",
+              "--resume", "snapshots", "--output-file", "out_b.txt"])
+        == 0
+    )
+    np.testing.assert_array_equal(
+        read_board(tmp / "out_b.txt", 100, 67),
+        read_board(tmp / "out_a.txt", 100, 67),
+    )
+
+
+def test_stream_io_rejects_non_sharded(workload):
+    with pytest.raises(ValueError, match="stream-io"):
+        main(["run", "--backend", "numpy", "--stream-io"])
+
+
+def test_stream_io_rejects_2d_mesh(workload):
+    with pytest.raises(ValueError, match="stream-io"):
+        main(["run", "--mesh-shape", "2,4", "--stream-io"])
+
+
+def test_state_validation_inside_stripe_loader(tmp_path):
+    rule = get_rule("conway")
+    bad = np.full((16, 8), 3, dtype=np.int8)  # state 3 under a 2-state rule
+    write_board(tmp_path / "bad.txt", bad)
+    be = ShardedBackend()
+    with pytest.raises(ValueError, match="state 3"):
+        r = be.prepare_from_file(tmp_path / "bad.txt", 16, 8, rule)
+        r.sync()

@@ -96,6 +96,27 @@ def make_runner(backend: "Backend", board: np.ndarray, rule: Rule) -> Runner:
     return HostRunner(backend, board, rule)
 
 
+def drive_runner(
+    r: Runner,
+    steps: int,
+    *,
+    chunk_steps: int = 0,
+    callback: ChunkCallback | None = None,
+) -> None:
+    """The shared chunked epoch loop over a Runner (no final fetch).
+
+    Each chunk's ``get_board`` thunk is bound to that chunk's state
+    (``Runner.snapshot``), so subscribers may defer materialization.
+    """
+    done = 0
+    for n in chunk_sizes(steps, chunk_steps):
+        r.advance(n)
+        done += n
+        if callback is not None:
+            callback(done, r.snapshot())
+    r.sync()
+
+
 def run_with_runner(
     backend: "Backend",
     board: np.ndarray,
@@ -105,19 +126,9 @@ def run_with_runner(
     chunk_steps: int = 0,
     callback: ChunkCallback | None = None,
 ) -> np.ndarray:
-    """The shared chunked ``run`` loop over a Runner.
-
-    Each chunk's ``get_board`` thunk is bound to that chunk's state
-    (``Runner.snapshot``), so subscribers may defer materialization.
-    """
+    """Chunked ``run`` over a fresh Runner, returning the final board."""
     r = make_runner(backend, board, rule)
-    done = 0
-    for n in chunk_sizes(steps, chunk_steps):
-        r.advance(n)
-        done += n
-        if callback is not None:
-            callback(done, r.snapshot())
-    r.sync()
+    drive_runner(r, steps, chunk_steps=chunk_steps, callback=callback)
     return r.fetch()
 
 

@@ -87,34 +87,97 @@ class ShardedBackend:
         self.pad_lanes = pad_lanes
         self.bitpack = bitpack
 
-    def _device_put_sharded(self, host: np.ndarray, h_pad: int, w_pad: int):
-        """Shard a host array onto the mesh, zero-padding to (h_pad, w_pad).
+    def _device_put_stream(
+        self, load_rows, h: int, w: int, h_pad: int, w_phys: int, use_bits: bool
+    ):
+        """Build the sharded device array from a row-range loader.
 
-        Each device's block is materialized independently — on a multi-host
-        job every process only builds its addressable shards.
+        ``load_rows(r0, r1) -> int8[(r1-r0), w]`` supplies logical board
+        rows; each device's block is materialized independently, so on a
+        multi-host job every process only loads its own stripes' bytes —
+        the analogue of per-rank ``MPI_File_read_at`` offsets
+        (Parallel_Life_MPI.cpp:85), and what keeps 65536^2 feasible.
         """
         sharding = board_sharding(self.mesh)
-        h, w = host.shape
+        dtype = np.uint32 if use_bits else np.int8
 
         def cb(index):
             rows, cols = index
             r0 = rows.start or 0
             r1 = rows.stop if rows.stop is not None else h_pad
             c0 = cols.start or 0
-            c1 = cols.stop if cols.stop is not None else w_pad
-            block = np.zeros((r1 - r0, c1 - c0), dtype=host.dtype)
-            if r0 < h and c0 < w:
-                src = host[r0 : min(r1, h), c0 : min(c1, w)]
-                block[: src.shape[0], : src.shape[1]] = src
+            c1 = cols.stop if cols.stop is not None else w_phys
+            block = np.zeros((r1 - r0, c1 - c0), dtype=dtype)
+            n = min(r1, h) - r0
+            if n > 0:
+                stripe = load_rows(r0, r0 + n)
+                if use_bits:  # packed path is 1-D: columns unsplit
+                    packed = bitlife.pack_np(stripe)
+                    block[:n, : packed.shape[1]] = packed[:, c0 : min(c1, packed.shape[1])]
+                else:
+                    cw = min(c1, w) - c0
+                    if cw > 0:
+                        block[:n, :cw] = stripe[:, c0 : c0 + cw]
             return block
 
-        return jax.make_array_from_callback((h_pad, w_pad), sharding, cb)
+        return jax.make_array_from_callback((h_pad, w_phys), sharding, cb)
+
+    def _use_bits(self, rule: Rule) -> bool:
+        # the packed bitboard stays 1-D: a column split would land mid-word
+        return self.bitpack and self.n_cols == 1 and bitlife.supports(rule)
 
     def prepare(self, board: np.ndarray, rule: Rule):
         h, w = board.shape
+        board = np.asarray(board, np.int8)
+        return self._prepare_impl(lambda r0, r1: board[r0:r1], h, w, rule)
+
+    def prepare_from_file(self, path, height: int, width: int, rule: Rule):
+        """Runner whose board loads straight from a contract-format board
+        file, stripe by stripe inside the shard callbacks — the full board
+        is never materialized on one host."""
+        from tpu_life.io.sharded import read_stripe
+
+        def load_rows(r0: int, r1: int) -> np.ndarray:
+            stripe = read_stripe(path, r0, r1 - r0, width)
+            mx = int(stripe.max(initial=0))
+            if mx >= rule.states:
+                raise ValueError(
+                    f"board rows [{r0}, {r1}) contain state {mx} but rule "
+                    f"{rule.name!r} has only {rule.states} states"
+                )
+            return stripe
+
+        return self._prepare_impl(load_rows, height, width, rule)
+
+    def write_runner_to_file(self, runner, path, height: int, width: int, rule: Rule):
+        """Write the runner's board per addressable shard at contract byte
+        offsets (halo-free, any order) — the ``MPI_File_write_at_all``
+        analogue (Parallel_Life_MPI.cpp:175)."""
+        from tpu_life.io.sharded import write_stripe
+
+        if self.n_cols > 1:
+            raise ValueError("streaming output supports 1-D meshes only")
+        use_bits = self._use_bits(rule)
+        x = runner.x
+        jax.block_until_ready(x)
+        written: set[int] = set()
+        for shard in x.addressable_shards:
+            sl = shard.index[0]
+            r0 = sl.start or 0
+            if r0 in written or r0 >= height:
+                continue
+            written.add(r0)
+            r1 = sl.stop if sl.stop is not None else x.shape[0]
+            n = min(r1, height) - r0
+            data = np.asarray(shard.data)
+            stripe = (
+                bitlife.unpack_np(data[:n], width) if use_bits else data[:n, :width]
+            )
+            write_stripe(path, r0, stripe, total_rows=height)
+
+    def _prepare_impl(self, load_rows, h: int, w: int, rule: Rule):
         logical = (h, w)
-        # the packed bitboard stays 1-D: a column split would land mid-word
-        use_bits = self.bitpack and self.n_cols == 1 and bitlife.supports(rule)
+        use_bits = self._use_bits(rule)
 
         # shard height must divide evenly; keep sublane (8) alignment per shard
         h_pad = ceil_to(h, self.n * 8)
@@ -122,18 +185,16 @@ class ShardedBackend:
         block_steps = max(1, min(self.block_steps, shard_h // rule.radius))
 
         if use_bits:
-            host = bitlife.pack_np(np.asarray(board, np.int8))
-            w_phys = host.shape[1]
+            w_phys = bitlife.packed_width(w)
             to_np = lambda x: bitlife.unpack_np(np.asarray(x)[:h], w)
         else:
-            host = np.asarray(board, np.int8)
             unit = LANE if self.pad_lanes else 1
             w_phys = ceil_to(w, self.n_cols * unit)
             to_np = lambda x: np.asarray(x)[:h, :w]
         if self.n_cols > 1:
             shard_w = w_phys // self.n_cols
             block_steps = max(1, min(block_steps, shard_w // rule.radius))
-        x = self._device_put_sharded(host, h_pad, w_phys)
+        x = self._device_put_stream(load_rows, h, w, h_pad, w_phys, use_bits)
 
         runs: dict[int, object] = {}
 

@@ -81,6 +81,17 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
         "--partition-mode", default="shard_map", choices=["shard_map", "gspmd"]
     )
     r.add_argument("--sync-every", type=int, default=0)
+    r.add_argument(
+        "--stream-io",
+        action="store_true",
+        default=None,
+        help="per-shard streaming file I/O (sharded backend, 1-D mesh): the "
+        "board is never materialized whole on one host; auto-enabled for "
+        "big boards",
+    )
+    r.add_argument(
+        "--no-stream-io", dest="stream_io", action="store_false", help=""
+    )
     r.add_argument("--no-pad-lanes", action="store_true")
     r.add_argument(
         "--no-bitpack",
@@ -137,6 +148,7 @@ def main(argv: list[str] | None = None) -> int:
         block_steps=args.block_steps,
         partition_mode=args.partition_mode,
         sync_every=args.sync_every,
+        stream_io=args.stream_io,
         pad_lanes=not args.no_pad_lanes,
         bitpack=not args.no_bitpack,
         snapshot_every=args.snapshot_every,

@@ -40,6 +40,9 @@ class RunConfig:
     block_steps: int | None = None
     partition_mode: str = "shard_map"  # shard_map | gspmd
     sync_every: int = 0  # steps per host sync chunk; 0 = one fused run
+    # per-shard streaming file I/O (sharded backend, 1-D mesh): the board is
+    # never materialized whole on one host.  None = auto (on for big boards)
+    stream_io: bool | None = None
     pad_lanes: bool = True  # pad width to the 128-lane TPU tile
     bitpack: bool = True  # bit-sliced fast path for life-like rules
 

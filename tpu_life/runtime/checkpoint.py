@@ -27,6 +27,11 @@ def snapshot_path(directory: str | os.PathLike, step: int) -> Path:
     return Path(directory) / f"board_{step:09d}.txt"
 
 
+def write_sidecar(p: Path, step: int, rule: str, height: int, width: int) -> None:
+    meta = {"step": step, "rule": rule, "height": height, "width": width}
+    p.with_suffix(".json").write_text(json.dumps(meta))
+
+
 def save_snapshot(
     directory: str | os.PathLike,
     step: int,
@@ -38,13 +43,7 @@ def save_snapshot(
     d.mkdir(parents=True, exist_ok=True)
     p = snapshot_path(d, step)
     write_board(p, board)
-    meta = {
-        "step": step,
-        "rule": rule,
-        "height": int(board.shape[0]),
-        "width": int(board.shape[1]),
-    }
-    p.with_suffix(".json").write_text(json.dumps(meta))
+    write_sidecar(p, step, rule, int(board.shape[0]), int(board.shape[1]))
     return p
 
 
@@ -62,10 +61,11 @@ def latest_snapshot(directory: str | os.PathLike) -> tuple[int, Path] | None:
     return best
 
 
-def load_resume(
+def resolve_resume(
     path: str | os.PathLike, height: int, width: int
-) -> tuple[np.ndarray, int]:
-    """Load a board to resume from; returns (board, completed_steps).
+) -> tuple[Path, int, int, int]:
+    """Resolve a resume target to (board_file, completed_steps, height, width)
+    without reading the board — so streaming loaders can pread stripes.
 
     ``path`` may be a snapshot (step recovered from its sidecar/filename), a
     snapshot *directory* (latest snapshot wins), or any contract-format board
@@ -77,7 +77,7 @@ def load_resume(
         if found is None:
             raise FileNotFoundError(f"no snapshots in {p}")
         step, p = found
-        return read_board(p, height, width), step
+        return p, step, height, width
     step = 0
     sidecar = p.with_suffix(".json")
     if sidecar.exists():
@@ -89,4 +89,12 @@ def load_resume(
         m = _SNAP_RE.match(p.name)
         if m:
             step = int(m.group(1))
+    return p, step, height, width
+
+
+def load_resume(
+    path: str | os.PathLike, height: int, width: int
+) -> tuple[np.ndarray, int]:
+    """Load a board to resume from; returns (board, completed_steps)."""
+    p, step, height, width = resolve_resume(path, height, width)
     return read_board(p, height, width), step

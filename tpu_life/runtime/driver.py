@@ -14,7 +14,7 @@ from pathlib import Path
 
 import numpy as np
 
-from tpu_life.backends.base import get_backend
+from tpu_life.backends.base import drive_runner, get_backend
 from tpu_life.config import RunConfig
 from tpu_life.io.codec import read_board, write_board
 from tpu_life.models.rules import get_rule
@@ -24,9 +24,14 @@ from tpu_life.runtime.profiling import maybe_profile
 from tpu_life.utils.timing import Timer
 
 
+# auto-streaming threshold: boards at or above this many cells skip host
+# materialization when the backend can load/store per-shard (256 Mcells)
+_STREAM_AUTO_CELLS = 1 << 28
+
+
 @dataclass
 class RunResult:
-    board: np.ndarray
+    board: np.ndarray | None  # None on streamed runs (never materialized)
     steps_run: int
     elapsed_s: float
     backend: str
@@ -40,23 +45,6 @@ def run(cfg: RunConfig) -> RunResult:
     rule = get_rule(cfg.effective_rule())
 
     timer = Timer()  # spans I/O too, like the reference's Wtime bracket
-
-    start_step = 0
-    if cfg.resume:
-        board, start_step = ckpt.load_resume(cfg.resume, height, width)
-        log.info("resumed from %s at step %d", cfg.resume, start_step)
-    else:
-        board = read_board(cfg.input_file, height, width)
-    if board.shape != (height, width):
-        raise ValueError(
-            f"board shape {board.shape} != configured ({height}, {width})"
-        )
-    max_state = int(board.max(initial=0))
-    if max_state >= rule.states:
-        raise ValueError(
-            f"board contains state {max_state} but rule {rule.name!r} has "
-            f"only {rule.states} states (0..{rule.states - 1})"
-        )
 
     backend_name = cfg.backend
     if cfg.mesh_shape is not None:
@@ -79,6 +67,48 @@ def run(cfg: RunConfig) -> RunResult:
         backend_kwargs["block_steps"] = cfg.block_steps
     backend = get_backend(backend_name, **backend_kwargs)
 
+    # Board source: a contract-format file (+ completed steps when resuming).
+    # Streamed per-shard straight onto the mesh when supported — the 65536^2
+    # path where the board never materializes whole on one host.
+    start_step = 0
+    input_path = cfg.input_file
+    if cfg.resume:
+        input_path, start_step, height, width = ckpt.resolve_resume(
+            cfg.resume, height, width
+        )
+        log.info("resuming from %s at step %d", input_path, start_step)
+
+    can_stream = (
+        hasattr(backend, "prepare_from_file") and getattr(backend, "n_cols", 1) == 1
+    )
+    stream = (
+        cfg.stream_io
+        if cfg.stream_io is not None
+        # auto-stream only when the result goes to a file — a library caller
+        # with no output_file needs RunResult.board, which streaming skips
+        else can_stream
+        and bool(cfg.output_file)
+        and height * width >= _STREAM_AUTO_CELLS
+    )
+    if stream and not can_stream:
+        raise ValueError(
+            "--stream-io needs the sharded backend on a 1-D mesh "
+            f"(got backend {backend_name!r})"
+        )
+
+    board = None
+    runner = None
+    if stream:
+        runner = backend.prepare_from_file(input_path, height, width, rule)
+    else:
+        board = read_board(input_path, height, width)
+        max_state = int(board.max(initial=0))
+        if max_state >= rule.states:
+            raise ValueError(
+                f"board contains state {max_state} but rule {rule.name!r} has "
+                f"only {rule.states} states (0..{rule.states - 1})"
+            )
+
     remaining = max(0, steps - start_step)
     recorder = MetricsRecorder(
         height * width, cfg.metrics or cfg.verbose, start_step=start_step
@@ -99,18 +129,33 @@ def run(cfg: RunConfig) -> RunResult:
     def on_chunk(done_local: int, get_board) -> None:
         nonlocal last_snap
         done = start_step + done_local
-        board_np = get_board()  # one device->host transfer per chunk
-        recorder.record_chunk(done, timer.elapsed, board_np)
+        if recorder.enabled or cfg.verbose:
+            # one device->host transfer per chunk; on streamed runs this is
+            # the only thing that gathers the board (metrics count it whole)
+            board_np = get_board()
+            recorder.record_chunk(done, timer.elapsed, board_np)
+        else:
+            board_np = None
         if (
             cfg.snapshot_every > 0
             and done_local // cfg.snapshot_every > last_snap // cfg.snapshot_every
         ):
             last_snap = done_local
-            p = ckpt.save_snapshot(
-                cfg.snapshot_dir, done, board_np, rule=rule.name
-            )
+            if runner is not None:
+                # per-shard snapshot write: the board stays sharded
+                Path(cfg.snapshot_dir).mkdir(parents=True, exist_ok=True)
+                p = ckpt.snapshot_path(cfg.snapshot_dir, done)
+                backend.write_runner_to_file(runner, p, height, width, rule)
+                ckpt.write_sidecar(p, done, rule.name, height, width)
+            else:
+                p = ckpt.save_snapshot(
+                    cfg.snapshot_dir,
+                    done,
+                    board_np if board_np is not None else get_board(),
+                    rule=rule.name,
+                )
             log.info("snapshot step=%d -> %s", done, p)
-        if cfg.verbose:
+        if cfg.verbose and board_np is not None:
             log.debug("board at step %d:\n%s", done, dump_board(board_np))
 
     callback = (
@@ -120,17 +165,25 @@ def run(cfg: RunConfig) -> RunResult:
     )
 
     with maybe_profile(cfg.profile):
-        board = backend.run(
-            board,
-            rule,
-            remaining,
-            chunk_steps=chunk,
-            callback=callback,
-        )
+        if runner is not None:
+            drive_runner(runner, remaining, chunk_steps=chunk, callback=callback)
+        else:
+            board = backend.run(
+                board,
+                rule,
+                remaining,
+                chunk_steps=chunk,
+                callback=callback,
+            )
 
     if cfg.output_file:
         Path(cfg.output_file).parent.mkdir(parents=True, exist_ok=True)
-        write_board(cfg.output_file, board)
+        if runner is not None:
+            backend.write_runner_to_file(
+                runner, cfg.output_file, height, width, rule
+            )
+        else:
+            write_board(cfg.output_file, board)
 
     elapsed = timer.elapsed
     # Contract parity: the reference's lead-rank report
