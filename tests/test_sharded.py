@@ -88,24 +88,37 @@ def test_callback_chunking(rng_board):
     np.testing.assert_array_equal(out, run_np(b, rule, 10))
 
 
+@pytest.mark.parametrize("bitpack", [True, False])
 @pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2), (2, 2), (1, 8)])
-def test_2d_mesh_matches_reference(mesh_shape, rng_board):
+def test_2d_mesh_matches_reference(mesh_shape, bitpack, rng_board):
     rule = get_rule("conway")
     b = rng_board(70, 150, seed=21)  # uneven in both axes
     expect = run_np(b, rule, 9)
-    be = ShardedBackend(mesh_shape=mesh_shape)
+    be = ShardedBackend(mesh_shape=mesh_shape, bitpack=bitpack)
     np.testing.assert_array_equal(be.run(b, rule, 9), expect)
 
 
+@pytest.mark.parametrize("bitpack", [True, False])
 @pytest.mark.parametrize("block_steps", [1, 3])
-def test_2d_mesh_deep_halo(block_steps, rng_board):
+def test_2d_mesh_deep_halo(block_steps, bitpack, rng_board):
     # deep halos in both axes: corners must propagate through the two-phase
     # (rows then row-extended cols) exchange
     rule = get_rule("conway")
     b = rng_board(64, 160, seed=22)
     expect = run_np(b, rule, 12)
-    be = ShardedBackend(mesh_shape=(2, 4), block_steps=block_steps)
+    be = ShardedBackend(mesh_shape=(2, 4), block_steps=block_steps, bitpack=bitpack)
     np.testing.assert_array_equal(be.run(b, rule, 12), expect)
+
+
+@pytest.mark.parametrize("block_steps", [1, 2, 33, 40])
+def test_2d_packed_wide_board(block_steps, rng_board):
+    # packed 2-D with multiple words per column shard, including halo
+    # depths that cross a word boundary (block_steps > 32 -> 2-word halo)
+    rule = get_rule("conway")
+    b = rng_board(48, 520, seed=25)  # 520 cells -> 17 words; pads to 20
+    expect = run_np(b, rule, 40)
+    be = ShardedBackend(mesh_shape=(2, 4), block_steps=block_steps, bitpack=True)
+    np.testing.assert_array_equal(be.run(b, rule, 40), expect)
 
 
 def test_2d_mesh_radius2(rng_board):

@@ -247,7 +247,8 @@ def make_pallas_packed_multi_step(
             rule,
             bitlife.make_total_planes(hshift_left, hshift_right, bitlife._vshift),
         )
-        # iota/where restatement of bitlife.col_mask(lw, wp): a captured
+        # iota/where restatement of the in-board word mask that
+        # bitlife.make_masked_packed_step builds from word offsets: a captured
         # constant array is rejected by pallas_call, so the mask is rebuilt
         # from lane ids (keep in sync with col_mask's partial-word semantics)
         colmask = jnp.where(

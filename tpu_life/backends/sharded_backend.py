@@ -34,7 +34,7 @@ from tpu_life.backends.base import ChunkCallback, register_backend, run_with_run
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
 from tpu_life.ops.stencil import make_masked_step
-from tpu_life.parallel.halo import make_sharded_run, make_sharded_run_2d
+from tpu_life.parallel.halo import make_sharded_run
 from tpu_life.parallel.mesh import (
     COL_AXIS,
     ROW_AXIS,
@@ -111,20 +111,18 @@ class ShardedBackend:
             n = min(r1, h) - r0
             if n > 0:
                 stripe = load_rows(r0, r0 + n)
-                if use_bits:  # packed path is 1-D: columns unsplit
-                    packed = bitlife.pack_np(stripe)
-                    block[:n, : packed.shape[1]] = packed[:, c0 : min(c1, packed.shape[1])]
-                else:
-                    cw = min(c1, w) - c0
-                    if cw > 0:
-                        block[:n, :cw] = stripe[:, c0 : c0 + cw]
+                src = bitlife.pack_np(stripe) if use_bits else stripe
+                cw = min(c1, src.shape[1]) - c0  # c0/c1 in storage units
+                if cw > 0:
+                    block[:n, :cw] = src[:, c0 : c0 + cw]
             return block
 
         return jax.make_array_from_callback((h_pad, w_phys), sharding, cb)
 
     def _use_bits(self, rule: Rule) -> bool:
-        # the packed bitboard stays 1-D: a column split would land mid-word
-        return self.bitpack and self.n_cols == 1 and bitlife.supports(rule)
+        # on a 2-D mesh, word-aligned shard boundaries keep the bitboard
+        # splittable along columns too (ceil(pad/32)-word halos)
+        return self.bitpack and bitlife.supports(rule)
 
     def prepare(self, board: np.ndarray, rule: Rule):
         h, w = board.shape
@@ -185,29 +183,29 @@ class ShardedBackend:
         block_steps = max(1, min(self.block_steps, shard_h // rule.radius))
 
         if use_bits:
-            w_phys = bitlife.packed_width(w)
-            to_np = lambda x: bitlife.unpack_np(np.asarray(x)[:h], w)
+            w_phys = ceil_to(bitlife.packed_width(w), self.n_cols)
+            to_np = lambda x: bitlife.unpack_np(
+                np.asarray(x)[:h, : bitlife.packed_width(w)], w
+            )
         else:
             unit = LANE if self.pad_lanes else 1
             w_phys = ceil_to(w, self.n_cols * unit)
             to_np = lambda x: np.asarray(x)[:h, :w]
         if self.n_cols > 1:
             shard_w = w_phys // self.n_cols
-            block_steps = max(1, min(block_steps, shard_w // rule.radius))
+            # column-shard width bounds the halo: cells for int8, whole
+            # words (32 cells each) for the packed bitboard
+            cells_per_shard = shard_w * (bitlife.WORD if use_bits else 1)
+            block_steps = max(1, min(block_steps, cells_per_shard // rule.radius))
         x = self._device_put_stream(load_rows, h, w, h_pad, w_phys, use_bits)
 
         runs: dict[int, object] = {}
 
         def get_run(bs: int):
             if bs not in runs:
-                if self.n_cols > 1:
-                    runs[bs] = make_sharded_run_2d(
-                        rule, self.mesh, logical, block_steps=bs
-                    )
-                else:
-                    runs[bs] = make_sharded_run(
-                        rule, self.mesh, logical, block_steps=bs, packed=use_bits
-                    )
+                runs[bs] = make_sharded_run(
+                    rule, self.mesh, logical, block_steps=bs, packed=use_bits
+                )
             return runs[bs]
 
         gspmd_run = (

@@ -202,32 +202,41 @@ def make_packed_step(
     return step
 
 
-def col_mask(width: int, wp: int) -> np.ndarray:
-    """uint32[wp] mask of in-board bits (pads the last partial word)."""
-    full, rem = divmod(width, WORD)
-    m = np.zeros(wp, dtype=np.uint32)
-    m[:full] = np.uint32(0xFFFFFFFF)
-    if rem:
-        m[full] = np.uint32((1 << rem) - 1)
-    return m
-
-
 def make_masked_packed_step(
     rule: Rule, logical_shape: tuple[int, int]
-) -> Callable[[jax.Array, jax.Array | int], jax.Array]:
+) -> Callable[..., jax.Array]:
     """Packed step that pins cells outside the logical board dead.
 
-    ``row_offset`` is the global row of packed row 0 (traced inside
-    shard_map); column padding bits are masked via ``col_mask``.
+    ``row_offset`` is the global row of packed row 0, ``word_offset`` the
+    global packed-word index of word column 0 (both traced inside
+    shard_map; ``word_offset`` matters on 2-D meshes where the word axis is
+    sharded too).  Column padding bits are masked per the global layout.
     """
     step = make_packed_step(rule)
     lh, lw = logical_shape
+    full, rem = divmod(lw, WORD)
 
-    def masked(x: jax.Array, row_offset: jax.Array | int = 0) -> jax.Array:
+    def masked(
+        x: jax.Array,
+        row_offset: jax.Array | int = 0,
+        word_offset: jax.Array | int = 0,
+    ) -> jax.Array:
         h, wp = x.shape
         rows = row_offset + jnp.arange(h)
         row_ok = ((rows >= 0) & (rows < lh)).astype(jnp.uint32)[:, None]
-        cmask = jnp.asarray(col_mask(lw, wp))[None, :]
+        gw = word_offset + jnp.arange(wp)
+        cmask = jnp.where(
+            gw < full,
+            jnp.uint32(0xFFFFFFFF),
+            jnp.where(
+                (gw == full) & (rem > 0),
+                jnp.uint32((1 << rem) - 1 if rem else 0),
+                jnp.uint32(0),
+            ),
+        )[None, :]
+        # negative word indices (left halo beyond the global edge) fall in
+        # neither branch above only via gw < full — guard them explicitly
+        cmask = jnp.where((gw >= 0)[None, :], cmask, jnp.uint32(0))
         return step(x) & (row_ok * cmask)
 
     return masked

@@ -67,51 +67,16 @@ def make_sharded_run(
     a uint32 bitboard (``tpu_life.ops.bitlife``) — the ring exchange is
     identical, just 32x narrower.
     """
-    if not packed:
-        # the unpacked 1-D stripe is the n_cols=1 special case of the 2-D
-        # block decomposition — one builder, one halo/scan/jit scaffold
-        return make_sharded_run_2d(
-            rule, mesh, logical_shape, row_axis=axis, block_steps=block_steps
-        )
-
-    n = mesh.shape[axis]
-    pad = halo_depth(rule, block_steps)
-    masked_step = bitlife.make_masked_packed_step(rule, tuple(logical_shape))
-    fwd = [(i, i + 1) for i in range(n - 1)]  # shard i's bottom rows -> i+1's top halo
-    bwd = [(i + 1, i) for i in range(n - 1)]  # shard i's top rows -> i-1's bottom halo
-
-    def local_block(chunk: jax.Array) -> jax.Array:
-        h_local = chunk.shape[0]
-        idx = lax.axis_index(axis)
-        top_halo = lax.ppermute(chunk[h_local - pad :, :], axis, fwd)
-        bot_halo = lax.ppermute(chunk[:pad, :], axis, bwd)
-        ext = jnp.concatenate([top_halo, chunk, bot_halo], axis=0)
-        row_offset = idx * h_local - pad
-        for _ in range(block_steps):
-            ext = masked_step(ext, row_offset)
-        return ext[pad : pad + h_local, :]
-
-    def local_run(chunk: jax.Array, num_blocks: int) -> jax.Array:
-        if chunk.shape[0] < pad:
-            raise ValueError(
-                f"shard height {chunk.shape[0]} < halo depth {pad}; "
-                f"lower block_steps or use fewer devices"
-            )
-        out, _ = lax.scan(
-            lambda c, _: (local_block(c), None), chunk, None, length=num_blocks
-        )
-        return out
-
-    @partial(jax.jit, static_argnames="num_blocks", donate_argnums=0)
-    def run(board: jax.Array, num_blocks: int) -> jax.Array:
-        return shard_map(
-            partial(local_run, num_blocks=num_blocks),
-            mesh=mesh,
-            in_specs=P(axis, None),
-            out_specs=P(axis, None),
-        )(board)
-
-    return run
+    # one builder, one halo/scan/jit scaffold: the 1-D stripe is the
+    # n_cols=1 special case of the 2-D block decomposition
+    return make_sharded_run_2d(
+        rule,
+        mesh,
+        logical_shape,
+        row_axis=axis,
+        block_steps=block_steps,
+        packed=packed,
+    )
 
 
 def make_sharded_run_2d(
@@ -122,6 +87,7 @@ def make_sharded_run_2d(
     row_axis: str = ROW_AXIS,
     col_axis: str = COL_AXIS,
     block_steps: int = 1,
+    packed: bool = False,
 ) -> Callable[[jax.Array, int], jax.Array]:
     """2-D block decomposition: halos exchanged along BOTH mesh axes.
 
@@ -131,16 +97,26 @@ def make_sharded_run_2d(
     then the *row-extended* edge columns, so the corner cells ride the
     column exchange transitively (two hops, same as a 2-D MPI Cart shift
     would do, but expressed as two ``ppermute`` pairs XLA pipelines over
-    ICI).  int8 path only; the packed bitboard stays 1-D where a column
-    split would land mid-word.  On a mesh without a ``col_axis`` (or with
-    one shard along it) the column phase drops out and this *is* the
-    unpacked 1-D stripe run.
+    ICI).  With ``packed=True`` the board is the uint32 bitboard
+    (``tpu_life.ops.bitlife``): shard boundaries sit on word boundaries and
+    the column halo is ``ceil(depth/32)`` whole words — 32x less ICI
+    traffic, same exchange shape.  On a mesh without a ``col_axis`` (or
+    with one shard along it) the column phase drops out and this *is* the
+    1-D stripe run.
     """
     n_r = mesh.shape[row_axis]
     split_cols = col_axis in mesh.shape and mesh.shape[col_axis] > 1
     n_c = mesh.shape[col_axis] if split_cols else 1
     pad = halo_depth(rule, block_steps)
-    masked_step = make_masked_step(rule, tuple(logical_shape))
+    # column-axis halo in *storage units*: cells for int8, whole words for
+    # the packed bitboard (word carries propagate 1 bit/step, so ceil(pad/32)
+    # words always hold the pad cells the block needs)
+    pad_c = -(-pad // bitlife.WORD) if packed else pad
+    masked_step = (
+        bitlife.make_masked_packed_step(rule, tuple(logical_shape))
+        if packed
+        else make_masked_step(rule, tuple(logical_shape))
+    )
     fwd_r = [(i, i + 1) for i in range(n_r - 1)]
     bwd_r = [(i + 1, i) for i in range(n_r - 1)]
     fwd_c = [(i, i + 1) for i in range(n_c - 1)]
@@ -155,22 +131,22 @@ def make_sharded_run_2d(
         row_offset = ri * hl - pad
         if split_cols:
             ci = lax.axis_index(col_axis)
-            left = lax.ppermute(ext[:, wl - pad :], col_axis, fwd_c)
-            right = lax.ppermute(ext[:, :pad], col_axis, bwd_c)
+            left = lax.ppermute(ext[:, wl - pad_c :], col_axis, fwd_c)
+            right = lax.ppermute(ext[:, :pad_c], col_axis, bwd_c)
             ext = jnp.concatenate([left, ext, right], axis=1)
-            col_offset = ci * wl - pad
+            col_offset = ci * wl - pad_c
         else:
             col_offset = 0
         for _ in range(block_steps):
             ext = masked_step(ext, row_offset, col_offset)
-        col0 = pad if split_cols else 0
+        col0 = pad_c if split_cols else 0
         return ext[pad : pad + hl, col0 : col0 + wl]
 
     def local_run(chunk: jax.Array, num_blocks: int) -> jax.Array:
-        if chunk.shape[0] < pad or (split_cols and chunk.shape[1] < pad):
+        if chunk.shape[0] < pad or (split_cols and chunk.shape[1] < pad_c):
             raise ValueError(
-                f"shard {chunk.shape} smaller than halo depth {pad}; "
-                f"lower block_steps or use a smaller mesh"
+                f"shard {chunk.shape} smaller than halo depth "
+                f"{(pad, pad_c)}; lower block_steps or use a smaller mesh"
             )
         out, _ = lax.scan(
             lambda c, _: (local_block(c), None), chunk, None, length=num_blocks
