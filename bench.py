@@ -1,8 +1,12 @@
 """Headline benchmark: cell-updates/sec/chip, Conway B3/S23, 16384^2.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` is value / 1e11 — the north-star per-chip target from
 BASELINE.json (the reference publishes no numbers of its own; SURVEY.md §6).
+Extra fields record provenance: ``platform`` (tpu/cpu), ``backend``,
+``size``, ``steps``, and ``degraded`` (true when the accelerator was
+unavailable and the number is a shrunken CPU-fallback measurement, not a
+TPU result).
 
 Measures *sustained device throughput* of the fused step loop: the board is
 staged on device once (Runner API), then two fused runs of different step
@@ -12,6 +16,12 @@ Host codec / transfer costs are the I/O path, benchmarked separately
 (experiments/), exactly as the reference's ``Total time`` conflated them
 (Parallel_Life_MPI.cpp:199,233-236) — a conflation we choose not to copy.
 
+Failure model (the round-1 lesson, BENCH_r01.json rc=1): the tunneled-TPU
+plugin can *hang* or *raise* at first device query when its chip grant is
+stale.  So the default platform is probed in a throwaway subprocess with a
+timeout; on any failure the bench forces CPU, shrinks the workload, and
+still emits its JSON line — the capture can never again be empty.
+
 Flags: --size N --steps N --rule R --backend B --block-steps K (all optional).
 """
 
@@ -19,42 +29,76 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 TARGET = 1e11  # cell-updates/sec/chip north-star (BASELINE.json)
 
+# workload when the accelerator is unavailable: small enough that the XLA
+# CPU path finishes in seconds, still large enough for a stable delta
+DEGRADED_SIZE = 2048
+DEGRADED_STEPS = 110
+DEGRADED_BASE_STEPS = 10
 
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--size", type=int, default=16384)
-    p.add_argument("--steps", type=int, default=1000)
-    p.add_argument("--base-steps", type=int, default=100)
-    p.add_argument("--rule", default="conway")
-    p.add_argument(
-        "--backend",
-        default=None,
-        choices=["jax", "sharded", "pallas", "numpy"],
-        help="default: pallas on TPU (fastest single-chip path), jax elsewhere "
-        "(pallas off-TPU would run in Python interpret mode)",
-    )
-    p.add_argument(
-        "--block-steps",
-        type=int,
-        default=None,
-        help="steps per halo exchange / HBM pass; unset keeps each backend's default",
-    )
-    p.add_argument("--repeats", type=int, default=3)
-    p.add_argument("--platform", default=None)
-    p.add_argument("--no-bitpack", action="store_true")
-    args = p.parse_args()
-    if args.steps <= args.base_steps:
-        p.error("--steps must be greater than --base-steps (delta timing)")
+PROBE_TIMEOUT_S = 180.0  # first TPU attach can be slow; hang is minutes
 
+
+def _probe_default_platform() -> str | None:
+    """Platform of the default JAX backend, probed in a subprocess.
+
+    Returns ``None`` when the probe crashes *or hangs* — both observed
+    failure modes of a wedged tunneled-TPU plugin (it blocks claiming a
+    stale chip grant, so an in-process ``jax.devices()`` would hang the
+    bench itself; a killable subprocess is the only safe query).
+    """
+    import signal
+    import tempfile
+
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    # output goes to a temp file and the child gets its own session: a child
+    # stuck in uninterruptible device I/O (or a pipe-holding grandchild)
+    # could otherwise block subprocess.run past its own timeout
+    with tempfile.TemporaryFile(mode="w+") as out:
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code],
+                stdout=out,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+        except OSError:
+            return None
+        try:
+            rc = proc.wait(timeout=PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            return None
+        if rc != 0:
+            return None
+        out.seek(0)
+        for line in out.read().splitlines():
+            if line.startswith("PLATFORM="):
+                return line.removeprefix("PLATFORM=")
+    return None
+
+
+def _emit(result: dict) -> None:
+    print(json.dumps(result))
+
+
+def run_bench(args, platform: str, degraded: bool) -> dict:
     from tpu_life.utils.platform import ensure_platform
 
-    ensure_platform(args.platform)
+    # an explicit override beats the probe; otherwise pin what was probed so
+    # a plugin that forces itself as default cannot override our choice
+    ensure_platform(args.platform or platform)
 
     import jax
 
@@ -72,13 +116,14 @@ def main() -> None:
             * rng.integers(0, 2, size=(n, n), dtype=np.int8)
         )
 
-    if args.backend is None:
-        args.backend = "pallas" if jax.devices()[0].platform == "tpu" else "jax"
+    backend_name = args.backend
+    if backend_name is None:
+        backend_name = "pallas" if platform == "tpu" else "jax"
 
     kwargs = {"bitpack": not args.no_bitpack}
     if args.block_steps is not None:
         kwargs["block_steps"] = args.block_steps
-    backend = get_backend(args.backend, **kwargs)
+    backend = get_backend(backend_name, **kwargs)
     runner = make_runner(backend, board, rule)
 
     def timed(steps: int) -> float:
@@ -103,18 +148,146 @@ def main() -> None:
     )
     best = n * n / per_step
 
-    n_chips = 1 if args.backend in ("jax", "pallas", "numpy") else len(jax.devices())
+    # per-chip divisor = the device count the backend actually used (a mesh
+    # backend may span fewer devices than jax.devices() reports)
+    mesh = getattr(backend, "mesh", None)
+    n_chips = int(mesh.devices.size) if mesh is not None else 1
     per_chip = best / n_chips
-    print(
-        json.dumps(
+    return {
+        "metric": "cell_updates_per_sec_per_chip",
+        "value": per_chip,
+        "unit": "cells/s/chip",
+        "vs_baseline": per_chip / TARGET,
+        "platform": platform,
+        "backend": backend_name,
+        "size": n,
+        "steps": args.steps,
+        "n_chips": n_chips,
+        "degraded": degraded,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--base-steps", type=int, default=None)
+    p.add_argument("--rule", default="conway")
+    p.add_argument(
+        "--backend",
+        default=None,
+        choices=["jax", "sharded", "pallas", "numpy"],
+        help="default: pallas on TPU (fastest single-chip path), jax elsewhere "
+        "(pallas off-TPU would run in Python interpret mode)",
+    )
+    p.add_argument(
+        "--block-steps",
+        type=int,
+        default=None,
+        help="steps per halo exchange / HBM pass; unset keeps each backend's default",
+    )
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--no-bitpack", action="store_true")
+    args = p.parse_args()
+
+    # fail fast on pure config errors — they must never trigger the
+    # accelerator-failure fallback below
+    from tpu_life.models.rules import get_rule
+
+    try:
+        get_rule(args.rule)
+    except Exception as e:  # noqa: BLE001
+        p.error(f"unknown rule {args.rule!r}: {e}")
+
+    platform = args.platform or os.environ.get("TPU_LIFE_PLATFORM")
+    if platform is None:
+        platform = _probe_default_platform()
+        if platform is None:
+            platform = "cpu"
+            # keep any child interpreters from re-attempting the wedged
+            # plugin's chip claim (it registers itself at startup)
+            os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+    # degraded = not a full-size TPU measurement (chip absent, wedged, or
+    # CPU explicitly requested): the shrunken-default CPU number must never
+    # read as a headline accelerator result
+    degraded = platform != "tpu"
+    on_accel = not degraded
+    # remember which knobs the user pinned: an accelerator-failure retry must
+    # preserve *what* is measured (backend, block-steps, explicit sizes) and
+    # only let unset workload knobs fall to the child's shrunken defaults
+    explicit = {
+        "--size": args.size,
+        "--steps": args.steps,
+        "--base-steps": args.base_steps,
+        "--backend": args.backend,
+        "--block-steps": args.block_steps,
+    }
+    if args.size is None:
+        args.size = 16384 if on_accel else DEGRADED_SIZE
+    if args.steps is None:
+        args.steps = 1000 if on_accel else DEGRADED_STEPS
+    if args.base_steps is None:
+        args.base_steps = 100 if on_accel else DEGRADED_BASE_STEPS
+    if args.steps <= args.base_steps:
+        p.error("--steps must be greater than --base-steps (delta timing)")
+
+    try:
+        result = run_bench(args, platform, degraded)
+    except Exception as e:  # noqa: BLE001 — the JSON line must always appear
+        if platform != "cpu" and not os.environ.get("TPU_LIFE_BENCH_NO_RETRY"):
+            # accelerator path blew up mid-run: re-run the whole bench in a
+            # fresh interpreter pinned to CPU (in-process retry would inherit
+            # poisoned backend state)
+            env = dict(os.environ)
+            env["TPU_LIFE_BENCH_NO_RETRY"] = "1"
+            env["TPU_LIFE_PLATFORM"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""
+            cmd = [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--platform",
+                "cpu",
+                "--rule",
+                args.rule,
+                "--repeats",
+                str(args.repeats),
+            ]
+            for flag, value in explicit.items():
+                if value is not None:
+                    cmd += [flag, str(value)]
+            if args.no_bitpack:
+                cmd.append("--no-bitpack")
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=1800, env=env
+                )
+                line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+                retried = json.loads(line)
+                retried["degraded"] = True
+                retried["fallback_from"] = f"{platform}: {e!r}"
+                _emit(retried)
+                return
+            except Exception as e2:  # noqa: BLE001
+                e = RuntimeError(f"{e!r}; cpu retry failed: {e2!r}")
+        _emit(
             {
                 "metric": "cell_updates_per_sec_per_chip",
-                "value": per_chip,
+                "value": 0.0,
                 "unit": "cells/s/chip",
-                "vs_baseline": per_chip / TARGET,
+                "vs_baseline": 0.0,
+                "platform": platform,
+                "backend": args.backend,
+                "size": args.size,
+                "steps": args.steps,
+                "n_chips": 0,
+                "degraded": True,
+                "error": repr(e)[:500],
             }
         )
-    )
+        return
+    _emit(result)
 
 
 if __name__ == "__main__":
