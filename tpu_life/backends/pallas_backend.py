@@ -183,6 +183,65 @@ def _zero_frame(y: jax.Array, fr: int, fc: int) -> jax.Array:
     return y
 
 
+def _packed_tile_advance(
+    rule: Rule, tile_shape: tuple[int, int], logical: tuple[int, int], block_steps: int
+) -> Callable[[jax.Array, jax.Array | int], jax.Array]:
+    """``advance(tile, row0) -> tile`` after ``block_steps`` masked bit-sliced
+    substeps, for use *inside* a Pallas kernel on a VMEM-resident tile.
+
+    ``row0`` is the global (logical-board) row index of tile row 0 — static
+    in the single-device kernel, a scalar-prefetch value in the sharded one.
+
+    Horizontal neighbor planes use ``pltpu.roll`` word shifts with the
+    wrapped carry masked at the board's first/last lane — exactly the
+    reference's clamped dead boundary (Parallel_Life_MPI.cpp:21-27) with no
+    dead columns needed.  Vertical shifts clamp at tile edges
+    (``bitlife._vshift``): wrong only on the halo fringe, which callers
+    discard.  Cells beyond the logical board (lane padding, the last partial
+    word, halo rows past the edges) are re-masked dead every substep.
+    """
+    ext_r, wp = tile_shape
+    lh, lw = logical
+    full_words, rem_bits = divmod(lw, bitlife.WORD)
+    partial = np.uint32((1 << rem_bits) - 1)
+    u0 = np.uint32(0)
+    ones32 = np.uint32(0xFFFFFFFF)
+
+    def advance(tile: jax.Array, row0) -> jax.Array:
+        lane = lax.broadcasted_iota(jnp.int32, (ext_r, wp), 1)
+        rows = lax.broadcasted_iota(jnp.int32, (ext_r, wp), 0) + row0
+        first_lane = lane == 0
+        last_lane = lane == wp - 1
+
+        def hshift_left(x):  # L[c] = x[c-1]; no left word at lane 0
+            carry = jnp.where(first_lane, u0, pltpu.roll(x, 1, axis=1))
+            return (x << 1) | (carry >> 31)
+
+        def hshift_right(x):  # R[c] = x[c+1]; no right word at the last lane
+            carry = jnp.where(last_lane, u0, pltpu.roll(x, wp - 1, axis=1))
+            return (x >> 1) | (carry << 31)
+
+        step = bitlife.make_packed_step(
+            rule,
+            bitlife.make_total_planes(hshift_left, hshift_right, bitlife._vshift),
+        )
+        # iota/where restatement of the in-board word mask that
+        # bitlife.make_masked_packed_step builds from word offsets: a captured
+        # constant array is rejected by pallas_call, so the mask is rebuilt
+        # from lane ids (keep in sync with col_mask's partial-word semantics)
+        colmask = jnp.where(
+            lane < full_words, ones32, jnp.where(lane == full_words, partial, u0)
+        )
+        mask = jnp.where((rows >= 0) & (rows < lh), colmask, u0)
+
+        def body(_, x):
+            return step(x) & mask
+
+        return lax.fori_loop(0, block_steps, body, tile)
+
+    return advance
+
+
 def make_pallas_packed_multi_step(
     rule: Rule,
     padded_shape: tuple[int, int],
@@ -200,24 +259,13 @@ def make_pallas_packed_multi_step(
     than int8), tiled as **full-width row stripes** so the only halo is
     vertical (``fr >= block_steps`` rows).  Each stripe is DMA'd into VMEM
     once, advanced ``block_steps`` whole steps with the carry-save adder
-    tree, and written back — compute per HBM byte goes up ``block_steps``-x
-    on top of bit-slicing's 8x.
-
-    Horizontal neighbor planes use ``pltpu.roll`` word shifts with the
-    wrapped carry masked at the board's first/last lane — exactly the
-    reference's clamped dead boundary (Parallel_Life_MPI.cpp:21-27) with no
-    dead columns needed.  Cells beyond the logical board (lane padding, the
-    last partial word, halo rows past the edges) are re-masked dead every
-    substep.
+    tree (``_packed_tile_advance``), and written back — compute per HBM byte
+    goes up ``block_steps``-x on top of bit-slicing's 8x.
     """
     hp, wp = padded_shape
-    lh, lw = logical
     nb_r = (hp - 2 * fr) // block_rows
     ext_r = block_rows + 2 * fr
-    full_words, rem_bits = divmod(lw, bitlife.WORD)
-    partial = np.uint32((1 << rem_bits) - 1)
-    u0 = np.uint32(0)
-    ones32 = np.uint32(0xFFFFFFFF)
+    advance = _packed_tile_advance(rule, (ext_r, wp), logical, block_steps)
 
     def kernel(x_hbm, out_hbm, scratch, in_sem, out_sem):
         i = pl.program_id(0)
@@ -228,38 +276,7 @@ def make_pallas_packed_multi_step(
         cp.start()
         cp.wait()
 
-        lane = lax.broadcasted_iota(jnp.int32, (ext_r, wp), 1)
-        rows = lax.broadcasted_iota(jnp.int32, (ext_r, wp), 0) + (r0 - fr)
-        first_lane = lane == 0
-        last_lane = lane == wp - 1
-
-        def hshift_left(x):  # L[c] = x[c-1]; no left word at lane 0
-            carry = jnp.where(first_lane, u0, pltpu.roll(x, 1, axis=1))
-            return (x << 1) | (carry >> 31)
-
-        def hshift_right(x):  # R[c] = x[c+1]; no right word at the last lane
-            carry = jnp.where(last_lane, u0, pltpu.roll(x, wp - 1, axis=1))
-            return (x >> 1) | (carry << 31)
-
-        # vertical shifts clamp at tile edges (bitlife._vshift): wrong only on
-        # the halo fringe, which is discarded
-        step = bitlife.make_packed_step(
-            rule,
-            bitlife.make_total_planes(hshift_left, hshift_right, bitlife._vshift),
-        )
-        # iota/where restatement of the in-board word mask that
-        # bitlife.make_masked_packed_step builds from word offsets: a captured
-        # constant array is rejected by pallas_call, so the mask is rebuilt
-        # from lane ids (keep in sync with col_mask's partial-word semantics)
-        colmask = jnp.where(
-            lane < full_words, ones32, jnp.where(lane == full_words, partial, u0)
-        )
-        mask = jnp.where((rows >= 0) & (rows < lh), colmask, u0)
-
-        def body(_, x):
-            return step(x) & mask
-
-        scratch[:] = lax.fori_loop(0, block_steps, body, scratch[:])
+        scratch[:] = advance(scratch[:], r0 - fr)
 
         wr = pltpu.make_async_copy(
             scratch.at[pl.ds(fr, block_rows), :],
@@ -287,6 +304,182 @@ def make_pallas_packed_multi_step(
         return _zero_frame(grid_step(x), fr, 0)
 
     return step_then_zero_frame
+
+
+def make_pallas_sharded_stripe_block(
+    rule: Rule,
+    ext_shape: tuple[int, int],
+    logical: tuple[int, int],
+    fr: int,
+    *,
+    block_rows: int,
+    block_steps: int,
+    interpret: bool = False,
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """The per-shard twin of :func:`make_pallas_packed_multi_step`.
+
+    ``block(ext_chunk, row0) -> chunk``: one deep-halo block
+    (``block_steps`` bit-sliced CA steps) on a shard's halo-extended packed
+    chunk, gridding over row stripes.  Differences from the single-device
+    kernel: the output drops the ``fr``-row halo frame (the next block's
+    halo comes from ``ppermute``, not from this buffer), and the global row
+    index of ext row 0 (``row0``) is a *traced* scalar — each shard's
+    position on the mesh — delivered via scalar prefetch so the in-kernel
+    validity mask can pin out-of-board rows dead.
+    """
+    ext_rows, wp = ext_shape
+    out_rows = ext_rows - 2 * fr
+    nb_r = out_rows // block_rows
+    ext_r = block_rows + 2 * fr
+    advance = _packed_tile_advance(rule, (ext_r, wp), logical, block_steps)
+
+    def kernel(row0_ref, x_hbm, out_hbm, scratch, in_sem, out_sem):
+        i = pl.program_id(0)
+        r0 = i * block_rows  # ext-chunk row of scratch row 0
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(r0, ext_r), :], scratch, in_sem
+        )
+        cp.start()
+        cp.wait()
+
+        scratch[:] = advance(scratch[:], row0_ref[0] + r0)
+
+        wr = pltpu.make_async_copy(
+            scratch.at[pl.ds(fr, block_rows), :],
+            out_hbm.at[pl.ds(r0, block_rows), :],
+            out_sem,
+        )
+        wr.start()
+        wr.wait()
+
+    stepper = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nb_r,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((ext_r, wp), jnp.uint32),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((out_rows, wp), jnp.uint32),
+        interpret=interpret,
+    )
+
+    def block(ext: jax.Array, row0: jax.Array) -> jax.Array:
+        return stepper(jnp.atleast_1d(row0).astype(jnp.int32), ext)
+
+    return block
+
+
+def sharded_pallas_halo_rows(rule: Rule, block_steps: int) -> int:
+    """ppermute payload / kernel halo depth for the sharded stripe kernel:
+    sublane-aligned so every DMA window offset stays aligned.  The single
+    source of truth for both the tiling feasibility check
+    (``ShardedBackend._pallas_tiling``) and the kernel construction below.
+    """
+    from tpu_life.parallel.halo import halo_depth
+
+    return ceil_to(halo_depth(rule, block_steps), SUBLANE)
+
+
+def make_sharded_pallas_run(
+    rule: Rule,
+    mesh,
+    logical_shape: tuple[int, int],
+    *,
+    block_steps: int = 1,
+    block_rows: int = 256,
+    row_axis: str | None = None,
+    interpret: bool = False,
+) -> Callable[[jax.Array, int], jax.Array]:
+    """``run(board, num_blocks)``: the sharded epoch loop with the Pallas
+    stripe kernel as the local stepper — single-chip kernel throughput on a
+    multi-chip mesh.
+
+    The composition VERDICT.md round 1 called for: halos move over ICI via
+    non-periodic ``ppermute`` exactly as in ``tpu_life.parallel.halo``
+    (the reference's ``MPI_Sendrecv`` ring, Parallel_Life_MPI.cpp:104-145),
+    while each shard's ``block_steps`` substeps run in the deep-halo VMEM
+    kernel instead of the XLA scan.  1-D row meshes + packed bitboards only
+    (the headline configuration); the XLA path remains for 2-D meshes and
+    non-life-like rules.
+
+    The ppermute payload is ``fr = ceil8(radius * block_steps)`` rows —
+    sublane-aligned so every kernel DMA window stays aligned; the few extra
+    halo rows are real neighbor rows and simply widen the valid fringe.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    from tpu_life.parallel.mesh import ROW_AXIS
+
+    if row_axis is None:
+        row_axis = ROW_AXIS
+    n_r = mesh.shape[row_axis]
+    fr = sharded_pallas_halo_rows(rule, block_steps)
+    fwd = [(i, i + 1) for i in range(n_r - 1)]
+    bwd = [(i + 1, i) for i in range(n_r - 1)]
+
+    def local_run(chunk: jax.Array, num_blocks: int) -> jax.Array:
+        hl, wp = chunk.shape
+        if fr > hl:
+            raise ValueError(
+                f"halo depth {fr} exceeds shard height {hl}; lower "
+                f"block_steps or use a smaller mesh"
+            )
+        if hl % block_rows:
+            raise ValueError(
+                f"shard height {hl} not a multiple of block_rows {block_rows}"
+            )
+        kern = make_pallas_sharded_stripe_block(
+            rule,
+            (hl + 2 * fr, wp),
+            tuple(logical_shape),
+            fr,
+            block_rows=block_rows,
+            block_steps=block_steps,
+            interpret=interpret,
+        )
+        ri = lax.axis_index(row_axis)
+        row0 = ri * hl - fr  # global row of ext row 0
+
+        def block(c: jax.Array) -> jax.Array:
+            # ppermute zero-fills at the mesh ends = clamped dead boundary
+            top = lax.ppermute(c[hl - fr :, :], row_axis, fwd)
+            bot = lax.ppermute(c[:fr, :], row_axis, bwd)
+            ext = jnp.concatenate([top, c, bot], axis=0)
+            return kern(ext, row0)
+
+        out, _ = lax.scan(
+            lambda c, _: (block(c), None), chunk, None, length=num_blocks
+        )
+        return out
+
+    spec = P(row_axis, None)
+
+    @partial(jax.jit, static_argnames="num_blocks", donate_argnums=0)
+    def run(board: jax.Array, num_blocks: int) -> jax.Array:
+        # check_vma=False: varying-mesh-axes tracking cannot yet see through
+        # pallas_call (its scalar-prefetch / DMA jaxpr mixes vma sets and the
+        # checker aborts, suggesting exactly this flag); the specs still
+        # partition the board, only the extra consistency check is off
+        return shard_map(
+            partial(local_run, num_blocks=num_blocks),
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
+            check_vma=False,
+        )(board)
+
+    return run
 
 
 @register_backend("pallas")

@@ -80,6 +80,13 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument(
         "--partition-mode", default="shard_map", choices=["shard_map", "gspmd"]
     )
+    r.add_argument(
+        "--local-kernel",
+        default="auto",
+        choices=["auto", "xla", "pallas"],
+        help="per-shard stepper of the sharded backend: Pallas deep-halo "
+        "stripe kernel vs XLA scan (auto: Pallas on TPU 1-D packed meshes)",
+    )
     r.add_argument("--sync-every", type=int, default=0)
     r.add_argument(
         "--stream-io",
@@ -147,6 +154,7 @@ def main(argv: list[str] | None = None) -> int:
         mesh_shape=_parse_mesh_shape(parser, args.mesh_shape),
         block_steps=args.block_steps,
         partition_mode=args.partition_mode,
+        local_kernel=args.local_kernel,
         sync_every=args.sync_every,
         stream_io=args.stream_io,
         pad_lanes=not args.no_pad_lanes,

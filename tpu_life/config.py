@@ -39,6 +39,10 @@ class RunConfig:
     # backend's own default (sharded: 1, pallas: 8)
     block_steps: int | None = None
     partition_mode: str = "shard_map"  # shard_map | gspmd
+    # per-shard stepper of the sharded backend: the Pallas deep-halo stripe
+    # kernel (single-chip-fast) or the XLA bitlife/stencil scan.  auto =
+    # Pallas on TPU 1-D packed meshes, XLA everywhere else
+    local_kernel: str = "auto"  # auto | xla | pallas
     sync_every: int = 0  # steps per host sync chunk; 0 = one fused run
     # per-shard streaming file I/O (sharded backend, 1-D mesh): the board is
     # never materialized whole on one host.  None = auto (on for big boards)

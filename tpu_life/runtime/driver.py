@@ -62,6 +62,7 @@ def run(cfg: RunConfig) -> RunResult:
         partition_mode=cfg.partition_mode,
         pad_lanes=cfg.pad_lanes,
         bitpack=cfg.bitpack,
+        local_kernel=cfg.local_kernel,
     )
     if cfg.block_steps is not None:
         backend_kwargs["block_steps"] = cfg.block_steps
