@@ -1,0 +1,97 @@
+"""Multi-host wiring: init_distributed is called by the driver, and
+single-writer side effects (whole-board output, the ``Total time`` report)
+are gated on the lead process — the reference's rank-0 gating
+(Parallel_Life_MPI.cpp:195-197, :234-236).
+
+A real multi-host launch needs N hosts; these tests exercise the wiring
+single-process: the env-gated ``jax.distributed.initialize`` call, and the
+driver's behavior when it believes it is a non-lead process.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.config import RunConfig
+from tpu_life.io.codec import read_board, write_board, write_config
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.parallel import mesh
+from tpu_life.runtime import driver
+
+
+@pytest.fixture
+def workload(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    board = random_board(40, 33, seed=7)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "grid_size_data.txt", 40, 33, 5)
+    return tmp_path, board
+
+
+def test_init_distributed_noop_without_env(monkeypatch):
+    calls = []
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setattr(mesh.jax.distributed, "initialize", lambda: calls.append(1))
+    mesh.init_distributed()
+    assert calls == []
+
+
+def test_init_distributed_joins_when_env_present(monkeypatch):
+    calls = []
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "host0:8476")
+    monkeypatch.setattr(mesh, "_distributed_initialized", False)
+    monkeypatch.setattr(mesh.jax.distributed, "initialize", lambda: calls.append(1))
+    mesh.init_distributed()
+    assert calls == [1]
+    # idempotent: the driver calls this once per run(), jax.distributed
+    # rejects a second real initialize
+    mesh.init_distributed()
+    assert calls == [1]
+
+
+def test_driver_calls_init_distributed(workload, monkeypatch):
+    calls = []
+    monkeypatch.setattr(driver, "init_distributed", lambda: calls.append(1))
+    driver.run(RunConfig(backend="numpy", output_file=""))
+    assert calls == [1]
+
+
+def test_lead_process_writes_and_reports(workload, capsys):
+    tmp, board = workload
+    res = driver.run(RunConfig(backend="numpy", output_file="out.txt"))
+    got = read_board(tmp / "out.txt", 40, 33)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 5))
+    assert "Total time =" in capsys.readouterr().out
+    assert res.board is not None
+
+
+def test_non_lead_process_skips_output_and_report(workload, monkeypatch, capsys):
+    tmp, _ = workload
+    monkeypatch.setattr(driver, "_is_lead_process", lambda: False)
+    driver.run(RunConfig(backend="numpy", output_file="out.txt"))
+    assert not (tmp / "out.txt").exists()
+    assert "Total time =" not in capsys.readouterr().out
+
+
+def test_non_lead_process_still_writes_its_shards(workload, monkeypatch):
+    # per-shard streamed output is collective (MPI_File_write_at_all,
+    # Parallel_Life_MPI.cpp:175): every process writes the shards it
+    # addresses, lead or not
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (fake CPU) platform")
+    tmp, board = workload
+    monkeypatch.setattr(driver, "_is_lead_process", lambda: False)
+    driver.run(
+        RunConfig(backend="sharded", stream_io=True, output_file="out.txt")
+    )
+    got = read_board(tmp / "out.txt", 40, 33)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 5))
+
+
+def test_stream_io_without_output_rejected(workload):
+    with pytest.raises(ValueError, match="stream_io"):
+        driver.run(RunConfig(backend="sharded", stream_io=True, output_file=""))

@@ -78,9 +78,58 @@ def test_stream_io_rejects_non_sharded(workload):
         main(["run", "--backend", "numpy", "--stream-io"])
 
 
-def test_stream_io_rejects_2d_mesh(workload):
-    with pytest.raises(ValueError, match="stream-io"):
-        main(["run", "--mesh-shape", "2,4", "--stream-io"])
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 fake devices")
+@pytest.mark.parametrize("bitpack", [True, False])
+def test_streamed_run_2d_mesh_matches_truth(workload, bitpack, tmp_path):
+    """2-D block decomposition composes with streaming I/O in both
+    directions (VERDICT r2 item 4): column shards read/write row *segments*
+    at contract offsets."""
+    tmp, board = workload
+    rule = get_rule("conway")
+    be = ShardedBackend(mesh_shape=(2, 2), bitpack=bitpack)
+    runner = be.prepare_from_file(tmp / "data.txt", 100, 67, rule)
+    runner.advance(10)
+    be.write_runner_to_file(runner, tmp / "streamed2d.txt", 100, 67, rule)
+    got = read_board(tmp / "streamed2d.txt", 100, 67)
+    np.testing.assert_array_equal(got, run_np(board, rule, 10))
+    assert (tmp / "streamed2d.txt").stat().st_size == 100 * 68
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 fake devices")
+def test_cli_stream_io_2d_mesh(workload):
+    tmp, board = workload
+    assert (
+        main(["run", "--mesh-shape", "2,4", "--stream-io",
+              "--output-file", "out2d.txt"])
+        == 0
+    )
+    got = read_board(tmp / "out2d.txt", 100, 67)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 10))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 fake devices")
+@pytest.mark.parametrize("bitpack", [True, False])
+def test_2d_stream_loader_reads_each_byte_once(workload, bitpack, monkeypatch):
+    """The 2-D streaming loader asks for exactly its own cells — no
+    full-width re-reads per column shard (VERDICT r2 weak #5)."""
+    from tpu_life.io import sharded as io_sharded
+
+    tmp, board = workload
+    rule = get_rule("conway")
+    read_cells = [0]
+    orig = io_sharded.read_block
+
+    def counting_read_block(path, r0, nr, c0, nc, width):
+        read_cells[0] += nr * nc
+        return orig(path, r0, nr, c0, nc, width)
+
+    monkeypatch.setattr(io_sharded, "read_block", counting_read_block)
+    be = ShardedBackend(mesh_shape=(2, 2), bitpack=bitpack)
+    runner = be.prepare_from_file(tmp / "data.txt", 100, 67, rule)
+    runner.sync()
+    # every logical cell read at most once (padding shards read nothing)
+    assert read_cells[0] <= 100 * 67
+    np.testing.assert_array_equal(runner.fetch(), board)
 
 
 def test_state_validation_inside_stripe_loader(tmp_path):

@@ -48,6 +48,11 @@ class Runner(Protocol):
         to whatever the runner holds when the thunk finally runs)."""
         ...
 
+    def live_count(self) -> int:
+        """Exact count of live (state 1) cells — on device runners a sharded
+        on-device reduction, never a board gather (SURVEY.md §5)."""
+        ...
+
 
 class HostRunner:
     """Fallback Runner for host backends (numpy / stripes): state is a
@@ -69,6 +74,9 @@ class HostRunner:
 
     def snapshot(self) -> Callable[[], np.ndarray]:
         return lambda board=self.board: board
+
+    def live_count(self) -> int:
+        return int(np.count_nonzero(self.board == 1))
 
 
 class Backend(Protocol):

@@ -36,10 +36,11 @@ class DeviceRunner:
     1-element readback (``block_until_ready`` alone can return before the
     device finishes on async tunneled platforms)."""
 
-    def __init__(self, x: jax.Array, advance, to_np):
+    def __init__(self, x: jax.Array, advance, to_np, count_live=None):
         self.x = x
         self._advance = advance
         self._to_np = to_np
+        self._count_live = count_live
 
     def advance(self, steps: int) -> None:
         if steps > 0:
@@ -51,6 +52,16 @@ class DeviceRunner:
 
     def fetch(self) -> np.ndarray:
         return self._to_np(self.x)
+
+    def live_count(self) -> int:
+        """Exact live-cell (state 1) count, reduced *on device* — on a
+        sharded board each device reduces its own shard and XLA inserts the
+        cross-device psum, so only two scalars reach the host (SURVEY.md §5
+        "live-cell count via sharded reduction").  Falls back to a host
+        count only for runners without a device reduction."""
+        if self._count_live is not None:
+            return bitlife.combine_live_count(self._count_live(self.x))
+        return int(np.count_nonzero(self.fetch() == 1))
 
     def snapshot(self):
         """Thunk bound to the current device array.  Valid until the next
@@ -68,7 +79,12 @@ def packed_device_runner(board: np.ndarray, rule: Rule, device) -> DeviceRunner:
     advance = lambda x, n: bitlife.multi_step_packed(
         x, rule=rule, steps=n, logical_shape=(h, w)
     )
-    return DeviceRunner(x, advance, lambda x: bitlife.unpack_np(np.asarray(x), w))
+    return DeviceRunner(
+        x,
+        advance,
+        lambda x: bitlife.unpack_np(np.asarray(x), w),
+        count_live=bitlife.live_count_packed,
+    )
 
 
 @register_backend("jax")
@@ -90,7 +106,12 @@ class JaxBackend:
         advance = lambda x, n: multi_step(
             x, rule=rule, steps=n, logical_shape=logical
         )
-        return DeviceRunner(x, advance, lambda x: np.asarray(x)[:h, :w])
+        return DeviceRunner(
+            x,
+            advance,
+            lambda x: np.asarray(x)[:h, :w],
+            count_live=bitlife.live_count_cells,
+        )
 
     def run(
         self,

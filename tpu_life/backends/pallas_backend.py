@@ -414,10 +414,10 @@ def make_sharded_pallas_run(
     """
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    # no jax.experimental fallback here: the call below passes check_vma,
+    # which the pre-0.6 experimental shard_map (check_rep) would reject —
+    # a fallback import could never actually run (ADVICE r2)
+    from jax import shard_map
 
     from tpu_life.parallel.mesh import ROW_AXIS
 
@@ -521,7 +521,13 @@ class PallasBackend:
         self.interpret = interpret
 
     @staticmethod
-    def _make_runner(x, make_stepper: Callable[[int], Callable], block_steps: int, to_np):
+    def _make_runner(
+        x,
+        make_stepper: Callable[[int], Callable],
+        block_steps: int,
+        to_np,
+        count_live=None,
+    ):
         """Shared scaffolding over a ``make_stepper(k)`` factory: per-k stepper
         cache, jitted donate-in-place scan over blocks, remainder split."""
         steppers: dict[int, Callable] = {}
@@ -545,7 +551,7 @@ class PallasBackend:
                 x = run_blocks(x, blocks=1, k=rem)
             return x
 
-        return DeviceRunner(x, advance, to_np)
+        return DeviceRunner(x, advance, to_np, count_live=count_live)
 
     # stripe-scratch budget: ext_r x wp uint32 must leave Mosaic's ~16 MB
     # scoped VMEM room for the adder tree's temporaries
@@ -597,6 +603,9 @@ class PallasBackend:
             make_stepper,
             block_steps,
             lambda x: bitlife.unpack_np(np.asarray(x)[fr : fr + h], w),
+            # the frame rows are re-masked dead every step, but count only
+            # the logical rows anyway so the invariant isn't load-bearing
+            count_live=jax.jit(lambda x: bitlife.live_count_packed(x[fr : fr + h])),
         )
 
     def prepare(self, board: np.ndarray, rule: Rule) -> Runner:
@@ -620,7 +629,12 @@ class PallasBackend:
             wp = ceil_to(w, LANE)
             x = jax.device_put(pad_board(board, h, wp), self.device)
             advance = lambda x, n: multi_step(x, rule=rule, steps=n, logical_shape=logical)
-            return DeviceRunner(x, advance, lambda x: np.asarray(x)[:h, :w])
+            return DeviceRunner(
+                x,
+                advance,
+                lambda x: np.asarray(x)[:h, :w],
+                count_live=bitlife.live_count_cells,
+            )
 
         # zero frame: `halo` deep, aligned so DMA window offsets stay on
         # sublane/lane boundaries (fr - halo multiple of 8, fc - halo of 128)
@@ -651,6 +665,9 @@ class PallasBackend:
             make_stepper,
             block_steps,
             lambda x: np.asarray(x)[fr : fr + h, fc : fc + w],
+            count_live=jax.jit(
+                lambda x: bitlife.live_count_cells(x[fr : fr + h, fc : fc + w])
+            ),
         )
 
     def run(

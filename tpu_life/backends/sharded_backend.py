@@ -99,14 +99,16 @@ class ShardedBackend:
         self.pallas_interpret = pallas_interpret
 
     def _device_put_stream(
-        self, load_rows, h: int, w: int, h_pad: int, w_phys: int, use_bits: bool
+        self, load_block, h: int, w: int, h_pad: int, w_phys: int, use_bits: bool
     ):
-        """Build the sharded device array from a row-range loader.
+        """Build the sharded device array from a rectangular block loader.
 
-        ``load_rows(r0, r1) -> int8[(r1-r0), w]`` supplies logical board
-        rows; each device's block is materialized independently, so on a
-        multi-host job every process only loads its own stripes' bytes —
-        the analogue of per-rank ``MPI_File_read_at`` offsets
+        ``load_block(r0, r1, c0, c1) -> int8[(r1-r0), (c1-c0)]`` supplies the
+        requested sub-rectangle of the logical board (columns in cells); each
+        device's block is materialized independently and asks for exactly its
+        own cells, so on a 2-D mesh a column shard never re-reads the rest of
+        its rows, and on a multi-host job every process only loads its own
+        shards' bytes — the analogue of per-rank ``MPI_File_read_at`` offsets
         (Parallel_Life_MPI.cpp:85), and what keeps 65536^2 feasible.
         """
         sharding = board_sharding(self.mesh)
@@ -120,12 +122,15 @@ class ShardedBackend:
             c1 = cols.stop if cols.stop is not None else w_phys
             block = np.zeros((r1 - r0, c1 - c0), dtype=dtype)
             n = min(r1, h) - r0
-            if n > 0:
-                stripe = load_rows(r0, r0 + n)
-                src = bitlife.pack_np(stripe) if use_bits else stripe
-                cw = min(c1, src.shape[1]) - c0  # c0/c1 in storage units
-                if cw > 0:
-                    block[:n, :cw] = src[:, c0 : c0 + cw]
+            # storage units (packed words / cells) -> logical cell columns;
+            # packed shard boundaries sit on word boundaries, so cell0 is
+            # word-aligned and the segment packs independently
+            cell0 = c0 * bitlife.WORD if use_bits else c0
+            cell1 = min(c1 * bitlife.WORD if use_bits else c1, w)
+            if n > 0 and cell1 > cell0:
+                seg = load_block(r0, r0 + n, cell0, cell1)
+                src = bitlife.pack_np(seg) if use_bits else seg
+                block[:n, : src.shape[1]] = src
             return block
 
         return jax.make_array_from_callback((h_pad, w_phys), sharding, cb)
@@ -138,51 +143,62 @@ class ShardedBackend:
     def prepare(self, board: np.ndarray, rule: Rule):
         h, w = board.shape
         board = np.asarray(board, np.int8)
-        return self._prepare_impl(lambda r0, r1: board[r0:r1], h, w, rule)
+        return self._prepare_impl(
+            lambda r0, r1, c0, c1: board[r0:r1, c0:c1], h, w, rule
+        )
 
     def prepare_from_file(self, path, height: int, width: int, rule: Rule):
         """Runner whose board loads straight from a contract-format board
-        file, stripe by stripe inside the shard callbacks — the full board
+        file, block by block inside the shard callbacks — the full board
         is never materialized on one host."""
-        from tpu_life.io.sharded import read_stripe
+        from tpu_life.io.sharded import read_block
 
-        def load_rows(r0: int, r1: int) -> np.ndarray:
-            stripe = read_stripe(path, r0, r1 - r0, width)
-            mx = int(stripe.max(initial=0))
+        def load_block(r0: int, r1: int, c0: int, c1: int) -> np.ndarray:
+            seg = read_block(path, r0, r1 - r0, c0, c1 - c0, width)
+            mx = int(seg.max(initial=0))
             if mx >= rule.states:
                 raise ValueError(
                     f"board rows [{r0}, {r1}) contain state {mx} but rule "
                     f"{rule.name!r} has only {rule.states} states"
                 )
-            return stripe
+            return seg
 
-        return self._prepare_impl(load_rows, height, width, rule)
+        return self._prepare_impl(load_block, height, width, rule)
 
     def write_runner_to_file(self, runner, path, height: int, width: int, rule: Rule):
         """Write the runner's board per addressable shard at contract byte
         offsets (halo-free, any order) — the ``MPI_File_write_at_all``
-        analogue (Parallel_Life_MPI.cpp:175)."""
-        from tpu_life.io.sharded import write_stripe
+        analogue (Parallel_Life_MPI.cpp:175).  On a 2-D mesh each column
+        shard writes its row *segments* at ``row * (width+1) + col_offset``
+        — the reference's offset scheme (:172-175) generalized to blocks."""
+        from tpu_life.io.sharded import write_block
 
-        if self.n_cols > 1:
-            raise ValueError("streaming output supports 1-D meshes only")
         use_bits = self._use_bits(rule)
         x = runner.x
         jax.block_until_ready(x)
-        written: set[int] = set()
+        written: set[tuple[int, int]] = set()
         for shard in x.addressable_shards:
-            sl = shard.index[0]
-            r0 = sl.start or 0
-            if r0 in written or r0 >= height:
+            rows, cols = shard.index
+            r0 = rows.start or 0
+            c0 = cols.start or 0
+            # storage units -> logical cell columns (word-aligned when packed)
+            cell0 = c0 * bitlife.WORD if use_bits else c0
+            if (r0, cell0) in written or r0 >= height or cell0 >= width:
                 continue
-            written.add(r0)
-            r1 = sl.stop if sl.stop is not None else x.shape[0]
+            written.add((r0, cell0))
+            r1 = rows.stop if rows.stop is not None else x.shape[0]
+            c1 = cols.stop if cols.stop is not None else x.shape[1]
             n = min(r1, height) - r0
+            cell1 = min(c1 * bitlife.WORD if use_bits else c1, width)
             data = np.asarray(shard.data)
-            stripe = (
-                bitlife.unpack_np(data[:n], width) if use_bits else data[:n, :width]
+            seg = (
+                bitlife.unpack_np(data[:n], cell1 - cell0)
+                if use_bits
+                else data[:n, : cell1 - cell0]
             )
-            write_stripe(path, r0, stripe, total_rows=height)
+            write_block(
+                path, r0, cell0, seg, total_rows=height, total_cols=width
+            )
 
     # stripe-scratch budget for the Pallas local kernel (cf.
     # PallasBackend.MAX_PACKED_TILE_BYTES): ext_r x wp uint32 must leave
@@ -231,8 +247,10 @@ class ShardedBackend:
             want = 16 if cells >= 8192 * 8192 else 8
         else:
             want = max(1, self._block_steps_arg)
+        from tpu_life.backends.pallas_backend import sharded_pallas_halo_rows
+
         for k in range(want, 0, -1):
-            fr = ceil_to(k * rule.radius, SUBLANE)
+            fr = sharded_pallas_halo_rows(rule, k)
             if fr > sh:
                 continue
             max_br = min(self.pallas_block_rows, ext_budget - 2 * fr, sh)
@@ -329,7 +347,15 @@ class ShardedBackend:
 
         from tpu_life.backends.jax_backend import DeviceRunner
 
-        return DeviceRunner(x, advance, to_np)
+        # live-cell metric as a sharded on-device reduction: each device
+        # popcounts its own shard, XLA inserts the psum, two scalars reach
+        # the host (SURVEY.md §5).  Padding rows/words are pinned dead by the
+        # masked step (and zeroed at load), so the whole physical array is
+        # countable without slicing — slicing a sharded axis would reshard.
+        count_live = (
+            bitlife.live_count_packed if use_bits else bitlife.live_count_cells
+        )
+        return DeviceRunner(x, advance, to_np, count_live=count_live)
 
     def run(
         self,

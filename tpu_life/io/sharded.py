@@ -19,7 +19,13 @@ import os
 
 import numpy as np
 
-from tpu_life.io.codec import decode_board, encode_board, row_stride
+from tpu_life.io.codec import (
+    ASCII_ZERO,
+    NEWLINE,
+    decode_board,
+    encode_board,
+    row_stride,
+)
 
 
 def stripe_bounds(height: int, num_shards: int) -> list[tuple[int, int]]:
@@ -58,6 +64,101 @@ def read_stripe(
         f.seek(row_start * stride)
         buf = f.read(num_rows * stride)
     return decode_board(buf, num_rows, width)
+
+
+def read_block(
+    path: str | os.PathLike,
+    row_start: int,
+    num_rows: int,
+    col_start: int,
+    num_cols: int,
+    width: int,
+) -> np.ndarray:
+    """Read the rectangular sub-block rows ``[row_start, row_start+num_rows)``
+    × cells ``[col_start, col_start+num_cols)`` of a board file.
+
+    The 2-D-mesh analogue of the reference's per-rank offset reads
+    (Parallel_Life_MPI.cpp:85), generalized to blocks: one ``pread`` per row
+    of exactly the segment's bytes, so a column shard never touches (or
+    re-reads) the rest of the row.  Full-width requests delegate to
+    :func:`read_stripe` (native fast path).
+    """
+    if col_start == 0 and num_cols == width:
+        return read_stripe(path, row_start, num_rows, width)
+    if col_start < 0 or col_start + num_cols > width:
+        raise ValueError(
+            f"column range [{col_start}, {col_start + num_cols}) outside "
+            f"board width {width}"
+        )
+    stride = row_stride(width)
+    out = np.empty((num_rows, num_cols), dtype=np.uint8)
+    fd = os.open(os.fspath(path), os.O_RDONLY)
+    try:
+        for i in range(num_rows):
+            off = (row_start + i) * stride + col_start
+            buf = os.pread(fd, num_cols, off)
+            if len(buf) != num_cols:
+                raise ValueError(
+                    f"short read at row {row_start + i}: got {len(buf)} of "
+                    f"{num_cols} bytes"
+                )
+            out[i] = np.frombuffer(buf, dtype=np.uint8)
+    finally:
+        os.close(fd)
+    if not ((out >= ASCII_ZERO) & (out <= ASCII_ZERO + 9)).all():
+        raise ValueError("board block contains bytes outside '0'..'9'")
+    return (out - ASCII_ZERO).astype(np.int8)
+
+
+def write_block(
+    path: str | os.PathLike,
+    row_start: int,
+    col_start: int,
+    block: np.ndarray,
+    *,
+    total_rows: int,
+    total_cols: int,
+) -> None:
+    """Write a rectangular sub-block at its contract byte offsets.
+
+    Generalizes :func:`write_stripe` to 2-D block decompositions: row ``r``'s
+    segment lands at byte ``r * (total_cols + 1) + col_start`` — the
+    ``MPI_File_write_at_all`` offset scheme (Parallel_Life_MPI.cpp:172-175)
+    extended with a column offset.  The shard owning the last column also
+    writes each row's ``'\\n'`` terminator (a pre-sized file is
+    zero-filled, so some writer must own every byte of the stride).
+    """
+    block = np.asarray(block)
+    h, w = block.shape
+    if col_start == 0 and w == total_cols:
+        write_stripe(path, row_start, block, total_rows=total_rows)
+        return
+    if col_start < 0 or col_start + w > total_cols:
+        raise ValueError(
+            f"column range [{col_start}, {col_start + w}) outside board "
+            f"width {total_cols}"
+        )
+    stride = row_stride(total_cols)
+    last_col = col_start + w == total_cols
+    seg = np.empty((h, w + (1 if last_col else 0)), dtype=np.uint8)
+    seg[:, :w] = block.astype(np.uint8) + ASCII_ZERO
+    if last_col:
+        seg[:, w] = NEWLINE
+    payload = seg.tobytes()
+    k = seg.shape[1]
+    fd = os.open(os.fspath(path), os.O_WRONLY | os.O_CREAT, 0o644)
+    try:
+        total = total_rows * stride
+        if os.fstat(fd).st_size != total:
+            os.ftruncate(fd, total)
+        for i in range(h):
+            os.pwrite(
+                fd,
+                payload[i * k : (i + 1) * k],
+                (row_start + i) * stride + col_start,
+            )
+    finally:
+        os.close(fd)
 
 
 def write_stripe(

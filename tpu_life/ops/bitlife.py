@@ -230,7 +230,7 @@ def make_masked_packed_step(
             jnp.uint32(0xFFFFFFFF),
             jnp.where(
                 (gw == full) & (rem > 0),
-                jnp.uint32((1 << rem) - 1 if rem else 0),
+                jnp.uint32((1 << rem) - 1),  # == 0 when rem == 0 (branch dead then)
                 jnp.uint32(0),
             ),
         )[None, :]
@@ -243,6 +243,46 @@ def make_masked_packed_step(
 
 
 from functools import partial as _partial
+
+
+@jax.jit
+def live_count_packed(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Live-cell count of a packed bitboard as ``(hi, lo)`` uint32 scalars
+    (count = ``(hi << 8) + lo``, combined on host by
+    :func:`combine_live_count`).
+
+    On a sharded board this is the SURVEY §5 "live-cell count via sharded
+    reduction": each device popcounts and reduces its own shard, XLA inserts
+    the cross-device ``psum``, and only two scalars ever reach the host — no
+    board gather (contrast a host-side ``np.count_nonzero`` after a full
+    gather).  The hi/lo split keeps the count exact where a single uint32 sum
+    would wrap (65536² = 2**32 cells) and float32 would round: per-row
+    popcounts are ≤ width, and the 8-bit split bounds each half-sum by
+    ``H * W / 256`` resp. ``H * 255`` — exact up to 2**40 cells.
+    """
+    rows = jnp.sum(
+        jax.lax.population_count(x).astype(jnp.uint32), axis=1, dtype=jnp.uint32
+    )
+    hi = jnp.sum(rows >> 8, dtype=jnp.uint32)
+    lo = jnp.sum(rows & jnp.uint32(0xFF), dtype=jnp.uint32)
+    return hi, lo
+
+
+@jax.jit
+def live_count_cells(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Live-cell (state == 1) count of an int8 board as ``(hi, lo)`` —
+    the unpacked-domain twin of :func:`live_count_packed`, same sharded
+    reduction shape."""
+    rows = jnp.sum((x == 1).astype(jnp.uint32), axis=1, dtype=jnp.uint32)
+    hi = jnp.sum(rows >> 8, dtype=jnp.uint32)
+    lo = jnp.sum(rows & jnp.uint32(0xFF), dtype=jnp.uint32)
+    return hi, lo
+
+
+def combine_live_count(hi_lo: tuple[jax.Array, jax.Array]) -> int:
+    """Host-side combine of the two reduction scalars into an exact int."""
+    hi, lo = hi_lo
+    return (int(hi) << 8) + int(lo)
 
 
 @_partial(

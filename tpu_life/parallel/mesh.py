@@ -20,17 +20,28 @@ ROW_AXIS = "rows"
 COL_AXIS = "cols"
 
 
+_distributed_initialized = False
+
+
 def init_distributed() -> None:
     """Join a multi-host JAX job if the environment describes one.
 
     The analogue of ``MPI_Init`` (Parallel_Life_MPI.cpp:195).  Controlled by
     the standard JAX cluster-environment variables; a plain single-process
-    run is a no-op so the same entry point serves laptop and pod.
+    run is a no-op so the same entry point serves laptop and pod.  Idempotent
+    — ``jax.distributed.initialize`` is not reentrant, and the driver calls
+    this on every ``run()``.
     """
+    global _distributed_initialized
+    if _distributed_initialized or getattr(
+        jax.distributed, "is_initialized", lambda: False
+    )():
+        return
     if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
     ):
         jax.distributed.initialize()
+        _distributed_initialized = True
 
 
 def make_mesh(num_devices: int | None = None, *, devices=None, axis: str = ROW_AXIS) -> Mesh:

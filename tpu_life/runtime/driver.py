@@ -14,10 +14,11 @@ from pathlib import Path
 
 import numpy as np
 
-from tpu_life.backends.base import drive_runner, get_backend
+from tpu_life.backends.base import drive_runner, get_backend, make_runner
 from tpu_life.config import RunConfig
 from tpu_life.io.codec import read_board, write_board
 from tpu_life.models.rules import get_rule
+from tpu_life.parallel.mesh import init_distributed
 from tpu_life.runtime import checkpoint as ckpt
 from tpu_life.runtime.metrics import MetricsRecorder, configure_logging, dump_board, log
 from tpu_life.runtime.profiling import maybe_profile
@@ -39,8 +40,24 @@ class RunResult:
     metrics: list[dict] = field(default_factory=list)
 
 
+def _is_lead_process() -> bool:
+    """True on the process that owns single-writer side effects (whole-board
+    output, the ``Total time`` report) — the analogue of the reference's
+    rank-0 gating (Parallel_Life_MPI.cpp:234-236).  Per-shard streamed writes
+    are NOT gated on this: like ``MPI_File_write_at_all``
+    (Parallel_Life_MPI.cpp:175), every process writes the byte ranges of the
+    shards it addresses."""
+    import jax
+
+    return jax.process_index() == 0
+
+
 def run(cfg: RunConfig) -> RunResult:
     configure_logging(cfg.verbose)
+    # Join a multi-host job if the environment describes one — the MPI_Init
+    # analogue (Parallel_Life_MPI.cpp:195-197).  Must precede any device
+    # query, hence before backend construction below.
+    init_distributed()
     height, width, steps = cfg.resolved_geometry()
     rule = get_rule(cfg.effective_rule())
 
@@ -79,9 +96,7 @@ def run(cfg: RunConfig) -> RunResult:
         )
         log.info("resuming from %s at step %d", input_path, start_step)
 
-    can_stream = (
-        hasattr(backend, "prepare_from_file") and getattr(backend, "n_cols", 1) == 1
-    )
+    can_stream = hasattr(backend, "prepare_from_file")
     stream = (
         cfg.stream_io
         if cfg.stream_io is not None
@@ -93,12 +108,27 @@ def run(cfg: RunConfig) -> RunResult:
     )
     if stream and not can_stream:
         raise ValueError(
-            "--stream-io needs the sharded backend on a 1-D mesh "
+            "--stream-io needs the sharded backend "
             f"(got backend {backend_name!r})"
+        )
+    if (
+        stream
+        and not cfg.output_file
+        and cfg.snapshot_every <= 0
+        and not cfg.metrics
+    ):
+        # a streamed run's board is never materialized, so with no output
+        # file, no snapshots and no metrics the run would compute into the
+        # void — reject instead of silently returning RunResult(board=None).
+        # (metrics-only streamed runs are fine: live counts flow through the
+        # gather-free on-device reduction into RunResult.metrics)
+        raise ValueError(
+            "stream_io=True produces no host board; pass output_file, "
+            "snapshot_every or metrics, or use stream_io=False to get "
+            "RunResult.board"
         )
 
     board = None
-    runner = None
     if stream:
         runner = backend.prepare_from_file(input_path, height, width, rule)
     else:
@@ -109,6 +139,7 @@ def run(cfg: RunConfig) -> RunResult:
                 f"board contains state {max_state} but rule {rule.name!r} has "
                 f"only {rule.states} states (0..{rule.states - 1})"
             )
+        runner = make_runner(backend, board, rule)
 
     remaining = max(0, steps - start_step)
     recorder = MetricsRecorder(
@@ -130,19 +161,19 @@ def run(cfg: RunConfig) -> RunResult:
     def on_chunk(done_local: int, get_board) -> None:
         nonlocal last_snap
         done = start_step + done_local
-        if recorder.enabled or cfg.verbose:
-            # one device->host transfer per chunk; on streamed runs this is
-            # the only thing that gathers the board (metrics count it whole)
-            board_np = get_board()
-            recorder.record_chunk(done, timer.elapsed, board_np)
-        else:
-            board_np = None
+        if recorder.enabled:
+            # live count via the runner's on-device sharded reduction — two
+            # scalars cross to the host, never the board (SURVEY.md §5), so
+            # --metrics composes with --stream-io at any board size
+            recorder.record_chunk(done, timer.elapsed, runner.live_count())
+        # a board gather happens only for the --verbose small-board dump
+        board_np = get_board() if cfg.verbose else None
         if (
             cfg.snapshot_every > 0
             and done_local // cfg.snapshot_every > last_snap // cfg.snapshot_every
         ):
             last_snap = done_local
-            if runner is not None:
+            if stream:
                 # per-shard snapshot write: the board stays sharded
                 Path(cfg.snapshot_dir).mkdir(parents=True, exist_ok=True)
                 p = ckpt.snapshot_path(cfg.snapshot_dir, done)
@@ -166,30 +197,30 @@ def run(cfg: RunConfig) -> RunResult:
     )
 
     with maybe_profile(cfg.profile):
-        if runner is not None:
-            drive_runner(runner, remaining, chunk_steps=chunk, callback=callback)
-        else:
-            board = backend.run(
-                board,
-                rule,
-                remaining,
-                chunk_steps=chunk,
-                callback=callback,
-            )
+        drive_runner(runner, remaining, chunk_steps=chunk, callback=callback)
+    if not stream:
+        board = runner.fetch()
 
+    lead = _is_lead_process()
     if cfg.output_file:
         Path(cfg.output_file).parent.mkdir(parents=True, exist_ok=True)
-        if runner is not None:
+        if stream:
+            # per-shard collective write: every process writes the byte
+            # ranges of the shards it addresses (MPI_File_write_at_all,
+            # Parallel_Life_MPI.cpp:175) — never gated on the lead
             backend.write_runner_to_file(
                 runner, cfg.output_file, height, width, rule
             )
-        else:
+        elif lead:
+            # whole-board write: single writer, like rank 0 owning the
+            # host-materialized result
             write_board(cfg.output_file, board)
 
     elapsed = timer.elapsed
-    # Contract parity: the reference's lead-rank report
-    # (Parallel_Life_MPI.cpp:234-236).
-    print(f"Total time = {elapsed}")
+    if lead:
+        # Contract parity: the reference's lead-rank report
+        # (Parallel_Life_MPI.cpp:234-236).
+        print(f"Total time = {elapsed}")
     return RunResult(
         board=board,
         steps_run=remaining,

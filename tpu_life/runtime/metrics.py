@@ -32,10 +32,13 @@ class MetricsRecorder:
         self.start_step = start_step  # rates count only this run's steps
         self.records: list[dict] = []
 
-    def record_chunk(self, step: int, elapsed: float, board: np.ndarray) -> None:
+    def record_chunk(self, step: int, elapsed: float, live: int) -> None:
+        """Record one host-sync chunk.  ``live`` comes from the runner's
+        on-device sharded reduction (``Runner.live_count``) — the recorder
+        never sees the board, so metrics cannot force a gather (SURVEY.md §5
+        "live-cell count via sharded reduction")."""
         if not self.enabled:
             return
-        live = int(np.count_nonzero(board == 1))
         done = step - self.start_step
         rec = {
             "step": step,
