@@ -116,16 +116,35 @@ def _emit(result: dict) -> None:
 
 
 def run_bench(args, platform: str, degraded: bool) -> dict:
-    from tpu_life.utils.platform import ensure_platform
+    # Pin the platform ONLY on an explicit user override (--platform or
+    # TPU_LIFE_PLATFORM).  The round-3 capture died precisely because we
+    # pinned the *probed* value: under the axon plugin the default backend
+    # reports device.platform == "tpu" while `jax_platforms="tpu"` kills
+    # backend init ("No jellyfish device found") — the plugin registers
+    # under a different platform name than its devices report.  Unpinned
+    # init is what the probe itself measured, so leave it alone and verify
+    # the resulting backend afterwards instead (VERDICT r3 item 1).
+    pinned = args.platform or os.environ.get("TPU_LIFE_PLATFORM")
+    if pinned:
+        from tpu_life.utils.platform import ensure_platform
 
-    # an explicit override beats the probe; otherwise pin what was probed so
-    # a plugin that forces itself as default cannot override our choice
-    ensure_platform(args.platform or platform)
+        ensure_platform(pinned)
 
     import jax
 
     from tpu_life.backends.base import get_backend, make_runner
     from tpu_life.models.rules import get_rule
+
+    # post-init verification: the platform the backend actually gave us.
+    # Recorded alongside the probed value; a mismatch (probe said tpu,
+    # process came up cpu) downgrades the capture to degraded rather than
+    # mislabeling a CPU number as a TPU result.
+    actual = jax.devices()[0].platform
+    if actual != platform:
+        raise RuntimeError(
+            f"platform mismatch: probe/request said {platform!r} but the "
+            f"default backend initialized as {actual!r}"
+        )
 
     rule = get_rule(args.rule)
     n = args.size
@@ -185,6 +204,8 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
         "unit": "cells/s/chip",
         "vs_baseline": per_chip / TARGET,
         "platform": platform,
+        "platform_actual": actual,
+        "platform_pinned": bool(pinned),
         "backend": backend_name,
         "local_kernel": kwargs.get("local_kernel"),
         "size": n,

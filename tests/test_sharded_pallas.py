@@ -107,6 +107,24 @@ def test_auto_stays_on_xla_off_tpu():
     assert b._resolve_local_kernel(use_bits=True) is False
 
 
+def test_packed_width_is_lane_aligned():
+    """Mosaic rejects DMA slices whose minor dim isn't a multiple of 128
+    (lanes); interpret mode doesn't enforce it, so pin the layout invariant
+    directly.  Regression: the reference's 500-wide board packs to 16 words
+    and crashed the real-TPU compile until _prepare_impl lane-aligned it.
+    """
+    from tpu_life.utils.padding import LANE
+
+    rng = np.random.default_rng(19)
+    board = rng.integers(0, 2, size=(64, 500), dtype=np.int8)
+    rule = get_rule("conway")
+    b = make_backend(num_devices=2)
+    runner = b.prepare(board, rule)
+    assert runner.x.shape[1] % LANE == 0
+    runner.advance(3)
+    np.testing.assert_array_equal(runner.fetch(), run_np(board, rule, 3))
+
+
 def test_streaming_io_with_pallas_kernel(tmp_path):
     """prepare_from_file / write_runner_to_file compose with the Pallas path
     (h_pad differs from the XLA path's; offsets must still be contract-exact).

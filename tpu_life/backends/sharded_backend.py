@@ -265,9 +265,16 @@ class ShardedBackend:
     def _prepare_impl(self, load_rows, h: int, w: int, rule: Rule):
         logical = (h, w)
         use_bits = self._use_bits(rule)
+        want_pallas = self._resolve_local_kernel(use_bits)
 
         if use_bits:
-            w_phys = ceil_to(bitlife.packed_width(w), self.n_cols)
+            # the Pallas stripe kernel DMAs full-width rows, so the packed
+            # width must be lane-aligned (Mosaic rejects slices whose minor
+            # dim isn't a multiple of 128 — hit on the reference's 500-wide
+            # board, 16 words); mirror PallasBackend._prepare_packed.  The
+            # extra zero words are re-masked dead every substep.
+            unit = LANE if want_pallas else 1
+            w_phys = ceil_to(bitlife.packed_width(w), self.n_cols * unit)
             to_np = lambda x: bitlife.unpack_np(
                 np.asarray(x)[:h, : bitlife.packed_width(w)], w
             )
@@ -277,7 +284,7 @@ class ShardedBackend:
             to_np = lambda x: np.asarray(x)[:h, :w]
 
         pallas_tiling = None
-        if self._resolve_local_kernel(use_bits):
+        if want_pallas:
             pallas_tiling = self._pallas_tiling(h, w_phys, rule, cells=h * w)
             if pallas_tiling is None and self.local_kernel == "pallas":
                 raise ValueError(
