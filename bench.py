@@ -125,6 +125,12 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
     # init is what the probe itself measured, so leave it alone and verify
     # the resulting backend afterwards instead (VERDICT r3 item 1).
     pinned = args.platform or os.environ.get("TPU_LIFE_PLATFORM")
+    if pinned is None and platform == "cpu":
+        # the probe failed (or degraded us to CPU): pin the always-valid cpu
+        # backend so in-process init can neither hang on the wedged plugin
+        # the probe dodged nor attach to a just-recovered chip and mislabel
+        # the capture — only the "tpu" pin is plugin-hostile, cpu is safe
+        pinned = "cpu"
     if pinned:
         from tpu_life.utils.platform import ensure_platform
 

@@ -1,0 +1,101 @@
+"""Larger-than-Life r=5 throughput: the sharded int8 Pallas path vs rivals.
+
+BASELINE.md row 6 / SURVEY.md §7.6: the wide-radius rule family is where
+the deep-halo Pallas design earns its keep — at radius 5 the separable box
+sum does 22 shifted adds per cell per step, so keeping the working set in
+VMEM across ``block_steps`` matters far more than for Conway.  This
+experiment measures cells/s on rule ``bugs`` (R5,C2,S34..58,B34..45) for:
+
+- ``sharded`` + ``local_kernel='pallas'`` — the int8 2-D-tiled deep-halo
+  kernel per shard inside shard_map (the VERDICT r3 item 3 composition);
+- ``sharded`` + ``local_kernel='xla'`` — the masked XLA scan per shard;
+- ``pallas`` — the single-device 2-D-tiled kernel (no mesh scaffolding).
+
+Delta timing (two fused runs of different step counts, differenced) cancels
+the constant dispatch + readback RTT, same as bench.py.
+
+Usage: python experiments/ltl_bench.py [n=8192] [steps=64] [base=8] [rule=bugs]
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def measure(backend_name, board, rule, steps, base, **kwargs):
+    from tpu_life.backends.base import get_backend, make_runner
+
+    backend = get_backend(backend_name, **kwargs)
+    runner = make_runner(backend, board, rule)
+
+    def timed(k):
+        t0 = time.perf_counter()
+        runner.advance(k)
+        runner.sync()
+        return time.perf_counter() - t0
+
+    timed(base)  # compile both step counts
+    timed(steps)
+    deltas = [(timed(steps) - timed(base)) / (steps - base) for _ in range(3)]
+    positive = [d for d in deltas if d > 0]
+    per_step = min(positive) if positive else timed(steps) / steps
+    n_cells = board.shape[0] * board.shape[1]
+    return n_cells / per_step
+
+
+def run(n=8192, steps=64, base=8, rule_name="bugs"):
+    from tpu_life.models.rules import get_rule
+    from tpu_life.ops.reference import run_np
+
+    rule = get_rule(rule_name)
+    rng = np.random.default_rng(0)
+    board = rng.integers(0, 2, size=(n, n), dtype=np.int8)
+
+    # correctness spot check on a small slice before the big timing run
+    small = board[:256, :256]
+    from tpu_life.backends.base import get_backend
+
+    got = get_backend("sharded", local_kernel="pallas").run(small, rule, 4)
+    ok = np.array_equal(got, run_np(small, rule, 4))
+    print(f"# correctness (256^2, 4 steps): {ok}")
+    if not ok:
+        raise SystemExit(1)
+
+    results = {}
+    for label, name, kw in [
+        ("sharded+pallas", "sharded", {"local_kernel": "pallas"}),
+        ("sharded+xla", "sharded", {"local_kernel": "xla"}),
+        ("pallas", "pallas", {}),
+    ]:
+        cells_s = measure(name, board, rule, steps, base, **kw)
+        results[label] = cells_s
+        print(f"# {label}: {cells_s:.3e} cells/s")
+
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "experiment": "ltl_r5_throughput",
+                "rule": rule.name,
+                "size": n,
+                "steps": steps,
+                "platform": jax.devices()[0].platform,
+                "cells_per_sec": results,
+                "speedup_vs_xla": results["sharded+pallas"] / results["sharded+xla"],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    kw = dict(arg.split("=") for arg in sys.argv[1:])
+    run(
+        n=int(kw.get("n", 8192)),
+        steps=int(kw.get("steps", 64)),
+        base=int(kw.get("base", 8)),
+        rule_name=kw.get("rule", "bugs"),
+    )

@@ -80,19 +80,9 @@ def test_glider_crosses_shard_boundary():
 
 def test_explicit_pallas_rejects_unsupported_configs():
     with pytest.raises(ValueError, match="local_kernel"):
-        # 2-D mesh: the packed stripe kernel is 1-D only
+        # 2-D mesh: the per-shard Pallas kernels are 1-D row meshes only
         make_backend(mesh_shape=(2, 2)).run(
             np.zeros((32, 64), np.int8), get_rule("conway"), 1
-        )
-    with pytest.raises(ValueError, match="local_kernel"):
-        # bitpack off: no packed bitboard to stripe
-        make_backend(num_devices=2, bitpack=False).run(
-            np.zeros((32, 64), np.int8), get_rule("conway"), 1
-        )
-    with pytest.raises(ValueError, match="local_kernel"):
-        # non-life-like rule: outside the bit-sliced family
-        make_backend(num_devices=2).run(
-            np.zeros((32, 64), np.int8), get_rule("bugs"), 1
         )
     with pytest.raises(ValueError, match="local_kernel"):
         # gspmd derives its own halo exchange; incompatible by design
@@ -104,7 +94,78 @@ def test_explicit_pallas_rejects_unsupported_configs():
 def test_auto_stays_on_xla_off_tpu():
     """`auto` must not pick Python-speed interpret mode on CPU meshes."""
     b = ShardedBackend(num_devices=2)
-    assert b._resolve_local_kernel(use_bits=True) is False
+    assert b._resolve_local_kernel(use_bits=True) is None
+    assert b._resolve_local_kernel(use_bits=False) is None
+
+
+# --- the int8 2-D-tiled local kernel (LtL / Generations / unpacked) --------
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 8])
+def test_int8_kernel_ltl_bugs_matches_numpy(n_devices):
+    """VERDICT r3 item 3: radius-5 Larger-than-Life through the sharded
+    Pallas path, bit-identical to the truth executor across shard counts."""
+    rng = np.random.default_rng(23)
+    board = rng.integers(0, 2, size=(8 * n_devices + 5, 150), dtype=np.int8)
+    rule = get_rule("bugs")
+    out = make_backend(num_devices=n_devices, block_steps=2).run(board, rule, 5)
+    np.testing.assert_array_equal(out, run_np(board, rule, 5))
+
+
+@pytest.mark.parametrize("rule_name", ["brians_brain", "bugs_decay", "star_wars"])
+def test_int8_kernel_multistate_rules(rule_name):
+    """Generations decay states through the sharded int8 kernel."""
+    rng = np.random.default_rng(29)
+    rule = get_rule(rule_name)
+    board = (
+        rng.integers(0, rule.states, size=(40, 90), dtype=np.int8)
+        * rng.integers(0, 2, size=(40, 90), dtype=np.int8)
+    )
+    out = make_backend(num_devices=4, block_steps=2).run(board, rule, 6)
+    np.testing.assert_array_equal(out, run_np(board, rule, 6))
+
+
+def test_int8_kernel_unpacked_conway_matches_xla():
+    """bitpack=False routes life-like rules down the int8 kernel; the result
+    must stay bit-identical to the XLA local kernel."""
+    rng = np.random.default_rng(31)
+    board = rng.integers(0, 2, size=(48, 70), dtype=np.int8)
+    rule = get_rule("conway")
+    pallas = make_backend(num_devices=4, bitpack=False, block_steps=2).run(
+        board, rule, 6
+    )
+    xla = ShardedBackend(
+        num_devices=4, bitpack=False, block_steps=2, local_kernel="xla"
+    ).run(board, rule, 6)
+    np.testing.assert_array_equal(pallas, xla)
+    np.testing.assert_array_equal(pallas, run_np(board, rule, 6))
+
+
+def test_int8_kernel_block_steps_remainders():
+    """Odd step counts split into deep-halo blocks + a remainder block whose
+    kernel reuses the prepare-time frame layout."""
+    rng = np.random.default_rng(37)
+    board = rng.integers(0, 2, size=(40, 60), dtype=np.int8)
+    rule = get_rule("bugs")
+    out = make_backend(num_devices=2, block_steps=3).run(board, rule, 7)
+    np.testing.assert_array_equal(out, run_np(board, rule, 7))
+
+
+def test_int8_kernel_streaming_io(tmp_path):
+    """File->shards->file round trip through the frame-shifted int8 layout
+    (col_shift): offsets must still be contract-exact."""
+    from tpu_life.io.codec import read_board, write_board
+
+    rng = np.random.default_rng(41)
+    board = rng.integers(0, 2, size=(36, 83), dtype=np.int8)
+    src, dst = tmp_path / "in.txt", tmp_path / "out.txt"
+    write_board(src, board)
+    rule = get_rule("bugs")
+    b = make_backend(num_devices=4, block_steps=2)
+    runner = b.prepare_from_file(src, 36, 83, rule)
+    runner.advance(5)
+    b.write_runner_to_file(runner, dst, 36, 83, rule)
+    np.testing.assert_array_equal(read_board(dst, 36, 83), run_np(board, rule, 5))
 
 
 def test_packed_width_is_lane_aligned():

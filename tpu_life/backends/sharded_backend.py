@@ -61,6 +61,7 @@ class ShardedBackend:
         mesh_shape: tuple[int, int] | None = None,
         local_kernel: str = "auto",
         pallas_block_rows: int = 256,
+        pallas_block_cols: int = 512,
         pallas_interpret: bool | None = None,
         **_,
     ):
@@ -96,6 +97,7 @@ class ShardedBackend:
             raise ValueError(f"unknown local_kernel {local_kernel!r}")
         self.local_kernel = local_kernel
         self.pallas_block_rows = max(8, pallas_block_rows - pallas_block_rows % 8)
+        self.pallas_block_cols = ceil_to(max(LANE, pallas_block_cols), LANE)
         self.pallas_interpret = pallas_interpret
 
     def _device_put_stream(
@@ -174,6 +176,7 @@ class ShardedBackend:
         from tpu_life.io.sharded import write_block
 
         use_bits = self._use_bits(rule)
+        shift = getattr(runner, "col_shift", 0)
         x = runner.x
         jax.block_until_ready(x)
         written: set[tuple[int, int]] = set()
@@ -194,7 +197,7 @@ class ShardedBackend:
             seg = (
                 bitlife.unpack_np(data[:n], cell1 - cell0)
                 if use_bits
-                else data[:n, : cell1 - cell0]
+                else data[:n, shift : shift + cell1 - cell0]
             )
             write_block(
                 path, r0, cell0, seg, total_rows=height, total_cols=width
@@ -210,26 +213,43 @@ class ShardedBackend:
             return self.pallas_interpret
         return self.mesh.devices.flat[0].platform != "tpu"
 
-    def _resolve_local_kernel(self, use_bits: bool) -> bool:
-        """True when the per-shard stepper should be the Pallas stripe kernel
-        (VERDICT round 1 item 1: multi-chip runs keep single-chip throughput).
+    def _resolve_local_kernel(self, use_bits: bool) -> str | None:
+        """Which Pallas kernel the per-shard stepper should be, or None for
+        the XLA scan (VERDICT round 1 item 1: multi-chip runs keep
+        single-chip throughput).  ``'packed'`` = the bit-sliced stripe kernel
+        (life-like rules); ``'int8'`` = the 2-D-tiled deep-halo kernel
+        (Larger-than-Life / Generations / unpacked boards — VERDICT r3
+        item 3).  Both need a 1-D row mesh under shard_map.
         """
         if self.local_kernel == "xla":
-            return False
-        supported = (
-            use_bits and self.n_cols == 1 and self.partition_mode == "shard_map"
-        )
+            return None
+        supported = self.n_cols == 1 and self.partition_mode == "shard_map"
         if self.local_kernel == "pallas":
             if not supported:
                 raise ValueError(
-                    "local_kernel='pallas' needs a 1-D row mesh, a "
-                    "bit-packable (life-like) rule with bitpack=True, and "
+                    "local_kernel='pallas' needs a 1-D row mesh and "
                     "partition_mode='shard_map'"
                 )
-            return True
         # auto: compiled Pallas on TPU; elsewhere interpret mode would be
         # Python-speed, so keep the XLA scan
-        return supported and not self._pallas_interp()
+        elif not supported or self._pallas_interp():
+            return None
+        return "packed" if use_bits else "int8"
+
+    def _fit_block_rows(self, row_bytes: int, fr: int, sh: int) -> int:
+        """Largest sublane-aligned divisor of shard height ``sh`` whose ext
+        stripe (``block_rows + 2*fr`` rows of ``row_bytes`` each) fits the
+        VMEM budget, or 0 when none does.  Shared by both tiling searches
+        so their feasibility decisions cannot drift apart.
+        """
+        ext_budget = (
+            self.MAX_PALLAS_TILE_BYTES // row_bytes // SUBLANE * SUBLANE
+        )
+        max_br = min(self.pallas_block_rows, ext_budget - 2 * fr, sh)
+        return next(
+            (d for d in range(max_br - max_br % SUBLANE, 0, -SUBLANE) if sh % d == 0),
+            0,
+        )
 
     def _pallas_tiling(
         self, h: int, wp: int, rule: Rule, cells: int
@@ -241,7 +261,6 @@ class ShardedBackend:
         kernel grid tiles each shard with no remainder stripe.
         """
         sh = ceil_to(-(-h // self.n), SUBLANE)
-        ext_budget = self.MAX_PALLAS_TILE_BYTES // (wp * 4) // SUBLANE * SUBLANE
         if self._block_steps_arg is None:
             # mirror PallasBackend: deep blocks pay off once HBM-bound
             want = 16 if cells >= 8192 * 8192 else 8
@@ -253,19 +272,45 @@ class ShardedBackend:
             fr = sharded_pallas_halo_rows(rule, k)
             if fr > sh:
                 continue
-            max_br = min(self.pallas_block_rows, ext_budget - 2 * fr, sh)
-            br = next(
-                (d for d in range(max_br - max_br % SUBLANE, 0, -SUBLANE) if sh % d == 0),
-                0,
-            )
+            br = self._fit_block_rows(wp * 4, fr, sh)
             if br >= SUBLANE:
                 return br, k, fr, sh
+        return None
+
+    def _pallas_int8_tiling(
+        self, h: int, w: int, rule: Rule
+    ) -> tuple[int, int, int, int, int, int] | None:
+        """(block_rows, block_cols, block_steps, fr, fc, shard_h) for the
+        sharded int8 2-D-tiled kernel, or None when no tile fits the VMEM
+        budget (then the XLA scan takes over).  ``fr`` is the ppermute
+        payload, ``fc`` the zero-column frame baked into the board layout.
+        """
+        from tpu_life.backends.pallas_backend import sharded_pallas_int8_frame
+
+        sh = ceil_to(-(-h // self.n), SUBLANE)
+        bc = self.pallas_block_cols
+        if self._block_steps_arg is None:
+            want = 8  # mirror PallasBackend's int8 default (k=8 peak on v5e)
+        else:
+            want = max(1, self._block_steps_arg)
+        for k in range(want, 0, -1):
+            fr, fc = sharded_pallas_int8_frame(rule, k)
+            if fr > sh or fc > bc:
+                continue
+            # budget the tile's int32 working set (cf. MAX_PALLAS_TILE_BYTES)
+            br = self._fit_block_rows((bc + 2 * fc) * 4, fr, sh)
+            if br >= SUBLANE:
+                return br, bc, k, fr, fc, sh
         return None
 
     def _prepare_impl(self, load_rows, h: int, w: int, rule: Rule):
         logical = (h, w)
         use_bits = self._use_bits(rule)
-        want_pallas = self._resolve_local_kernel(use_bits)
+        kernel_mode = self._resolve_local_kernel(use_bits)
+
+        pallas_tiling = None  # packed stripe kernel (life-like rules)
+        int8_tiling = None  # int8 2-D-tiled kernel (LtL / Generations)
+        col_shift = 0  # physical col of logical col 0 (int8 frame layout)
 
         if use_bits:
             # the Pallas stripe kernel DMAs full-width rows, so the packed
@@ -273,27 +318,44 @@ class ShardedBackend:
             # dim isn't a multiple of 128 — hit on the reference's 500-wide
             # board, 16 words); mirror PallasBackend._prepare_packed.  The
             # extra zero words are re-masked dead every substep.
-            unit = LANE if want_pallas else 1
+            unit = LANE if kernel_mode == "packed" else 1
             w_phys = ceil_to(bitlife.packed_width(w), self.n_cols * unit)
             to_np = lambda x: bitlife.unpack_np(
                 np.asarray(x)[:h, : bitlife.packed_width(w)], w
             )
+            if kernel_mode == "packed":
+                pallas_tiling = self._pallas_tiling(h, w_phys, rule, cells=h * w)
+                if pallas_tiling is None and self.local_kernel == "pallas":
+                    raise ValueError(
+                        "no Pallas stripe tiling fits the VMEM budget for this "
+                        "board/mesh; use local_kernel='xla'"
+                    )
         else:
-            unit = LANE if self.pad_lanes else 1
-            w_phys = ceil_to(w, self.n_cols * unit)
-            to_np = lambda x: np.asarray(x)[:h, :w]
-
-        pallas_tiling = None
-        if want_pallas:
-            pallas_tiling = self._pallas_tiling(h, w_phys, rule, cells=h * w)
-            if pallas_tiling is None and self.local_kernel == "pallas":
-                raise ValueError(
-                    "no Pallas stripe tiling fits the VMEM budget for this "
-                    "board/mesh; use local_kernel='xla'"
-                )
+            if kernel_mode == "int8":
+                int8_tiling = self._pallas_int8_tiling(h, w, rule)
+                if int8_tiling is None and self.local_kernel == "pallas":
+                    raise ValueError(
+                        "no Pallas int8 tiling fits the VMEM budget for this "
+                        "board/mesh; use local_kernel='xla'"
+                    )
+            if int8_tiling is not None:
+                _, i8_bc, _, _, i8_fc, _ = int8_tiling
+                # frame layout: fc zero columns each side so every tile DMA
+                # window is in-bounds (the sharded analogue of
+                # PallasBackend's baked-in zero border)
+                col_shift = i8_fc
+                w_phys = i8_fc + ceil_to(w, i8_bc) + i8_fc
+                to_np = lambda x: np.asarray(x)[:h, i8_fc : i8_fc + w]
+            else:
+                unit = LANE if self.pad_lanes else 1
+                w_phys = ceil_to(w, self.n_cols * unit)
+                to_np = lambda x: np.asarray(x)[:h, :w]
 
         if pallas_tiling is not None:
             pallas_block_rows, block_steps, _, shard_h = pallas_tiling
+            h_pad = self.n * shard_h
+        elif int8_tiling is not None:
+            i8_br, i8_bc, block_steps, _, i8_fc, shard_h = int8_tiling
             h_pad = self.n * shard_h
         else:
             # shard height must divide evenly; keep sublane (8) alignment per shard
@@ -306,7 +368,23 @@ class ShardedBackend:
                 # words (32 cells each) for the packed bitboard
                 cells_per_shard = shard_w * (bitlife.WORD if use_bits else 1)
                 block_steps = max(1, min(block_steps, cells_per_shard // rule.radius))
-        x = self._device_put_stream(load_rows, h, w, h_pad, w_phys, use_bits)
+        if col_shift:
+            # present the frame-shifted board to the shard loader: physical
+            # col x holds logical col x - col_shift, zeros in the frame
+            def load_shifted(r0, r1, c0, c1, _inner=load_rows):
+                out = np.zeros((r1 - r0, c1 - c0), np.int8)
+                s0, s1 = max(c0 - col_shift, 0), min(c1 - col_shift, w)
+                if s1 > s0:
+                    out[:, s0 + col_shift - c0 : s1 + col_shift - c0] = _inner(
+                        r0, r1, s0, s1
+                    )
+                return out
+
+            x = self._device_put_stream(
+                load_shifted, h, col_shift + w, h_pad, w_phys, use_bits
+            )
+        else:
+            x = self._device_put_stream(load_rows, h, w, h_pad, w_phys, use_bits)
 
         runs: dict[int, object] = {}
 
@@ -323,6 +401,25 @@ class ShardedBackend:
                         logical,
                         block_steps=bs,
                         block_rows=pallas_block_rows,
+                        interpret=interp,
+                    )
+                return runs[bs]
+
+        elif int8_tiling is not None:
+            from tpu_life.backends.pallas_backend import make_sharded_pallas_int8_run
+
+            interp = self._pallas_interp()
+
+            def get_run(bs: int):
+                if bs not in runs:
+                    runs[bs] = make_sharded_pallas_int8_run(
+                        rule,
+                        self.mesh,
+                        logical,
+                        block_steps=bs,
+                        block_rows=i8_br,
+                        block_cols=i8_bc,
+                        frame_cols=i8_fc,
                         interpret=interp,
                     )
                 return runs[bs]
@@ -362,7 +459,11 @@ class ShardedBackend:
         count_live = (
             bitlife.live_count_packed if use_bits else bitlife.live_count_cells
         )
-        return DeviceRunner(x, advance, to_np, count_live=count_live)
+        runner = DeviceRunner(x, advance, to_np, count_live=count_live)
+        # physical col of logical col 0 — write_runner_to_file needs it to
+        # skip the int8 frame columns (0 everywhere else)
+        runner.col_shift = col_shift
+        return runner
 
     def run(
         self,
