@@ -165,30 +165,15 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
 
     backend_name = args.backend  # resolved in main() before any run
 
+    from tpu_life.utils.timing import delta_seconds_per_step
+
     def measure(name: str, kwargs: dict) -> tuple[float, int]:
         """cells/s/chip for one backend config via delta timing."""
         backend = get_backend(name, **kwargs)
         runner = make_runner(backend, board, rule)
-
-        def timed(steps: int) -> float:
-            t0 = time.perf_counter()
-            runner.advance(steps)
-            runner.sync()
-            return time.perf_counter() - t0
-
-        # warmup: compile both timed step counts + first dispatch
-        timed(args.base_steps)
-        timed(args.steps)
-
-        # delta timing: (t_big - t_small) / (steps_big - steps_small) cancels
-        # the constant per-call overhead (dispatch RTT, scalar readback)
-        deltas = [
-            (timed(args.steps) - timed(args.base_steps))
-            / (args.steps - args.base_steps)
-            for _ in range(args.repeats)
-        ]
-        positive = [d for d in deltas if d > 0]
-        per_step = min(positive) if positive else timed(args.steps) / args.steps
+        per_step = delta_seconds_per_step(
+            runner, args.steps, args.base_steps, repeats=args.repeats
+        )
         best = n * n / per_step
 
         # per-chip divisor = the device count the backend actually used (a

@@ -18,30 +18,18 @@ Usage: python experiments/ltl_bench.py [n=8192] [steps=64] [base=8] [rule=bugs]
 """
 
 import json
-import time
 
 import numpy as np
 
 
 def measure(backend_name, board, rule, steps, base, **kwargs):
     from tpu_life.backends.base import get_backend, make_runner
+    from tpu_life.utils.timing import delta_seconds_per_step
 
     backend = get_backend(backend_name, **kwargs)
     runner = make_runner(backend, board, rule)
-
-    def timed(k):
-        t0 = time.perf_counter()
-        runner.advance(k)
-        runner.sync()
-        return time.perf_counter() - t0
-
-    timed(base)  # compile both step counts
-    timed(steps)
-    deltas = [(timed(steps) - timed(base)) / (steps - base) for _ in range(3)]
-    positive = [d for d in deltas if d > 0]
-    per_step = min(positive) if positive else timed(steps) / steps
-    n_cells = board.shape[0] * board.shape[1]
-    return n_cells / per_step
+    per_step = delta_seconds_per_step(runner, steps, base)
+    return board.shape[0] * board.shape[1] / per_step
 
 
 def run(n=8192, steps=64, base=8, rule_name="bugs"):

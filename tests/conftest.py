@@ -29,6 +29,12 @@ import pytest
 from tpu_life.models.patterns import random_board
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end tests (subprocesses, goldens)"
+    )
+
+
 @pytest.fixture
 def rng_board():
     def make(h, w, density=0.5, states=2, seed=0):

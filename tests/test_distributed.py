@@ -95,3 +95,64 @@ def test_non_lead_process_still_writes_its_shards(workload, monkeypatch):
 def test_stream_io_without_output_rejected(workload):
     with pytest.raises(ValueError, match="stream_io"):
         driver.run(RunConfig(backend="sharded", stream_io=True, output_file=""))
+
+
+@pytest.mark.slow
+def test_two_process_distributed_run(tmp_path):
+    """Two REAL OS processes, localhost coordinator, Gloo CPU collectives:
+    init_distributed -> sharded run with cross-process ppermute halos ->
+    collective per-shard output writes.  The merged file must equal the
+    truth executor — the ``mpiexec -n 2`` analogue of the reference
+    (Parallel_Life_MPI.cpp:195-197), with no mocks anywhere (VERDICT r3
+    item 5, replacing monkeypatch-only coverage of the multi-host wiring).
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    board = random_board(37, 29, seed=13)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "grid_size_data.txt", 37, 29, 6)
+
+    with socket.socket() as s:  # free localhost port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # children must not inherit the fake 8-device flag (each process
+        # contributes its own single CPU device to the 2-device global mesh)
+        # nor any preset coordinate triple
+        if k not in ("XLA_FLAGS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")
+    }
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+    assert any("processes=2 global_devices=2" in o for o in outs)
+    # "Total time =" is lead-gated: exactly one process reports it
+    assert sum("Total time =" in o for o in outs) == 1
+
+    got = read_board(tmp_path / "out.txt", 37, 29)
+    np.testing.assert_array_equal(got, run_np(board, get_rule("conway"), 6))

@@ -1,0 +1,44 @@
+"""Platform selection + the wedged-plugin watchdog (VERDICT r3 item 8)."""
+
+import time
+
+import pytest
+
+from tpu_life.utils import platform as plat
+
+
+def test_devices_with_watchdog_returns_devices():
+    devices = plat.devices_with_watchdog(timeout_s=60)
+    assert len(devices) >= 1
+
+
+def test_devices_with_watchdog_times_out_on_hang(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda: time.sleep(30))
+    with pytest.raises(TimeoutError, match="wedged"):
+        plat.devices_with_watchdog(timeout_s=0.2)
+
+
+def test_devices_with_watchdog_propagates_errors(monkeypatch):
+    import jax
+
+    def boom():
+        raise RuntimeError("no chip for you")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    with pytest.raises(RuntimeError, match="no chip"):
+        plat.devices_with_watchdog(timeout_s=10)
+
+
+def test_cli_exits_2_with_message_on_wedged_plugin(monkeypatch, capsys, tmp_path):
+    import jax
+
+    from tpu_life import cli
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("TPU_LIFE_DEVICE_TIMEOUT_S", "0.2")
+    monkeypatch.setattr(jax, "devices", lambda: time.sleep(30))
+    rc = cli.main(["run"])
+    assert rc == 2
+    assert "wedged" in capsys.readouterr().err

@@ -55,3 +55,136 @@ def test_mpi_backend_errors_helpfully_without_mpi4py():
         pass
     with pytest.raises(ValueError, match="unavailable.*mpi4py|mpi4py"):
         get_backend("mpi")
+
+
+# --- MpiBackend via an injected in-process communicator ---------------------
+# mpi4py cannot be installed in this image, so the per-rank Sendrecv/gather
+# logic runs over a thread-backed fake implementing the same surface — the
+# first time this code path has ever executed (VERDICT r3 item 9).
+
+
+class _FakeWorld:
+    """Shared state for an R-rank fake communicator over threads."""
+
+    def __init__(self, size: int):
+        import queue
+        import threading
+
+        self.size = size
+        self._queues: dict = {}
+        self._lock = threading.Lock()
+        self._barrier = threading.Barrier(size)
+        self._slots: list = [None] * size
+        self._queue_mod = queue
+
+    def chan(self, src: int, dst: int, tag: int):
+        with self._lock:
+            return self._queues.setdefault(
+                (src, dst, tag), self._queue_mod.Queue()
+            )
+
+    def exchange_all(self, rank: int, value):
+        """allgather: deposit, meet, copy out, meet again (so a fast rank
+        cannot overwrite slots before everyone has read)."""
+        self._slots[rank] = value
+        self._barrier.wait(timeout=60)
+        vals = list(self._slots)
+        self._barrier.wait(timeout=60)
+        return vals
+
+
+class _FakeComm:
+    """The subset of the mpi4py communicator surface MpiBackend uses."""
+
+    def __init__(self, world: _FakeWorld, rank: int):
+        self.world = world
+        self.rank = rank
+
+    def Get_rank(self) -> int:
+        return self.rank
+
+    def Get_size(self) -> int:
+        return self.world.size
+
+    def Sendrecv(self, sendbuf, dest, sendtag, recvbuf, source, recvtag):
+        self.world.chan(self.rank, dest, sendtag).put(
+            np.array(sendbuf, copy=True)
+        )
+        recvbuf[...] = self.world.chan(source, self.rank, recvtag).get(
+            timeout=60
+        )
+
+    def allgather(self, value):
+        return self.world.exchange_all(self.rank, value)
+
+    def gather(self, value, root=0):
+        vals = self.world.exchange_all(self.rank, value)
+        return vals if self.rank == root else None
+
+
+def _run_mpi_ranks(board, rule, steps, size, **run_kwargs):
+    """Run MpiBackend on `size` fake ranks concurrently; return per-rank
+    results (re-raising any rank's exception)."""
+    import threading
+
+    from tpu_life.backends.stripes_backend import MpiBackend
+
+    world = _FakeWorld(size)
+    results: list = [None] * size
+    errors: list = [None] * size
+
+    def work(rank: int) -> None:
+        try:
+            be = MpiBackend(comm=_FakeComm(world, rank))
+            results[rank] = be.run(board, rule, steps, **run_kwargs)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors[rank] = e
+
+    threads = [
+        threading.Thread(target=work, args=(i,), name=f"rank{i}")
+        for i in range(size)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+@pytest.mark.parametrize("size", [1, 2, 4])
+def test_mpi_backend_matches_numpy_across_rank_counts(size, rng_board):
+    rule = get_rule("conway")
+    b = rng_board(44, 31, seed=55)
+    expect = run_np(b, rule, 8)
+    for out in _run_mpi_ranks(b, rule, 8, size):
+        np.testing.assert_array_equal(out, expect)
+
+
+def test_mpi_backend_wide_radius(rng_board):
+    rule = parse_rule("R2,C2,S8..12,B7..8")
+    b = rng_board(36, 28, seed=56)
+    expect = run_np(b, rule, 5)
+    for out in _run_mpi_ranks(b, rule, 5, 3):
+        np.testing.assert_array_equal(out, expect)
+
+
+def test_mpi_backend_chunk_callback_is_rank0_only(rng_board):
+    rule = get_rule("conway")
+    b = rng_board(24, 20, seed=57)
+    calls: list = []
+
+    # the callback object is shared; only rank 0 must ever invoke it
+    def cb(done, get_board):
+        import threading
+
+        calls.append((threading.current_thread().name, done, get_board()))
+
+    outs = _run_mpi_ranks(b, rule, 6, 3, chunk_steps=2, callback=cb)
+    assert [c[0] for c in calls] == ["rank0"] * 3
+    assert [c[1] for c in calls] == [2, 4, 6]
+    np.testing.assert_array_equal(calls[-1][2], run_np(b, rule, 6))
+    for out in outs:
+        np.testing.assert_array_equal(out, run_np(b, rule, 6))

@@ -288,7 +288,9 @@ class ShardedBackend:
         from tpu_life.backends.pallas_backend import sharded_pallas_int8_frame
 
         sh = ceil_to(-(-h // self.n), SUBLANE)
-        bc = self.pallas_block_cols
+        # clamp the tile width to the board: a narrow board must not pay for
+        # a full 512-cell tile of mostly padding columns
+        bc = min(self.pallas_block_cols, ceil_to(w, LANE))
         if self._block_steps_arg is None:
             want = 8  # mirror PallasBackend's int8 default (k=8 peak on v5e)
         else:

@@ -94,26 +94,33 @@ class StripesBackend:
 
 @register_backend("mpi")
 class MpiBackend:
-    """Real-MPI variant: one stripe per rank via mpi4py, if available.
+    """Real-MPI variant: one stripe per rank via mpi4py (EXPERIMENTAL).
 
     The driver process is rank 0; this backend only functions under
     ``mpiexec`` with mpi4py installed — otherwise it raises with guidance.
+    mpi4py is not installable in the CI image, so the per-rank logic is
+    exercised by ``tests/test_stripes.py`` through an injected in-process
+    fake communicator (``comm=``) that implements the same ``Sendrecv`` /
+    ``gather`` / ``allgather`` surface over threads; a real ``mpiexec -n``
+    run has never executed in CI — hence the experimental label in the CLI.
     Halo traffic uses 1 byte/cell (the reference inflated halos 4x by
     sending MPI_INT, Parallel_Life_MPI.cpp:114-115; SURVEY.md §2.4).
     """
 
     name = "mpi"
 
-    def __init__(self, **_):
-        try:
-            from mpi4py import MPI  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "backend 'mpi' needs mpi4py (not installed in this image); "
-                "use --backend stripes for the single-process structural "
-                "equivalent"
-            ) from e
-        self.MPI = MPI
+    def __init__(self, *, comm=None, **_):
+        if comm is None:
+            try:
+                from mpi4py import MPI
+            except ImportError as e:
+                raise ImportError(
+                    "backend 'mpi' needs mpi4py (not installed in this image); "
+                    "use --backend stripes for the single-process structural "
+                    "equivalent"
+                ) from e
+            comm = MPI.COMM_WORLD
+        self.comm = comm
 
     def run(
         self,
@@ -124,8 +131,7 @@ class MpiBackend:
         chunk_steps: int = 0,
         callback: ChunkCallback | None = None,
     ) -> np.ndarray:
-        MPI = self.MPI
-        comm = MPI.COMM_WORLD
+        comm = self.comm
         rank, size = comm.Get_rank(), comm.Get_size()
         board = np.asarray(board, np.int8)
         h, w = board.shape
@@ -160,9 +166,14 @@ class MpiBackend:
                 stripe = nxt[r:-r] if size > 1 else nxt
                 done += 1
             if callback is not None:
-                # every rank reconstructs the global board so snapshot /
-                # metric hooks behave identically across backends
-                full = np.vstack(comm.allgather(stripe))
-                callback(done, lambda full=full: full)
+                # per-chunk side effects (snapshots, metrics) are rank-0
+                # single-writer — gather to root only, instead of every rank
+                # reconstructing the whole board (O(size) traffic, not
+                # O(size^2); VERDICT r3 item 9)
+                parts = comm.gather(stripe, root=0)
+                if rank == 0:
+                    full = np.vstack(parts)
+                    callback(done, lambda full=full: full)
+        # the Backend.run contract returns the board on every caller
         gathered = comm.allgather(stripe)
         return np.vstack(gathered)

@@ -37,10 +37,30 @@ def init_distributed() -> None:
         jax.distributed, "is_initialized", lambda: False
     )():
         return
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
-    ):
-        jax.distributed.initialize()
+    )
+    if addr:
+        kwargs = {}
+        num = os.environ.get("JAX_NUM_PROCESSES")
+        if num is not None:
+            # explicit process spec (the mpiexec -n analogue): launchers that
+            # aren't a recognized cluster environment pass the coordinate
+            # triple directly instead of relying on auto-detection
+            pid = os.environ.get("JAX_PROCESS_ID")
+            if pid is None:
+                raise RuntimeError(
+                    "incomplete distributed process spec: JAX_NUM_PROCESSES "
+                    "is set but JAX_PROCESS_ID is not (the explicit triple is "
+                    "JAX_COORDINATOR_ADDRESS + JAX_NUM_PROCESSES + "
+                    "JAX_PROCESS_ID)"
+                )
+            kwargs = dict(
+                coordinator_address=addr,
+                num_processes=int(num),
+                process_id=int(pid),
+            )
+        jax.distributed.initialize(**kwargs)
         _distributed_initialized = True
 
 

@@ -19,3 +19,49 @@ def ensure_platform(platform: str | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", platform)
+
+
+DEVICE_QUERY_TIMEOUT_S = 180.0  # first tunneled-TPU attach can take minutes
+
+
+def devices_with_watchdog(timeout_s: float | None = None):
+    """``jax.devices()`` that cannot hang the process forever.
+
+    A tunneled-TPU plugin blocks indefinitely at the first device query when
+    its chip grant is stale (the round-1 bench lesson, BENCH_r01.json rc=1)
+    — and ``get_backend('auto')`` triggers exactly that query in-process, so
+    ``python -m tpu_life run`` on a wedged machine would just hang
+    (VERDICT r3 item 8).  The query runs in a daemon thread with a timeout;
+    on expiry a TimeoutError with recovery guidance is raised (the stuck
+    thread is abandoned — callers are expected to exit).
+    """
+    import threading
+
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("TPU_LIFE_DEVICE_TIMEOUT_S", DEVICE_QUERY_TIMEOUT_S)
+        )
+    result: dict = {}
+
+    def query() -> None:
+        try:
+            import jax
+
+            result["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — re-raised on the caller side
+            result["error"] = e
+
+    t = threading.Thread(target=query, daemon=True, name="device-watchdog")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError(
+            f"device query hung for {timeout_s:.0f}s — the accelerator "
+            "plugin appears wedged (stale chip grant?).  Run on CPU with "
+            "TPU_LIFE_PLATFORM=cpu (and PALLAS_AXON_POOL_IPS= to skip "
+            "plugin registration), or retry in a few minutes once the "
+            "grant expires."
+        )
+    if "error" in result:
+        raise result["error"]
+    return result["devices"]

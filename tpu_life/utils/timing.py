@@ -25,3 +25,35 @@ class Timer:
     @property
     def elapsed(self) -> float:
         return time.perf_counter() - self.start
+
+
+def delta_seconds_per_step(
+    runner, steps: int, base_steps: int, repeats: int = 3
+) -> float:
+    """Sustained device seconds/step of a Runner via delta timing.
+
+    Two fused runs of different step counts are timed and differenced — the
+    delta cancels the constant dispatch + readback latency, which on a
+    tunneled TPU dwarfs the kernel time itself.  The first pair of calls
+    warms up compilation for both step counts.  Negative deltas (timer
+    noise) are discarded; if none are positive the plain per-step time of
+    the long run is returned.  Single source of the methodology for both
+    ``bench.py`` and ``experiments/``.
+    """
+    if steps <= base_steps:
+        raise ValueError(f"steps {steps} must exceed base_steps {base_steps}")
+
+    def timed(k: int) -> float:
+        t0 = time.perf_counter()
+        runner.advance(k)
+        runner.sync()
+        return time.perf_counter() - t0
+
+    timed(base_steps)  # warmup: compile both timed step counts
+    timed(steps)
+    deltas = [
+        (timed(steps) - timed(base_steps)) / (steps - base_steps)
+        for _ in range(repeats)
+    ]
+    positive = [d for d in deltas if d > 0]
+    return min(positive) if positive else timed(steps) / steps
