@@ -1,0 +1,83 @@
+"""2-D block I/O microbench: native threaded segments vs the Python loop.
+
+VERDICT r3 item 6: ``read_block``/``write_block`` were one Python-level
+``pread``/``pwrite`` per row segment — on a 65536^2 board over an (8,4)
+mesh that is ~16k Python syscall round-trips per shard per write.  This
+measures the native (``native/codec.cpp`` tl_read_block/tl_write_block)
+vs pure-Python path on one 2-D shard of an N^2 board.
+
+Usage: python experiments/blockio_bench.py [n=8192] [mesh_r=8] [mesh_c=4]
+"""
+
+import json
+import time
+
+
+def run(n=8192, mesh_r=8, mesh_c=4):
+    import numpy as np
+
+    import tpu_life.io.codec as codec
+    from tpu_life.io import native, sharded
+
+    if not native.build():
+        raise SystemExit("native library unavailable")
+
+    rows, cols = n // mesh_r, n // mesh_c
+    rng = np.random.default_rng(0)
+    shard = rng.integers(0, 2, size=(rows, cols), dtype=np.int8)
+
+    import tempfile, os, pathlib
+
+    d = tempfile.mkdtemp()
+    path = pathlib.Path(d) / "board.txt"
+
+    def timeit(fn, repeats=3):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # interior shard (not last column: no newline ownership, the common case)
+    r0, c0 = rows, cols
+    results = {}
+    for label, force_python in [("native", False), ("python", True)]:
+        native_fn = codec._native
+        if force_python:
+            codec._native = lambda: None
+        try:
+            results[f"write_{label}_s"] = timeit(
+                lambda: sharded.write_block(
+                    path, r0, c0, shard, total_rows=n, total_cols=n
+                )
+            )
+            results[f"read_{label}_s"] = timeit(
+                lambda: sharded.read_block(path, r0, rows, c0, cols, n)
+            )
+        finally:
+            codec._native = native_fn
+
+    got = sharded.read_block(path, r0, rows, c0, cols, n)
+    assert np.array_equal(got, shard), "parity violation"
+    os.remove(path)
+
+    print(
+        json.dumps(
+            {
+                "experiment": "blockio_native_vs_python",
+                "board": n,
+                "shard": [rows, cols],
+                **{k: round(v, 6) for k, v in results.items()},
+                "write_speedup": results["write_python_s"] / results["write_native_s"],
+                "read_speedup": results["read_python_s"] / results["read_native_s"],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    kw = dict(arg.split("=") for arg in sys.argv[1:])
+    run(**{k: int(v) for k, v in kw.items()})

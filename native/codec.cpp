@@ -27,6 +27,18 @@ namespace {
 constexpr unsigned char kZero = '0';
 constexpr unsigned char kNewline = '\n';
 
+// branch-free digit decode the compiler can vectorize: validity is OR-folded
+// into one flag checked per row instead of branching per byte
+inline int decode_segment(const unsigned char* src, int8_t* dst, long n) {
+  unsigned char bad = 0;
+  for (long c = 0; c < n; ++c) {
+    unsigned char b = src[c];
+    bad |= static_cast<unsigned char>((b < kZero) | (b > kZero + 9));
+    dst[c] = static_cast<int8_t>(b - kZero);
+  }
+  return bad ? -3 : 0;
+}
+
 struct DecodeTask {
   const unsigned char* buf;
   int8_t* out;
@@ -41,18 +53,13 @@ void* decode_rows(void* arg) {
   const long stride = t->w + 1;
   for (long r = t->row_begin; r < t->row_end; ++r) {
     const unsigned char* src = t->buf + r * stride;
-    int8_t* dst = t->out + r * t->w;
     if (src[t->w] != kNewline) {
       t->rc = -2;
       return nullptr;
     }
-    for (long c = 0; c < t->w; ++c) {
-      unsigned char b = src[c];
-      if (b < kZero || b > kZero + 9) {
-        t->rc = -3;
-        return nullptr;
-      }
-      dst[c] = static_cast<int8_t>(b - kZero);
+    if (decode_segment(src, t->out + r * t->w, t->w) != 0) {
+      t->rc = -3;
+      return nullptr;
     }
   }
   t->rc = 0;
@@ -193,6 +200,192 @@ int tl_write_stripe(const char* path, long row_start, long nrows, long w,
   int rc = pwrite_all(fd, buf.data(), nrows * stride, row_start * stride);
   close(fd);
   return rc;
+}
+
+}  // extern "C"
+
+// --- 2-D block I/O ---------------------------------------------------------
+// The 2-D-mesh analogue of the stripe calls: a rectangular sub-block is
+// nrows strided row *segments* of ncols cells at byte offset
+// row * (total_cols + 1) + col_start — the reference's offset scheme
+// (Parallel_Life_MPI.cpp:172-175) generalized with a column offset.  Threads
+// split the rows; each thread issues its own pread/pwrite per segment, so the
+// syscall fan-out that was a Python-level loop in tpu_life/io/sharded.py runs
+// as parallel C instead (VERDICT r3 item 6).
+
+namespace {
+
+struct ReadBlockTask {
+  int fd;
+  int8_t* out;  // (nrows, ncols) row-major
+  long col_start, ncols, stride;
+  long row0;  // absolute file row of out row 0
+  long row_begin, row_end;
+  int rc;
+};
+
+void* read_block_rows(void* arg) {
+  auto* t = static_cast<ReadBlockTask*>(arg);
+  const long n = t->row_end - t->row_begin;
+  if (n <= 0) {
+    t->rc = 0;
+    return nullptr;
+  }
+  // When the segment is a decent fraction of the row, one spanning pread per
+  // bounded row group (neighbors' columns included) beats a syscall per row:
+  // the page cache serves the extra bytes at memcpy speed.  The group cap
+  // keeps the transient buffer ~8 MiB per thread no matter how large the
+  // block — a 65536^2 column shard must not buffer the whole file.  Narrow
+  // segments of very wide rows keep the per-row reads.
+  const bool spanning = t->ncols * 4 >= t->stride;
+  if (spanning) {
+    const long group = std::max(1L, (8L << 20) / t->stride);
+    std::vector<unsigned char> buf;
+    try {
+      buf.resize(std::min(n, group) * t->stride);
+    } catch (...) {  // bad_alloc must not escape a pthread start routine
+      t->rc = -1;
+      return nullptr;
+    }
+    for (long g0 = 0; g0 < n; g0 += group) {
+      const long g = std::min(group, n - g0);
+      const long base =
+          (t->row0 + t->row_begin + g0) * t->stride + t->col_start;
+      const long span = (g - 1) * t->stride + t->ncols;
+      if (pread_all(t->fd, buf.data(), span, base) != 0) {
+        t->rc = -1;
+        return nullptr;
+      }
+      for (long r = 0; r < g; ++r) {
+        if (decode_segment(buf.data() + r * t->stride,
+                           t->out + (t->row_begin + g0 + r) * t->ncols,
+                           t->ncols) != 0) {
+          t->rc = -3;
+          return nullptr;
+        }
+      }
+    }
+  } else {
+    std::vector<unsigned char> buf(t->ncols);
+    for (long r = t->row_begin; r < t->row_end; ++r) {
+      long off = (t->row0 + r) * t->stride + t->col_start;
+      if (pread_all(t->fd, buf.data(), t->ncols, off) != 0) {
+        t->rc = -1;
+        return nullptr;
+      }
+      if (decode_segment(buf.data(), t->out + r * t->ncols, t->ncols) != 0) {
+        t->rc = -3;
+        return nullptr;
+      }
+    }
+  }
+  t->rc = 0;
+  return nullptr;
+}
+
+struct WriteBlockTask {
+  int fd;
+  const int8_t* in;  // (nrows, ncols) row-major
+  long col_start, ncols, stride;
+  long row0;
+  long row_begin, row_end;
+  bool last_col;  // this block owns each row's '\n' terminator
+  int rc;
+};
+
+void* write_block_rows(void* arg) {
+  auto* t = static_cast<WriteBlockTask*>(arg);
+  const long seg = t->ncols + (t->last_col ? 1 : 0);
+  std::vector<unsigned char> buf(seg);
+  for (long r = t->row_begin; r < t->row_end; ++r) {
+    const int8_t* src = t->in + r * t->ncols;
+    for (long c = 0; c < t->ncols; ++c)
+      buf[c] = static_cast<unsigned char>(src[c] + kZero);
+    if (t->last_col) buf[t->ncols] = kNewline;
+    long off = (t->row0 + r) * t->stride + t->col_start;
+    if (pwrite_all(t->fd, buf.data(), seg, off) != 0) {
+      t->rc = -1;
+      return nullptr;
+    }
+  }
+  t->rc = 0;
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Read the sub-block rows [row_start, row_start+nrows) x cells
+// [col_start, col_start+ncols) of a board file of width total_cols.
+int tl_read_block(const char* path, long row_start, long nrows, long col_start,
+                  long ncols, long total_cols, int8_t* out, int nthreads) {
+  if (nrows <= 0 || ncols <= 0 || row_start < 0 || col_start < 0 ||
+      col_start + ncols > total_cols)
+    return -2;
+  const long stride = total_cols + 1;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  nthreads = clamp_threads(nrows, nthreads);
+  std::vector<ReadBlockTask> tasks(nthreads);
+  long per = (nrows + nthreads - 1) / nthreads;
+  for (int i = 0; i < nthreads; ++i) {
+    tasks[i] = {fd,        out,
+                col_start, ncols,
+                stride,    row_start,
+                std::min<long>(i * per, nrows),
+                std::min<long>((i + 1) * per, nrows),
+                0};
+  }
+  run_threaded(nrows, nthreads, read_block_rows, tasks.data(),
+               sizeof(ReadBlockTask), nullptr, nullptr);
+  close(fd);
+  for (auto& t : tasks)
+    if (t.rc != 0) return t.rc;
+  return 0;
+}
+
+// Write a sub-block at its contract offsets, pre-sizing the file to
+// total_rows x (total_cols + 1) so independent block writers (any order,
+// any process) compose; the block touching the last column also writes each
+// row's '\n' terminator.
+int tl_write_block(const char* path, long row_start, long col_start,
+                   long nrows, long ncols, long total_rows, long total_cols,
+                   const int8_t* in, int nthreads) {
+  if (nrows <= 0 || ncols <= 0 || row_start < 0 || col_start < 0 ||
+      col_start + ncols > total_cols || total_rows < row_start + nrows)
+    return -2;
+  const long stride = total_cols + 1;
+  int fd = open(path, O_WRONLY | O_CREAT, 0644);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (st.st_size != total_rows * stride &&
+      ftruncate(fd, total_rows * stride) != 0) {
+    close(fd);
+    return -1;
+  }
+  nthreads = clamp_threads(nrows, nthreads);
+  std::vector<WriteBlockTask> tasks(nthreads);
+  long per = (nrows + nthreads - 1) / nthreads;
+  const bool last_col = col_start + ncols == total_cols;
+  for (int i = 0; i < nthreads; ++i) {
+    tasks[i] = {fd,        in,
+                col_start, ncols,
+                stride,    row_start,
+                std::min<long>(i * per, nrows),
+                std::min<long>((i + 1) * per, nrows),
+                last_col,  0};
+  }
+  run_threaded(nrows, nthreads, write_block_rows, tasks.data(),
+               sizeof(WriteBlockTask), nullptr, nullptr);
+  close(fd);
+  for (auto& t : tasks)
+    if (t.rc != 0) return t.rc;
+  return 0;
 }
 
 }  // extern "C"

@@ -51,6 +51,85 @@ def test_stripe_roundtrip(tmp_path):
     np.testing.assert_array_equal(native.read_stripe(p, 37, 55, 300), board[37:92])
 
 
+def test_block_roundtrip_matches_python_path(tmp_path):
+    """Native 2-D block read/write vs the pure-Python pread/pwrite loop:
+    same bytes, same cells, out-of-order writers compose (VERDICT r3 item 6)."""
+    import tpu_life.io.codec as codec
+    from tpu_life.io import sharded
+
+    board = random_board(160, 210, states=3, seed=65)
+    p_nat, p_py = tmp_path / "nat.txt", tmp_path / "py.txt"
+    blocks = [  # a 2x2 block decomposition, written out of order
+        (80, 100, board[80:160, 100:210]),
+        (0, 0, board[0:80, 0:100]),
+        (0, 100, board[0:80, 100:210]),
+        (80, 0, board[80:160, 0:100]),
+    ]
+    for r0, c0, blk in blocks:
+        native.write_block(p_nat, r0, c0, blk, total_rows=160, total_cols=210)
+    native_fn = codec._native
+    codec._native = lambda: None  # force the pure-Python path
+    try:
+        for r0, c0, blk in blocks:
+            sharded.write_block(p_py, r0, c0, blk, total_rows=160, total_cols=210)
+    finally:
+        codec._native = native_fn
+    assert p_nat.read_bytes() == p_py.read_bytes()
+    got = native.read_block(p_nat, 40, 90, 50, 120, 210)
+    np.testing.assert_array_equal(got, board[40:130, 50:170])
+
+
+def test_block_write_rejects_row_overflow(tmp_path):
+    """Both the native and pure-Python paths must reject a block extending
+    past total_rows instead of silently growing the pre-sized file."""
+    import tpu_life.io.codec as codec
+    from tpu_life.io import sharded
+
+    blk = np.ones((20, 10), np.int8)
+    with pytest.raises(ValueError, match="row range|geometry"):
+        sharded.write_block(
+            tmp_path / "a.txt", 90, 0, blk, total_rows=100, total_cols=30
+        )
+    native_fn = codec._native
+    codec._native = lambda: None
+    try:
+        with pytest.raises(ValueError, match="row range"):
+            sharded.write_block(
+                tmp_path / "b.txt", 90, 0, blk, total_rows=100, total_cols=30
+            )
+    finally:
+        codec._native = native_fn
+
+
+def test_block_read_rejects_bad_byte(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_bytes(b"0x0\n000\n")
+    with pytest.raises(ValueError, match="outside"):
+        native.read_block(p, 0, 2, 1, 2, 3)
+
+
+def test_block_dispatch_threshold(tmp_path, monkeypatch):
+    """Above _NATIVE_THRESHOLD the sharded block I/O routes through the
+    native library and stays bit-identical with the Python loop."""
+    import tpu_life.io.codec as codec
+    from tpu_life.io import sharded
+
+    board = random_board(1200, 1900, seed=66)  # block below is > 1<<20 cells
+    p = tmp_path / "b.txt"
+    sharded.write_block(p, 0, 0, board[:, :950], total_rows=1200, total_cols=1900)
+    sharded.write_block(p, 0, 950, board[:, 950:], total_rows=1200, total_cols=1900)
+    got = sharded.read_block(p, 0, 1200, 950, 950, 1900)
+    np.testing.assert_array_equal(got, board[:, 950:])
+    native_fn = codec._native
+    codec._native = lambda: None
+    try:
+        np.testing.assert_array_equal(
+            sharded.read_block(p, 0, 1200, 950, 950, 1900), board[:, 950:]
+        )
+    finally:
+        codec._native = native_fn
+
+
 def test_large_board_dispatch(tmp_path):
     # above the dispatch threshold the public codec uses the native path;
     # results must stay byte-identical with the pure path

@@ -29,7 +29,14 @@ def _load() -> ctypes.CDLL | None:
     return nativelib.load_library(
         _LIB_NAME,
         env_override="TPU_LIFE_NATIVE_LIB",
-        int_functions=["tl_decode", "tl_encode", "tl_read_stripe", "tl_write_stripe"],
+        int_functions=[
+            "tl_decode",
+            "tl_encode",
+            "tl_read_stripe",
+            "tl_write_stripe",
+            "tl_read_block",
+            "tl_write_block",
+        ],
     )
 
 
@@ -97,6 +104,56 @@ def read_stripe(path, row_start: int, num_rows: int, width: int) -> np.ndarray:
     )
     _check(rc, "read_stripe")
     return out
+
+
+def read_block(
+    path,
+    row_start: int,
+    num_rows: int,
+    col_start: int,
+    num_cols: int,
+    width: int,
+) -> np.ndarray:
+    """Threaded strided-segment block read (native/codec.cpp tl_read_block)."""
+    out = np.empty((num_rows, num_cols), dtype=np.int8)
+    rc = _lib.tl_read_block(
+        os.fspath(path).encode(),
+        ctypes.c_long(row_start),
+        ctypes.c_long(num_rows),
+        ctypes.c_long(col_start),
+        ctypes.c_long(num_cols),
+        ctypes.c_long(width),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int(_default_threads()),
+    )
+    _check(rc, "read_block")
+    return out
+
+
+def write_block(
+    path,
+    row_start: int,
+    col_start: int,
+    block: np.ndarray,
+    *,
+    total_rows: int,
+    total_cols: int,
+) -> None:
+    """Threaded strided-segment block write (native/codec.cpp tl_write_block)."""
+    block = np.ascontiguousarray(block, dtype=np.int8)
+    h, w = block.shape
+    rc = _lib.tl_write_block(
+        os.fspath(path).encode(),
+        ctypes.c_long(row_start),
+        ctypes.c_long(col_start),
+        ctypes.c_long(h),
+        ctypes.c_long(w),
+        ctypes.c_long(total_rows),
+        ctypes.c_long(total_cols),
+        block.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int(_default_threads()),
+    )
+    _check(rc, "write_block")
 
 
 def write_stripe(path, row_start: int, stripe: np.ndarray, *, total_rows: int) -> None:
