@@ -86,6 +86,7 @@ def test_block_write_rejects_row_overflow(tmp_path):
     from tpu_life.io import sharded
 
     blk = np.ones((20, 10), np.int8)
+    full = np.ones((20, 30), np.int8)
     with pytest.raises(ValueError, match="row range|geometry"):
         sharded.write_block(
             tmp_path / "a.txt", 90, 0, blk, total_rows=100, total_cols=30
@@ -97,8 +98,29 @@ def test_block_write_rejects_row_overflow(tmp_path):
             sharded.write_block(
                 tmp_path / "b.txt", 90, 0, blk, total_rows=100, total_cols=30
             )
+        # full-width blocks delegate to write_stripe — the check must fire
+        # BEFORE that delegation (and in write_stripe itself)
+        with pytest.raises(ValueError, match="row range"):
+            sharded.write_block(
+                tmp_path / "c.txt", 90, 0, full, total_rows=100, total_cols=30
+            )
+        with pytest.raises(ValueError, match="row range"):
+            sharded.write_stripe(tmp_path / "d.txt", 90, full, total_rows=100)
     finally:
         codec._native = native_fn
+
+
+def test_stale_library_missing_symbols_falls_back(tmp_path, monkeypatch):
+    """A pre-existing .so built before new entry points were added must load
+    as None (NumPy fallback / rebuild), not crash the binding import."""
+    from tpu_life.utils import nativelib
+
+    lib = nativelib.load_library(
+        "libtpulife_io.so",
+        env_override="TPU_LIFE_NATIVE_LIB",
+        int_functions=["tl_decode", "tl_no_such_symbol"],
+    )
+    assert lib is None
 
 
 def test_block_read_rejects_bad_byte(tmp_path):

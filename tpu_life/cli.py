@@ -87,7 +87,9 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
         default="auto",
         choices=["auto", "xla", "pallas"],
         help="per-shard stepper of the sharded backend: Pallas deep-halo "
-        "stripe kernel vs XLA scan (auto: Pallas on TPU 1-D packed meshes)",
+        "kernels (bit-sliced stripes for life-like rules, int8 2-D tiles "
+        "for Larger-than-Life / Generations) vs the XLA scan (auto: Pallas "
+        "on TPU 1-D meshes)",
     )
     r.add_argument("--sync-every", type=int, default=0)
     r.add_argument(

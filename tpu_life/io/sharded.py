@@ -139,6 +139,14 @@ def write_block(
     """
     block = np.asarray(block)
     h, w = block.shape
+    if row_start < 0 or row_start + h > total_rows:
+        # before ANY path (including the full-width write_stripe delegation):
+        # a silent pwrite past the pre-sized file would corrupt the contract,
+        # and the native rc=-2 check must not be stricter than Python's
+        raise ValueError(
+            f"row range [{row_start}, {row_start + h}) outside board "
+            f"height {total_rows}"
+        )
     if col_start == 0 and w == total_cols:
         write_stripe(path, row_start, block, total_rows=total_rows)
         return
@@ -146,13 +154,6 @@ def write_block(
         raise ValueError(
             f"column range [{col_start}, {col_start + w}) outside board "
             f"width {total_cols}"
-        )
-    if row_start < 0 or row_start + h > total_rows:
-        # keep the pure-Python path as strict as the native rc=-2 check: a
-        # silent pwrite past the pre-sized file would corrupt the contract
-        raise ValueError(
-            f"row range [{row_start}, {row_start + h}) outside board "
-            f"height {total_rows}"
         )
     from tpu_life.io import codec
 
@@ -199,6 +200,11 @@ def write_stripe(
 
     stripe = np.asarray(stripe)
     h, w = stripe.shape
+    if row_start < 0 or row_start + h > total_rows:
+        raise ValueError(
+            f"row range [{row_start}, {row_start + h}) outside board "
+            f"height {total_rows}"
+        )
     nat = codec._native()
     if nat is not None and h * w >= codec._NATIVE_THRESHOLD:
         nat.write_stripe(path, row_start, stripe, total_rows=total_rows)

@@ -40,10 +40,13 @@ def load_library(
         if p and p.is_file():
             try:
                 lib = ctypes.CDLL(str(p))
-            except OSError:
+                for fn in int_functions:
+                    getattr(lib, fn).restype = ctypes.c_int
+            except (OSError, AttributeError):
+                # AttributeError = a stale build missing newer entry points:
+                # treat it as unloadable (NumPy fallback / rebuild) rather
+                # than crashing every import of the binding module
                 continue
-            for fn in int_functions:
-                getattr(lib, fn).restype = ctypes.c_int
             return lib
     return None
 
