@@ -115,6 +115,9 @@ def test_stale_library_missing_symbols_falls_back(tmp_path, monkeypatch):
     as None (NumPy fallback / rebuild), not crash the binding import."""
     from tpu_life.utils import nativelib
 
+    # guard against a vacuous pass: the library file must exist so the
+    # missing-symbol getattr (not the missing-file check) is what runs
+    assert (nativelib.NATIVE_DIR / "libtpulife_io.so").is_file()
     lib = nativelib.load_library(
         "libtpulife_io.so",
         env_override="TPU_LIFE_NATIVE_LIB",

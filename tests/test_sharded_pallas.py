@@ -80,11 +80,6 @@ def test_glider_crosses_shard_boundary():
 
 def test_explicit_pallas_rejects_unsupported_configs():
     with pytest.raises(ValueError, match="local_kernel"):
-        # 2-D mesh: the per-shard Pallas kernels are 1-D row meshes only
-        make_backend(mesh_shape=(2, 2)).run(
-            np.zeros((32, 64), np.int8), get_rule("conway"), 1
-        )
-    with pytest.raises(ValueError, match="local_kernel"):
         # gspmd derives its own halo exchange; incompatible by design
         make_backend(num_devices=2, partition_mode="gspmd").run(
             np.zeros((32, 64), np.int8), get_rule("conway"), 1
@@ -141,6 +136,58 @@ def test_int8_kernel_unpacked_conway_matches_xla():
     np.testing.assert_array_equal(pallas, run_np(board, rule, 6))
 
 
+@pytest.mark.parametrize("mesh_shape", [(2, 2), (2, 4), (4, 2)])
+def test_int8_kernel_2d_mesh_ltl(mesh_shape):
+    """The int8 kernel on a 2-D block mesh: both halo phases (rows, then
+    row-extended columns so corners ride transitively) feed the kernel's
+    DMA frame.  Radius-5 halos cross BOTH seam kinds here."""
+    rng = np.random.default_rng(43)
+    board = rng.integers(0, 2, size=(8 * mesh_shape[0] + 5, 150), dtype=np.int8)
+    rule = get_rule("bugs")
+    out = make_backend(mesh_shape=mesh_shape, block_steps=2).run(board, rule, 5)
+    np.testing.assert_array_equal(out, run_np(board, rule, 5))
+
+
+def test_int8_kernel_2d_mesh_glider():
+    """Conway glider sailing across a 2-D-mesh corner seam, through the
+    unpacked int8 kernel (explicit pallas on a 2-D mesh runs unpacked)."""
+    from tpu_life.models.patterns import GLIDER, place
+
+    rule = get_rule("conway")
+    board = np.zeros((64, 64), dtype=np.int8)
+    board = place(board, GLIDER, 26, 26)
+    out = make_backend(mesh_shape=(2, 2), block_steps=2).run(board, rule, 24)
+    np.testing.assert_array_equal(out, run_np(board, rule, 24))
+    assert out.sum() == 5
+
+
+def test_int8_kernel_2d_mesh_multistate():
+    rng = np.random.default_rng(47)
+    rule = get_rule("brians_brain")
+    board = (
+        rng.integers(0, rule.states, size=(40, 90), dtype=np.int8)
+        * rng.integers(0, 2, size=(40, 90), dtype=np.int8)
+    )
+    out = make_backend(mesh_shape=(2, 2), block_steps=2).run(board, rule, 6)
+    np.testing.assert_array_equal(out, run_np(board, rule, 6))
+
+
+def test_int8_kernel_2d_streaming_io(tmp_path):
+    """File->2-D shards->file through the halo-free int8 layout."""
+    from tpu_life.io.codec import read_board, write_board
+
+    rng = np.random.default_rng(53)
+    board = rng.integers(0, 2, size=(36, 83), dtype=np.int8)
+    src, dst = tmp_path / "in.txt", tmp_path / "out.txt"
+    write_board(src, board)
+    rule = get_rule("bugs")
+    b = make_backend(mesh_shape=(2, 2), block_steps=2)
+    runner = b.prepare_from_file(src, 36, 83, rule)
+    runner.advance(5)
+    b.write_runner_to_file(runner, dst, 36, 83, rule)
+    np.testing.assert_array_equal(read_board(dst, 36, 83), run_np(board, rule, 5))
+
+
 def test_int8_kernel_block_steps_remainders():
     """Odd step counts split into deep-halo blocks + a remainder block whose
     kernel reuses the prepare-time frame layout."""
@@ -152,8 +199,8 @@ def test_int8_kernel_block_steps_remainders():
 
 
 def test_int8_kernel_streaming_io(tmp_path):
-    """File->shards->file round trip through the frame-shifted int8 layout
-    (col_shift): offsets must still be contract-exact."""
+    """File->shards->file round trip through the halo-free int8 layout:
+    offsets must still be contract-exact."""
     from tpu_life.io.codec import read_board, write_board
 
     rng = np.random.default_rng(41)

@@ -386,15 +386,34 @@ def make_pallas_sharded_stripe_block(
 
 
 def _sharded_epoch_loop(
-    mesh, row_axis: str, fr: int, make_block
+    mesh,
+    row_axis: str,
+    fr: int,
+    make_block,
+    *,
+    col_axis: str | None = None,
+    fc: int = 0,
+    halo_cols: int = 0,
 ) -> Callable[[jax.Array, int], jax.Array]:
     """Shared scaffold for the sharded Pallas runs: non-periodic ``ppermute``
-    row halos (skipped entirely on one-shard meshes, where both neighbors
-    are off the mesh end — VERDICT r3 item 2), a ``lax.scan`` over deep-halo
+    row halos (skipped entirely on one-shard axes, where both neighbors are
+    off the mesh end — VERDICT r3 item 2), a ``lax.scan`` over deep-halo
     blocks, and the jit + shard_map wrapper.
 
-    ``make_block(hl, wp) -> block(ext, row0) -> (hl, wp) chunk`` builds the
-    per-shard kernel once shard shapes are known (and may validate them).
+    ``make_block(hl, wl) -> block(ext, row0, col0) -> (hl, wl) chunk``
+    builds the per-shard kernel once shard shapes are known (and may
+    validate them).  ``ext`` carries ``fr`` extension rows and ``fc``
+    extension columns on each side; ``(row0, col0)`` are the global board
+    coordinates of ext cell (0, 0).
+
+    Columns: with ``fc > 0`` the chunk is column-extended too.  On a 2-D
+    mesh (``col_axis`` sized > 1) only the ``halo_cols`` edge columns that
+    the stencil actually needs ride the column ``ppermute`` — they are
+    exchanged *after* (and including) the row extension, so corner cells
+    arrive transitively, exactly like the two-phase XLA exchange
+    (tpu_life.parallel.halo) — and are padded with dead zeros out to the
+    lane-aligned ``fc`` the kernel DMA windows require.  On a 1-D mesh the
+    whole column extension is the zero frame (the clamped board edge).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -404,38 +423,62 @@ def _sharded_epoch_loop(
     from jax import shard_map
 
     n_r = mesh.shape[row_axis]
-    fwd = [(i, i + 1) for i in range(n_r - 1)]
-    bwd = [(i + 1, i) for i in range(n_r - 1)]
+    split_cols = col_axis is not None and mesh.shape.get(col_axis, 1) > 1
+    n_c = mesh.shape[col_axis] if split_cols else 1
+    fwd_r = [(i, i + 1) for i in range(n_r - 1)]
+    bwd_r = [(i + 1, i) for i in range(n_r - 1)]
+    fwd_c = [(i, i + 1) for i in range(n_c - 1)]
+    bwd_c = [(i + 1, i) for i in range(n_c - 1)]
 
     def local_run(chunk: jax.Array, num_blocks: int) -> jax.Array:
-        hl, wp = chunk.shape
-        if fr > hl:
+        hl, wl = chunk.shape
+        if fr > hl or (split_cols and halo_cols > wl):
             raise ValueError(
-                f"halo depth {fr} exceeds shard height {hl}; lower "
-                f"block_steps or use a smaller mesh"
+                f"halo depth {(fr, halo_cols)} exceeds shard shape "
+                f"{(hl, wl)}; lower block_steps or use a smaller mesh"
             )
-        kern = make_block(hl, wp)
+        kern = make_block(hl, wl)
         ri = lax.axis_index(row_axis)
         row0 = ri * hl - fr  # global row of ext row 0
+        if split_cols:
+            col0 = lax.axis_index(col_axis) * wl - fc
+        else:
+            col0 = -fc
 
-        zero_halo = jnp.zeros((fr, wp), chunk.dtype)
+        zero_rows = jnp.zeros((fr, wl), chunk.dtype)
+        er = hl + 2 * fr
 
         def block(c: jax.Array) -> jax.Array:
             if n_r == 1:
-                top = bot = zero_halo
+                top = bot = zero_rows
             else:
                 # ppermute zero-fills at the mesh ends = clamped dead boundary
-                top = lax.ppermute(c[hl - fr :, :], row_axis, fwd)
-                bot = lax.ppermute(c[:fr, :], row_axis, bwd)
+                top = lax.ppermute(c[hl - fr :, :], row_axis, fwd_r)
+                bot = lax.ppermute(c[:fr, :], row_axis, bwd_r)
             ext = jnp.concatenate([top, c, bot], axis=0)
-            return kern(ext, row0)
+            if fc:
+                if split_cols:
+                    # exchange only the stencil-needed edge columns of the
+                    # row-extended chunk; pad to the aligned fc with zeros
+                    left = lax.ppermute(
+                        ext[:, wl - halo_cols :], col_axis, fwd_c
+                    )
+                    right = lax.ppermute(ext[:, :halo_cols], col_axis, bwd_c)
+                    pad = jnp.zeros((er, fc - halo_cols), chunk.dtype)
+                    ext = jnp.concatenate(
+                        [pad, left, ext, right, pad], axis=1
+                    )
+                else:
+                    zpad = jnp.zeros((er, fc), chunk.dtype)
+                    ext = jnp.concatenate([zpad, ext, zpad], axis=1)
+            return kern(ext, row0, col0)
 
         out, _ = lax.scan(
             lambda c, _: (block(c), None), chunk, None, length=num_blocks
         )
         return out
 
-    spec = P(row_axis, None)
+    spec = P(row_axis, col_axis if split_cols else None)
 
     @partial(jax.jit, static_argnames="num_blocks", donate_argnums=0)
     def run(board: jax.Array, num_blocks: int) -> jax.Array:
@@ -507,7 +550,7 @@ def make_sharded_pallas_run(
             raise ValueError(
                 f"shard height {hl} not a multiple of block_rows {block_rows}"
             )
-        return make_pallas_sharded_stripe_block(
+        kern = make_pallas_sharded_stripe_block(
             rule,
             (hl + 2 * fr, wp),
             tuple(logical_shape),
@@ -516,6 +559,8 @@ def make_sharded_pallas_run(
             block_steps=block_steps,
             interpret=interpret,
         )
+        # packed stripes are full-width: no column extension, col0 unused
+        return lambda ext, row0, col0: kern(ext, row0)
 
     return _sharded_epoch_loop(mesh, row_axis, fr, make_block)
 
@@ -541,32 +586,33 @@ def make_pallas_sharded_int8_block(
     block_cols: int,
     block_steps: int,
     interpret: bool = False,
-) -> Callable[[jax.Array, jax.Array], jax.Array]:
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
     """The per-shard twin of :func:`make_pallas_multi_step` — wide-radius /
-    multistate rules on a row-sharded board (SURVEY.md §7.6's deep-halo
+    multistate rules on a mesh-sharded board (SURVEY.md §7.6's deep-halo
     design composed with the mesh; reference analogue: the ghost-row scheme
-    of Parallel_Life_MPI.cpp:104-145 at radius > 1).
+    of Parallel_Life_MPI.cpp:104-145 at radius > 1, generalized to 2-D
+    block decompositions).
 
-    ``block(ext_chunk, row0) -> chunk``: ``block_steps`` int8 CA steps on a
-    shard's halo-extended chunk, gridding over 2-D tiles.  The *vertical*
-    halo (``fr`` rows) arrives by ``ppermute`` and is dropped from the
-    output; the *horizontal* frame (``fc`` zero columns each side) is baked
-    into the array layout — columns are not sharded, so the frame plays the
-    role of :func:`make_pallas_multi_step`'s zero border and must be
-    re-zeroed by the caller after each call (``_zero_frame``).  ``row0``
-    (global row of ext row 0) is scalar-prefetched, as in
-    :func:`make_pallas_sharded_stripe_block`.
+    ``block(ext_chunk, row0, col0) -> chunk``: ``block_steps`` int8 CA
+    steps on a shard's halo-extended chunk, gridding over 2-D tiles.  Both
+    halos (``fr`` rows, ``fc`` cols) arrive concatenated onto the chunk by
+    the epoch loop — neighbor data on interior edges, zeros at the mesh
+    ends — and are dropped from the output, which therefore tiles exactly
+    (no unwritten frame to re-zero).  ``row0``/``col0`` (the global board
+    coordinates of ext cell (0, 0)) are scalar-prefetched so the in-kernel
+    validity mask can pin out-of-board cells dead on every mesh position.
     """
-    ext_rows, wp = ext_shape
+    ext_rows, ext_cols = ext_shape
     fr, fc = frame
     lh, lw = logical
     out_rows = ext_rows - 2 * fr
+    out_cols = ext_cols - 2 * fc
     nb_r = out_rows // block_rows
-    nb_c = (wp - 2 * fc) // block_cols
+    nb_c = out_cols // block_cols
     ext_r = block_rows + 2 * fr
     ext_c = block_cols + 2 * fc
 
-    def kernel(row0_ref, x_hbm, out_hbm, scratch, in_sem, out_sem):
+    def kernel(origin_ref, x_hbm, out_hbm, scratch, in_sem, out_sem):
         i = pl.program_id(0)
         j = pl.program_id(1)
         r0 = i * block_rows  # ext-chunk row of scratch row 0
@@ -577,20 +623,21 @@ def make_pallas_sharded_int8_block(
         cp.start()
         cp.wait()
 
-        # validity on the logical board: global row of scratch row 0 is the
-        # shard offset plus the tile offset; global col of scratch col 0 is
-        # c0 - fc (columns are unsharded, the frame shifts them)
+        # validity on the logical board: the scalar-prefetched origin is
+        # the global coordinate of ext cell (0, 0) for this shard
         row_ids = lax.broadcasted_iota(jnp.int32, (ext_r, ext_c), 0) + (
-            row0_ref[0] + r0
+            origin_ref[0] + r0
         )
-        col_ids = lax.broadcasted_iota(jnp.int32, (ext_r, ext_c), 1) + (c0 - fc)
+        col_ids = lax.broadcasted_iota(jnp.int32, (ext_r, ext_c), 1) + (
+            origin_ref[1] + c0
+        )
         valid = (row_ids >= 0) & (row_ids < lh) & (col_ids >= 0) & (col_ids < lw)
 
         _int8_substeps(scratch, valid, rule, block_steps)
 
         wr = pltpu.make_async_copy(
             scratch.at[pl.ds(fr, block_rows), pl.ds(fc, block_cols)],
-            out_hbm.at[pl.ds(r0, block_rows), pl.ds(c0 + fc, block_cols)],
+            out_hbm.at[pl.ds(r0, block_rows), pl.ds(c0, block_cols)],
             out_sem,
         )
         wr.start()
@@ -609,12 +656,15 @@ def make_pallas_sharded_int8_block(
                 pltpu.SemaphoreType.DMA(()),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((out_rows, wp), jnp.int8),
+        out_shape=jax.ShapeDtypeStruct((out_rows, out_cols), jnp.int8),
         interpret=interpret,
     )
 
-    def block(ext: jax.Array, row0: jax.Array) -> jax.Array:
-        return stepper(jnp.atleast_1d(row0).astype(jnp.int32), ext)
+    def block(ext: jax.Array, row0: jax.Array, col0: jax.Array) -> jax.Array:
+        origin = jnp.stack(
+            [jnp.asarray(row0, jnp.int32), jnp.asarray(col0, jnp.int32)]
+        )
+        return stepper(origin, ext)
 
     return block
 
@@ -627,8 +677,8 @@ def make_sharded_pallas_int8_run(
     block_steps: int = 1,
     block_rows: int = 256,
     block_cols: int = 512,
-    frame_cols: int | None = None,
     row_axis: str | None = None,
+    col_axis: str | None = None,
     interpret: bool = False,
 ) -> Callable[[jax.Array, int], jax.Array]:
     """``run(board, num_blocks)``: the sharded epoch loop with the int8
@@ -636,32 +686,31 @@ def make_sharded_pallas_int8_run(
     rules at single-chip kernel throughput on a multi-chip mesh (VERDICT r3
     item 3; BASELINE.md row 6's weak-scaling config).
 
-    Same shape as :func:`make_sharded_pallas_run` (ppermute row halos inside
-    shard_map) with two differences: the board is int8 with a ``frame_cols``
-    zero-column border baked into the layout, and the local kernel tiles in
-    2-D.  ``frame_cols`` is a *layout* constant fixed at prepare time (from
-    the configured block_steps); remainder runs with smaller ``block_steps``
-    reuse it — deeper than needed is harmless, the extra frame is just more
-    dead border.
+    Works on 1-D row meshes and 2-D rows × cols block meshes alike: the
+    epoch loop extends each shard with ``fr`` halo rows and ``fc`` halo
+    columns per side (ppermute on sharded axes, zeros at mesh ends / on a
+    1-D mesh), and the kernel tiles the extended chunk in 2-D.  Only the
+    ``radius * block_steps`` columns the stencil needs ride the column
+    exchange; the rest of the lane-aligned ``fc`` is dead padding.
     """
-    from tpu_life.parallel.mesh import ROW_AXIS
+    from tpu_life.parallel.halo import halo_depth
+    from tpu_life.parallel.mesh import COL_AXIS, ROW_AXIS
 
     if row_axis is None:
         row_axis = ROW_AXIS
-    fr, fc_min = sharded_pallas_int8_frame(rule, block_steps)
-    fc = fc_min if frame_cols is None else frame_cols
-    if fc < fc_min:
-        raise ValueError(f"frame_cols {fc} shallower than halo needs {fc_min}")
+    if col_axis is None:
+        col_axis = COL_AXIS
+    fr, fc = sharded_pallas_int8_frame(rule, block_steps)
 
-    def make_block(hl: int, wp: int):
-        if hl % block_rows or (wp - 2 * fc) % block_cols:
+    def make_block(hl: int, wl: int):
+        if hl % block_rows or wl % block_cols:
             raise ValueError(
-                f"shard {(hl, wp)} not tiled by blocks {(block_rows, block_cols)}"
-                f" with frame {fc}"
+                f"shard {(hl, wl)} not tiled by blocks "
+                f"{(block_rows, block_cols)}"
             )
-        kern = make_pallas_sharded_int8_block(
+        return make_pallas_sharded_int8_block(
             rule,
-            (hl + 2 * fr, wp),
+            (hl + 2 * fr, wl + 2 * fc),
             tuple(logical_shape),
             (fr, fc),
             block_rows=block_rows,
@@ -670,13 +719,15 @@ def make_sharded_pallas_int8_run(
             interpret=interpret,
         )
 
-        def block(ext: jax.Array, row0: jax.Array) -> jax.Array:
-            # the kernel writes interior tiles only; re-zero the column frame
-            return _zero_frame(kern(ext, row0), 0, fc)
-
-        return block
-
-    return _sharded_epoch_loop(mesh, row_axis, fr, make_block)
+    return _sharded_epoch_loop(
+        mesh,
+        row_axis,
+        fr,
+        make_block,
+        col_axis=col_axis,
+        fc=fc,
+        halo_cols=halo_depth(rule, block_steps),
+    )
 
 
 @register_backend("pallas")

@@ -87,9 +87,10 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
         default="auto",
         choices=["auto", "xla", "pallas"],
         help="per-shard stepper of the sharded backend: Pallas deep-halo "
-        "kernels (bit-sliced stripes for life-like rules, int8 2-D tiles "
-        "for Larger-than-Life / Generations) vs the XLA scan (auto: Pallas "
-        "on TPU 1-D meshes)",
+        "kernels vs the XLA scan.  auto on TPU picks the bit-sliced stripe "
+        "kernel (life-like rules, 1-D meshes) or the int8 2-D-tiled kernel "
+        "(Larger-than-Life / Generations, any mesh); explicit pallas on a "
+        "2-D mesh runs life-like rules through the int8 kernel unpacked",
     )
     r.add_argument("--sync-every", type=int, default=0)
     r.add_argument(
