@@ -1,0 +1,91 @@
+"""Quine-McCluskey rule synthesis (tpu_life.ops.boolmin).
+
+The synthesized SOP is the semantics of the bit-sliced rule application,
+so it gets both exhaustive truth-table checks here and (in test_bitlife /
+test_property) bit-identity against the NumPy executor.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from tpu_life.models.rules import RULE_REGISTRY, Rule, get_rule
+from tpu_life.ops import bitlife
+from tpu_life.ops.boolmin import minimize, rule_sop, verify
+
+
+def brute_force_eval(implicants, i):
+    return any((i & m) == v for m, v in implicants)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_minimize_random_tables(seed):
+    """Random 5-input functions with random don't-cares: the cover must
+    match the spec on every cared input."""
+    rng = np.random.default_rng(seed)
+    kinds = rng.integers(0, 3, size=32)  # 0 off, 1 on, 2 don't-care
+    minterms = {i for i in range(32) if kinds[i] == 1}
+    dontcares = {i for i in range(32) if kinds[i] == 2}
+    sop = minimize(minterms, dontcares, nbits=5)
+    verify(sop, minterms, dontcares, nbits=5)
+
+
+def test_minimize_constants():
+    assert minimize(set(), set(), nbits=5) == []
+    assert minimize(set(range(32)), set(), nbits=5) == [(0, 0)]
+    # all-minterms-or-dontcare also collapses to constant true
+    assert minimize({0}, set(range(1, 32)), nbits=5) == [(0, 0)]
+
+
+def test_rule_sop_matches_rule_semantics_all_registered():
+    """For every registered life-like rule: the SOP evaluated on the 20
+    possible (total, alive) states must equal the rule definition."""
+    seen = set()
+    for rule in RULE_REGISTRY.values():
+        if not bitlife.supports(rule) or rule.name in seen:
+            continue
+        seen.add(rule.name)
+        sop = rule_sop(rule.birth, rule.survive)
+        for alive, total in itertools.product((0, 1), range(10)):
+            if alive and total == 0:
+                continue  # impossible: total includes the live center
+            idx = total | (alive << 4)
+            want = (
+                (total in rule.birth)
+                if not alive
+                else ((total - 1) in rule.survive)
+            )
+            assert brute_force_eval(sop, idx) == want, (rule.name, alive, total)
+
+
+def test_rule_sop_is_smaller_than_eq_masks_for_count_rich_rules():
+    """The point of the synthesis: Day & Night's 9 equality masks must
+    collapse to fewer products."""
+    rule = get_rule("daynight")
+    sop = rule_sop(rule.birth, rule.survive)
+    assert len(sop) < len(rule.birth) + len(rule.survive)
+
+
+@pytest.mark.parametrize("rule_name", ["conway", "highlife", "daynight", "seeds"])
+def test_packed_step_still_bit_identical(rule_name):
+    """The synthesized step vs the truth executor, directly."""
+    from tpu_life.ops.reference import run_np
+
+    rule = get_rule(rule_name)
+    rng = np.random.default_rng(71)
+    board = rng.integers(0, 2, size=(40, 70), dtype=np.int8)
+    packed = bitlife.pack_np(board)
+    import jax.numpy as jnp
+
+    step = bitlife.make_packed_step(rule)
+    out = packed
+    for _ in range(5):
+        out = step(jnp.asarray(out))
+        # re-mask padding (the masked wrapper does this in production)
+        out = np.asarray(out)
+        out_cells = bitlife.unpack_np(out, 70)
+        out = bitlife.pack_np(out_cells)
+    np.testing.assert_array_equal(
+        bitlife.unpack_np(np.asarray(out), 70), run_np(board, rule, 5)
+    )

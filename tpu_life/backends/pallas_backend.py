@@ -566,10 +566,12 @@ def make_sharded_pallas_run(
 
 
 def sharded_pallas_int8_frame(rule: Rule, block_steps: int) -> tuple[int, int]:
-    """(fr, fc) halo frame for the sharded int8 kernel: rows sublane-aligned
-    (the ppermute payload), columns lane-aligned (the baked-in zero frame).
-    Single source of truth for ``ShardedBackend._pallas_int8_tiling`` and the
-    kernel construction below."""
+    """(fr, fc) halo extension depths for the sharded int8 kernel: rows
+    sublane-aligned, columns lane-aligned (both concatenated onto the shard
+    per block by the epoch loop — neighbor data up to the stencil's reach,
+    dead zeros beyond; the shard layout itself is halo-free).  Single source
+    of truth for ``ShardedBackend._pallas_int8_tiling`` and the kernel
+    construction below."""
     from tpu_life.parallel.halo import halo_depth
 
     d = halo_depth(rule, block_steps)

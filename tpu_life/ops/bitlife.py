@@ -18,10 +18,10 @@ accesses on the full board are the bottleneck.
 Counting (classic bit-slicing, cf. the public "Life in bitplanes" trick):
 vertical 3-row sums as (ones, twos) bitplanes via carry-save adders, then a
 horizontal 3-column add of those planes giving total-sum bitplanes
-b0,b1,b2,b3 (total = center + 8 neighbors, range 0..9).  A life-like rule
-membership test then becomes an OR over 4-bit equality masks:
-``alive' = OR_{v in B} [~alive & total==v]  |  OR_{v in S} [alive & total==v+1]``
-(+1 because the total includes the center for alive cells).
+b0,b1,b2,b3 (total = center + 8 neighbors, range 0..9).  The rule is then
+applied as the Quine-McCluskey-minimized sum-of-products of
+``alive'(b0..b3, x)`` (``tpu_life.ops.boolmin``) — a handful of wide AND/OR
+products instead of one 4-bit equality mask per birth/survive count.
 """
 
 from __future__ import annotations
@@ -164,16 +164,6 @@ def make_total_planes(
 _total_planes = make_total_planes(_hshift_left, _hshift_right, _vshift)
 
 
-def _eq_mask(planes, value: int) -> jax.Array:
-    """Bitmask of cells whose 4-bit total equals ``value``."""
-    b0, b1, b2, b3 = planes
-    m = b0 if value & 1 else ~b0
-    m = m & (b1 if value & 2 else ~b1)
-    m = m & (b2 if value & 4 else ~b2)
-    m = m & (b3 if value & 8 else ~b3)
-    return m
-
-
 def make_packed_step(
     rule: Rule, total_planes: Callable | None = None
 ) -> Callable[[jax.Array], jax.Array]:
@@ -181,23 +171,44 @@ def make_packed_step(
 
     ``total_planes`` swaps in an alternative bitplane counter (the Pallas
     kernel's roll-based one); default is the XLA pad/concat version.
+
+    The rule itself is applied as the Quine-McCluskey-minimized
+    sum-of-products of ``alive'(b0..b3, x)`` (``tpu_life.ops.boolmin``):
+    for count-rich rules this replaces one 4-bit equality mask per
+    birth/survive value with a handful of wide implicants — e.g. Day &
+    Night's 9 masks collapse to a few products — and the exhaustive
+    truth-table check in ``rule_sop`` pins the synthesis to the original
+    OR-of-equalities semantics.
     """
     if not supports(rule):
         raise ValueError(f"bit-sliced path supports life-like rules only, got {rule}")
     if total_planes is None:
         total_planes = _total_planes
-    birth = sorted(rule.birth)
-    survive = sorted(rule.survive)
+    from tpu_life.ops.boolmin import rule_sop
+
+    sop = rule_sop(rule.birth, rule.survive)
 
     def step(x: jax.Array) -> jax.Array:
         planes = total_planes(x)
-        born = jnp.zeros_like(x)
-        for v in birth:
-            born = born | _eq_mask(planes, v)  # dead: total == count
-        surv = jnp.zeros_like(x)
-        for v in survive:
-            surv = surv | _eq_mask(planes, v + 1)  # alive: total == count+1
-        return (~x & born) | (x & surv)
+        literals = (*planes, x)  # input bits 0..3 = total planes, bit 4 = x
+        inverted = [None] * 5  # lazily-shared complements
+        out = None
+        for mask, value in sop:
+            term = None
+            for bit in range(5):
+                if not mask & (1 << bit):
+                    continue
+                if value & (1 << bit):
+                    lit = literals[bit]
+                else:
+                    if inverted[bit] is None:
+                        inverted[bit] = ~literals[bit]
+                    lit = inverted[bit]
+                term = lit if term is None else term & lit
+            if term is None:  # (0, 0): constant-true cover
+                term = ~jnp.zeros_like(x)
+            out = term if out is None else out | term
+        return jnp.zeros_like(x) if out is None else out
 
     return step
 
