@@ -138,14 +138,19 @@ def rule_sop(
     """Minimal SOP for ``alive'(total_b0..b3, x)`` of a life-like rule.
 
     Input bit layout: bits 0..3 = the total-count bitplanes (center + 8
-    neighbors, 0..9), bit 4 = the center cell.  Don't-cares: totals > 9,
-    and total == 0 while alive.
+    neighbors, 0..9), bit 4 = the center cell.  Don't-cares: totals > 9;
+    total == 0 while alive (the total includes the center); and total == 9
+    while dead (9 needs all eight neighbors plus the center).
     """
     minterms, dontcares = set(), set()
     for x_bit in (0, 1):
         for total in range(16):
             idx = total | (x_bit << 4)
-            if total > 9 or (x_bit == 1 and total == 0):
+            if (
+                total > 9
+                or (x_bit == 1 and total == 0)
+                or (x_bit == 0 and total == 9)
+            ):
                 dontcares.add(idx)
             elif (total in birth) if x_bit == 0 else ((total - 1) in survive):
                 minterms.add(idx)
