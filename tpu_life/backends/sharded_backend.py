@@ -279,7 +279,10 @@ class ShardedBackend:
             if fr > sh:
                 continue
             br = self._fit_block_rows(wp * 4, fr, sh)
-            if br >= SUBLANE:
+            # br >= fr keeps interior tiles inside the chunk for the
+            # kernel's stitched (top, chunk, bot) DMA windows (implied for
+            # the single-tile br == sh case, since fr <= sh here)
+            if br >= max(SUBLANE, fr):
                 return br, k, fr, sh
         return None
 
