@@ -188,6 +188,17 @@ def test_int8_kernel_2d_streaming_io(tmp_path):
     np.testing.assert_array_equal(read_board(dst, 36, 83), run_np(board, rule, 5))
 
 
+def test_int8_kernel_include_center_variant():
+    """LtL M1 (center-counting) rules through the sharded int8 kernel."""
+    from tpu_life.models.rules import parse_rule
+
+    rng = np.random.default_rng(59)
+    board = rng.integers(0, 2, size=(40, 70), dtype=np.int8)
+    rule = parse_rule("R2,C2,M1,S5..10,B5..8")
+    out = make_backend(num_devices=2, block_steps=2).run(board, rule, 5)
+    np.testing.assert_array_equal(out, run_np(board, rule, 5))
+
+
 def test_int8_kernel_block_steps_remainders():
     """Odd step counts split into deep-halo blocks + a remainder block whose
     kernel reuses the prepare-time frame layout."""
