@@ -1,0 +1,113 @@
+"""The driver contracts: bench.py's one-JSON-line protocol and
+__graft_entry__'s compile-check/dryrun entry points.
+
+Round 3 was lost to an untested bench.py code path (the platform pin that
+killed TPU init), so the capture machinery itself now has coverage: these
+run the real bench as a subprocess on CPU and assert the emitted record's
+shape and honesty fields.  The TPU-specific leg can only run on the chip,
+but every flag-resolution and fallback branch this exercises is shared.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_proc(*args, env_extra=None, timeout=600):
+    """Run bench.py as a subprocess with the one shared isolation recipe
+    (no fake-device flags, no accelerator plugin, repo on sys.path)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PALLAS_AXON_POOL_IPS"] = ""  # never touch an accelerator plugin
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+    )
+
+
+def run_bench(*args, env_extra=None, timeout=600):
+    r = bench_proc(*args, env_extra=env_extra, timeout=timeout)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = r.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+@pytest.mark.slow
+def test_bench_emits_contract_record_on_cpu():
+    rec = run_bench(
+        "--platform", "cpu", "--size", "256", "--steps", "40",
+        "--base-steps", "4", "--repeats", "1",
+    )
+    # the driver's contract: one JSON line with these fields
+    assert rec["metric"] == "cell_updates_per_sec_per_chip"
+    assert rec["unit"] == "cells/s/chip"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] == pytest.approx(rec["value"] / 1e11)
+    # honesty fields: an explicit-cpu run must self-report as degraded,
+    # pinned, and actually-on-cpu
+    assert rec["platform"] == "cpu"
+    assert rec["platform_actual"] == "cpu"
+    assert rec["platform_pinned"] is True
+    assert rec["degraded"] is True
+    assert rec["n_chips"] == 1
+    assert rec["size"] == 256 and rec["steps"] == 40
+
+
+@pytest.mark.slow
+def test_bench_env_pin_and_degraded_defaults():
+    """TPU_LIFE_PLATFORM=cpu pins without flags; unset workload knobs fall
+    to the shrunken degraded defaults (not the 16384 accelerator ones)."""
+    rec = run_bench(
+        "--steps", "20", "--base-steps", "2", "--repeats", "1",
+        env_extra={"TPU_LIFE_PLATFORM": "cpu"},
+    )
+    assert rec["platform"] == "cpu" and rec["platform_pinned"] is True
+    assert rec["size"] == 2048  # DEGRADED_SIZE, not the 16384 TPU default
+    assert rec["backend"] == "jax"  # not the composed TPU flagship
+
+
+@pytest.mark.slow
+def test_bench_rejects_bad_config_without_fallback():
+    """Pure config errors must exit 2 (argparse), never trigger the
+    accelerator-failure CPU fallback that would mask them."""
+    r = bench_proc("--rule", "nonsense", timeout=120)
+    assert r.returncode == 2
+    assert "unknown rule" in r.stderr
+    assert not r.stdout.strip()  # no fake capture line
+
+
+@pytest.mark.slow
+def test_graft_entry_contract():
+    """entry() returns a jittable fn + args; dryrun_multichip passes on the
+    fake 8-device mesh and prints one ok line per leg (the artifact the
+    judge reads — ADVICE r3)."""
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as g
+    finally:
+        sys.path.pop(0)
+
+    import jax
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == args[0].shape and out.dtype == args[0].dtype
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        g.dryrun_multichip(8)
+    legs = [l for l in buf.getvalue().splitlines() if l.startswith("dryrun leg")]
+    assert len(legs) == 5, legs
+    assert all(l.endswith(": ok") for l in legs)
