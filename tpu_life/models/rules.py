@@ -190,6 +190,19 @@ register_rule(
 register_rule(
     "anneal", Rule("B4678/S35678", frozenset({4, 6, 7, 8}), frozenset({3, 5, 6, 7, 8}))
 )
+register_rule("maze", Rule("B3/S12345", frozenset({3}), frozenset({1, 2, 3, 4, 5})))
+register_rule(
+    "coral", Rule("B3/S45678", frozenset({3}), frozenset({4, 5, 6, 7, 8}))
+)
+register_rule(
+    "replicator",
+    Rule("B1357/S1357", frozenset({1, 3, 5, 7}), frozenset({1, 3, 5, 7})),
+)
+register_rule(
+    "two_by_two",
+    Rule("B36/S125", frozenset({3, 6}), frozenset({1, 2, 5})),
+)
+register_rule("diamoeba", Rule("B35678/S5678", frozenset({3, 5, 6, 7, 8}), frozenset({5, 6, 7, 8})))
 register_rule(
     "brians_brain", Rule("B2/S/C3", frozenset({2}), frozenset(), states=3)
 )
