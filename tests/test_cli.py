@@ -130,6 +130,31 @@ def test_mesh_shape_rejects_other_backends(workload):
         main(["run", "--backend", "numpy", "--mesh-shape", "2,4"])
 
 
+@pytest.mark.slow
+def test_console_entry_prints_tidy_errors(tmp_path):
+    """`python -m tpu_life` turns user errors into one stderr line + exit 1
+    (SKILL.md's 'raw traceback by design' rough edge, fixed); main() itself
+    still raises for library callers (the test above)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_life", "run"],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,  # no grid_size_data.txt here
+        timeout=120,
+        env=env,
+    )
+    assert r.returncode == 1
+    assert "tpu_life: error:" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
 def test_profile_flag_writes_trace(workload, tmp_path):
     tmp, board = workload
     trace_dir = tmp / "trace"

@@ -1,4 +1,4 @@
-from tpu_life.cli import main
+from tpu_life.cli import console_main
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(console_main())

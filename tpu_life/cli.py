@@ -255,5 +255,23 @@ def _gen(args) -> int:
     return 0
 
 
+def console_main() -> int:
+    """Process entry point: user-facing errors become one tidy stderr line
+    + exit 1 instead of a traceback.  ``main`` itself keeps raising so
+    library callers (and tests) see the real exceptions."""
+    try:
+        return main()
+    except KeyboardInterrupt:
+        print("tpu_life: interrupted", file=sys.stderr)
+        return 130
+    except (ValueError, RuntimeError, OSError) as e:
+        # user-facing errors (bad config/flags, missing files/libraries,
+        # unwritable outputs, incomplete distributed specs) — OSError covers
+        # FileNotFound/IsADirectory/Permission; unexpected bugs still show
+        # their traceback
+        print(f"tpu_life: error: {e}", file=sys.stderr)
+        return 1
+
+
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(console_main())
