@@ -142,6 +142,10 @@ def test_console_entry_prints_tidy_errors(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # never let a test subprocess claim the accelerator (one holder only;
+    # a concurrent claim can hang far past any reasonable test timeout)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run(
         [sys.executable, "-m", "tpu_life", "run"],
         capture_output=True,
