@@ -46,20 +46,26 @@ DEGRADED_BASE_STEPS = 10
 
 PROBE_TIMEOUT_S = 180.0  # first TPU attach can be slow; hang is minutes
 
-# a wedged chip grant clears on a timescale of ~10 min; a bounded retry
-# loop gives a transiently wedged chip a second chance inside the capture
-# window instead of instantly degrading to CPU (VERDICT r2 item 1b)
-PROBE_RETRIES = int(os.environ.get("TPU_LIFE_PROBE_RETRIES", "3"))
-PROBE_RETRY_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_WAIT_S", "90"))
+# a wedged chip grant usually clears in ~10 min but outages up to an hour
+# were observed (round 4); the retry loop rides out a transient wedge
+# inside the capture window instead of instantly degrading to CPU
+# (VERDICT r2 item 1b).  The long wait applies only to HANGS (stale grant,
+# worth waiting out: 5 attempts x (180 s probe + 240 s wait) ≈ 31 min);
+# fast CRASHES (plugin raises in seconds — the BENCH_r01 mode) get a short
+# wait so a deterministically broken plugin cannot burn ~16 min of sleeps
+# before the guaranteed JSON line.
+PROBE_RETRIES = int(os.environ.get("TPU_LIFE_PROBE_RETRIES", "5"))
+PROBE_RETRY_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_WAIT_S", "240"))
+PROBE_CRASH_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_CRASH_WAIT_S", "30"))
 
 
-def _probe_default_platform() -> str | None:
-    """Platform of the default JAX backend, probed in a subprocess.
+def _probe_default_platform() -> tuple[str | None, str]:
+    """(platform, mode) of the default JAX backend, probed in a subprocess.
 
-    Returns ``None`` when the probe crashes *or hangs* — both observed
-    failure modes of a wedged tunneled-TPU plugin (it blocks claiming a
-    stale chip grant, so an in-process ``jax.devices()`` would hang the
-    bench itself; a killable subprocess is the only safe query).
+    ``mode`` is ``"ok"``, ``"crash"`` (probe exited nonzero — a raising
+    plugin) or ``"hang"`` (timeout-killed — a stale chip grant blocking
+    device init; an in-process ``jax.devices()`` would hang the bench
+    itself, so a killable subprocess is the only safe query).
     """
     import signal
     import tempfile
@@ -77,7 +83,7 @@ def _probe_default_platform() -> str | None:
                 start_new_session=True,
             )
         except OSError:
-            return None
+            return None, "crash"
         try:
             rc = proc.wait(timeout=PROBE_TIMEOUT_S)
         except subprocess.TimeoutExpired:
@@ -85,29 +91,30 @@ def _probe_default_platform() -> str | None:
                 os.killpg(proc.pid, signal.SIGKILL)
             except OSError:
                 pass
-            return None
+            return None, "hang"
         if rc != 0:
-            return None
+            return None, "crash"
         out.seek(0)
         for line in out.read().splitlines():
             if line.startswith("PLATFORM="):
-                return line.removeprefix("PLATFORM=")
-    return None
+                return line.removeprefix("PLATFORM="), "ok"
+    return None, "crash"
 
 
 def _probe_with_retries() -> str | None:
     """Probe the default platform, waiting out a transiently wedged grant."""
     for attempt in range(PROBE_RETRIES):
-        platform = _probe_default_platform()
+        platform, mode = _probe_default_platform()
         if platform is not None:
             return platform
         if attempt + 1 < PROBE_RETRIES:
+            wait = PROBE_RETRY_WAIT_S if mode == "hang" else PROBE_CRASH_WAIT_S
             print(
-                f"# probe attempt {attempt + 1}/{PROBE_RETRIES} failed; "
-                f"retrying in {PROBE_RETRY_WAIT_S:.0f}s",
+                f"# probe attempt {attempt + 1}/{PROBE_RETRIES} failed "
+                f"({mode}); retrying in {wait:.0f}s",
                 file=sys.stderr,
             )
-            time.sleep(PROBE_RETRY_WAIT_S)
+            time.sleep(wait)
     return None
 
 
