@@ -278,10 +278,12 @@ def main() -> None:
         p.error(f"unknown rule {args.rule!r}: {e}")
 
     platform = args.platform or os.environ.get("TPU_LIFE_PLATFORM")
+    probe_failed = False
     if platform is None:
         platform = _probe_with_retries()
         if platform is None:
             platform = "cpu"
+            probe_failed = True
             # keep any child interpreters from re-attempting the wedged
             # plugin's chip claim (it registers itself at startup)
             os.environ["PALLAS_AXON_POOL_IPS"] = ""
@@ -328,6 +330,15 @@ def main() -> None:
             if bit_packable and not args.no_bitpack:
                 args.local_kernel = "pallas"
 
+    def annotate(record: dict) -> dict:
+        if probe_failed:
+            # why this capture is CPU: every accelerator probe crashed or
+            # hung (wedged chip grant / broken plugin) — record it so a
+            # degraded capture self-explains instead of looking like a
+            # silent choice.  Applied to every emit path, error included.
+            record["probe_failed"] = True
+        return record
+
     try:
         result = run_bench(args, platform, degraded)
     except Exception as e:  # noqa: BLE001 — the JSON line must always appear
@@ -362,7 +373,7 @@ def main() -> None:
                 retried = json.loads(line)
                 retried["degraded"] = True
                 retried["fallback_from"] = f"{platform}: {e!r}"
-                _emit(retried)
+                _emit(annotate(retried))
                 return
             except Exception as e2:  # noqa: BLE001
                 e = RuntimeError(f"{e!r}; cpu retry failed: {e2!r}")
@@ -380,6 +391,7 @@ def main() -> None:
                 "degraded": True,
                 "error": repr(e)[:500],
             }
+            | ({"probe_failed": True} if probe_failed else {})
         )
         return
     _emit(result)
