@@ -378,23 +378,24 @@ def main() -> None:
             except Exception as e2:  # noqa: BLE001
                 e = RuntimeError(f"{e!r}; cpu retry failed: {e2!r}")
         _emit(
-            {
-                "metric": "cell_updates_per_sec_per_chip",
-                "value": 0.0,
-                "unit": "cells/s/chip",
-                "vs_baseline": 0.0,
-                "platform": platform,
-                "backend": args.backend,
-                "size": args.size,
-                "steps": args.steps,
-                "n_chips": 0,
-                "degraded": True,
-                "error": repr(e)[:500],
-            }
-            | ({"probe_failed": True} if probe_failed else {})
+            annotate(
+                {
+                    "metric": "cell_updates_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "cells/s/chip",
+                    "vs_baseline": 0.0,
+                    "platform": platform,
+                    "backend": args.backend,
+                    "size": args.size,
+                    "steps": args.steps,
+                    "n_chips": 0,
+                    "degraded": True,
+                    "error": repr(e)[:500],
+                }
+            )
         )
         return
-    _emit(result)
+    _emit(annotate(result))
 
 
 if __name__ == "__main__":

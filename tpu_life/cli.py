@@ -113,6 +113,37 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument("--snapshot-every", type=int, default=0)
     r.add_argument("--snapshot-dir", default="snapshots")
     r.add_argument("--resume", default=None)
+    r.add_argument(
+        "--max-restarts",
+        type=int,
+        default=0,
+        help="elastic recovery: on a recoverable device failure, rebuild the "
+        "backend and resume from the newest snapshot (pair with "
+        "--snapshot-every) at most this many times; 0 fails fast",
+    )
+    r.add_argument(
+        "--fault-at",
+        type=int,
+        default=0,
+        metavar="STEP",
+        help="fault-injection drill: simulate a device failure the first "
+        "time the run crosses STEP (exercises the --max-restarts path)",
+    )
+    r.add_argument(
+        "--fault-count",
+        type=int,
+        default=1,
+        help="how many times the --fault-at drill fires (recovery rewinds "
+        "below the fault step, so it re-fires until spent)",
+    )
+    r.add_argument(
+        "--restart-wait",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="wait this long before each recovery attempt (device losses "
+        "take time to clear)",
+    )
     r.add_argument("--profile", default=None, metavar="TRACE_DIR")
     r.add_argument("--metrics", action="store_true")
     r.add_argument("--verbose", "-v", action="store_true")
@@ -176,6 +207,10 @@ def main(argv: list[str] | None = None) -> int:
         snapshot_every=args.snapshot_every,
         snapshot_dir=args.snapshot_dir,
         resume=args.resume,
+        max_restarts=args.max_restarts,
+        fault_at=args.fault_at,
+        fault_count=args.fault_count,
+        restart_wait_s=args.restart_wait,
         profile=args.profile,
         metrics=args.metrics,
         verbose=args.verbose,

@@ -54,6 +54,21 @@ class RunConfig:
     snapshot_every: int = 0
     snapshot_dir: str = "snapshots"
     resume: str | None = None
+    # elastic recovery: on a recoverable device failure mid-run (RuntimeError
+    # from a blocked step — preemption, device loss), rebuild the backend and
+    # resume from the newest snapshot (or the original input when none exists
+    # yet), at most this many times.  0 = fail fast (the reference's model:
+    # any rank failure kills the job, SURVEY.md §5)
+    max_restarts: int = 0
+    # fault injection drill: raise a simulated device failure when the fused
+    # loop crosses this absolute step, fault_count times in a row (recovery
+    # rewinds below fault_at, so the drill re-fires until spent — the
+    # multi-failure / budget-exhaustion path).  0 = off
+    fault_at: int = 0
+    fault_count: int = 1
+    # seconds to wait before each recovery attempt — a real device loss can
+    # take a while to clear; 0 keeps drills and tests instant
+    restart_wait_s: float = 0.0
     profile: str | None = None  # jax.profiler trace directory
     verbose: bool = False
     metrics: bool = False  # per-chunk live-cell counts + throughput

@@ -9,6 +9,7 @@ loop with optional snapshot/metric chunking -> write output -> report
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -20,6 +21,7 @@ from tpu_life.io.codec import read_board, write_board
 from tpu_life.models.rules import get_rule
 from tpu_life.parallel.mesh import init_distributed
 from tpu_life.runtime import checkpoint as ckpt
+from tpu_life.runtime import recovery
 from tpu_life.runtime.metrics import MetricsRecorder, configure_logging, dump_board, log
 from tpu_life.runtime.profiling import maybe_profile
 from tpu_life.utils.timing import Timer
@@ -38,6 +40,7 @@ class RunResult:
     backend: str
     rule: str
     metrics: list[dict] = field(default_factory=list)
+    restarts: int = 0  # recoveries taken by the elastic-recovery loop
 
 
 def _is_lead_process() -> bool:
@@ -128,18 +131,33 @@ def run(cfg: RunConfig) -> RunResult:
             "RunResult.board"
         )
 
-    board = None
-    if stream:
-        runner = backend.prepare_from_file(input_path, height, width, rule)
-    else:
-        board = read_board(input_path, height, width)
-        max_state = int(board.max(initial=0))
-        if max_state >= rule.states:
-            raise ValueError(
-                f"board contains state {max_state} but rule {rule.name!r} has "
-                f"only {rule.states} states (0..{rule.states - 1})"
+    origin = (input_path, start_step)  # restart target when no snapshot exists
+    fault_fired: list[bool] = []
+
+    def build_runner(source, start):
+        """(runner, host_board|None) staged from a contract-format file.
+
+        Called once up front and again after each elastic-recovery restart
+        (with the rebuilt ``backend`` binding from the enclosing scope)."""
+        if stream:
+            r = backend.prepare_from_file(source, height, width, rule)
+            b = None
+        else:
+            b = read_board(source, height, width)
+            max_state = int(b.max(initial=0))
+            if max_state >= rule.states:
+                raise ValueError(
+                    f"board contains state {max_state} but rule {rule.name!r} "
+                    f"has only {rule.states} states (0..{rule.states - 1})"
+                )
+            r = make_runner(backend, b, rule)
+        if cfg.fault_at > 0:
+            r = recovery.FaultingRunner(
+                r, start, cfg.fault_at, fault_fired, cfg.fault_count
             )
-        runner = make_runner(backend, board, rule)
+        return r, b
+
+    runner, board = build_runner(input_path, start_step)
 
     remaining = max(0, steps - start_step)
     recorder = MetricsRecorder(
@@ -154,13 +172,16 @@ def run(cfg: RunConfig) -> RunResult:
             else min(chunk, cfg.snapshot_every)
         )
 
-    last_snap = 0  # crossing detection: snapshot at the first sync point
-    # at-or-past each snapshot_every multiple, so sync_every and
-    # snapshot_every need not divide each other
+    # crossing detection: snapshot at the first sync point at-or-past each
+    # snapshot_every multiple, so sync_every and snapshot_every need not
+    # divide each other.  Mutable holder because the elastic-recovery loop
+    # rewinds `start` and resets `last_snap` on restart; `written` records
+    # the absolute steps of snapshots THIS run wrote — the only snapshots
+    # recovery will trust as restart sources.
+    state = {"start": start_step, "last_snap": 0, "written": []}
 
     def on_chunk(done_local: int, get_board) -> None:
-        nonlocal last_snap
-        done = start_step + done_local
+        done = state["start"] + done_local
         if recorder.enabled:
             # live count via the runner's on-device sharded reduction — two
             # scalars cross to the host, never the board (SURVEY.md §5), so
@@ -170,14 +191,17 @@ def run(cfg: RunConfig) -> RunResult:
         board_np = get_board() if cfg.verbose else None
         if (
             cfg.snapshot_every > 0
-            and done_local // cfg.snapshot_every > last_snap // cfg.snapshot_every
+            and done_local // cfg.snapshot_every
+            > state["last_snap"] // cfg.snapshot_every
         ):
-            last_snap = done_local
+            state["last_snap"] = done_local
             if stream:
                 # per-shard snapshot write: the board stays sharded
                 Path(cfg.snapshot_dir).mkdir(parents=True, exist_ok=True)
                 p = ckpt.snapshot_path(cfg.snapshot_dir, done)
-                backend.write_runner_to_file(runner, p, height, width, rule)
+                backend.write_runner_to_file(
+                    recovery.unwrap(runner), p, height, width, rule
+                )
                 ckpt.write_sidecar(p, done, rule.name, height, width)
             else:
                 p = ckpt.save_snapshot(
@@ -186,6 +210,7 @@ def run(cfg: RunConfig) -> RunResult:
                     board_np if board_np is not None else get_board(),
                     rule=rule.name,
                 )
+            state["written"].append(done)
             log.info("snapshot step=%d -> %s", done, p)
         if cfg.verbose and board_np is not None:
             log.debug("board at step %d:\n%s", done, dump_board(board_np))
@@ -196,25 +221,108 @@ def run(cfg: RunConfig) -> RunResult:
         else None
     )
 
-    with maybe_profile(cfg.profile):
-        drive_runner(runner, remaining, chunk_steps=chunk, callback=callback)
-    if not stream:
-        board = runner.fetch()
+    # The epoch drive, wrapped in the elastic-recovery loop: a recoverable
+    # failure (RuntimeError from a blocked step — preemption, device loss,
+    # or the --fault-at drill) rebuilds the backend and resumes from the
+    # newest snapshot, up to cfg.max_restarts times.  The reference's model
+    # is the 0-restart degenerate case: any failure kills the job
+    # (SURVEY.md §5 "failure detection" row).
+    restarts = 0
+    # Elastic recovery is process-local: in a multi-process job the peers
+    # would keep collectives posted (or rewind to a different step) while
+    # this process restarts, deadlocking or diverging — there the recovery
+    # unit is the whole job, relaunched with --resume, which every process
+    # resolves identically.
+    max_restarts = cfg.max_restarts
+    if max_restarts > 0:
+        import jax
 
-    lead = _is_lead_process()
-    if cfg.output_file:
-        Path(cfg.output_file).parent.mkdir(parents=True, exist_ok=True)
-        if stream:
-            # per-shard collective write: every process writes the byte
-            # ranges of the shards it addresses (MPI_File_write_at_all,
-            # Parallel_Life_MPI.cpp:175) — never gated on the lead
-            backend.write_runner_to_file(
-                runner, cfg.output_file, height, width, rule
+        if jax.process_count() > 1:
+            log.warning(
+                "multi-process job: in-process elastic recovery disabled; "
+                "on failure, relaunch the whole job with --resume %s",
+                cfg.snapshot_dir,
             )
-        elif lead:
-            # whole-board write: single writer, like rank 0 owning the
-            # host-materialized result
-            write_board(cfg.output_file, board)
+            max_restarts = 0
+    # (source, step) to rebuild from; the rebuild happens INSIDE the try so
+    # a device still detaching when we reconstruct the backend consumes a
+    # restart and retries, instead of escaping with budget remaining
+    pending: tuple | None = None
+    with maybe_profile(cfg.profile):
+        while True:
+            try:
+                if pending is not None:
+                    source, resume_step = pending
+                    backend = get_backend(backend_name, **backend_kwargs)
+                    state["start"] = resume_step
+                    state["last_snap"] = 0
+                    # drop metric records the rewind is about to re-earn
+                    recorder.records[:] = [
+                        r for r in recorder.records if r["step"] <= resume_step
+                    ]
+                    runner, board = build_runner(source, resume_step)
+                    pending = None
+                drive_runner(
+                    runner,
+                    max(0, steps - state["start"]),
+                    chunk_steps=chunk,
+                    callback=callback,
+                )
+                # the terminal device interactions — the final host gather
+                # (non-stream) / the per-shard streamed output write — are
+                # as killable as any step, so they sit inside the recovery
+                # scope too; the retry rewinds to the newest snapshot,
+                # re-drives the tail and re-attempts them
+                if stream:
+                    if cfg.output_file:
+                        Path(cfg.output_file).parent.mkdir(
+                            parents=True, exist_ok=True
+                        )
+                        backend.write_runner_to_file(
+                            recovery.unwrap(runner),
+                            cfg.output_file,
+                            height,
+                            width,
+                            rule,
+                        )
+                else:
+                    board = runner.fetch()
+                break
+            except recovery.RECOVERABLE as e:
+                if restarts >= max_restarts:
+                    raise
+                restarts += 1
+                if state["written"]:
+                    # only snapshots THIS run wrote are trusted restart
+                    # sources — a stale snapshots/ dir left by an earlier,
+                    # unrelated run cannot hijack the resume
+                    snap = max(state["written"])
+                    pending = (ckpt.snapshot_path(cfg.snapshot_dir, snap), snap)
+                else:
+                    pending = origin
+                log.warning(
+                    "recoverable failure (%s: %s); restart %d/%d from %s "
+                    "at step %d",
+                    type(e).__name__,
+                    e,
+                    restarts,
+                    max_restarts,
+                    pending[0],
+                    pending[1],
+                )
+                if cfg.restart_wait_s > 0:
+                    time.sleep(cfg.restart_wait_s)
+    # the streamed per-shard collective write already happened inside the
+    # recovery scope above (every process writes the byte ranges of the
+    # shards it addresses — MPI_File_write_at_all, Parallel_Life_MPI.cpp:175
+    # — never gated on the lead); only the whole-board single-writer path
+    # remains, a pure host-side write
+    lead = _is_lead_process()
+    if cfg.output_file and not stream and lead:
+        Path(cfg.output_file).parent.mkdir(parents=True, exist_ok=True)
+        # whole-board write: single writer, like rank 0 owning the
+        # host-materialized result
+        write_board(cfg.output_file, board)
 
     elapsed = timer.elapsed
     if lead:
@@ -228,4 +336,5 @@ def run(cfg: RunConfig) -> RunResult:
         backend=getattr(backend, "name", cfg.backend),
         rule=rule.name,
         metrics=recorder.records,
+        restarts=restarts,
     )

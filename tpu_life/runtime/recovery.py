@@ -1,0 +1,103 @@
+"""Failure detection + elastic recovery.
+
+The reference has neither: any rank failure kills the whole MPI job, and the
+per-epoch ``MPI_Barrier`` is its only synchronization primitive
+(Parallel_Life_MPI.cpp:220; SURVEY.md §5 "failure detection" row).  On TPU
+the realistic failure modes are preemption and device/runtime loss, which
+surface as ``RuntimeError`` (XlaRuntimeError) from a blocked step.  The
+driver treats those as *recoverable*: it rebuilds the backend, resumes from
+the newest snapshot (or the original input when none exists yet), and
+re-runs the lost steps — up to ``--max-restarts`` times.  This closes the
+loop SURVEY.md §5 left open: snapshots were already restartable by hand via
+``--resume``; now the driver detects the failure and restarts itself.
+
+``--fault-at N`` is the matching fault-injection drill: a proxy Runner
+raises a simulated device loss the first time the fused loop would cross
+absolute step N, exercising exactly the recovery path a real failure takes
+(and doubling as the recovery test fixture, ``tests/test_recovery.py``).
+
+What recovery can NOT do in-process: a chip grant that *hangs* (rather than
+raises) never returns control — that mode is handled one level up by the
+CLI's watchdogged device probe (``tpu_life/utils/platform.py``), which
+refuses to start the run instead.  And recovery is *process-local*: in a
+multi-process job, one process rewinding while its peers sit in a posted
+collective would deadlock or diverge, so the driver disables it when
+``jax.process_count() > 1`` — there the recovery unit is the whole job
+(relaunch with ``--resume``, which every process resolves identically).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """Simulated device loss, raised by the ``--fault-at`` drill."""
+
+
+#: Exception types the driver may recover from by rebuilding + resuming.
+#: Device/runtime loss (XlaRuntimeError) subclasses RuntimeError; config
+#: and user errors (ValueError, FileNotFoundError, KeyError) never match,
+#: so a typo cannot silently burn restart attempts.
+RECOVERABLE: tuple[type[BaseException], ...] = (RuntimeError,)
+
+
+def unwrap(runner):
+    """The backend's own Runner behind a possible ``FaultingRunner`` proxy —
+    for backend APIs that take their runner back (``write_runner_to_file``)."""
+    return runner._inner if isinstance(runner, FaultingRunner) else runner
+
+
+class FaultingRunner:
+    """Runner proxy that raises ``InjectedFault`` in ``advance`` — where a
+    real device failure would surface — when the run *crosses* absolute
+    step ``fault_at`` (a run resumed at or past ``fault_at`` has already
+    crossed it and is left alone).
+
+    ``fired`` is a list shared across restarts (one entry per firing), so
+    the drill kills the run ``fault_count`` times per ``driver.run`` call:
+    after recovery rewinds to a snapshot before ``fault_at``, the re-wrapped
+    runner fires again until the budget is spent — which is how the
+    multi-failure / budget-exhaustion paths get exercised.
+    """
+
+    def __init__(
+        self,
+        inner,
+        start_step: int,
+        fault_at: int,
+        fired: list[bool],
+        fault_count: int = 1,
+    ):
+        self._inner = inner
+        self._done = start_step
+        self._fault_at = fault_at
+        self._fired = fired
+        self._fault_count = fault_count
+
+    def advance(self, steps: int) -> None:
+        if (
+            len(self._fired) < self._fault_count
+            and self._done < self._fault_at <= self._done + steps
+        ):
+            self._fired.append(True)
+            raise InjectedFault(
+                f"injected device failure crossing step {self._fault_at} "
+                f"({len(self._fired)}/{self._fault_count})"
+            )
+        self._inner.advance(steps)
+        self._done += steps
+
+    def sync(self) -> None:
+        self._inner.sync()
+
+    def fetch(self) -> np.ndarray:
+        return self._inner.fetch()
+
+    def snapshot(self) -> Callable[[], np.ndarray]:
+        return self._inner.snapshot()
+
+    def live_count(self) -> int:
+        return self._inner.live_count()
