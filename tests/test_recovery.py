@@ -186,6 +186,29 @@ def test_stale_snapshots_cannot_hijack_recovery(tmp_path):
     )
 
 
+def test_failure_during_initial_staging_is_retried(tmp_path, monkeypatch):
+    # the very first board staging sits inside the recovery scope too: a
+    # device still detaching at job start consumes a restart and retries
+    from tpu_life.runtime import driver as drv
+
+    calls = {"n": 0}
+    real = drv.make_runner
+
+    def flaky(backend, board, rule):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("device detaching during staging")
+        return real(backend, board, rule)
+
+    monkeypatch.setattr(drv, "make_runner", flaky)
+    board, base = _setup(tmp_path)
+    res = run(RunConfig(backend="numpy", max_restarts=1, **base))
+    assert res.restarts == 1 and calls["n"] == 2
+    np.testing.assert_array_equal(
+        res.board, run_np(board, get_rule("conway"), 20)
+    )
+
+
 def test_multi_process_job_disables_recovery(tmp_path, monkeypatch):
     # recovery is process-local by design: one process rewinding would
     # deadlock peers in posted collectives, so with process_count > 1 the

@@ -27,6 +27,31 @@ def build_parser() -> argparse.ArgumentParser:
     info = sub.add_parser("info", help="show devices, rules and version")
     info.set_defaults(command="info")
 
+    pat = sub.add_parser(
+        "pattern",
+        help="RLE pattern interchange: import/export boards, stamp named "
+        "patterns",
+    )
+    pat.add_argument(
+        "action",
+        choices=["import", "export", "list"],
+        help="import: RLE/named pattern -> contract board+config; "
+        "export: contract board -> RLE; list: named patterns",
+    )
+    pat.add_argument("--rle", default=None, metavar="FILE",
+                     help="RLE file (import source / export destination; "
+                     "export defaults to stdout)")
+    pat.add_argument("--name", default=None,
+                     help="named pattern to import (see `pattern list`)")
+    pat.add_argument("--height", type=int, default=None)
+    pat.add_argument("--width", type=int, default=None)
+    pat.add_argument("--at", default=None, metavar="R,C",
+                     help="top-left placement of the pattern (default: centered)")
+    pat.add_argument("--input-file", default="data.txt")
+    pat.add_argument("--config-file", default="grid_size_data.txt")
+    pat.add_argument("--steps", type=int, default=100,
+                     help="steps written to the config file on import")
+
     g = sub.add_parser("gen", help="generate a random board + config")
     g.add_argument("--height", type=int, required=True)
     g.add_argument("--width", type=int, required=True)
@@ -172,6 +197,8 @@ def main(argv: list[str] | None = None) -> int:
         return _info()
     if args.command == "gen":
         return _gen(args)
+    if args.command == "pattern":
+        return _pattern(parser, args)
 
     from tpu_life.utils.platform import devices_with_watchdog, ensure_platform
 
@@ -270,6 +297,82 @@ def _info() -> int:
         "ok" if native_io.available() else "numpy fallback (make -C native)",
     )
     print("rules:", ", ".join(sorted(RULE_REGISTRY)))
+    return 0
+
+
+def _pattern(parser, args) -> int:
+    """RLE interchange (`tpu_life/io/rle.py`): published patterns drop into
+    the reference's contract codec and back out."""
+    from pathlib import Path
+
+    import numpy as np
+
+    from tpu_life.io import rle
+    from tpu_life.io.codec import read_board, read_config, write_board, write_config
+    from tpu_life.models import patterns
+
+    named = {
+        n.lower(): getattr(patterns, n)
+        for n in dir(patterns)
+        if n.isupper() and isinstance(getattr(patterns, n), np.ndarray)
+    }
+    if args.action == "list":
+        for n in sorted(named):
+            h, w = named[n].shape
+            print(f"{n}  {h}x{w}")
+        return 0
+
+    if args.action == "export":
+        height, width = args.height, args.width
+        if height is None or width is None:
+            ch, cw, _ = read_config(args.config_file)
+            height = ch if height is None else height
+            width = cw if width is None else width
+        board = read_board(args.input_file, height, width)
+        text = rle.emit_rle(board)
+        if args.rle:
+            Path(args.rle).write_text(text)
+            print(f"wrote {args.rle} ({height}x{width})")
+        else:
+            print(text, end="")
+        return 0
+
+    # import
+    if (args.rle is None) == (args.name is None):
+        parser.error("pattern import needs exactly one of --rle / --name")
+    if args.rle is not None:
+        cells, meta = rle.parse_rle(Path(args.rle).read_text())
+        if meta.get("rule"):
+            print(f"pattern rule: {meta['rule']} (pass via `run --rule`)")
+    else:
+        key = args.name.lower()
+        if key not in named:
+            parser.error(
+                f"unknown pattern {args.name!r}; see `tpu_life pattern list`"
+            )
+        cells = named[key]
+    ph, pw = cells.shape
+    height = args.height if args.height is not None else ph
+    width = args.width if args.width is not None else pw
+    if args.at is not None:
+        try:
+            top, left = (int(v) for v in args.at.split(","))
+        except ValueError:
+            parser.error(f"--at must be 'R,C', got {args.at!r}")
+    else:
+        top, left = (height - ph) // 2, (width - pw) // 2
+    if top < 0 or left < 0 or top + ph > height or left + pw > width:
+        parser.error(
+            f"pattern {ph}x{pw} at ({top},{left}) does not fit a "
+            f"{height}x{width} board"
+        )
+    board = patterns.place(patterns.empty(height, width), cells, top, left)
+    write_board(args.input_file, board)
+    write_config(args.config_file, height, width, args.steps)
+    print(
+        f"wrote {args.input_file} ({height}x{width}, pattern at "
+        f"{top},{left}) and {args.config_file}"
+    )
     return 0
 
 

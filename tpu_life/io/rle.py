@@ -1,0 +1,147 @@
+"""Run-length-encoded (RLE) pattern interchange.
+
+The reference's only board format is its raw digit grid (`data.txt`,
+Parallel_Life_MPI.cpp:84-99) — fine as a contract, useless for exchanging
+patterns with the wider cellular-automaton ecosystem, whose lingua franca
+is the RLE format (``x = W, y = H, rule = B3/S23`` header; ``b``/``o``
+dead/live run tokens, ``$`` row advance, ``!`` terminator, ``#`` comment
+lines).  This module converts between RLE text and the framework's int8
+board arrays, so any published pattern drops straight into the contract
+codec (`tpu_life/io/codec.py`) and vice versa.
+
+Two-state only: multi-state Generations RLE dialects are rejected loudly
+rather than guessed at.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+
+def parse_rle(text: str) -> tuple[np.ndarray, dict]:
+    """RLE text -> (int8 board, meta).
+
+    ``meta`` carries ``rule`` (the header's rule string, if any) and
+    ``comments`` (the ``#``-line bodies).  The header's x/y are authoritative
+    when present (rows are padded with dead cells to x, and the row count to
+    y); without a header the bounding box of the encoded cells is used.
+    """
+    height = width = None
+    rule = None
+    comments: list[str] = []
+    rows: list[list[int]] = []
+    cur: list[int] = []
+    count = 0
+    done = False
+    saw_header = False
+    for line in text.splitlines():
+        s = line.strip()
+        if not s:
+            continue
+        if s.startswith("#"):
+            comments.append(s[1:].strip())
+            continue
+        if not saw_header and not rows and not cur and s[:1] in "xX":
+            kv = {}
+            for part in s.split(","):
+                if "=" in part:
+                    k, v = part.split("=", 1)
+                    kv[k.strip().lower()] = v.strip()
+            try:
+                width = int(kv["x"])
+                height = int(kv["y"])
+            except (KeyError, ValueError) as e:
+                raise ValueError(f"malformed RLE header {s!r}") from e
+            rule = kv.get("rule")
+            saw_header = True
+            continue
+        for ch in s:
+            if done:
+                break
+            if ch.isdigit():
+                count = count * 10 + int(ch)
+            elif ch in "bB.":
+                cur.extend([0] * max(1, count))
+                count = 0
+            elif ch in "oOA":
+                cur.extend([1] * max(1, count))
+                count = 0
+            elif ch == "$":
+                n = max(1, count)
+                count = 0
+                rows.append(cur)
+                cur = []
+                rows.extend([] for _ in range(n - 1))
+            elif ch == "!":
+                done = True
+            elif ch.isspace():
+                continue
+            else:
+                raise ValueError(
+                    f"unsupported RLE token {ch!r} (two-state b/o dialect only)"
+                )
+        if done:
+            break
+    if cur or not rows:
+        rows.append(cur)
+    w = width if width is not None else max((len(r) for r in rows), default=0)
+    h = height if height is not None else len(rows)
+    if len(rows) > h or any(len(r) > w for r in rows):
+        raise ValueError(
+            f"RLE body exceeds its declared extent x={w}, y={h}"
+        )
+    board = np.zeros((h, w), np.int8)
+    for i, r in enumerate(rows):
+        if r:
+            board[i, : len(r)] = r
+    return board, {"rule": rule, "comments": comments}
+
+
+def emit_rle(
+    board: np.ndarray,
+    *,
+    rule: str | None = "B3/S23",
+    comments: tuple[str, ...] = (),
+    line_width: int = 70,
+) -> str:
+    """int8 board -> RLE text (header + wrapped body, trailing newline)."""
+    board = np.asarray(board)
+    if board.max(initial=0) > 1:
+        raise ValueError(
+            "RLE export is two-state only; this board has states > 1"
+        )
+    h, w = board.shape
+    row_tokens: list[str] = []
+    for r in range(h):
+        row = board[r]
+        last = int(np.max(np.nonzero(row)[0])) + 1 if row.any() else 0
+        toks = []
+        i = 0
+        while i < last:
+            j = i
+            while j < last and row[j] == row[i]:
+                j += 1
+            n = j - i
+            toks.append((str(n) if n > 1 else "") + ("o" if row[i] else "b"))
+            i = j
+        row_tokens.append("".join(toks))
+    body = "$".join(row_tokens) + "!"
+    # collapse empty-row runs into counted $ and drop trailing dead rows
+    body = re.sub(r"\$+", lambda m: (str(len(m.group())) if len(m.group()) > 1 else "") + "$", body)
+    body = re.sub(r"(\d+)?\$!", "!", body)
+    # wrap on token boundaries (a token = optional count + one tag char)
+    tokens = re.findall(r"\d*[bo$!]", body)
+    lines: list[str] = []
+    cur_line = ""
+    for t in tokens:
+        if cur_line and len(cur_line) + len(t) > line_width:
+            lines.append(cur_line)
+            cur_line = ""
+        cur_line += t
+    if cur_line:
+        lines.append(cur_line)
+    header = f"x = {w}, y = {h}" + (f", rule = {rule}" if rule else "")
+    out = [f"#C {c}" for c in comments] + [header] + lines
+    return "\n".join(out) + "\n"

@@ -157,8 +157,6 @@ def run(cfg: RunConfig) -> RunResult:
             )
         return r, b
 
-    runner, board = build_runner(input_path, start_step)
-
     remaining = max(0, steps - start_step)
     recorder = MetricsRecorder(
         height * width, cfg.metrics or cfg.verbose, start_step=start_step
@@ -244,16 +242,22 @@ def run(cfg: RunConfig) -> RunResult:
                 cfg.snapshot_dir,
             )
             max_restarts = 0
-    # (source, step) to rebuild from; the rebuild happens INSIDE the try so
-    # a device still detaching when we reconstruct the backend consumes a
-    # restart and retries, instead of escaping with budget remaining
-    pending: tuple | None = None
+    # (source, step) to build/rebuild from; ALL board staging — including
+    # the very first — happens INSIDE the try, so a device still detaching
+    # when we construct the runner consumes a restart and retries, instead
+    # of escaping with budget remaining
+    pending: tuple | None = (input_path, start_step)
+    first_build = True
+    runner = board = None
     with maybe_profile(cfg.profile):
         while True:
             try:
                 if pending is not None:
                     source, resume_step = pending
-                    backend = get_backend(backend_name, **backend_kwargs)
+                    if not first_build:
+                        # a failure poisoned the old backend: start fresh
+                        backend = get_backend(backend_name, **backend_kwargs)
+                    first_build = False
                     state["start"] = resume_step
                     state["last_snap"] = 0
                     # drop metric records the rewind is about to re-earn
