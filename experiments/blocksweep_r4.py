@@ -1,0 +1,80 @@
+"""block_steps sweep of the flagship composed path on the real chip.
+
+One process, back-to-back measurements (chip throughput wobbles +-20%
+between capture windows, so cross-process comparisons lie; within one
+process the configs share the window).  Sweeps the deep-halo blocking
+factor k — CA steps per ppermute exchange / HBM pass — for
+`sharded --local-kernel pallas` at 16384^2 Conway, the headline bench
+config, using the same delta-timing as bench.py.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python experiments/blocksweep_r4.py \
+       [--ks 4,8,16,32,64] [--backends sharded,pallas] [--tag confirm]
+Writes RESULTS_blocksweep_r4[_tag].json next to itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ks", default="4,8,16,32,64")
+    ap.add_argument("--backends", default="sharded")
+    ap.add_argument("--tag", default="")
+    args = ap.parse_args()
+
+    import jax
+
+    from tpu_life.backends.base import get_backend, make_runner
+    from tpu_life.models.rules import get_rule
+    from tpu_life.utils.timing import delta_seconds_per_step
+
+    n = 16384
+    steps, base_steps, repeats = 1000, 100, 3
+    platform = jax.devices()[0].platform
+    rule = get_rule("conway")
+    board = np.random.default_rng(0).integers(0, 2, size=(n, n), dtype=np.int8)
+
+    rows = []
+    for name in args.backends.split(","):
+        for k in (int(v) for v in args.ks.split(",")):
+            kwargs = {"block_steps": k, "bitpack": True}
+            if name == "sharded":
+                kwargs["local_kernel"] = "pallas"
+            backend = get_backend(name, **kwargs)
+            runner = make_runner(backend, board, rule)
+            per_step = delta_seconds_per_step(
+                runner, steps, base_steps, repeats=repeats
+            )
+            cells_s = n * n / per_step
+            rows.append(
+                {"backend": name, "block_steps": k,
+                 "cells_per_sec_per_chip": cells_s}
+            )
+            print(f"{name:8s} k={k:3d}  {cells_s:.3e} cells/s/chip")
+
+    best = max(rows, key=lambda r: r["cells_per_sec_per_chip"])
+    out = {
+        "config": "conway 16384^2, delta timing; sharded = composed "
+        "sharded+pallas local kernel, pallas = single-device kernel",
+        "platform": platform,
+        "steps": steps,
+        "repeats": repeats,
+        "sweep": rows,
+        "best": best,
+        "note": "single process, back-to-back; ratios are trustworthy, "
+        "absolute numbers carry the window's chip state",
+    }
+    tag = f"_{args.tag}" if args.tag else ""
+    p = pathlib.Path(__file__).with_name(f"RESULTS_blocksweep_r4{tag}.json")
+    p.write_text(json.dumps(out, indent=1))
+    print(f"wrote {p}")
+
+
+if __name__ == "__main__":
+    main()

@@ -57,8 +57,28 @@ def test_parse_without_header_uses_bounding_box():
 def test_parse_rejects_multistate_and_overflow():
     with pytest.raises(ValueError, match="unsupported RLE token"):
         parse_rle("x = 2, y = 1\npA!")
+    with pytest.raises(ValueError, match="unsupported RLE token"):
+        # 'B' is state 2 in the multi-state dialect — rejected loudly, not
+        # silently read as a dead cell
+        parse_rle("x = 2, y = 1\noB!")
     with pytest.raises(ValueError, match="exceeds its declared extent"):
         parse_rle("x = 2, y = 1\n3o!")
+
+
+def test_parse_header_keeps_comma_delimited_ltl_rule():
+    # Golly Larger-than-Life rule strings contain commas; the header parser
+    # must return the whole spec, not its first field
+    _, meta = parse_rle(
+        "x = 3, y = 1, rule = R5,C2,S34..58,B34..45\n3o!\n"
+    )
+    assert meta["rule"] == "R5,C2,S34..58,B34..45"
+
+
+def test_zero_extent_round_trip():
+    for shape in [(0, 3), (0, 0)]:
+        board = np.zeros(shape, np.int8)
+        back, _ = parse_rle(emit_rle(board))
+        assert back.shape == shape
 
 
 @pytest.mark.parametrize("h,w,density", [(1, 1, 1.0), (7, 13, 0.4), (40, 200, 0.5)])
@@ -119,6 +139,20 @@ def test_cli_pattern_import_evolve_export(tmp_path, monkeypatch):
     ) == 0
     back, _ = parse_rle((tmp_path / "out.rle").read_text())
     np.testing.assert_array_equal(back, evolved)
+
+
+def test_cli_pattern_export_records_the_rule(tmp_path, monkeypatch):
+    from tpu_life import cli
+    from tpu_life.io.codec import write_board, write_config
+
+    monkeypatch.chdir(tmp_path)
+    write_board("data.txt", patterns.GLIDER)
+    write_config("grid_size_data.txt", 3, 3, 1)
+    assert cli.main(
+        ["pattern", "export", "--rle", "g.rle", "--rule", "B36/S23"]
+    ) == 0
+    _, meta = parse_rle((tmp_path / "g.rle").read_text())
+    assert meta["rule"] == "B36/S23"
 
 
 def test_cli_pattern_import_rle_file(tmp_path, monkeypatch):

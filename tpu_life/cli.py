@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     pat.add_argument("--config-file", default="grid_size_data.txt")
     pat.add_argument("--steps", type=int, default=100,
                      help="steps written to the config file on import")
+    pat.add_argument("--rule", default="B3/S23",
+                     help="rule string stamped into the exported RLE header "
+                     "(record what the board was actually evolved under)")
 
     g = sub.add_parser("gen", help="generate a random board + config")
     g.add_argument("--height", type=int, required=True)
@@ -329,7 +332,7 @@ def _pattern(parser, args) -> int:
             height = ch if height is None else height
             width = cw if width is None else width
         board = read_board(args.input_file, height, width)
-        text = rle.emit_rle(board)
+        text = rle.emit_rle(board, rule=args.rule)
         if args.rle:
             Path(args.rle).write_text(text)
             print(f"wrote {args.rle} ({height}x{width})")

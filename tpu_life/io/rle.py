@@ -44,17 +44,19 @@ def parse_rle(text: str) -> tuple[np.ndarray, dict]:
             comments.append(s[1:].strip())
             continue
         if not saw_header and not rows and not cur and s[:1] in "xX":
-            kv = {}
-            for part in s.split(","):
-                if "=" in part:
-                    k, v = part.split("=", 1)
-                    kv[k.strip().lower()] = v.strip()
-            try:
-                width = int(kv["x"])
-                height = int(kv["y"])
-            except (KeyError, ValueError) as e:
-                raise ValueError(f"malformed RLE header {s!r}") from e
-            rule = kv.get("rule")
+            # the rule value may itself contain commas (Golly LtL specs like
+            # R5,C2,S34..58,B34..45), so it must be matched as "rest of
+            # line", never comma-split
+            m = re.match(
+                r"x\s*=\s*(\d+)\s*,\s*y\s*=\s*(\d+)"
+                r"(?:\s*,\s*rule\s*=\s*(.+?))?\s*$",
+                s,
+                re.IGNORECASE,
+            )
+            if m is None:
+                raise ValueError(f"malformed RLE header {s!r}")
+            width, height = int(m.group(1)), int(m.group(2))
+            rule = m.group(3)
             saw_header = True
             continue
         for ch in s:
@@ -62,10 +64,13 @@ def parse_rle(text: str) -> tuple[np.ndarray, dict]:
                 break
             if ch.isdigit():
                 count = count * 10 + int(ch)
-            elif ch in "bB.":
+            elif ch in "b.":
                 cur.extend([0] * max(1, count))
                 count = 0
-            elif ch in "oOA":
+            elif ch in "oA":
+                # 'A' is state-1 in the multi-state dialect == live here;
+                # 'B'..'X' are states >= 2 and fall through to the loud
+                # rejection below rather than silently corrupting cells
                 cur.extend([1] * max(1, count))
                 count = 0
             elif ch == "$":
@@ -84,7 +89,7 @@ def parse_rle(text: str) -> tuple[np.ndarray, dict]:
                 )
         if done:
             break
-    if cur or not rows:
+    if cur:
         rows.append(cur)
     w = width if width is not None else max((len(r) for r in rows), default=0)
     h = height if height is not None else len(rows)
@@ -116,17 +121,24 @@ def emit_rle(
     row_tokens: list[str] = []
     for r in range(h):
         row = board[r]
-        last = int(np.max(np.nonzero(row)[0])) + 1 if row.any() else 0
-        toks = []
-        i = 0
-        while i < last:
-            j = i
-            while j < last and row[j] == row[i]:
-                j += 1
-            n = j - i
-            toks.append((str(n) if n > 1 else "") + ("o" if row[i] else "b"))
-            i = j
-        row_tokens.append("".join(toks))
+        nz = np.flatnonzero(row)
+        last = int(nz[-1]) + 1 if nz.size else 0
+        if not last:
+            row_tokens.append("")
+            continue
+        seg = row[:last]
+        # vectorized run detection: Python work scales with the number of
+        # runs, not cells (dense multi-gigacell boards are the contract
+        # codec's job, not RLE's)
+        bounds = np.flatnonzero(np.diff(seg)) + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [last]))
+        row_tokens.append(
+            "".join(
+                (str(e - s) if e - s > 1 else "") + ("o" if seg[s] else "b")
+                for s, e in zip(starts, ends)
+            )
+        )
     body = "$".join(row_tokens) + "!"
     # collapse empty-row runs into counted $ and drop trailing dead rows
     body = re.sub(r"\$+", lambda m: (str(len(m.group())) if len(m.group()) > 1 else "") + "$", body)
