@@ -54,15 +54,41 @@ def test_parse_without_header_uses_bounding_box():
     assert meta["rule"] is None
 
 
-def test_parse_rejects_multistate_and_overflow():
+def test_parse_rejects_high_states_and_overflow():
     with pytest.raises(ValueError, match="unsupported RLE token"):
+        # 'p' starts a prefix pair for states >= 25 — beyond both the RLE
+        # alphabet we support and the contract codec's 10-state cap
         parse_rle("x = 2, y = 1\npA!")
-    with pytest.raises(ValueError, match="unsupported RLE token"):
-        # 'B' is state 2 in the multi-state dialect — rejected loudly, not
-        # silently read as a dead cell
-        parse_rle("x = 2, y = 1\noB!")
     with pytest.raises(ValueError, match="exceeds its declared extent"):
         parse_rle("x = 2, y = 1\n3o!")
+
+
+def test_parse_multistate_alphabet():
+    # Generations dialect: '.' dead, 'A'..'X' states 1..24
+    board, _ = parse_rle("x = 2, y = 2, rule = B2/S/C3\n.A$B.!")
+    np.testing.assert_array_equal(board, [[0, 1], [2, 0]])
+
+
+def test_headerless_body_starting_with_X_is_not_a_header():
+    # 'X' (state 24) is a body token; the header sniff must not claim it
+    board, _ = parse_rle("X!")
+    np.testing.assert_array_equal(board, [[24]])
+    with pytest.raises(ValueError, match="malformed RLE header"):
+        parse_rle("x = nope, y = 3\no!")
+
+
+def test_multistate_round_trip(rng_board):
+    board = rng_board(17, 40, density=0.6, states=4, seed=5)
+    text = emit_rle(board, rule="B2/S/C4", states=4)
+    back, meta = parse_rle(text)
+    np.testing.assert_array_equal(back, board)
+    assert meta["rule"] == "B2/S/C4"
+    assert "o" not in text.splitlines()[-1]  # multistate alphabet, not b/o
+
+
+def test_two_state_emit_keeps_canonical_dialect():
+    text = emit_rle(patterns.GLIDER)
+    assert "A" not in text and "o" in text
 
 
 def test_parse_header_keeps_comma_delimited_ltl_rule():
@@ -102,9 +128,12 @@ def test_emit_drops_trailing_dead_rows_and_collapses_blanks():
     np.testing.assert_array_equal(back, board)
 
 
-def test_emit_rejects_multistate():
-    with pytest.raises(ValueError, match="two-state only"):
-        emit_rle(np.full((2, 2), 2, np.int8))
+def test_emit_rejects_states_beyond_alphabet():
+    # states <= 24 emit via the Generations alphabet; beyond it is an error
+    text = emit_rle(np.full((2, 2), 2, np.int8))
+    assert "B" in text
+    with pytest.raises(ValueError, match="states up to 24"):
+        emit_rle(np.full((2, 2), 25, np.int8))
 
 
 def test_cli_pattern_import_evolve_export(tmp_path, monkeypatch):
@@ -139,6 +168,47 @@ def test_cli_pattern_import_evolve_export(tmp_path, monkeypatch):
     ) == 0
     back, _ = parse_rle((tmp_path / "out.rle").read_text())
     np.testing.assert_array_equal(back, evolved)
+
+
+def test_cli_multistate_import_evolve_export(tmp_path, monkeypatch):
+    # a Brian's Brain (3-state Generations) pattern through the whole CLI
+    # loop: RLE import -> evolve -> RLE export -> parse equals run_np
+    from tpu_life import cli
+    from tpu_life.io.codec import read_board
+    from tpu_life.models.rules import get_rule
+    from tpu_life.ops.reference import run_np
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "bb.rle").write_text(
+        "x = 4, y = 3, rule = B2/S/C3\n.AA.$A..A$.BB.!\n"
+    )
+    assert cli.main(
+        ["pattern", "import", "--rle", "bb.rle",
+         "--height", "16", "--width", "16", "--steps", "3"]
+    ) == 0
+    board = read_board("data.txt", 16, 16)
+    assert int(board.max()) == 2
+    assert cli.main(["run", "--backend", "numpy", "--rule", "brians_brain"]) == 0
+    evolved = read_board("output.txt", 16, 16)
+    np.testing.assert_array_equal(
+        evolved, run_np(board, get_rule("brians_brain"), 3)
+    )
+    assert cli.main(
+        ["pattern", "export", "--input-file", "output.txt",
+         "--rle", "out.rle", "--rule", "brians_brain"]
+    ) == 0
+    back, meta = parse_rle((tmp_path / "out.rle").read_text())
+    np.testing.assert_array_equal(back, evolved)
+    assert meta["rule"] == "brians_brain"
+
+
+def test_cli_import_rejects_states_beyond_codec(tmp_path, monkeypatch):
+    from tpu_life import cli
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "k.rle").write_text("x = 1, y = 1\nK!\n")  # state 11
+    with pytest.raises(SystemExit):
+        cli.main(["pattern", "import", "--rle", "k.rle"])
 
 
 def test_cli_pattern_export_records_the_rule(tmp_path, monkeypatch):

@@ -332,7 +332,13 @@ def _pattern(parser, args) -> int:
             height = ch if height is None else height
             width = cw if width is None else width
         board = read_board(args.input_file, height, width)
-        text = rle.emit_rle(board, rule=args.rule)
+        try:
+            from tpu_life.models.rules import get_rule
+
+            states = get_rule(args.rule).states
+        except (KeyError, ValueError):
+            states = 2  # unknown rule string: dialect follows board content
+        text = rle.emit_rle(board, rule=args.rule, states=states)
         if args.rle:
             Path(args.rle).write_text(text)
             print(f"wrote {args.rle} ({height}x{width})")
@@ -345,6 +351,11 @@ def _pattern(parser, args) -> int:
         parser.error("pattern import needs exactly one of --rle / --name")
     if args.rle is not None:
         cells, meta = rle.parse_rle(Path(args.rle).read_text())
+        if cells.max(initial=0) > 9:
+            parser.error(
+                "pattern uses states > 9, which don't fit the contract "
+                "codec's digit encoding"
+            )
         if meta.get("rule"):
             print(f"pattern rule: {meta['rule']} (pass via `run --rule`)")
     else:

@@ -9,8 +9,12 @@ lines).  This module converts between RLE text and the framework's int8
 board arrays, so any published pattern drops straight into the contract
 codec (`tpu_life/io/codec.py`) and vice versa.
 
-Two-state only: multi-state Generations RLE dialects are rejected loudly
-rather than guessed at.
+Both standard dialects are supported: two-state (``b``/``o``) and the
+multi-state Generations alphabet (``.`` dead, ``A``..``X`` states 1..24),
+covering the framework's whole rule space (Generations rules like Brian's
+Brain are 3-4 states).  States above 24 (the ``p``..``y`` prefix-pair
+extension) are rejected loudly — the contract codec caps states at 10
+anyway (`tpu_life/models/rules.py` Rule.states).
 """
 
 from __future__ import annotations
@@ -43,7 +47,9 @@ def parse_rle(text: str) -> tuple[np.ndarray, dict]:
         if s.startswith("#"):
             comments.append(s[1:].strip())
             continue
-        if not saw_header and not rows and not cur and s[:1] in "xX":
+        # header sniff: 'X' is also a body token (state 24), so only a
+        # first line containing '=' is treated as a header candidate
+        if not saw_header and not rows and not cur and s[:1] in "xX" and "=" in s:
             # the rule value may itself contain commas (Golly LtL specs like
             # R5,C2,S34..58,B34..45), so it must be matched as "rest of
             # line", never comma-split
@@ -67,11 +73,13 @@ def parse_rle(text: str) -> tuple[np.ndarray, dict]:
             elif ch in "b.":
                 cur.extend([0] * max(1, count))
                 count = 0
-            elif ch in "oA":
-                # 'A' is state-1 in the multi-state dialect == live here;
-                # 'B'..'X' are states >= 2 and fall through to the loud
-                # rejection below rather than silently corrupting cells
+            elif ch == "o":
                 cur.extend([1] * max(1, count))
+                count = 0
+            elif "A" <= ch <= "X":
+                # multi-state Generations alphabet: 'A' = state 1 (== live)
+                # through 'X' = state 24
+                cur.extend([ord(ch) - 64] * max(1, count))
                 count = 0
             elif ch == "$":
                 n = max(1, count)
@@ -85,7 +93,9 @@ def parse_rle(text: str) -> tuple[np.ndarray, dict]:
                 continue
             else:
                 raise ValueError(
-                    f"unsupported RLE token {ch!r} (two-state b/o dialect only)"
+                    f"unsupported RLE token {ch!r} (b/o and the ./A..X "
+                    f"multi-state alphabet are supported; states above 24 "
+                    f"are not)"
                 )
         if done:
             break
@@ -108,15 +118,28 @@ def emit_rle(
     board: np.ndarray,
     *,
     rule: str | None = "B3/S23",
+    states: int = 2,
     comments: tuple[str, ...] = (),
     line_width: int = 70,
 ) -> str:
-    """int8 board -> RLE text (header + wrapped body, trailing newline)."""
+    """int8 board -> RLE text (header + wrapped body, trailing newline).
+
+    Two-state boards use the ``b``/``o`` dialect; ``states > 2`` (or any
+    cell above 1) switches to the Generations ``.``/``A..X`` alphabet.
+    """
     board = np.asarray(board)
-    if board.max(initial=0) > 1:
+    max_state = int(board.max(initial=0))
+    multi = states > 2 or max_state > 1
+    if max_state > 24:
         raise ValueError(
-            "RLE export is two-state only; this board has states > 1"
+            "RLE export supports states up to 24 ('X'); this board exceeds it"
         )
+
+    def tag(v: int) -> str:
+        if multi:
+            return "." if v == 0 else chr(64 + v)
+        return "o" if v else "b"
+
     h, w = board.shape
     row_tokens: list[str] = []
     for r in range(h):
@@ -135,7 +158,7 @@ def emit_rle(
         ends = np.concatenate((bounds, [last]))
         row_tokens.append(
             "".join(
-                (str(e - s) if e - s > 1 else "") + ("o" if seg[s] else "b")
+                (str(e - s) if e - s > 1 else "") + tag(int(seg[s]))
                 for s, e in zip(starts, ends)
             )
         )
@@ -144,7 +167,7 @@ def emit_rle(
     body = re.sub(r"\$+", lambda m: (str(len(m.group())) if len(m.group()) > 1 else "") + "$", body)
     body = re.sub(r"(\d+)?\$!", "!", body)
     # wrap on token boundaries (a token = optional count + one tag char)
-    tokens = re.findall(r"\d*[bo$!]", body)
+    tokens = re.findall(r"\d*(?:[bo$!.]|[A-X])", body)
     lines: list[str] = []
     cur_line = ""
     for t in tokens:
