@@ -112,3 +112,22 @@ def test_highlife_replicator_differs_from_conway():
     a = run_np(b, get_rule("conway"), 10)
     h = run_np(b, get_rule("highlife"), 10)
     assert not np.array_equal(a, h)
+
+
+def test_pulsar_period_three():
+    # hand-checkable canonical oscillator: returns to itself at step 3,
+    # never earlier
+    rule = get_rule("conway")
+    b = patterns.place(patterns.empty(17, 17), patterns.PULSAR, 2, 2)
+    assert not np.array_equal(run_np(b, rule, 1), b)
+    assert not np.array_equal(run_np(b, rule, 2), b)
+    np.testing.assert_array_equal(run_np(b, rule, 3), b)
+
+
+def test_gosper_gun_emits_a_glider_every_30_steps():
+    # the gun's 36 cells grow by exactly one 5-cell glider per period
+    rule = get_rule("conway")
+    b = patterns.place(patterns.empty(50, 80), patterns.GOSPER_GLIDER_GUN, 5, 5)
+    assert int(b.sum()) == 36
+    assert int(run_np(b, rule, 30).sum()) == 41
+    assert int(run_np(b, rule, 60).sum()) == 46

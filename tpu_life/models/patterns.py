@@ -14,6 +14,14 @@ def _p(rows: list[str]) -> np.ndarray:
     return np.array([[int(c) for c in r] for r in rows], dtype=np.int8)
 
 
+def _rle(text: str) -> np.ndarray:
+    # larger patterns are defined via their published RLE strings through
+    # the framework's own parser (tpu_life/io/rle.py)
+    from tpu_life.io.rle import parse_rle
+
+    return parse_rle(text)[0]
+
+
 BLOCK = _p(["11", "11"])  # still life
 BLINKER = _p(["111"])  # period-2 oscillator
 TOAD = _p(["0111", "1110"])  # period-2 oscillator
@@ -21,6 +29,16 @@ BEACON = _p(["1100", "1100", "0011", "0011"])  # period-2 oscillator
 GLIDER = _p(["010", "001", "111"])  # moves (+1, +1) every 4 steps
 LWSS = _p(["01111", "10001", "00001", "10010"])  # lightweight spaceship
 R_PENTOMINO = _p(["011", "110", "010"])  # methuselah
+PULSAR = _rle(  # period-3 oscillator, 13x13
+    "x = 13, y = 13\n"
+    "2b3o3b3o2b$13b$o4bobo4bo$o4bobo4bo$o4bobo4bo$2b3o3b3o2b$13b$"
+    "2b3o3b3o2b$o4bobo4bo$o4bobo4bo$o4bobo4bo$13b$2b3o3b3o2b!"
+)
+GOSPER_GLIDER_GUN = _rle(  # emits one glider every 30 steps
+    "x = 36, y = 9\n"
+    "24bo$22bobo$12b2o6b2o12b2o$11bo3bo4b2o12b2o$2o8bo5bo3b2o$"
+    "2o8bo3bob2o4bobo$10bo5bo7bo$11bo3bo$12b2o!"
+)
 
 
 def place(board: np.ndarray, pattern: np.ndarray, top: int, left: int) -> np.ndarray:
