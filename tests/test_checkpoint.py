@@ -57,6 +57,57 @@ def test_driver_snapshots_and_resume(tmp_path, rng_board):
     np.testing.assert_array_equal(res2.board, expect)
 
 
+def test_snapshot_publish_is_atomic(tmp_path, monkeypatch):
+    # a crash mid-write must not leave a truncated board_N.txt: --resume
+    # trusts the newest snapshot, and a partial newest would wedge every
+    # later resume.  Simulate the crash with a writer that emits partial
+    # bytes then dies; the target name must not exist afterwards.
+    from tpu_life.runtime import checkpoint as ckpt
+
+    def dying_write(path, board):
+        with open(path, "wb") as f:
+            f.write(b"01")  # partial bytes
+        raise RuntimeError("device fell over mid-write")
+
+    monkeypatch.setattr(ckpt, "write_board", dying_write)
+    b = random_board(8, 8, seed=1)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="mid-write"):
+        save_snapshot(tmp_path / "snaps", 7, b, rule="B3/S23")
+    # neither a truncated target nor an orphan tmp survives the crash
+    assert list((tmp_path / "snaps").iterdir()) == []
+    assert latest_snapshot(tmp_path / "snaps") is None
+
+
+def test_resolve_resume_skips_truncated_newest(tmp_path):
+    # a multi-process collective snapshot write can be killed mid-file;
+    # directory resume must fall back to the newest INTACT snapshot
+    from tpu_life.runtime.checkpoint import resolve_resume, write_sidecar
+
+    b = random_board(8, 9, seed=3)
+    save_snapshot(tmp_path / "snaps", 10, b, rule="B3/S23")
+    bad = tmp_path / "snaps" / "board_000000020.txt"
+    bad.write_bytes(b"0101")  # truncated: 4 bytes instead of 8*10
+    write_sidecar(bad, 20, "B3/S23", 8, 9)
+    p, step, h, w = resolve_resume(tmp_path / "snaps", 8, 9)
+    assert step == 10 and p.name == "board_000000010.txt"
+    # with no intact snapshot at all, resume fails loudly
+    import pytest
+
+    (tmp_path / "snaps" / "board_000000010.txt").unlink()
+    (tmp_path / "snaps" / "board_000000010.json").unlink()
+    with pytest.raises(FileNotFoundError, match="no intact snapshots"):
+        resolve_resume(tmp_path / "snaps", 8, 9)
+
+
+def test_snapshot_dir_has_no_leftover_tmp(tmp_path):
+    b = random_board(12, 12, seed=2)
+    save_snapshot(tmp_path / "snaps", 3, b, rule="B3/S23")
+    names = sorted(f.name for f in (tmp_path / "snaps").iterdir())
+    assert names == ["board_000000003.json", "board_000000003.txt"]
+
+
 def test_metrics_recorded(tmp_path):
     board = random_board(16, 16, seed=32)
     write_board(tmp_path / "data.txt", board)

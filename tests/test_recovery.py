@@ -271,6 +271,9 @@ def test_streamed_sharded_recovery(tmp_path):
     np.testing.assert_array_equal(
         read_board(tmp_path / "out.txt", 64, 48), expect
     )
+    # streamed snapshots publish atomically: no .tmp leftovers
+    leftovers = [f for f in (tmp_path / "snaps").iterdir() if f.suffix == ".tmp"]
+    assert leftovers == []
 
 
 def test_cli_flags_plumb_through(tmp_path, monkeypatch):

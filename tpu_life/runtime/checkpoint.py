@@ -12,8 +12,10 @@ binary itself.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
+from contextlib import contextmanager
 from pathlib import Path
 
 import numpy as np
@@ -21,6 +23,25 @@ import numpy as np
 from tpu_life.io.codec import read_board, write_board
 
 _SNAP_RE = re.compile(r"^board_(\d+)\.txt$")
+
+log = logging.getLogger("tpu_life")
+
+
+@contextmanager
+def atomic_publish(p: Path):
+    """Yield a tmp path to write; publish it onto ``p`` only on success.
+
+    A crash mid-write must never leave a truncated ``p`` — resume paths
+    trust these files — and must not litter orphan tmps either: on any
+    failure the tmp is unlinked, on success ``os.replace`` lands the bytes
+    atomically (POSIX rename).
+    """
+    tmp = p.with_suffix(".tmp")
+    try:
+        yield tmp
+        os.replace(tmp, p)
+    finally:
+        tmp.unlink(missing_ok=True)  # no-op after a successful replace
 
 
 def snapshot_path(directory: str | os.PathLike, step: int) -> Path:
@@ -42,23 +63,51 @@ def save_snapshot(
     d = Path(directory)
     d.mkdir(parents=True, exist_ok=True)
     p = snapshot_path(d, step)
-    write_board(p, board)
+    # the sidecar follows the board so it never describes bytes that
+    # aren't fully there
+    with atomic_publish(p) as tmp:
+        write_board(tmp, board)
     write_sidecar(p, step, rule, int(board.shape[0]), int(board.shape[1]))
     return p
 
 
-def latest_snapshot(directory: str | os.PathLike) -> tuple[int, Path] | None:
+def list_snapshots(directory: str | os.PathLike) -> list[tuple[int, Path]]:
+    """All snapshots in ``directory``, newest first."""
     d = Path(directory)
     if not d.is_dir():
-        return None
-    best: tuple[int, Path] | None = None
+        return []
+    found = []
     for f in d.iterdir():
         m = _SNAP_RE.match(f.name)
         if m:
-            step = int(m.group(1))
-            if best is None or step > best[0]:
-                best = (step, f)
-    return best
+            found.append((int(m.group(1)), f))
+    return sorted(found, reverse=True)
+
+
+def latest_snapshot(directory: str | os.PathLike) -> tuple[int, Path] | None:
+    snaps = list_snapshots(directory)
+    return snaps[0] if snaps else None
+
+
+def snapshot_intact(p: Path, height: int, width: int) -> bool:
+    """True when the snapshot's byte size matches its geometry (from the
+    sidecar when present, the caller's otherwise) — a file truncated by a
+    crash mid-write fails this.  Single-process writes publish atomically
+    (``atomic_publish``) so can't be truncated; multi-process collective
+    snapshot writes can, which is why directory resume checks this."""
+    h, w = height, width
+    sidecar = p.with_suffix(".json")
+    if sidecar.exists():
+        try:
+            meta = json.loads(sidecar.read_text())
+            h = int(meta.get("height", h))
+            w = int(meta.get("width", w))
+        except (ValueError, OSError):
+            return False
+    try:
+        return p.stat().st_size == h * (w + 1)
+    except OSError:
+        return False
 
 
 def resolve_resume(
@@ -73,11 +122,22 @@ def resolve_resume(
     """
     p = Path(path)
     if p.is_dir():
-        found = latest_snapshot(p)
-        if found is None:
+        snaps = list_snapshots(p)
+        if not snaps:
             raise FileNotFoundError(f"no snapshots in {p}")
-        step, p = found
-        return p, step, height, width
+        # prefer the newest INTACT snapshot: a job killed mid-collective-
+        # write can leave the newest truncated, and resuming must fall
+        # back to the one before it rather than wedge forever
+        for step, f in snaps:
+            if snapshot_intact(f, height, width):
+                if (step, f) != snaps[0]:
+                    log.warning(
+                        "skipping truncated snapshot %s; resuming from %s",
+                        snaps[0][1],
+                        f,
+                    )
+                return f, step, height, width
+        raise FileNotFoundError(f"no intact snapshots in {p}")
     step = 0
     sidecar = p.with_suffix(".json")
     if sidecar.exists():

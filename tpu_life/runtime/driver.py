@@ -43,6 +43,12 @@ class RunResult:
     restarts: int = 0  # recoveries taken by the elastic-recovery loop
 
 
+def _single_process() -> bool:
+    import jax
+
+    return jax.process_count() == 1
+
+
 def _is_lead_process() -> bool:
     """True on the process that owns single-writer side effects (whole-board
     output, the ``Total time`` report) — the analogue of the reference's
@@ -194,12 +200,23 @@ def run(cfg: RunConfig) -> RunResult:
         ):
             state["last_snap"] = done_local
             if stream:
-                # per-shard snapshot write: the board stays sharded
+                # per-shard snapshot write: the board stays sharded.
+                # Single-process: publish atomically (ckpt.atomic_publish).
+                # Multi-process: every process pwrites its shards into ONE
+                # file, so a rename dance cannot work — the collective
+                # write goes direct, and resolve_resume compensates by
+                # skipping truncated snapshots (ckpt.snapshot_intact).
                 Path(cfg.snapshot_dir).mkdir(parents=True, exist_ok=True)
                 p = ckpt.snapshot_path(cfg.snapshot_dir, done)
-                backend.write_runner_to_file(
-                    recovery.unwrap(runner), p, height, width, rule
-                )
+                if _single_process():
+                    with ckpt.atomic_publish(p) as tmp:
+                        backend.write_runner_to_file(
+                            recovery.unwrap(runner), tmp, height, width, rule
+                        )
+                else:
+                    backend.write_runner_to_file(
+                        recovery.unwrap(runner), p, height, width, rule
+                    )
                 ckpt.write_sidecar(p, done, rule.name, height, width)
             else:
                 p = ckpt.save_snapshot(
@@ -233,9 +250,7 @@ def run(cfg: RunConfig) -> RunResult:
     # resolves identically.
     max_restarts = cfg.max_restarts
     if max_restarts > 0:
-        import jax
-
-        if jax.process_count() > 1:
+        if not _single_process():
             log.warning(
                 "multi-process job: in-process elastic recovery disabled; "
                 "on failure, relaunch the whole job with --resume %s",
@@ -279,16 +294,29 @@ def run(cfg: RunConfig) -> RunResult:
                 # re-drives the tail and re-attempts them
                 if stream:
                     if cfg.output_file:
-                        Path(cfg.output_file).parent.mkdir(
-                            parents=True, exist_ok=True
-                        )
-                        backend.write_runner_to_file(
-                            recovery.unwrap(runner),
-                            cfg.output_file,
-                            height,
-                            width,
-                            rule,
-                        )
+                        # output format == input format, so output.txt is a
+                        # documented resume source — publish it atomically
+                        # too (single-process; the multi-process collective
+                        # write goes direct, like snapshots)
+                        out_p = Path(cfg.output_file)
+                        out_p.parent.mkdir(parents=True, exist_ok=True)
+                        if _single_process():
+                            with ckpt.atomic_publish(out_p) as tmp:
+                                backend.write_runner_to_file(
+                                    recovery.unwrap(runner),
+                                    tmp,
+                                    height,
+                                    width,
+                                    rule,
+                                )
+                        else:
+                            backend.write_runner_to_file(
+                                recovery.unwrap(runner),
+                                out_p,
+                                height,
+                                width,
+                                rule,
+                            )
                 else:
                     board = runner.fetch()
                 break
@@ -323,10 +351,13 @@ def run(cfg: RunConfig) -> RunResult:
     # remains, a pure host-side write
     lead = _is_lead_process()
     if cfg.output_file and not stream and lead:
-        Path(cfg.output_file).parent.mkdir(parents=True, exist_ok=True)
+        out_p = Path(cfg.output_file)
+        out_p.parent.mkdir(parents=True, exist_ok=True)
         # whole-board write: single writer, like rank 0 owning the
-        # host-materialized result
-        write_board(cfg.output_file, board)
+        # host-materialized result; atomic because output.txt is itself a
+        # documented resume source (output format == input format)
+        with ckpt.atomic_publish(out_p) as tmp:
+            write_board(tmp, board)
 
     elapsed = timer.elapsed
     if lead:
