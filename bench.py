@@ -201,6 +201,7 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
         "value": per_chip,
         "unit": "cells/s/chip",
         "vs_baseline": per_chip / TARGET,
+        "rule": args.rule,
         "platform": platform,
         "platform_actual": actual,
         "platform_pinned": bool(pinned),

@@ -108,6 +108,91 @@ def test_snapshot_dir_has_no_leftover_tmp(tmp_path):
     assert names == ["board_000000003.json", "board_000000003.txt"]
 
 
+def test_snapshot_retention(tmp_path):
+    board = random_board(24, 24, seed=4)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "cfg.txt", 24, 24, 20)
+    run(
+        RunConfig(
+            config_file=str(tmp_path / "cfg.txt"),
+            input_file=str(tmp_path / "data.txt"),
+            output_file=str(tmp_path / "out.txt"),
+            backend="numpy",
+            snapshot_every=4,
+            keep_snapshots=2,
+            snapshot_dir=str(tmp_path / "snaps"),
+        )
+    )
+    from tpu_life.runtime.checkpoint import list_snapshots
+
+    assert [s for s, _ in list_snapshots(tmp_path / "snaps")] == [20, 16]
+
+
+def test_prune_manages_only_named_steps(tmp_path):
+    # a stale higher-step snapshot from some other run is neither kept as
+    # "newest" nor deleted — retention touches only this run's snapshots
+    from tpu_life.runtime.checkpoint import list_snapshots, prune_snapshots
+
+    b = random_board(8, 8, seed=6)
+    for step in (4, 8, 1000):
+        save_snapshot(tmp_path / "snaps", step, b, rule="B3/S23")
+    kept = prune_snapshots(tmp_path / "snaps", 1, [4, 8])
+    assert kept == [8]
+    assert [s for s, _ in list_snapshots(tmp_path / "snaps")] == [1000, 8]
+
+
+def test_retention_composes_with_recovery(tmp_path):
+    # keep_snapshots=1 must still leave recovery a valid restart source
+    from tpu_life.ops.reference import run_np as _run_np
+    from tpu_life.models.rules import get_rule as _get_rule
+
+    board = random_board(40, 33, seed=7)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "cfg.txt", 40, 33, 20)
+    res = run(
+        RunConfig(
+            config_file=str(tmp_path / "cfg.txt"),
+            input_file=str(tmp_path / "data.txt"),
+            output_file=str(tmp_path / "out.txt"),
+            backend="numpy",
+            snapshot_every=5,
+            sync_every=5,
+            keep_snapshots=1,
+            fault_at=12,
+            max_restarts=1,
+            snapshot_dir=str(tmp_path / "snaps"),
+        )
+    )
+    assert res.restarts == 1
+    np.testing.assert_array_equal(
+        res.board, _run_np(board, _get_rule("conway"), 20)
+    )
+
+
+def test_metrics_file_sink(tmp_path):
+    import json
+
+    board = random_board(16, 16, seed=5)
+    write_board(tmp_path / "data.txt", board)
+    write_config(tmp_path / "cfg.txt", 16, 16, 6)
+    res = run(
+        RunConfig(
+            config_file=str(tmp_path / "cfg.txt"),
+            input_file=str(tmp_path / "data.txt"),
+            output_file=str(tmp_path / "out.txt"),
+            backend="numpy",
+            metrics_file=str(tmp_path / "m.jsonl"),  # implies metrics
+            sync_every=2,
+        )
+    )
+    lines = [
+        json.loads(ln)
+        for ln in (tmp_path / "m.jsonl").read_text().splitlines()
+    ]
+    assert [ln["step"] for ln in lines] == [2, 4, 6]
+    assert lines == res.metrics
+
+
 def test_metrics_recorded(tmp_path):
     board = random_board(16, 16, seed=32)
     write_board(tmp_path / "data.txt", board)

@@ -140,6 +140,13 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     )
     r.add_argument("--snapshot-every", type=int, default=0)
     r.add_argument("--snapshot-dir", default="snapshots")
+    r.add_argument(
+        "--keep-snapshots",
+        type=int,
+        default=0,
+        metavar="N",
+        help="retain only the newest N snapshots (0 = keep all)",
+    )
     r.add_argument("--resume", default=None)
     r.add_argument(
         "--max-restarts",
@@ -174,6 +181,12 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     )
     r.add_argument("--profile", default=None, metavar="TRACE_DIR")
     r.add_argument("--metrics", action="store_true")
+    r.add_argument(
+        "--metrics-file",
+        default=None,
+        metavar="JSONL",
+        help="append each metrics record as a JSON line (implies --metrics)",
+    )
     r.add_argument("--verbose", "-v", action="store_true")
 
 
@@ -236,6 +249,7 @@ def main(argv: list[str] | None = None) -> int:
         bitpack=not args.no_bitpack,
         snapshot_every=args.snapshot_every,
         snapshot_dir=args.snapshot_dir,
+        keep_snapshots=args.keep_snapshots,
         resume=args.resume,
         max_restarts=args.max_restarts,
         fault_at=args.fault_at,
@@ -243,6 +257,7 @@ def main(argv: list[str] | None = None) -> int:
         restart_wait_s=args.restart_wait,
         profile=args.profile,
         metrics=args.metrics,
+        metrics_file=args.metrics_file,
         verbose=args.verbose,
     )
     from tpu_life.runtime.driver import run

@@ -53,6 +53,9 @@ class RunConfig:
     # aux subsystems
     snapshot_every: int = 0
     snapshot_dir: str = "snapshots"
+    # retention: keep only the newest N snapshots (0 = keep all); pruning
+    # happens after each successful snapshot publish
+    keep_snapshots: int = 0
     resume: str | None = None
     # elastic recovery: on a recoverable device failure mid-run (RuntimeError
     # from a blocked step — preemption, device loss), rebuild the backend and
@@ -72,6 +75,8 @@ class RunConfig:
     profile: str | None = None  # jax.profiler trace directory
     verbose: bool = False
     metrics: bool = False  # per-chunk live-cell counts + throughput
+    # append each metrics record as a JSON line here (implies metrics)
+    metrics_file: str | None = None
 
     def resolved_geometry(self) -> tuple[int, int, int]:
         """(height, width, steps), reading the config file for any None."""

@@ -110,6 +110,28 @@ def snapshot_intact(p: Path, height: int, width: int) -> bool:
         return False
 
 
+def prune_snapshots(
+    directory: str | os.PathLike, keep: int, steps: list[int]
+) -> list[int]:
+    """Delete all but the newest ``keep`` of the given snapshot ``steps``;
+    returns the steps that remain.
+
+    Retention only ever touches the snapshots the caller names (the current
+    run's own writes) — a stale higher-numbered snapshot left by some
+    earlier run is neither trusted as "newest" nor deleted; it simply isn't
+    this run's to manage.  ``keep <= 0`` prunes nothing.
+    """
+    if keep <= 0:
+        return sorted(set(steps))
+    ordered = sorted(set(steps))
+    drop, kept = ordered[:-keep], ordered[-keep:]
+    for step in drop:
+        p = snapshot_path(directory, step)
+        p.unlink(missing_ok=True)
+        p.with_suffix(".json").unlink(missing_ok=True)
+    return kept
+
+
 def resolve_resume(
     path: str | os.PathLike, height: int, width: int
 ) -> tuple[Path, int, int, int]:

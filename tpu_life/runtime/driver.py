@@ -125,6 +125,7 @@ def run(cfg: RunConfig) -> RunResult:
         and not cfg.output_file
         and cfg.snapshot_every <= 0
         and not cfg.metrics
+        and not cfg.metrics_file
     ):
         # a streamed run's board is never materialized, so with no output
         # file, no snapshots and no metrics the run would compute into the
@@ -165,7 +166,16 @@ def run(cfg: RunConfig) -> RunResult:
 
     remaining = max(0, steps - start_step)
     recorder = MetricsRecorder(
-        height * width, cfg.metrics or cfg.verbose, start_step=start_step
+        height * width,
+        # enabled must be UNIFORM across processes: record_chunk calls the
+        # runner's collective live-count reduction, and a lead-only
+        # recorder would leave peers out of the psum and hang the job
+        cfg.metrics or cfg.verbose or bool(cfg.metrics_file),
+        start_step=start_step,
+        # the JSONL sink itself is a single-writer side effect: lead-only.
+        # It is a raw append log — recovery rewinds may repeat steps there
+        # (RunResult.metrics is the deduplicated record)
+        sink=cfg.metrics_file if _is_lead_process() else None,
     )
 
     chunk = cfg.sync_every
@@ -183,6 +193,9 @@ def run(cfg: RunConfig) -> RunResult:
     # the absolute steps of snapshots THIS run wrote — the only snapshots
     # recovery will trust as restart sources.
     state = {"start": start_step, "last_snap": 0, "written": []}
+    # retention pruning is a single-writer side effect (racing unlinks in a
+    # multi-process job would trip each other); gate it on the lead
+    lead_snapshots = _is_lead_process()
 
     def on_chunk(done_local: int, get_board) -> None:
         done = state["start"] + done_local
@@ -227,12 +240,24 @@ def run(cfg: RunConfig) -> RunResult:
                 )
             state["written"].append(done)
             log.info("snapshot step=%d -> %s", done, p)
+            if cfg.keep_snapshots > 0 and lead_snapshots:
+                # retention manages only THIS run's snapshots, and the
+                # kept list replaces state["written"] so elastic recovery
+                # never targets a pruned file
+                state["written"] = ckpt.prune_snapshots(
+                    cfg.snapshot_dir, cfg.keep_snapshots, state["written"]
+                )
         if cfg.verbose and board_np is not None:
             log.debug("board at step %d:\n%s", done, dump_board(board_np))
 
     callback = (
         on_chunk
-        if (cfg.snapshot_every > 0 or cfg.metrics or cfg.verbose)
+        if (
+            cfg.snapshot_every > 0
+            or cfg.metrics
+            or cfg.metrics_file
+            or cfg.verbose
+        )
         else None
     )
 

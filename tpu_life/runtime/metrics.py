@@ -26,11 +26,18 @@ def configure_logging(verbose: bool) -> None:
 
 
 class MetricsRecorder:
-    def __init__(self, cell_count: int, enabled: bool, start_step: int = 0):
+    def __init__(
+        self,
+        cell_count: int,
+        enabled: bool,
+        start_step: int = 0,
+        sink: str | None = None,
+    ):
         self.cell_count = cell_count
-        self.enabled = enabled
+        self.enabled = enabled or sink is not None
         self.start_step = start_step  # rates count only this run's steps
         self.records: list[dict] = []
+        self.sink = sink  # append each record as a JSON line here
 
     def record_chunk(self, step: int, elapsed: float, live: int) -> None:
         """Record one host-sync chunk.  ``live`` comes from the runner's
@@ -50,6 +57,11 @@ class MetricsRecorder:
             else float("nan"),
         }
         self.records.append(rec)
+        if self.sink:
+            import json
+
+            with open(self.sink, "a") as f:
+                f.write(json.dumps(rec) + "\n")
         log.info(
             "step=%d live=%d steps/s=%.2f cells/s=%.3e",
             step,
