@@ -10,7 +10,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from functools import partial
 
 N = 8192
 STEPS = 50

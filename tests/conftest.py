@@ -23,7 +23,6 @@ warnings.filterwarnings(
     "ignore", message=".*[Dd]onat.*", category=UserWarning
 )
 
-import numpy as np
 import pytest
 
 from tpu_life.models.patterns import random_board

@@ -10,7 +10,7 @@ import itertools
 import numpy as np
 import pytest
 
-from tpu_life.models.rules import RULE_REGISTRY, Rule, get_rule
+from tpu_life.models.rules import RULE_REGISTRY, get_rule
 from tpu_life.ops import bitlife
 from tpu_life.ops.boolmin import minimize, rule_sop, verify
 

@@ -8,7 +8,6 @@ import jax.numpy as jnp
 from tpu_life.models.rules import get_rule, parse_rule
 from tpu_life.ops.reference import neighbor_counts_np, run_np, step_np
 from tpu_life.ops.stencil import (
-    make_masked_step,
     make_step,
     multi_step,
     neighbor_counts,

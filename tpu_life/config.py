@@ -9,7 +9,7 @@ it (SURVEY.md §5 "Config / flag system").
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 
 from tpu_life.io.codec import read_config
