@@ -168,3 +168,20 @@ def test_profile_flag_writes_trace(workload, tmp_path):
     )
     # jax.profiler.trace writes a plugins/profile/<ts>/ tree
     assert trace_dir.exists() and any(trace_dir.rglob("*"))
+
+
+def test_bench_subcommand_emits_json(capsys):
+    """`tpu_life bench` prints one JSON line in the bench.py record shape."""
+    import json
+
+    from tpu_life.cli import main
+
+    rc = main(
+        ["bench", "--size", "128", "--steps", "20", "--base-steps", "2",
+         "--backend", "jax", "--repeats", "1"]
+    )
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "cell_updates_per_sec_per_chip"
+    assert rec["value"] > 0 and rec["n_chips"] >= 1
+    assert rec["rule"] == "conway" and rec["platform"] == "cpu"

@@ -55,6 +55,29 @@ def build_parser() -> argparse.ArgumentParser:
                      help="rule string stamped into the exported RLE header "
                      "(record what the board was actually evolved under)")
 
+    b = sub.add_parser(
+        "bench",
+        help="quick throughput measurement: cells/s/chip vs the 1e11 target",
+    )
+    # steps/base-steps match bench.py's delta methodology — the timed delta
+    # must hold far more compute than the tunnel's per-dispatch jitter, or
+    # the number is noise (a 90-step delta at 4096^2 is ~0.7 ms of compute
+    # against ~ms jitter).  size/repeats are smaller than bench.py's
+    # (16384 / 6 on an accelerator): this is the quick check, not the
+    # armored capture.
+    b.add_argument("--size", type=int, default=4096)
+    b.add_argument("--steps", type=int, default=1000)
+    b.add_argument("--base-steps", type=int, default=100)
+    b.add_argument("--repeats", type=int, default=3)
+    b.add_argument("--rule", default="conway")
+    b.add_argument("--backend", default="auto")
+    b.add_argument("--platform", default=None,
+                   help="force a JAX platform (cpu/tpu), like `run --platform`")
+    b.add_argument("--block-steps", type=int, default=None)
+    b.add_argument("--local-kernel", default=None,
+                   help="sharded backend only (ignored elsewhere, and "
+                   "recorded as null in the JSON)")
+
     g = sub.add_parser("gen", help="generate a random board + config")
     g.add_argument("--height", type=int, required=True)
     g.add_argument("--width", type=int, required=True)
@@ -228,6 +251,10 @@ def main(argv: list[str] | None = None) -> int:
     except TimeoutError as e:
         print(f"tpu_life: {e}", file=sys.stderr)
         return 2
+    if args.command == "bench":
+        # after the watchdog: _bench queries devices, and a wedged plugin
+        # must degrade into the message above, not a hang
+        return _bench(args)
     cfg = RunConfig(
         height=args.height,
         width=args.width,
@@ -315,6 +342,70 @@ def _info() -> int:
         "ok" if native_io.available() else "numpy fallback (make -C native)",
     )
     print("rules:", ", ".join(sorted(RULE_REGISTRY)))
+    return 0
+
+
+def _bench(args) -> int:
+    """In-process delta-timing throughput measurement, one JSON line.
+
+    The user-facing sibling of the repo's armored `bench.py` capture: same
+    delta method (two fused runs of different step counts, differenced to
+    cancel dispatch + readback latency), same record shape, but no probe /
+    fallback machinery — it measures whatever platform the session has.
+    """
+    import json
+
+    import numpy as np
+
+    from tpu_life.backends.base import get_backend, make_runner
+    from tpu_life.models.rules import get_rule
+    from tpu_life.utils.timing import delta_seconds_per_step
+
+    target = 1e11  # cell-updates/sec/chip north star (BASELINE.json)
+    rule = get_rule(args.rule)
+    n = args.size
+    rng = np.random.default_rng(0)
+    board = rng.integers(0, 2, size=(n, n), dtype=np.int8)
+    if rule.states > 2:
+        board *= rng.integers(1, rule.states, size=(n, n), dtype=np.int8)
+
+    kwargs = {}
+    if args.block_steps is not None:
+        kwargs["block_steps"] = args.block_steps
+    if args.local_kernel is not None:
+        # every backend tolerates unknown kwargs; the record below carries
+        # what the resolved backend ACTUALLY applied (null = the backend
+        # has no local-kernel concept), so `--backend auto` resolving to
+        # sharded still honors and truthfully labels the flag
+        kwargs["local_kernel"] = args.local_kernel
+    backend = get_backend(args.backend, **kwargs)
+    runner = make_runner(backend, board, rule)
+    per_step = delta_seconds_per_step(
+        runner, args.steps, args.base_steps, repeats=args.repeats
+    )
+    mesh = getattr(backend, "mesh", None)
+    n_chips = int(mesh.devices.size) if mesh is not None else 1
+    per_chip = n * n / per_step / n_chips
+
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "metric": "cell_updates_per_sec_per_chip",
+                "value": per_chip,
+                "unit": "cells/s/chip",
+                "vs_baseline": per_chip / target,
+                "rule": args.rule,  # as requested, matching bench.py's record
+                "platform": jax.devices()[0].platform,
+                "backend": getattr(backend, "name", args.backend),
+                "local_kernel": getattr(backend, "local_kernel", None),
+                "size": n,
+                "steps": args.steps,
+                "n_chips": n_chips,
+            }
+        )
+    )
     return 0
 
 
