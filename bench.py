@@ -145,7 +145,7 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
 
     import jax
 
-    from tpu_life.backends.base import get_backend, make_runner
+    from tpu_life.backends.base import get_backend
     from tpu_life.models.rules import get_rule
 
     # post-init verification: the platform the backend actually gave us.
@@ -172,22 +172,15 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
 
     backend_name = args.backend  # resolved in main() before any run
 
-    from tpu_life.utils.timing import delta_seconds_per_step
+    from tpu_life.backends.base import measure_throughput
 
     def measure(name: str, kwargs: dict) -> tuple[float, int]:
-        """cells/s/chip for one backend config via delta timing."""
+        """cells/s/chip for one backend config via the shared delta-timing
+        core (`measure_throughput`, also behind `tpu_life bench`)."""
         backend = get_backend(name, **kwargs)
-        runner = make_runner(backend, board, rule)
-        per_step = delta_seconds_per_step(
-            runner, args.steps, args.base_steps, repeats=args.repeats
+        return measure_throughput(
+            backend, board, rule, args.steps, args.base_steps, args.repeats
         )
-        best = n * n / per_step
-
-        # per-chip divisor = the device count the backend actually used (a
-        # mesh backend may span fewer devices than jax.devices() reports)
-        mesh = getattr(backend, "mesh", None)
-        n_chips = int(mesh.devices.size) if mesh is not None else 1
-        return best / n_chips, n_chips
 
     kwargs = {"bitpack": not args.no_bitpack}
     if args.block_steps is not None:

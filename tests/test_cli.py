@@ -185,3 +185,24 @@ def test_bench_subcommand_emits_json(capsys):
     assert rec["metric"] == "cell_updates_per_sec_per_chip"
     assert rec["value"] > 0 and rec["n_chips"] >= 1
     assert rec["rule"] == "conway" and rec["platform"] == "cpu"
+
+
+def test_bench_subcommand_sharded_mesh(capsys):
+    """The per-chip divisor reflects the mesh the backend actually spans."""
+    import json
+
+    import jax
+    import pytest
+
+    from tpu_life.cli import main
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 fake devices")
+    rc = main(
+        ["bench", "--size", "128", "--steps", "40", "--base-steps", "4",
+         "--backend", "sharded", "--local-kernel", "xla", "--repeats", "1"]
+    )
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["n_chips"] == 8
+    assert rec["backend"] == "sharded" and rec["local_kernel"] == "xla"

@@ -140,6 +140,32 @@ def run_with_runner(
     return r.fetch()
 
 
+def measure_throughput(
+    backend: "Backend",
+    board: np.ndarray,
+    rule: Rule,
+    steps: int,
+    base_steps: int,
+    repeats: int = 3,
+) -> tuple[float, int]:
+    """(cells/s/chip, n_chips) of a backend via delta timing.
+
+    The single measurement core shared by ``bench.py`` and the CLI's
+    ``bench`` subcommand so their numbers cannot drift: stage the board,
+    difference two fused runs (`delta_seconds_per_step`), and divide by
+    the device count the backend actually spans (a mesh backend may use
+    fewer devices than ``jax.devices()`` reports).
+    """
+    from tpu_life.utils.timing import delta_seconds_per_step
+
+    runner = make_runner(backend, board, rule)
+    per_step = delta_seconds_per_step(runner, steps, base_steps, repeats=repeats)
+    mesh = getattr(backend, "mesh", None)
+    n_chips = int(mesh.devices.size) if mesh is not None else 1
+    h, w = board.shape
+    return h * w / per_step / n_chips, n_chips
+
+
 BACKENDS: dict[str, Callable[..., Backend]] = {}
 
 

@@ -357,9 +357,8 @@ def _bench(args) -> int:
 
     import numpy as np
 
-    from tpu_life.backends.base import get_backend, make_runner
+    from tpu_life.backends.base import get_backend, measure_throughput
     from tpu_life.models.rules import get_rule
-    from tpu_life.utils.timing import delta_seconds_per_step
 
     target = 1e11  # cell-updates/sec/chip north star (BASELINE.json)
     rule = get_rule(args.rule)
@@ -379,13 +378,9 @@ def _bench(args) -> int:
         # sharded still honors and truthfully labels the flag
         kwargs["local_kernel"] = args.local_kernel
     backend = get_backend(args.backend, **kwargs)
-    runner = make_runner(backend, board, rule)
-    per_step = delta_seconds_per_step(
-        runner, args.steps, args.base_steps, repeats=args.repeats
+    per_chip, n_chips = measure_throughput(
+        backend, board, rule, args.steps, args.base_steps, args.repeats
     )
-    mesh = getattr(backend, "mesh", None)
-    n_chips = int(mesh.devices.size) if mesh is not None else 1
-    per_chip = n * n / per_step / n_chips
 
     import jax
 
