@@ -1,0 +1,135 @@
+"""Von Neumann (diamond) neighborhoods — the Golly LtL ``NN`` field.
+
+The reference's kernel is the r=1 Moore box (Parallel_Life_MPI.cpp:19-31);
+the rule engine generalizes to the |dx|+|dy| <= r diamond.  Executors with
+box-sum cores (bitpack, Pallas kernels, native C) must refuse or fall back
+— never silently count the wrong neighborhood — and the executors that do
+support it must stay bit-identical to the NumPy oracle.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.models.rules import Rule, get_rule
+from tpu_life.ops.reference import neighbor_counts_np, run_np
+
+
+VN_SPEC = "R2,C2,S2..4,B2..3,NN"
+
+
+def test_parse_nn_field():
+    rule = get_rule(VN_SPEC)
+    assert rule.neighborhood == "von_neumann"
+    assert rule.radius == 2
+    # diamond size at r=2 is 13 cells; center excluded -> max count 12
+    assert rule.max_count == 12
+
+
+def test_parse_rejects_unknown_neighborhood():
+    with pytest.raises(ValueError, match="unsupported neighborhood NZ"):
+        get_rule("R2,C2,S2..4,B2,NZ")
+
+
+def test_rule_count_bounds_follow_diamond():
+    Rule(name="ok", birth=frozenset({12}), survive=frozenset(),
+         radius=2, neighborhood="von_neumann")
+    with pytest.raises(ValueError, match="out of range"):
+        Rule(name="no", birth=frozenset({13}), survive=frozenset(),
+             radius=2, neighborhood="von_neumann")
+
+
+def test_diamond_counts_hand_checked():
+    b = np.zeros((5, 5), np.int8)
+    b[2, 2] = 1
+    c = neighbor_counts_np(b, radius=2, neighborhood="von_neumann")
+    expect = np.array(
+        [
+            [0, 0, 1, 0, 0],
+            [0, 1, 1, 1, 0],
+            [1, 1, 0, 1, 1],
+            [0, 1, 1, 1, 0],
+            [0, 0, 1, 0, 0],
+        ],
+        np.int32,
+    )
+    np.testing.assert_array_equal(c, expect)
+
+
+def test_r1_diamond_is_the_four_neighbour_cross():
+    b = np.zeros((3, 3), np.int8)
+    b[1, 1] = 1
+    c = neighbor_counts_np(b, radius=1, neighborhood="von_neumann")
+    np.testing.assert_array_equal(c, [[0, 1, 0], [1, 0, 1], [0, 1, 0]])
+
+
+@pytest.mark.parametrize("backend_name", ["jax", "pallas", "sharded", "stripes"])
+def test_executors_match_oracle(backend_name, rng_board):
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if backend_name == "sharded" and len(jax.devices()) < 8:
+        pytest.skip("needs 8 fake devices")
+    rule = get_rule(VN_SPEC)
+    board = rng_board(37, 41, density=0.45, seed=11)
+    expect = run_np(board, rule, 8)
+    kwargs = {"num_devices": 8} if backend_name == "sharded" else {}
+    if backend_name == "pallas":
+        kwargs["interpret"] = True
+    out = get_backend(backend_name, **kwargs).run(board, rule, 8)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_sharded_2d_mesh_matches(rng_board):
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    rule = get_rule(VN_SPEC)
+    board = rng_board(35, 29, density=0.5, seed=12)
+    out = get_backend("sharded", mesh_shape=(2, 2)).run(board, rule, 6)
+    np.testing.assert_array_equal(out, run_np(board, rule, 6))
+
+
+def test_generations_von_neumann(rng_board):
+    # multistate decay composes with the diamond neighborhood
+    rule = get_rule("R1,C3,S1..2,B2,NN")
+    board = rng_board(24, 24, density=0.4, states=3, seed=13)
+    from tpu_life.backends.base import get_backend
+
+    out = get_backend("jax").run(board, rule, 5)
+    np.testing.assert_array_equal(out, run_np(board, rule, 5))
+
+
+def test_explicit_pallas_local_kernel_refuses_with_the_real_reason(rng_board):
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device platform")
+    rule = get_rule(VN_SPEC)
+    board = rng_board(32, 32, seed=14)
+    be = get_backend("sharded", num_devices=2, local_kernel="pallas")
+    with pytest.raises(ValueError, match="Moore boxes only"):
+        be.run(board, rule, 1)
+
+
+def test_native_refuses_loudly():
+    from tpu_life.ops import native_step
+
+    if not native_step.build():
+        pytest.skip("native step library unavailable")
+    rule = get_rule(VN_SPEC)
+    b = np.zeros((8, 8), np.int8)
+    with pytest.raises(ValueError, match="Moore neighborhoods only"):
+        native_step.run_native(b, rule, 1)
+
+
+def test_bitpack_gate_excludes_von_neumann():
+    from tpu_life.ops import bitlife
+
+    assert not bitlife.supports(get_rule("R1,C2,S2..3,B3,NN"))
+    assert bitlife.supports(get_rule("conway"))

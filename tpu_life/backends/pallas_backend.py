@@ -920,9 +920,33 @@ class PallasBackend:
             count_live=jax.jit(lambda x: bitlife.live_count_packed(x[fr : fr + h])),
         )
 
+    def _xla_scan_runner(
+        self, board: np.ndarray, rule: Rule, logical: tuple[int, int]
+    ) -> Runner:
+        """Fused-XLA-scan DeviceRunner — the single fallback for every case
+        no Pallas kernel covers (small boards, non-Moore neighborhoods)."""
+        h, w = logical
+        if self.bitpack and bitlife.supports(rule):
+            return packed_device_runner(board, rule, self.device)
+        wp = ceil_to(w, LANE)
+        x = jax.device_put(pad_board(board, h, wp), self.device)
+        advance = lambda x, n: multi_step(
+            x, rule=rule, steps=n, logical_shape=logical
+        )
+        return DeviceRunner(
+            x,
+            advance,
+            lambda x: np.asarray(x)[:h, :w],
+            count_live=bitlife.live_count_cells,
+        )
+
     def prepare(self, board: np.ndarray, rule: Rule) -> Runner:
         h, w = board.shape
         logical = (h, w)
+        if rule.neighborhood != "moore":
+            # both Pallas kernels count via box sums; von Neumann diamonds
+            # run on the fused XLA scan (whose stencil supports them)
+            return self._xla_scan_runner(board, rule, logical)
         if self.bitpack and bitlife.supports(rule):
             tiling = self._packed_tiling(h, w)
             if tiling is not None:
@@ -935,18 +959,9 @@ class PallasBackend:
         halo = rule.radius * block_steps
         if h < self.block_rows or w < self.block_cols:
             # small board: the fused XLA scan is already VMEM-resident there;
-            # keep the bit-sliced fast path when the rule allows it
-            if self.bitpack and bitlife.supports(rule):
-                return packed_device_runner(board, rule, self.device)
-            wp = ceil_to(w, LANE)
-            x = jax.device_put(pad_board(board, h, wp), self.device)
-            advance = lambda x, n: multi_step(x, rule=rule, steps=n, logical_shape=logical)
-            return DeviceRunner(
-                x,
-                advance,
-                lambda x: np.asarray(x)[:h, :w],
-                count_live=bitlife.live_count_cells,
-            )
+            # _xla_scan_runner keeps the bit-sliced fast path when the rule
+            # allows it
+            return self._xla_scan_runner(board, rule, logical)
 
         # zero frame: `halo` deep, aligned so DMA window offsets stay on
         # sublane/lane boundaries (fr - halo multiple of 8, fc - halo of 128)

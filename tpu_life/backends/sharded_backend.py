@@ -298,6 +298,10 @@ class ShardedBackend:
         from tpu_life.backends.pallas_backend import sharded_pallas_int8_frame
         from tpu_life.parallel.halo import halo_depth
 
+        if rule.neighborhood != "moore":
+            # the int8 kernel's separable box sum is Moore-only; returning
+            # None routes von Neumann rules to the XLA local kernel
+            return None
         sh = ceil_to(-(-h // self.n), SUBLANE)
         # tile width: lane multiple <= the configured cap whose shard-width
         # rounding wastes the fewest padded columns (every padded column is
@@ -355,6 +359,11 @@ class ShardedBackend:
             if kernel_mode == "int8":
                 int8_tiling = self._pallas_int8_tiling(h, w, rule)
                 if int8_tiling is None and self.local_kernel == "pallas":
+                    if rule.neighborhood != "moore":
+                        raise ValueError(
+                            "the Pallas int8 kernel counts Moore boxes "
+                            "only; von Neumann rules need local_kernel='xla'"
+                        )
                     raise ValueError(
                         "no Pallas int8 tiling fits the VMEM budget for this "
                         "board/mesh; use local_kernel='xla'"

@@ -11,14 +11,16 @@ tables that XLA fuses into the stencil.  One engine covers:
 - Generations rules (``B2/S/C3`` Brian's Brain, ...): ``states > 2`` adds
   refractory decay states 2..states-1 that count as dead but block birth;
 - Larger-than-Life (``R5,C2,S34..58,B34..45`` Bugs, ...): ``radius > 1``
-  widens the Moore box neighborhood; counts stay exact in int32.
+  widens the neighborhood; counts stay exact in int32.  The ``N`` field
+  picks its shape: ``NM`` (default) = the ``(2r+1)^2`` Moore box, ``NN`` =
+  the ``|dx|+|dy| <= r`` von Neumann diamond.
 
 Semantics (synchronous update, clamped dead boundary — the reference's
 non-periodic edges, Parallel_Life_MPI.cpp:21-27):
 
-- ``count`` = number of *alive* (state == 1) cells in the
-  ``(2r+1)^2 - 1`` box neighborhood (center excluded unless
-  ``include_center``).
+- ``count`` = number of *alive* (state == 1) cells in the rule's
+  neighborhood (Moore box or von Neumann diamond per ``neighborhood``;
+  center excluded unless ``include_center``).
 - dead (0):  -> 1 if ``count in birth`` else 0
 - alive (1): -> 1 if ``count in survive`` else (2 if states > 2 else 0)
 - dying (s >= 2, Generations only): -> s + 1, wrapping to 0 at ``states``
@@ -41,6 +43,10 @@ class Rule:
     radius: int = 1
     states: int = 2
     include_center: bool = False  # LtL "M1" variants count the center cell
+    # Golly "N" field: "moore" = the (2r+1)^2 box (the reference's 8-cell
+    # scan at r=1, Parallel_Life_MPI.cpp:19-31), "von_neumann" = the
+    # |dx|+|dy| <= r diamond
+    neighborhood: str = "moore"
 
     def __post_init__(self):
         if self.radius < 1:
@@ -48,6 +54,11 @@ class Rule:
         if not (2 <= self.states <= 10):
             # 10-state ceiling keeps the disk codec single-digit ('0'..'9').
             raise ValueError(f"states must be in [2, 10], got {self.states}")
+        if self.neighborhood not in ("moore", "von_neumann"):
+            raise ValueError(
+                f"neighborhood must be 'moore' or 'von_neumann', "
+                f"got {self.neighborhood!r}"
+            )
         mc = self.max_count
         for s in self.birth | self.survive:
             if not (0 <= s <= mc):
@@ -55,8 +66,12 @@ class Rule:
 
     @property
     def max_count(self) -> int:
-        k = 2 * self.radius + 1
-        return k * k - (0 if self.include_center else 1)
+        r = self.radius
+        if self.neighborhood == "von_neumann":
+            size = 2 * r * (r + 1) + 1  # the diamond, center included
+        else:
+            size = (2 * r + 1) ** 2
+        return size - (0 if self.include_center else 1)
 
     @cached_property
     def tables(self) -> tuple[np.ndarray, np.ndarray]:
@@ -117,8 +132,9 @@ def parse_rule(spec: str) -> Rule:
     - named rules from the registry: ``conway``, ``highlife``, ...
     - B/S (optionally Generations): ``B3/S23``, ``B36/S23``, ``B2/S/C3``
     - S/B classic: ``23/3``, ``345/2/4``
-    - Larger-than-Life (Golly-style): ``R5,C2,M0,S34..58,B34..45``
-      (C = states, M = include center; C and M optional)
+    - Larger-than-Life (Golly-style): ``R5,C2,M0,S34..58,B34..45[,NM|NN]``
+      (C = states, M = include center, N = neighborhood: NM Moore box /
+      NN von Neumann diamond; C, M and N optional)
     """
     spec = spec.strip()
     key = spec.lower().replace("-", "_").replace(" ", "_")
@@ -140,6 +156,18 @@ def parse_rule(spec: str) -> Rule:
         radius = int(fields.get("R", 1))
         states = int(fields.get("C", "2") or "2")
         states = max(states, 2)  # Golly uses C0/C1 for plain 2-state
+        nb_field = fields.get("N", "M").upper()
+        if nb_field in ("M", ""):
+            neighborhood = "moore"
+        elif nb_field == "N":
+            neighborhood = "von_neumann"
+        else:
+            # rejected loudly: silently running an unsupported neighborhood
+            # as Moore would give wrong results with no warning
+            raise ValueError(
+                f"unsupported neighborhood N{nb_field} in rule {spec!r} "
+                f"(NM = Moore and NN = von Neumann are supported)"
+            )
         return Rule(
             name=spec,
             birth=_expand_ranges(fields.get("B", "")),
@@ -147,6 +175,7 @@ def parse_rule(spec: str) -> Rule:
             radius=radius,
             states=states,
             include_center=fields.get("M", "0") == "1",
+            neighborhood=neighborhood,
         )
 
     m = _BS_RE.match(spec) or _SB_RE.match(spec)

@@ -46,7 +46,12 @@ def packed_width(width: int) -> int:
 
 def supports(rule: Rule) -> bool:
     """The bit path covers exactly the reference's rule family."""
-    return rule.states == 2 and rule.radius == 1 and not rule.include_center
+    return (
+        rule.states == 2
+        and rule.radius == 1
+        and not rule.include_center
+        and rule.neighborhood == "moore"
+    )
 
 
 # --- pack / unpack ------------------------------------------------------------

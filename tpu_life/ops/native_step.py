@@ -55,6 +55,13 @@ def run_native(
     """
     if _lib is None:
         raise RuntimeError("native step library not loaded (make -C native)")
+    if rule.neighborhood != "moore":
+        # the C stepper's sliding-window box sum is Moore-only; erroring
+        # beats silently counting the wrong neighborhood
+        raise ValueError(
+            "native backend supports Moore neighborhoods only; use "
+            "--backend numpy/jax/sharded for von Neumann rules"
+        )
     out = np.array(board, dtype=np.int8, order="C")  # exactly one fresh copy
     h, w = out.shape
     lut = np.ascontiguousarray(rule.transition_table, dtype=np.int8)

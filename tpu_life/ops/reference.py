@@ -15,25 +15,39 @@ from tpu_life.models.rules import Rule
 
 
 def neighbor_counts_np(
-    board: np.ndarray, radius: int = 1, include_center: bool = False
+    board: np.ndarray,
+    radius: int = 1,
+    include_center: bool = False,
+    neighborhood: str = "moore",
 ) -> np.ndarray:
-    """Live-neighbor counts in the (2r+1)^2 Moore box, clamped dead boundary.
+    """Live-neighbor counts with a clamped dead boundary.
 
-    Separable: one pass of (2r+1) row shifts, one of (2r+1) column shifts —
-    O(r) work per cell instead of the reference's O(r^2) inner scan
-    (Parallel_Life_MPI.cpp:19-31).
+    Moore = the (2r+1)^2 box, computed separably: one pass of (2r+1) row
+    shifts, one of (2r+1) column shifts — O(r) work per cell instead of the
+    reference's O(r^2) inner scan (Parallel_Life_MPI.cpp:19-31).
+    Von Neumann = the |dx|+|dy| <= r diamond; not separable, so the truth
+    executor sums the O(r^2) shifted slices directly (clarity over speed —
+    this is the oracle, not the fast path).
     """
     h, w = board.shape
     alive = (board == 1).astype(np.int32)
-    k = 2 * radius + 1
     padded = np.zeros((h + 2 * radius, w + 2 * radius), dtype=np.int32)
     padded[radius : radius + h, radius : radius + w] = alive
-    rows = np.zeros((h, w + 2 * radius), dtype=np.int32)
-    for dy in range(k):
-        rows += padded[dy : dy + h, :]
     counts = np.zeros((h, w), dtype=np.int32)
-    for dx in range(k):
-        counts += rows[:, dx : dx + w]
+    if neighborhood == "von_neumann":
+        for dy in range(-radius, radius + 1):
+            half = radius - abs(dy)
+            for dx in range(-half, half + 1):
+                counts += padded[
+                    radius + dy : radius + dy + h, radius + dx : radius + dx + w
+                ]
+    else:
+        k = 2 * radius + 1
+        rows = np.zeros((h, w + 2 * radius), dtype=np.int32)
+        for dy in range(k):
+            rows += padded[dy : dy + h, :]
+        for dx in range(k):
+            counts += rows[:, dx : dx + w]
     if not include_center:
         counts -= alive
     return counts
@@ -41,7 +55,9 @@ def neighbor_counts_np(
 
 def step_np(board: np.ndarray, rule: Rule) -> np.ndarray:
     """One synchronous CA step via the rule's full transition LUT."""
-    counts = neighbor_counts_np(board, rule.radius, rule.include_center)
+    counts = neighbor_counts_np(
+        board, rule.radius, rule.include_center, rule.neighborhood
+    )
     return rule.transition_table[board.astype(np.int64), counts]
 
 

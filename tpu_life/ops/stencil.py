@@ -31,19 +31,36 @@ from tpu_life.ops.common import contiguous_ranges
 
 
 def neighbor_counts(
-    board: jax.Array, radius: int = 1, include_center: bool = False
+    board: jax.Array,
+    radius: int = 1,
+    include_center: bool = False,
+    neighborhood: str = "moore",
 ) -> jax.Array:
-    """int32 live-neighbor counts; clamped (dead) outside the array."""
+    """int32 live-neighbor counts; clamped (dead) outside the array.
+
+    Moore runs as two separable shift passes; the von Neumann diamond is
+    not separable, so it unrolls the O(r^2) shifted-slice adds — still a
+    static Python loop over XLA slices, fully fused under jit.
+    """
     h, w = board.shape
-    k = 2 * radius + 1
     alive = (board == 1).astype(jnp.int32)
     padded = jnp.pad(alive, radius)
-    rows = padded[0:h, :]
-    for dy in range(1, k):
-        rows = rows + padded[dy : dy + h, :]
-    counts = rows[:, 0:w]
-    for dx in range(1, k):
-        counts = counts + rows[:, dx : dx + w]
+    if neighborhood == "von_neumann":
+        counts = None
+        for dy in range(-radius, radius + 1):
+            half = radius - abs(dy)
+            row = padded[radius + dy : radius + dy + h, :]
+            for dx in range(-half, half + 1):
+                c = row[:, radius + dx : radius + dx + w]
+                counts = c if counts is None else counts + c
+    else:
+        k = 2 * radius + 1
+        rows = padded[0:h, :]
+        for dy in range(1, k):
+            rows = rows + padded[dy : dy + h, :]
+        counts = rows[:, 0:w]
+        for dx in range(1, k):
+            counts = counts + rows[:, dx : dx + w]
     if not include_center:
         counts = counts - alive
     return counts
@@ -121,7 +138,9 @@ def make_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
     """One full-array CA step ``int8[h, w] -> int8[h, w]``."""
 
     def step(board: jax.Array) -> jax.Array:
-        counts = neighbor_counts(board, rule.radius, rule.include_center)
+        counts = neighbor_counts(
+            board, rule.radius, rule.include_center, rule.neighborhood
+        )
         return apply_rule(board, counts, rule)
 
     return step
