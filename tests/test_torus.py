@@ -1,0 +1,132 @@
+"""Torus (periodic) boundary — the Golly ``:T`` bounded-grid suffix.
+
+The reference's world is clamped: indices outside the board are dead
+(Parallel_Life_MPI.cpp:21-27).  ``rule:T`` glues the edges into a
+board-sized torus instead.  Executors whose layouts assume the clamped
+contract (bitpack, Pallas kernels, the sharded/stripes halo machinery,
+native C) must refuse loudly; the ones that support it must match the
+NumPy oracle bit-for-bit — including on odd, non-lane-aligned widths,
+which is where silent padding would corrupt the wraparound.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.models.rules import get_rule
+from tpu_life.models import patterns
+from tpu_life.ops.reference import run_np
+
+
+def test_parse_torus_suffix():
+    rule = get_rule("conway:T")
+    assert rule.boundary == "torus"
+    assert rule.name == "B3/S23:T"
+    # parsing the suffixed form must not mutate the shared registry rule
+    assert get_rule("conway").boundary == "clamped"
+    assert get_rule("R2,C2,S2..4,B2..3,NN:T").boundary == "torus"
+
+
+def test_parse_rejects_bounded_grid_dimensions():
+    with pytest.raises(ValueError, match="board-sized"):
+        get_rule("B3/S23:T100,200")
+
+
+def test_glider_circumnavigates_the_torus():
+    # a glider moves (+1,+1) every 4 steps; on a 16x16 torus, 64 steps wrap
+    # it exactly back onto itself — the classic periodic-topology anchor
+    rule = get_rule("conway:T")
+    b = patterns.place(patterns.empty(16, 16), patterns.GLIDER, 6, 6)
+    assert np.array_equal(run_np(b, rule, 64), b)
+    assert not np.array_equal(run_np(b, rule, 32), b)
+    # on the clamped board the same glider dies against the wall instead
+    clamped = run_np(b, get_rule("conway"), 64)
+    assert not np.array_equal(clamped, b)
+
+
+def test_blinker_across_the_seam():
+    # a blinker spanning the vertical seam only works if columns w-1 and 0
+    # are true neighbors; hand-checkable period 2
+    rule = get_rule("conway:T")
+    b = np.zeros((8, 16), np.int8)
+    b[3, 15] = b[3, 0] = b[3, 1] = 1
+    one = run_np(b, rule, 1)
+    expect = np.zeros((8, 16), np.int8)
+    expect[2, 0] = expect[3, 0] = expect[4, 0] = 1
+    np.testing.assert_array_equal(one, expect)
+    np.testing.assert_array_equal(run_np(b, rule, 2), b)
+
+
+def test_radius_exceeding_board_wraps_multiply():
+    # r=2 on a 3-wide torus: offsets alias through multiple wraps; the
+    # wrap-padded slicing must count each OFFSET once (matching rolls)
+    from tpu_life.ops.reference import neighbor_counts_np
+
+    b = np.zeros((3, 3), np.int8)
+    b[1, 1] = 1
+    c = neighbor_counts_np(b, radius=2, neighborhood="moore", boundary="torus")
+    # every one of the 24 non-center offsets lands on SOME cell of the 3x3
+    # torus; the center cell also receives hits from offsets aliasing to 0
+    expect = np.zeros((3, 3), np.int32)
+    for dy in range(-2, 3):
+        for dx in range(-2, 3):
+            if (dy, dx) != (0, 0):
+                expect[(1 + dy) % 3, (1 + dx) % 3] += 1
+    np.testing.assert_array_equal(c, expect)
+
+
+@pytest.mark.parametrize("spec", ["conway:T", "R2,C2,S2..4,B2..3,NN:T",
+                                  "B2/S/C3:T"])
+def test_jax_matches_oracle_unpadded(spec, rng_board):
+    from tpu_life.backends.base import get_backend
+
+    rule = get_rule(spec)
+    states = rule.states
+    # odd width: a lane-padded board would wrap at the wrong column
+    board = rng_board(37, 41, density=0.45, states=states, seed=21)
+    expect = run_np(board, rule, 6)
+    out = get_backend("jax").run(board, rule, 6)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_pallas_backend_falls_back_and_matches(rng_board):
+    from tpu_life.backends.base import get_backend
+
+    rule = get_rule("conway:T")
+    board = rng_board(33, 29, seed=22)
+    out = get_backend("pallas", interpret=True).run(board, rule, 5)
+    np.testing.assert_array_equal(out, run_np(board, rule, 5))
+
+
+def test_clamped_executors_refuse_loudly(rng_board):
+    import jax
+
+    from tpu_life.backends.base import get_backend
+    from tpu_life.ops import bitlife
+
+    rule = get_rule("conway:T")
+    board = rng_board(24, 24, seed=23)
+    assert not bitlife.supports(rule)
+    if len(jax.devices()) >= 2:
+        with pytest.raises(ValueError, match="torus.*sharded"):
+            get_backend("sharded", num_devices=2).run(board, rule, 1)
+    with pytest.raises(ValueError, match="torus.*stripes"):
+        get_backend("stripes").run(board, rule, 1)
+    from tpu_life.ops import native_step
+
+    if native_step.build():
+        with pytest.raises(ValueError, match="clamped Moore"):
+            native_step.run_native(board, rule, 1)
+
+
+def test_cli_torus_run(tmp_path, monkeypatch):
+    from tpu_life import cli
+    from tpu_life.io.codec import read_board
+
+    monkeypatch.chdir(tmp_path)
+    assert cli.main(
+        ["pattern", "import", "--name", "glider",
+         "--height", "16", "--width", "16", "--at", "6,6", "--steps", "64"]
+    ) == 0
+    board = read_board("data.txt", 16, 16)
+    assert cli.main(["run", "--backend", "jax", "--rule", "conway:T"]) == 0
+    np.testing.assert_array_equal(read_board("output.txt", 16, 16), board)

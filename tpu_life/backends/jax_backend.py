@@ -101,7 +101,11 @@ class JaxBackend:
         logical = (h, w)
         if self.bitpack and bitlife.supports(rule):
             return packed_device_runner(board, rule, self.device)
-        w_pad = ceil_to(w, LANE) if self.pad_lanes else w
+        # torus boards must stay at exact shape: padding would sit between
+        # the logical edges the torus glues together (lane alignment is a
+        # perf preference; correctness wins)
+        pad = self.pad_lanes and rule.boundary == "clamped"
+        w_pad = ceil_to(w, LANE) if pad else w
         x = jax.device_put(pad_board(board, h, w_pad), self.device)
         advance = lambda x, n: multi_step(
             x, rule=rule, steps=n, logical_shape=logical

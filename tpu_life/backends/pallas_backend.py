@@ -924,11 +924,13 @@ class PallasBackend:
         self, board: np.ndarray, rule: Rule, logical: tuple[int, int]
     ) -> Runner:
         """Fused-XLA-scan DeviceRunner — the single fallback for every case
-        no Pallas kernel covers (small boards, non-Moore neighborhoods)."""
+        no Pallas kernel covers (small boards, non-Moore neighborhoods,
+        torus topology)."""
         h, w = logical
         if self.bitpack and bitlife.supports(rule):
             return packed_device_runner(board, rule, self.device)
-        wp = ceil_to(w, LANE)
+        # torus boards stay unpadded (the rolls wrap at the logical edges)
+        wp = ceil_to(w, LANE) if rule.boundary == "clamped" else w
         x = jax.device_put(pad_board(board, h, wp), self.device)
         advance = lambda x, n: multi_step(
             x, rule=rule, steps=n, logical_shape=logical
@@ -943,9 +945,10 @@ class PallasBackend:
     def prepare(self, board: np.ndarray, rule: Rule) -> Runner:
         h, w = board.shape
         logical = (h, w)
-        if rule.neighborhood != "moore":
-            # both Pallas kernels count via box sums; von Neumann diamonds
-            # run on the fused XLA scan (whose stencil supports them)
+        if rule.neighborhood != "moore" or rule.boundary != "clamped":
+            # both Pallas kernels count clamped box sums; von Neumann
+            # diamonds and torus wraparound run on the fused XLA scan
+            # (whose stencil supports them)
             return self._xla_scan_runner(board, rule, logical)
         if self.bitpack and bitlife.supports(rule):
             tiling = self._packed_tiling(h, w)

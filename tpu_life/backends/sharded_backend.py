@@ -330,6 +330,14 @@ class ShardedBackend:
         return None
 
     def _prepare_impl(self, load_rows, h: int, w: int, rule: Rule):
+        if rule.boundary == "torus":
+            # the halo machinery is clamped (zero halos at the global edges
+            # ARE the dead boundary); a torus needs ring-wraparound ppermute
+            # and unpadded shards — refuse rather than silently clamp
+            raise ValueError(
+                "torus boundary is not supported on the sharded backend "
+                "yet; use --backend jax/pallas/numpy"
+            )
         logical = (h, w)
         use_bits = self._use_bits(rule)
         kernel_mode = self._resolve_local_kernel(use_bits)

@@ -67,6 +67,11 @@ class StripesBackend:
         callback: ChunkCallback | None = None,
     ) -> np.ndarray:
         board = np.asarray(board, np.int8)
+        if rule.boundary == "torus":
+            raise ValueError(
+                "torus boundary is not supported on the stripes backend; "
+                "use --backend numpy/jax"
+            )
         h, _ = board.shape
         ranks = min(self.num_ranks, max(1, h // max(1, rule.radius)))
         bounds = stripe_bounds(h, ranks)
@@ -134,6 +139,11 @@ class MpiBackend:
         comm = self.comm
         rank, size = comm.Get_rank(), comm.Get_size()
         board = np.asarray(board, np.int8)
+        if rule.boundary == "torus":
+            raise ValueError(
+                "torus boundary is not supported on the mpi backend; "
+                "use --backend numpy/jax"
+            )
         h, w = board.shape
         bounds = stripe_bounds(h, size)
         a, b = bounds[rank]

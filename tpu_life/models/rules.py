@@ -15,8 +15,9 @@ tables that XLA fuses into the stencil.  One engine covers:
   picks its shape: ``NM`` (default) = the ``(2r+1)^2`` Moore box, ``NN`` =
   the ``|dx|+|dy| <= r`` von Neumann diamond.
 
-Semantics (synchronous update, clamped dead boundary — the reference's
-non-periodic edges, Parallel_Life_MPI.cpp:21-27):
+Semantics (synchronous update; boundary per ``Rule.boundary`` — "clamped"
+dead edges, the reference's non-periodic world (Parallel_Life_MPI.cpp:21-27),
+or a board-sized "torus" via the Golly ``:T`` suffix):
 
 - ``count`` = number of *alive* (state == 1) cells in the rule's
   neighborhood (Moore box or von Neumann diamond per ``neighborhood``;
@@ -28,6 +29,7 @@ non-periodic edges, Parallel_Life_MPI.cpp:21-27):
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from dataclasses import dataclass, field
 from functools import cached_property
@@ -47,6 +49,10 @@ class Rule:
     # scan at r=1, Parallel_Life_MPI.cpp:19-31), "von_neumann" = the
     # |dx|+|dy| <= r diamond
     neighborhood: str = "moore"
+    # world topology: "clamped" = the reference's dead non-periodic edges
+    # (Parallel_Life_MPI.cpp:21-27); "torus" = periodic wraparound (the
+    # Golly ":T" bounded-grid suffix, board-sized)
+    boundary: str = "clamped"
 
     def __post_init__(self):
         if self.radius < 1:
@@ -58,6 +64,10 @@ class Rule:
             raise ValueError(
                 f"neighborhood must be 'moore' or 'von_neumann', "
                 f"got {self.neighborhood!r}"
+            )
+        if self.boundary not in ("clamped", "torus"):
+            raise ValueError(
+                f"boundary must be 'clamped' or 'torus', got {self.boundary!r}"
             )
         mc = self.max_count
         for s in self.birth | self.survive:
@@ -135,8 +145,22 @@ def parse_rule(spec: str) -> Rule:
     - Larger-than-Life (Golly-style): ``R5,C2,M0,S34..58,B34..45[,NM|NN]``
       (C = states, M = include center, N = neighborhood: NM Moore box /
       NN von Neumann diamond; C, M and N optional)
+    - any of the above + Golly's bounded-grid suffix ``:T`` for a
+      board-sized torus (periodic wraparound): ``conway:T``, ``B3/S23:T``
     """
     spec = spec.strip()
+    m_t = re.search(r":\s*[tT](.*)$", spec)
+    if m_t is not None:
+        dims = m_t.group(1).strip()
+        if dims:
+            raise ValueError(
+                f"bounded-grid dimensions {dims!r} are unsupported: the "
+                f"torus is board-sized (use plain ':T')"
+            )
+        base = parse_rule(spec[: m_t.start()])
+        return dataclasses.replace(
+            base, name=f"{base.name}:T", boundary="torus"
+        )
     key = spec.lower().replace("-", "_").replace(" ", "_")
     if key in RULE_REGISTRY:
         return RULE_REGISTRY[key]

@@ -51,6 +51,7 @@ def supports(rule: Rule) -> bool:
         and rule.radius == 1
         and not rule.include_center
         and rule.neighborhood == "moore"
+        and rule.boundary == "clamped"
     )
 
 

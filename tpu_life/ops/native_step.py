@@ -55,12 +55,12 @@ def run_native(
     """
     if _lib is None:
         raise RuntimeError("native step library not loaded (make -C native)")
-    if rule.neighborhood != "moore":
-        # the C stepper's sliding-window box sum is Moore-only; erroring
-        # beats silently counting the wrong neighborhood
+    if rule.neighborhood != "moore" or rule.boundary != "clamped":
+        # the C stepper's sliding-window box sum is Moore-only and clamped;
+        # erroring beats silently computing the wrong semantics
         raise ValueError(
-            "native backend supports Moore neighborhoods only; use "
-            "--backend numpy/jax/sharded for von Neumann rules"
+            "native backend supports clamped Moore neighborhoods only; use "
+            "--backend numpy/jax for von Neumann or torus rules"
         )
     out = np.array(board, dtype=np.int8, order="C")  # exactly one fresh copy
     h, w = out.shape

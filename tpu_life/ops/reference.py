@@ -19,20 +19,25 @@ def neighbor_counts_np(
     radius: int = 1,
     include_center: bool = False,
     neighborhood: str = "moore",
+    boundary: str = "clamped",
 ) -> np.ndarray:
-    """Live-neighbor counts with a clamped dead boundary.
+    """Live-neighbor counts; dead outside the board (clamped) or periodic
+    wraparound (torus).
 
-    Moore = the (2r+1)^2 box, computed separably: one pass of (2r+1) row
-    shifts, one of (2r+1) column shifts — O(r) work per cell instead of the
-    reference's O(r^2) inner scan (Parallel_Life_MPI.cpp:19-31).
-    Von Neumann = the |dx|+|dy| <= r diamond; not separable, so the truth
-    executor sums the O(r^2) shifted slices directly (clarity over speed —
-    this is the oracle, not the fast path).
+    The boundary is entirely a *padding mode* — zeros for clamped, wrap for
+    torus — feeding one shared counting body.  Moore = the (2r+1)^2 box,
+    computed separably: one pass of (2r+1) row shifts, one of (2r+1) column
+    shifts — O(r) work per cell instead of the reference's O(r^2) inner
+    scan (Parallel_Life_MPI.cpp:19-31).  Von Neumann = the |dx|+|dy| <= r
+    diamond; not separable, so the O(r^2) shifted slices are summed
+    directly.
     """
     h, w = board.shape
     alive = (board == 1).astype(np.int32)
-    padded = np.zeros((h + 2 * radius, w + 2 * radius), dtype=np.int32)
-    padded[radius : radius + h, radius : radius + w] = alive
+    if boundary == "torus":
+        padded = np.pad(alive, radius, mode="wrap")
+    else:
+        padded = np.pad(alive, radius)
     counts = np.zeros((h, w), dtype=np.int32)
     if neighborhood == "von_neumann":
         for dy in range(-radius, radius + 1):
@@ -56,7 +61,7 @@ def neighbor_counts_np(
 def step_np(board: np.ndarray, rule: Rule) -> np.ndarray:
     """One synchronous CA step via the rule's full transition LUT."""
     counts = neighbor_counts_np(
-        board, rule.radius, rule.include_center, rule.neighborhood
+        board, rule.radius, rule.include_center, rule.neighborhood, rule.boundary
     )
     return rule.transition_table[board.astype(np.int64), counts]
 

@@ -35,16 +35,21 @@ def neighbor_counts(
     radius: int = 1,
     include_center: bool = False,
     neighborhood: str = "moore",
+    boundary: str = "clamped",
 ) -> jax.Array:
-    """int32 live-neighbor counts; clamped (dead) outside the array.
+    """int32 live-neighbor counts; dead outside the array (clamped) or
+    periodic (torus).
 
-    Moore runs as two separable shift passes; the von Neumann diamond is
-    not separable, so it unrolls the O(r^2) shifted-slice adds — still a
-    static Python loop over XLA slices, fully fused under jit.
+    The boundary is just the padding mode — zeros vs wrap — feeding the
+    same counting body: Moore as two separable shift passes, the von
+    Neumann diamond as unrolled O(r^2) shifted-slice adds; static Python
+    loops over XLA slices, fully fused under jit.  Torus counting assumes
+    the array IS the logical board (no physical padding); callers keep
+    torus boards unpadded.
     """
     h, w = board.shape
     alive = (board == 1).astype(jnp.int32)
-    padded = jnp.pad(alive, radius)
+    padded = jnp.pad(alive, radius, mode="wrap" if boundary == "torus" else "constant")
     if neighborhood == "von_neumann":
         counts = None
         for dy in range(-radius, radius + 1):
@@ -139,7 +144,11 @@ def make_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
 
     def step(board: jax.Array) -> jax.Array:
         counts = neighbor_counts(
-            board, rule.radius, rule.include_center, rule.neighborhood
+            board,
+            rule.radius,
+            rule.include_center,
+            rule.neighborhood,
+            rule.boundary,
         )
         return apply_rule(board, counts, rule)
 
@@ -150,6 +159,13 @@ def make_masked_step(
     rule: Rule, logical_shape: tuple[int, int]
 ) -> Callable[[jax.Array], jax.Array]:
     """A step that also pins physical padding cells dead (see validity_mask)."""
+    if rule.boundary == "torus":
+        # padding/masking would sit between the logical edges the torus
+        # glues together; torus boards must run unpadded (exact shape)
+        raise ValueError(
+            "torus boundary cannot run on padded/masked boards; keep the "
+            "board at its exact logical shape"
+        )
     step = make_step(rule)
 
     def masked(
