@@ -118,6 +118,26 @@ def test_clamped_executors_refuse_loudly(rng_board):
             native_step.run_native(board, rule, 1)
 
 
+def test_auto_backend_avoids_sharded_for_torus(rng_board):
+    # auto resolves to sharded on multi-device hosts — but sharded refuses
+    # torus rules, so the rule hint steers auto to a single-device backend
+    # and the default-backend docs example keeps working everywhere
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device platform")
+    rule = get_rule("conway:T")
+    be = get_backend("auto", rule=rule)
+    assert getattr(be, "name", "") != "sharded"
+    assert getattr(get_backend("auto"), "name", "") == "sharded"
+    board = rng_board(20, 20, seed=24)
+    np.testing.assert_array_equal(
+        be.run(board, rule, 4), run_np(board, rule, 4)
+    )
+
+
 def test_cli_torus_run(tmp_path, monkeypatch):
     from tpu_life import cli
     from tpu_life.io.codec import read_board

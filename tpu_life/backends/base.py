@@ -177,8 +177,13 @@ def register_backend(name: str):
     return deco
 
 
-def get_backend(name: str, **kwargs) -> Backend:
-    """Instantiate a backend by name; ``auto`` prefers accelerated paths."""
+def get_backend(name: str, *, rule: Rule | None = None, **kwargs) -> Backend:
+    """Instantiate a backend by name; ``auto`` prefers accelerated paths.
+
+    ``rule`` is an optional hint for ``auto``: features the sharded
+    backend refuses (torus topology) steer resolution to a single-device
+    backend instead of letting the default raise.
+    """
     # import for registration side effects
     from tpu_life.backends import numpy_backend, jax_backend, sharded_backend  # noqa: F401
 
@@ -186,7 +191,8 @@ def get_backend(name: str, **kwargs) -> Backend:
         import jax
 
         devices = jax.devices()
-        if len(devices) > 1:
+        torus = rule is not None and rule.boundary == "torus"
+        if len(devices) > 1 and not torus:
             name = "sharded"
         elif devices[0].platform == "tpu":
             # the Pallas deep-halo kernels are the fastest single-chip path

@@ -92,7 +92,7 @@ def run(cfg: RunConfig) -> RunResult:
     )
     if cfg.block_steps is not None:
         backend_kwargs["block_steps"] = cfg.block_steps
-    backend = get_backend(backend_name, **backend_kwargs)
+    backend = get_backend(backend_name, rule=rule, **backend_kwargs)
 
     # Board source: a contract-format file (+ completed steps when resuming).
     # Streamed per-shard straight onto the mesh when supported — the 65536^2
@@ -296,7 +296,7 @@ def run(cfg: RunConfig) -> RunResult:
                     source, resume_step = pending
                     if not first_build:
                         # a failure poisoned the old backend: start fresh
-                        backend = get_backend(backend_name, **backend_kwargs)
+                        backend = get_backend(backend_name, rule=rule, **backend_kwargs)
                     first_build = False
                     state["start"] = resume_step
                     state["last_snap"] = 0
