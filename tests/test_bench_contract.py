@@ -109,5 +109,5 @@ def test_graft_entry_contract():
     with contextlib.redirect_stdout(buf):
         g.dryrun_multichip(8)
     legs = [l for l in buf.getvalue().splitlines() if l.startswith("dryrun leg")]
-    assert len(legs) == 6, legs
+    assert len(legs) == 7, legs
     assert all(l.endswith(": ok") for l in legs)

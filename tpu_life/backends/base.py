@@ -180,9 +180,11 @@ def register_backend(name: str):
 def get_backend(name: str, *, rule: Rule | None = None, **kwargs) -> Backend:
     """Instantiate a backend by name; ``auto`` prefers accelerated paths.
 
-    ``rule`` is an optional hint for ``auto``: features the sharded
-    backend refuses (torus topology) steer resolution to a single-device
-    backend instead of letting the default raise.
+    ``rule`` is an optional hint for ``auto``: torus rules resolve to a
+    single-device backend even on multi-device hosts, because the sharded
+    torus path carries constraints (1-D mesh, height divisible by the
+    mesh) that ``auto`` cannot guarantee — auto must never raise.  Pass
+    ``--backend sharded`` explicitly to opt into the mesh torus.
     """
     # import for registration side effects
     from tpu_life.backends import numpy_backend, jax_backend, sharded_backend  # noqa: F401

@@ -329,15 +329,79 @@ class ShardedBackend:
                 return br, bc, k, sh, sw
         return None
 
+    def _blocked_runner(
+        self, x, block_steps: int, make_run, to_np, count_live, gspmd_run=None
+    ):
+        """DeviceRunner over a per-``block_steps`` cache of compiled sharded
+        runs: ``advance(n)`` = full blocks at ``block_steps`` + one
+        remainder block.  The single scaffold behind both the clamped and
+        torus prepare paths, so the blocking logic cannot drift."""
+        runs: dict[int, object] = {}
+
+        def get_run(bs: int):
+            if bs not in runs:
+                runs[bs] = make_run(bs)
+            return runs[bs]
+
+        def advance(x, n_steps: int):
+            if gspmd_run is not None:
+                return gspmd_run(x, steps=n_steps)
+            num_blocks, rem = divmod(n_steps, block_steps)
+            if num_blocks:
+                x = get_run(block_steps)(x, num_blocks)
+            if rem:
+                x = get_run(rem)(x, 1)
+            return x
+
+        from tpu_life.backends.jax_backend import DeviceRunner
+
+        return DeviceRunner(x, advance, to_np, count_live=count_live)
+
+    def _prepare_torus(self, load_rows, h: int, w: int, rule: Rule):
+        """Torus sharding: periodic ppermute ring + column-wrap substeps
+        (`make_sharded_run_torus`).  The board must be EXACT — padding
+        anywhere would sit inside the glued seam — hence the constraints;
+        violations raise with the precise reason instead of silently
+        clamping."""
+        if self.n_cols > 1:
+            raise ValueError(
+                "torus boundary needs a 1-D (rows) mesh; got a 2-D mesh"
+            )
+        if self.partition_mode != "shard_map":
+            raise ValueError(
+                "torus boundary needs partition_mode='shard_map'"
+            )
+        if self.local_kernel == "pallas":
+            raise ValueError(
+                "the Pallas kernels count clamped boxes; torus rules need "
+                "local_kernel='xla' (or 'auto')"
+            )
+        if h % self.n != 0:
+            raise ValueError(
+                f"torus boundary needs the board height ({h}) divisible by "
+                f"the mesh size ({self.n}) so no padding rows sit inside "
+                f"the glued seam"
+            )
+        from tpu_life.parallel.halo import make_sharded_run_torus
+
+        shard_h = h // self.n
+        block_steps = max(
+            1, min(self.block_steps, shard_h // max(1, rule.radius))
+        )
+        x = self._device_put_stream(load_rows, h, w, h, w, use_bits=False)
+        return self._blocked_runner(
+            x,
+            block_steps,
+            lambda bs: make_sharded_run_torus(
+                rule, self.mesh, (h, w), block_steps=bs
+            ),
+            lambda x: np.asarray(x),
+            bitlife.live_count_cells,
+        )
+
     def _prepare_impl(self, load_rows, h: int, w: int, rule: Rule):
         if rule.boundary == "torus":
-            # the halo machinery is clamped (zero halos at the global edges
-            # ARE the dead boundary); a torus needs ring-wraparound ppermute
-            # and unpadded shards — refuse rather than silently clamp
-            raise ValueError(
-                "torus boundary is not supported on the sharded backend "
-                "yet; use --backend jax/pallas/numpy"
-            )
+            return self._prepare_torus(load_rows, h, w, rule)
         logical = (h, w)
         use_bits = self._use_bits(rule)
         kernel_mode = self._resolve_local_kernel(use_bits)
@@ -405,69 +469,41 @@ class ShardedBackend:
                 block_steps = max(1, min(block_steps, cells_per_shard // rule.radius))
         x = self._device_put_stream(load_rows, h, w, h_pad, w_phys, use_bits)
 
-        runs: dict[int, object] = {}
-
         if pallas_tiling is not None:
             from tpu_life.backends.pallas_backend import make_sharded_pallas_run
 
             interp = self._pallas_interp()
-
-            def get_run(bs: int):
-                if bs not in runs:
-                    runs[bs] = make_sharded_pallas_run(
-                        rule,
-                        self.mesh,
-                        logical,
-                        block_steps=bs,
-                        block_rows=pallas_block_rows,
-                        interpret=interp,
-                    )
-                return runs[bs]
-
+            make_run = lambda bs: make_sharded_pallas_run(
+                rule,
+                self.mesh,
+                logical,
+                block_steps=bs,
+                block_rows=pallas_block_rows,
+                interpret=interp,
+            )
         elif int8_tiling is not None:
             from tpu_life.backends.pallas_backend import make_sharded_pallas_int8_run
 
             interp = self._pallas_interp()
-
-            def get_run(bs: int):
-                if bs not in runs:
-                    runs[bs] = make_sharded_pallas_int8_run(
-                        rule,
-                        self.mesh,
-                        logical,
-                        block_steps=bs,
-                        block_rows=i8_br,
-                        block_cols=i8_bc,
-                        interpret=interp,
-                    )
-                return runs[bs]
-
+            make_run = lambda bs: make_sharded_pallas_int8_run(
+                rule,
+                self.mesh,
+                logical,
+                block_steps=bs,
+                block_rows=i8_br,
+                block_cols=i8_bc,
+                interpret=interp,
+            )
         else:
-
-            def get_run(bs: int):
-                if bs not in runs:
-                    runs[bs] = make_sharded_run(
-                        rule, self.mesh, logical, block_steps=bs, packed=use_bits
-                    )
-                return runs[bs]
+            make_run = lambda bs: make_sharded_run(
+                rule, self.mesh, logical, block_steps=bs, packed=use_bits
+            )
 
         gspmd_run = (
             self._gspmd_run(rule, logical, use_bits)
             if self.partition_mode == "gspmd"
             else None
         )
-
-        def advance(x, n_steps: int):
-            if gspmd_run is not None:
-                return gspmd_run(x, steps=n_steps)
-            num_blocks, rem = divmod(n_steps, block_steps)
-            if num_blocks:
-                x = get_run(block_steps)(x, num_blocks)
-            if rem:
-                x = get_run(rem)(x, 1)
-            return x
-
-        from tpu_life.backends.jax_backend import DeviceRunner
 
         # live-cell metric as a sharded on-device reduction: each device
         # popcounts its own shard, XLA inserts the psum, two scalars reach
@@ -477,7 +513,9 @@ class ShardedBackend:
         count_live = (
             bitlife.live_count_packed if use_bits else bitlife.live_count_cells
         )
-        return DeviceRunner(x, advance, to_np, count_live=count_live)
+        return self._blocked_runner(
+            x, block_steps, make_run, to_np, count_live, gspmd_run
+        )
 
     def run(
         self,

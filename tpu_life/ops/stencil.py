@@ -47,9 +47,32 @@ def neighbor_counts(
     the array IS the logical board (no physical padding); callers keep
     torus boards unpadded.
     """
-    h, w = board.shape
     alive = (board == 1).astype(jnp.int32)
-    padded = jnp.pad(alive, radius, mode="wrap" if boundary == "torus" else "constant")
+    wrap = boundary == "torus"
+    return _counts(alive, radius, include_center, neighborhood, wrap, wrap)
+
+
+def _counts(
+    alive: jax.Array,
+    radius: int,
+    include_center: bool,
+    neighborhood: str,
+    row_wrap: bool,
+    col_wrap: bool,
+) -> jax.Array:
+    """The shared counting body, with the boundary expressed per axis as a
+    padding mode.  The mixed case (rows clamped, columns wrapped) is the
+    sharded torus's per-shard substep: row neighbors arrive as real halo
+    rows stacked by the exchange, column neighbors wrap in place."""
+    h, w = alive.shape
+    padded = jnp.pad(
+        alive, ((radius, radius), (0, 0)),
+        mode="wrap" if row_wrap else "constant",
+    )
+    padded = jnp.pad(
+        padded, ((0, 0), (radius, radius)),
+        mode="wrap" if col_wrap else "constant",
+    )
     if neighborhood == "von_neumann":
         counts = None
         for dy in range(-radius, radius + 1):
@@ -69,6 +92,28 @@ def neighbor_counts(
     if not include_center:
         counts = counts - alive
     return counts
+
+
+def make_wrap_cols_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
+    """Per-shard substep for the SHARDED torus: columns wrap in place
+    (each 1-D-mesh shard holds full board rows, so the east-west seam is
+    local), while rows see zero padding — the real north-south neighbors
+    arrive as halo rows stacked around the shard by the periodic exchange,
+    and the fringe the zero rows corrupt is discarded per block."""
+
+    def step(board: jax.Array) -> jax.Array:
+        alive = (board == 1).astype(jnp.int32)
+        counts = _counts(
+            alive,
+            rule.radius,
+            rule.include_center,
+            rule.neighborhood,
+            row_wrap=False,
+            col_wrap=True,
+        )
+        return apply_rule(board, counts, rule)
+
+    return step
 
 
 def _membership(counts: jax.Array, values: frozenset) -> jax.Array:

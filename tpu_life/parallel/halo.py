@@ -40,7 +40,7 @@ except ImportError:  # pragma: no cover
 
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
-from tpu_life.ops.stencil import make_masked_step
+from tpu_life.ops.stencil import make_masked_step, make_wrap_cols_step
 from tpu_life.parallel.mesh import COL_AXIS, ROW_AXIS
 
 
@@ -77,6 +77,80 @@ def make_sharded_run(
         block_steps=block_steps,
         packed=packed,
     )
+
+
+def make_sharded_run_torus(
+    rule: Rule,
+    mesh: Mesh,
+    logical_shape: tuple[int, int],
+    *,
+    row_axis: str = ROW_AXIS,
+    block_steps: int = 1,
+) -> Callable[[jax.Array, int], jax.Array]:
+    """Torus variant of the 1-D stripe run: the ``ppermute`` ring is
+    CLOSED — the wrap pair the clamped exchange deliberately omits delivers
+    the last shard's bottom rows as the first shard's top halo and vice
+    versa — and the per-shard substep wraps columns in place
+    (``make_wrap_cols_step``).  The reference's MPI analogue would be
+    ``MPI_Cart_create`` with ``periods=1``, the option its rank±1 topology
+    never takes (Parallel_Life_MPI.cpp:105-107,121-123).
+
+    The board must be EXACT: callers guarantee no padding anywhere (padding
+    would sit inside the glued seam), so — unlike the clamped run — there
+    is no validity masking on this path at all.
+    """
+    n_r = mesh.shape[row_axis]
+    pad = halo_depth(rule, block_steps)
+    step = make_wrap_cols_step(rule)
+    fwd = [(i, (i + 1) % n_r) for i in range(n_r)]
+    bwd = [((i + 1) % n_r, i) for i in range(n_r)]
+
+    def local_block(chunk: jax.Array) -> jax.Array:
+        hl, _ = chunk.shape
+        if n_r > 1:
+            top = lax.ppermute(chunk[hl - pad :, :], row_axis, fwd)
+            bot = lax.ppermute(chunk[:pad, :], row_axis, bwd)
+        else:
+            # one shard: its own edges ARE the wrap neighbors
+            top = chunk[hl - pad :, :]
+            bot = chunk[:pad, :]
+        ext = jnp.concatenate([top, chunk, bot], axis=0)
+        for _ in range(block_steps):
+            ext = step(ext)
+        return ext[pad : pad + hl, :]
+
+    def local_run(chunk: jax.Array, num_blocks: int) -> jax.Array:
+        if chunk.shape[0] < pad:
+            raise ValueError(
+                f"shard height {chunk.shape[0]} smaller than halo depth "
+                f"{pad}; lower block_steps or use a smaller mesh"
+            )
+        out, _ = lax.scan(
+            lambda c, _: (local_block(c), None), chunk, None, length=num_blocks
+        )
+        return out
+
+    spec = P(row_axis, None)
+
+    @partial(jax.jit, static_argnames="num_blocks", donate_argnums=0)
+    def run(board: jax.Array, num_blocks: int) -> jax.Array:
+        if board.shape != tuple(logical_shape):
+            # exactness IS the correctness contract here: any padding
+            # would sit inside the glued seam (trace-time check — shapes
+            # are static under jit)
+            raise ValueError(
+                f"torus board shape {board.shape} != logical "
+                f"{tuple(logical_shape)}; the torus run takes the exact "
+                f"unpadded board"
+            )
+        return shard_map(
+            partial(local_run, num_blocks=num_blocks),
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
+        )(board)
+
+    return run
 
 
 def make_sharded_run_2d(
