@@ -26,15 +26,27 @@ import numpy as np
 from tpu_life.backends.base import ChunkCallback, chunk_sizes, register_backend
 from tpu_life.io.sharded import stripe_bounds
 from tpu_life.models.rules import Rule
-from tpu_life.ops.reference import step_np
+from tpu_life.ops.reference import step_np, step_np_wrap_cols
 
 
-def _exchange_halos(stripes: list[np.ndarray], r: int) -> list[np.ndarray]:
-    """Return stripes extended with up-to-r halo rows from their neighbors."""
+def _exchange_halos(
+    stripes: list[np.ndarray], r: int, torus: bool
+) -> list[np.ndarray]:
+    """Return stripes extended with up-to-r halo rows from their neighbors.
+
+    Clamped: the first/last stripes get no top/bottom halo (the dead
+    boundary).  Torus: every stripe gets both halos — the ring closes via
+    the (i±1) mod n neighbors (the MPI_Cart periods=1 the reference's
+    rank±1 topology never takes)."""
+    n = len(stripes)
     out = []
     for i, s in enumerate(stripes):
-        top = stripes[i - 1][-r:] if i > 0 else np.zeros((0, s.shape[1]), s.dtype)
-        bot = stripes[i + 1][:r] if i < len(stripes) - 1 else np.zeros((0, s.shape[1]), s.dtype)
+        if torus:
+            top = stripes[(i - 1) % n][-r:]
+            bot = stripes[(i + 1) % n][:r]
+        else:
+            top = stripes[i - 1][-r:] if i > 0 else np.zeros((0, s.shape[1]), s.dtype)
+            bot = stripes[i + 1][:r] if i < n - 1 else np.zeros((0, s.shape[1]), s.dtype)
         out.append(np.vstack([top, s, bot]))
     return out
 
@@ -42,10 +54,12 @@ def _exchange_halos(stripes: list[np.ndarray], r: int) -> list[np.ndarray]:
 def _update_stripe(ext: np.ndarray, rule: Rule, n_top: int, n_bot: int) -> np.ndarray:
     """One CA step on an extended stripe; returns the interior rows.
 
-    Interior edges see true neighbor rows (the halos); global edges see the
-    clamped dead boundary exactly like the unsharded step.
+    Interior edges see true neighbor rows (the halos); global edges see
+    the clamped dead boundary — or, for torus rules, wrap halos on the row
+    axis and in-place column wrap (``step_np_wrap_cols``).
     """
-    nxt = step_np(ext, rule)
+    step = step_np_wrap_cols if rule.boundary == "torus" else step_np
+    nxt = step(ext, rule)
     stop = nxt.shape[0] - n_bot if n_bot else nxt.shape[0]
     return nxt[n_top:stop]
 
@@ -67,26 +81,22 @@ class StripesBackend:
         callback: ChunkCallback | None = None,
     ) -> np.ndarray:
         board = np.asarray(board, np.int8)
-        if rule.boundary == "torus":
-            raise ValueError(
-                "torus boundary is not supported on the stripes backend; "
-                "use --backend numpy/jax"
-            )
         h, _ = board.shape
         ranks = min(self.num_ranks, max(1, h // max(1, rule.radius)))
         bounds = stripe_bounds(h, ranks)
         stripes = [board[a:b].copy() for a, b in bounds]
         r = rule.radius
+        torus = rule.boundary == "torus"
         done = 0
         for n in chunk_sizes(steps, chunk_steps):
             for _ in range(n):
-                exts = _exchange_halos(stripes, r)
+                exts = _exchange_halos(stripes, r, torus)
                 stripes = [
                     _update_stripe(
                         ext,
                         rule,
-                        n_top=r if i > 0 else 0,
-                        n_bot=r if i < ranks - 1 else 0,
+                        n_top=r if (torus or i > 0) else 0,
+                        n_bot=r if (torus or i < ranks - 1) else 0,
                     )
                     for i, ext in enumerate(exts)
                 ]
@@ -139,41 +149,85 @@ class MpiBackend:
         comm = self.comm
         rank, size = comm.Get_rank(), comm.Get_size()
         board = np.asarray(board, np.int8)
-        if rule.boundary == "torus":
-            raise ValueError(
-                "torus boundary is not supported on the mpi backend; "
-                "use --backend numpy/jax"
-            )
+        torus = rule.boundary == "torus"
         h, w = board.shape
         bounds = stripe_bounds(h, size)
+        if min(b - a for a, b in bounds) < rule.radius:
+            # a stripe shorter than the radius makes the single-hop halo
+            # exchange insufficient (true neighbors live two ranks away) —
+            # refuse rather than silently diverge.  StripesBackend clamps
+            # its rank count for the same reason; a fixed MPI world cannot.
+            raise ValueError(
+                f"board height {h} over {size} ranks gives a stripe "
+                f"shorter than the rule radius {rule.radius}; use fewer "
+                f"ranks"
+            )
         a, b = bounds[rank]
         stripe = np.ascontiguousarray(board[a:b])
         r = rule.radius
         done = 0
         for n in chunk_sizes(steps, chunk_steps):
             for _ in range(n):
-                step_i = done
                 top = np.zeros((r, w), np.int8)
                 bot = np.zeros((r, w), np.int8)
-                # paired exchanges; Sendrecv is deadlock-free by construction
-                if rank > 0:
+                # paired exchanges; Sendrecv is deadlock-free by construction.
+                # Torus closes the ring with (rank±1) mod size neighbors —
+                # MPI_Cart periods=1, the option the reference's rank±1
+                # topology never takes (Parallel_Life_MPI.cpp:105-123)
+                if torus and size == 1:
+                    top, bot = stripe[-r:].copy(), stripe[:r].copy()
+                elif torus:
+                    # two cyclic SHIFTS (the MPI_Cart_shift pattern): each
+                    # call pairs its send with the recv satisfied by the
+                    # SAME call on the neighbor, so the ring cannot deadlock
+                    # — pairing send-up with recv-from-up instead would
+                    # leave every rank waiting on a message its peer only
+                    # posts in the next phase.  Constant phase tags (0/1)
+                    # keep size == 2 (both phases talk to the same peer)
+                    # unambiguous; MPI's in-order matching per (source,
+                    # tag) handles successive steps, and per-step tags
+                    # would overflow MPI_TAG_UB on long runs.
+                    up, down = (rank - 1) % size, (rank + 1) % size
+                    tag_up, tag_down = 0, 1
+                    # shift up: my top rows become up's bottom halo; my
+                    # bottom halo arrives from down (its top rows)
                     comm.Sendrecv(
-                        np.ascontiguousarray(stripe[:r]), dest=rank - 1,
-                        sendtag=step_i, recvbuf=top, source=rank - 1,
-                        recvtag=step_i,
+                        np.ascontiguousarray(stripe[:r]),
+                        dest=up, sendtag=tag_up,
+                        recvbuf=bot, source=down, recvtag=tag_up,
                     )
-                if rank < size - 1:
+                    # shift down: my bottom rows become down's top halo; my
+                    # top halo arrives from up (its bottom rows)
                     comm.Sendrecv(
-                        np.ascontiguousarray(stripe[-r:]), dest=rank + 1,
-                        sendtag=step_i, recvbuf=bot, source=rank + 1,
-                        recvtag=step_i,
+                        np.ascontiguousarray(stripe[-r:]),
+                        dest=down, sendtag=tag_down,
+                        recvbuf=top, source=up, recvtag=tag_down,
                     )
-                # zero halos at the global edges *are* the clamped boundary,
-                # so updating the extended stripe and trimming r rows per
-                # side is exact for every rank
-                ext = np.vstack([top, stripe, bot]) if size > 1 else stripe
-                nxt = step_np(ext, rule)
-                stripe = nxt[r:-r] if size > 1 else nxt
+                else:
+                    if rank > 0:
+                        comm.Sendrecv(
+                            np.ascontiguousarray(stripe[:r]), dest=rank - 1,
+                            sendtag=0, recvbuf=top, source=rank - 1,
+                            recvtag=0,
+                        )
+                    if rank < size - 1:
+                        comm.Sendrecv(
+                            np.ascontiguousarray(stripe[-r:]), dest=rank + 1,
+                            sendtag=0, recvbuf=bot, source=rank + 1,
+                            recvtag=0,
+                        )
+                if torus:
+                    # every stripe carries both halos; column seam wraps in
+                    # the substep, the fringe rows are trimmed
+                    ext = np.vstack([top, stripe, bot])
+                    stripe = step_np_wrap_cols(ext, rule)[r:-r]
+                else:
+                    # zero halos at the global edges *are* the clamped
+                    # boundary, so updating the extended stripe and trimming
+                    # r rows per side is exact for every rank
+                    ext = np.vstack([top, stripe, bot]) if size > 1 else stripe
+                    nxt = step_np(ext, rule)
+                    stripe = nxt[r:-r] if size > 1 else nxt
                 done += 1
             if callback is not None:
                 # per-chunk side effects (snapshots, metrics) are rank-0

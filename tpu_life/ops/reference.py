@@ -32,12 +32,32 @@ def neighbor_counts_np(
     diamond; not separable, so the O(r^2) shifted slices are summed
     directly.
     """
-    h, w = board.shape
     alive = (board == 1).astype(np.int32)
-    if boundary == "torus":
-        padded = np.pad(alive, radius, mode="wrap")
-    else:
-        padded = np.pad(alive, radius)
+    wrap = boundary == "torus"
+    return _counts_np(alive, radius, include_center, neighborhood, wrap, wrap)
+
+
+def _counts_np(
+    alive: np.ndarray,
+    radius: int,
+    include_center: bool,
+    neighborhood: str,
+    row_wrap: bool,
+    col_wrap: bool,
+) -> np.ndarray:
+    """The shared counting body with the boundary as a per-axis pad mode —
+    the mixed case (rows clamped, columns wrapped) is the per-stripe
+    substep of the torus-decomposed backends, where row neighbors arrive
+    as real halo rows and the east-west seam wraps in place."""
+    h, w = alive.shape
+    padded = np.pad(
+        alive, ((radius, radius), (0, 0)),
+        mode="wrap" if row_wrap else "constant",
+    )
+    padded = np.pad(
+        padded, ((0, 0), (radius, radius)),
+        mode="wrap" if col_wrap else "constant",
+    )
     counts = np.zeros((h, w), dtype=np.int32)
     if neighborhood == "von_neumann":
         for dy in range(-radius, radius + 1):
@@ -56,6 +76,23 @@ def neighbor_counts_np(
     if not include_center:
         counts -= alive
     return counts
+
+
+def step_np_wrap_cols(ext: np.ndarray, rule: Rule) -> np.ndarray:
+    """One substep on a halo-extended stripe of a torus board: columns
+    wrap in place (each stripe holds full board rows), rows see zero
+    padding — the real vertical neighbors are the stacked halo rows, and
+    the corrupted fringe is trimmed by the caller.  The NumPy twin of the
+    sharded backend's ``make_wrap_cols_step``."""
+    counts = _counts_np(
+        (ext == 1).astype(np.int32),
+        rule.radius,
+        rule.include_center,
+        rule.neighborhood,
+        row_wrap=False,
+        col_wrap=True,
+    )
+    return rule.transition_table[ext.astype(np.int64), counts]
 
 
 def step_np(board: np.ndarray, rule: Rule) -> np.ndarray:
