@@ -112,3 +112,58 @@ def test_sharded_2d_agrees_on_random_rules(seed):
     np.testing.assert_array_equal(
         be.run(b, rule, steps), run_np(b, rule, steps), err_msg=f"rule={rule}"
     )
+
+
+def _random_rule_extended(rng: np.random.Generator) -> Rule:
+    """Like ``_random_rule`` but also sampling the neighborhood and
+    topology axes (von Neumann diamonds, torus wraparound)."""
+    radius = int(rng.choice([1, 1, 2]))
+    states = int(rng.choice([2, 2, 3]))
+    neighborhood = str(rng.choice(["moore", "von_neumann"]))
+    boundary = str(rng.choice(["clamped", "torus"]))
+    if neighborhood == "von_neumann":
+        mc = 2 * radius * (radius + 1)
+    else:
+        mc = (2 * radius + 1) ** 2 - 1
+    birth = frozenset(
+        int(v) for v in rng.choice(mc + 1, size=rng.integers(1, 5), replace=False)
+    )
+    survive = frozenset(
+        int(v) for v in rng.choice(mc + 1, size=rng.integers(0, 5), replace=False)
+    )
+    return Rule(
+        name=f"fuzz-{neighborhood}-{boundary}-r{radius}c{states}",
+        birth=birth,
+        survive=survive,
+        radius=radius,
+        states=states,
+        neighborhood=neighborhood,
+        boundary=boundary,
+    )
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_neighborhood_topology_axes_agree(seed):
+    """Random points of the FULL rule space — including diamonds and tori —
+    agree across every executor that supports them (numpy truth, XLA
+    stencil, stripes, and the sharded mesh incl. the periodic ring)."""
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 fake devices for the sharded torus leg")
+    rng = np.random.default_rng(5000 + seed)
+    rule = _random_rule_extended(rng)
+    # height divisible by 8 so the sharded torus constraint always holds
+    b = _random_board(rng, rule, (40, 31))
+    steps = int(rng.integers(1, 6))
+    expect = run_np(b, rule, steps)
+    got = np.asarray(multi_step(b, rule=rule, steps=steps))
+    np.testing.assert_array_equal(got, expect, err_msg=f"stencil rule={rule}")
+    out_st = get_backend("stripes", num_devices=3).run(b, rule, steps)
+    np.testing.assert_array_equal(out_st, expect, err_msg=f"stripes rule={rule}")
+    out_sh = get_backend("sharded", num_devices=8).run(b, rule, steps)
+    np.testing.assert_array_equal(
+        out_sh, expect, err_msg=f"sharded rule={rule}"
+    )

@@ -377,7 +377,9 @@ def _bench(args) -> int:
         # has no local-kernel concept), so `--backend auto` resolving to
         # sharded still honors and truthfully labels the flag
         kwargs["local_kernel"] = args.local_kernel
-    backend = get_backend(args.backend, **kwargs)
+    # the rule hint keeps `auto` infallible (e.g. torus rules resolve to a
+    # single-device backend), matching the driver's resolution
+    backend = get_backend(args.backend, rule=rule, **kwargs)
     per_chip, n_chips = measure_throughput(
         backend, board, rule, args.steps, args.base_steps, args.repeats
     )
