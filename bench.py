@@ -46,16 +46,20 @@ DEGRADED_BASE_STEPS = 10
 
 PROBE_TIMEOUT_S = 180.0  # first TPU attach can be slow; hang is minutes
 
-# a wedged chip grant usually clears in ~10 min but outages up to an hour
+# a wedged chip grant usually clears in ~10 min but multi-hour outages
 # were observed (round 4); the retry loop rides out a transient wedge
 # inside the capture window instead of instantly degrading to CPU
-# (VERDICT r2 item 1b).  The long wait applies only to HANGS (stale grant,
-# worth waiting out: 5 attempts x (180 s probe + 240 s wait) ≈ 31 min);
-# fast CRASHES (plugin raises in seconds — the BENCH_r01 mode) get a short
-# wait so a deterministically broken plugin cannot burn ~16 min of sleeps
-# before the guaranteed JSON line.
-PROBE_RETRIES = int(os.environ.get("TPU_LIFE_PROBE_RETRIES", "5"))
-PROBE_RETRY_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_WAIT_S", "240"))
+# (VERDICT r2 item 1b).  The long wait applies only to HANGS (stale
+# grant) and is deliberately SPARSE: each probe itself claims the chip at
+# interpreter start (the plugin's sitecustomize registers before user
+# code), so frequent probing can RENEW the very grant it is waiting out —
+# observed 2026-07-30, when ~7-min probe cadence kept a wedge alive for
+# hours.  4 probes of 180 s with 900 s quiet gaps between them
+# (4x180 + 3x900 = 57 min of coverage, 15-min gaps).  Fast CRASHES (plugin raises in seconds — the
+# BENCH_r01 mode) get a short wait so a deterministically broken plugin
+# cannot burn an hour of sleeps before the guaranteed JSON line.
+PROBE_RETRIES = int(os.environ.get("TPU_LIFE_PROBE_RETRIES", "4"))
+PROBE_RETRY_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_WAIT_S", "900"))
 PROBE_CRASH_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_CRASH_WAIT_S", "30"))
 
 
