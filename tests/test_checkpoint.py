@@ -7,7 +7,12 @@ from tpu_life.io.codec import write_board, write_config
 from tpu_life.models.patterns import random_board
 from tpu_life.models.rules import get_rule
 from tpu_life.ops.reference import run_np
-from tpu_life.runtime.checkpoint import latest_snapshot, load_resume, save_snapshot
+from tpu_life.runtime.checkpoint import (
+    latest_snapshot,
+    load_resume,
+    save_snapshot,
+    snapshot_intact,
+)
 from tpu_life.runtime.driver import run
 
 
@@ -229,3 +234,14 @@ def test_driver_rejects_out_of_range_states(tmp_path):
                 backend="numpy",
             )
         )
+
+
+def test_snapshot_intact_without_sidecar(tmp_path):
+    # bare contract-format boards (no sidecar) validate against the
+    # caller's geometry; missing files are simply not intact
+    p = tmp_path / "board_000000005.txt"
+    b = random_board(6, 7, seed=8)
+    write_board(p, b)
+    assert snapshot_intact(p, 6, 7)
+    assert not snapshot_intact(p, 6, 9)
+    assert not snapshot_intact(tmp_path / "missing.txt", 6, 7)
