@@ -74,6 +74,7 @@ def test_info(capsys):
     assert main(["info"]) == 0
     out = capsys.readouterr().out
     assert "tpu-life" in out and "conway" in out
+    assert "von Neumann" in out and ":T" in out  # the rule axes line
 
 
 def test_output_resume_roundtrip(workload):

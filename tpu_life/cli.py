@@ -342,6 +342,11 @@ def _info() -> int:
         "ok" if native_io.available() else "numpy fallback (make -C native)",
     )
     print("rules:", ", ".join(sorted(RULE_REGISTRY)))
+    print(
+        "rule axes: B/S + Generations /C + Larger-than-Life R,C,M,S,B specs; "
+        "neighborhoods NM (Moore) / NN (von Neumann); topology clamped "
+        "(default) / board-sized torus via the ':T' suffix"
+    )
     return 0
 
 
