@@ -22,6 +22,19 @@ stale.  So the default platform is probed in a throwaway subprocess with a
 timeout; on any failure the bench forces CPU, shrinks the workload, and
 still emits its JSON line — the capture can never again be empty.
 
+The emit guarantee survives signals too (the round-4 lesson,
+BENCH_r04.json rc=124, parsed: null): the probe retry schedule slept past
+the driver's capture window and ``timeout``'s SIGTERM killed the process
+mid-sleep with nothing on stdout.  Two defenses now hold the line:
+ * total probe time (probes + quiet gaps) is bounded by an overall
+   deadline (``TPU_LIFE_BENCH_DEADLINE_S``, default 20 min — comfortably
+   inside any sane capture window), so the retry loop can never outlast
+   the harness; and
+ * SIGTERM/SIGALRM handlers emit the degraded JSON line (with
+   ``killed``/``phase`` provenance) before dying, and a SIGALRM hard
+   deadline (``TPU_LIFE_BENCH_HARD_DEADLINE_S``, default 40 min) backstops
+   even a wedged measurement phase.
+
 Flags: --size N --steps N --rule R --backend B --block-steps K (all optional).
 """
 
@@ -48,19 +61,130 @@ PROBE_TIMEOUT_S = 180.0  # first TPU attach can be slow; hang is minutes
 
 # a wedged chip grant usually clears in ~10 min but multi-hour outages
 # were observed (round 4); the retry loop rides out a transient wedge
-# inside the capture window instead of instantly degrading to CPU
-# (VERDICT r2 item 1b).  The long wait applies only to HANGS (stale
-# grant) and is deliberately SPARSE: each probe itself claims the chip at
-# interpreter start (the plugin's sitecustomize registers before user
-# code), so frequent probing can RENEW the very grant it is waiting out —
-# observed 2026-07-30, when ~7-min probe cadence kept a wedge alive for
-# hours.  4 probes of 180 s with 900 s quiet gaps between them
-# (4x180 + 3x900 = 57 min of coverage, 15-min gaps).  Fast CRASHES (plugin raises in seconds — the
-# BENCH_r01 mode) get a short wait so a deterministically broken plugin
-# cannot burn an hour of sleeps before the guaranteed JSON line.
+# instead of instantly degrading to CPU (VERDICT r2 item 1b).  The long
+# wait applies only to HANGS (stale grant) and is deliberately SPARSE:
+# each probe itself claims the chip at interpreter start (the plugin's
+# sitecustomize registers before user code), so frequent probing can
+# RENEW the very grant it is waiting out — observed 2026-07-30, when
+# ~7-min probe cadence kept a wedge alive for hours.  The nominal
+# schedule (900 s gaps) is clamped by PROBE_DEADLINE_S below: at the
+# defaults that means roughly two 180 s probes around one ~14-min gap,
+# all inside the 20-min budget — never again the r4 57-min schedule that
+# outslept the capture window.  Fast CRASHES (plugin raises in seconds —
+# the BENCH_r01 mode) get a short wait so a deterministically broken
+# plugin cannot burn an hour of sleeps before the guaranteed JSON line.
 PROBE_RETRIES = int(os.environ.get("TPU_LIFE_PROBE_RETRIES", "4"))
 PROBE_RETRY_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_WAIT_S", "900"))
 PROBE_CRASH_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_CRASH_WAIT_S", "30"))
+
+# overall ceiling on the probe phase (probes + quiet gaps together): the r4
+# schedule's 57 min of coverage outlasted the driver's capture window and
+# the process died sleeping, JSON-less.  Sparse retries still matter (each
+# probe renews a wedged grant), but never at the cost of the emit — gaps
+# are clamped so the last probe always lands inside this budget.
+PROBE_DEADLINE_S = float(os.environ.get("TPU_LIFE_BENCH_DEADLINE_S", "1200"))
+# absolute backstop for the whole bench: SIGALRM fires, the degraded line
+# is emitted, the process exits 0.  Wide enough for a full 16384^2 TPU
+# capture (~5 min measured) after a budget-limited probe phase.
+HARD_DEADLINE_S = float(os.environ.get("TPU_LIFE_BENCH_HARD_DEADLINE_S", "2400"))
+MIN_RETRY_GAP_S = 60.0  # below this a clamped gap would just renew the wedge
+
+# what the signal-path emitters know when they must speak for a dying process
+_SIGNAL_STATE: dict = {"phase": "startup", "emitted": False}
+
+
+def _die_emitting(signame: str) -> None:
+    """Emit the degraded JSON line (once, from whichever emitter got the
+    signal first) and hard-exit 0.  Callable from any thread."""
+    import signal
+
+    lock = _SIGNAL_STATE["emit_lock"]
+    if not lock.acquire(blocking=False):
+        # another emitter (or a racing one) is mid-write; block until the
+        # process dies under us rather than truncating its line with _exit
+        lock.acquire()
+        os._exit(0)
+    try:
+        if not _SIGNAL_STATE.get("emitted"):
+            record = {
+                "metric": "cell_updates_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "cells/s/chip",
+                "vs_baseline": 0.0,
+                "platform": _SIGNAL_STATE.get("platform"),
+                "backend": _SIGNAL_STATE.get("backend"),
+                "size": _SIGNAL_STATE.get("size"),
+                "steps": _SIGNAL_STATE.get("steps"),
+                "n_chips": 0,
+                "degraded": True,
+                "killed": signame,
+                "phase": _SIGNAL_STATE.get("phase"),
+            }
+            if _SIGNAL_STATE.get("probe_failed"):
+                record["probe_failed"] = True
+            # one os.write straight to fd 1: reentrancy-safe against an
+            # in-progress main-thread print and unbuffered, so the line
+            # lands even though we _exit without interpreter teardown
+            os.write(1, (json.dumps(record) + "\n").encode())
+    finally:
+        # don't orphan a live probe child: hung in device init it would
+        # keep renewing the very chip claim the next capture waits out
+        probe_pid = _SIGNAL_STATE.get("probe_pid")
+        if probe_pid:
+            try:
+                os.killpg(probe_pid, signal.SIGKILL)
+            except OSError:
+                pass
+        os._exit(0)
+
+
+def _install_signal_emitters() -> None:
+    """SIGTERM/SIGALRM → emit the degraded JSON line, exit 0.
+
+    ``timeout`` sends SIGTERM first; r4's bench died in a probe sleep with
+    nothing on stdout (rc 124, parsed: null).  Two delivery paths share
+    one emit:
+
+     * a Python-level handler, which runs wherever the interpreter is
+       interruptible — covering every ``time.sleep`` in the retry
+       schedule, the exact place r4 died; and
+     * a watchdog thread blocked on a ``signal.set_wakeup_fd`` pipe.
+       CPython's C-level handler writes the signal number to that fd at
+       OS delivery time even when the main thread is wedged inside a
+       non-returning C call (a hung device init/execute — the very wedge
+       mode the probe subprocess exists to dodge), so the JSON line goes
+       out even from a state where no Python handler can ever run.
+
+    ``os._exit`` after the write: the process may hold poisoned device
+    state not worth unwinding through.  SIGALRM at ``HARD_DEADLINE_S``
+    backstops the whole bench through the same two paths.
+    """
+    import signal
+    import threading
+
+    _SIGNAL_STATE["emit_lock"] = threading.Lock()
+
+    def emit_and_die(signum, frame):  # noqa: ARG001
+        _die_emitting(signal.Signals(signum).name)
+
+    rfd, wfd = os.pipe()
+    os.set_blocking(wfd, False)  # a full pipe must never block the C handler
+
+    def watchdog():
+        data = os.read(rfd, 1)
+        name = "SIGNAL"
+        if data:
+            try:
+                name = signal.Signals(data[0]).name
+            except ValueError:
+                pass
+        _die_emitting(name)
+
+    threading.Thread(target=watchdog, daemon=True, name="emit-watchdog").start()
+    signal.set_wakeup_fd(wfd, warn_on_full_buffer=False)
+    signal.signal(signal.SIGTERM, emit_and_die)
+    signal.signal(signal.SIGALRM, emit_and_die)
+    signal.alarm(max(1, int(HARD_DEADLINE_S)))
 
 
 def _probe_default_platform() -> tuple[str | None, str]:
@@ -73,6 +197,15 @@ def _probe_default_platform() -> tuple[str | None, str]:
     """
     import signal
     import tempfile
+
+    forced = os.environ.get("TPU_LIFE_PROBE_FORCE")
+    if forced:
+        # drill hook (mirrors the driver's --fault-at): fake a probe outcome
+        # without touching any plugin, so the retry/deadline/signal machinery
+        # is testable on hosts where the real probe would just succeed
+        if forced in ("hang", "crash"):
+            return None, forced
+        return forced, "ok"
 
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     # output goes to a temp file and the child gets its own session: a child
@@ -88,6 +221,7 @@ def _probe_default_platform() -> tuple[str | None, str]:
             )
         except OSError:
             return None, "crash"
+        _SIGNAL_STATE["probe_pid"] = proc.pid
         try:
             rc = proc.wait(timeout=PROBE_TIMEOUT_S)
         except subprocess.TimeoutExpired:
@@ -96,6 +230,8 @@ def _probe_default_platform() -> tuple[str | None, str]:
             except OSError:
                 pass
             return None, "hang"
+        finally:
+            _SIGNAL_STATE["probe_pid"] = None
         if rc != 0:
             return None, "crash"
         out.seek(0)
@@ -106,24 +242,58 @@ def _probe_default_platform() -> tuple[str | None, str]:
 
 
 def _probe_with_retries() -> str | None:
-    """Probe the default platform, waiting out a transiently wedged grant."""
+    """Probe the default platform, waiting out a transiently wedged grant.
+
+    Total probe-phase time (probes and quiet gaps together) is bounded by
+    ``PROBE_DEADLINE_S``: a gap is clamped so the probe after it still fits
+    the budget, and when the clamped gap drops below ``MIN_RETRY_GAP_S``
+    (dense re-probing only renews the wedge) the loop gives up instead —
+    sleeping past the harness's capture window is how round 4 lost its
+    JSON line.
+    """
+    deadline = time.monotonic() + PROBE_DEADLINE_S
     for attempt in range(PROBE_RETRIES):
         platform, mode = _probe_default_platform()
         if platform is not None:
             return platform
-        if attempt + 1 < PROBE_RETRIES:
-            wait = PROBE_RETRY_WAIT_S if mode == "hang" else PROBE_CRASH_WAIT_S
-            print(
-                f"# probe attempt {attempt + 1}/{PROBE_RETRIES} failed "
-                f"({mode}); retrying in {wait:.0f}s",
-                file=sys.stderr,
-            )
-            time.sleep(wait)
+        if attempt + 1 >= PROBE_RETRIES:
+            break
+        wait = PROBE_RETRY_WAIT_S if mode == "hang" else PROBE_CRASH_WAIT_S
+        budget = deadline - time.monotonic() - PROBE_TIMEOUT_S
+        # clamp the gap so the probe after it still fits the budget; give up
+        # only when the CLAMP squeezed a gap below the useful minimum (a
+        # natively short crash-mode gap is fine — dense re-probing is only a
+        # hazard for hangs, and 30s crash retries are the BENCH_r01 promise)
+        if wait > budget:
+            if budget < min(wait, MIN_RETRY_GAP_S):
+                print(
+                    f"# probe attempt {attempt + 1}/{PROBE_RETRIES} failed "
+                    f"({mode}); retry budget exhausted "
+                    f"(deadline {PROBE_DEADLINE_S:.0f}s) — degrading now",
+                    file=sys.stderr,
+                )
+                break
+            wait = budget
+        print(
+            f"# probe attempt {attempt + 1}/{PROBE_RETRIES} failed "
+            f"({mode}); retrying in {wait:.0f}s",
+            file=sys.stderr,
+        )
+        _SIGNAL_STATE["phase"] = f"probe-wait-{attempt + 1}"
+        time.sleep(wait)
+        _SIGNAL_STATE["phase"] = f"probe-{attempt + 2}"
     return None
 
 
 def _emit(result: dict) -> None:
-    print(json.dumps(result))
+    # single os.write AFTER which the emitted flag flips: a signal landing
+    # mid-write finds emitted=False and prints its own complete line after
+    # our partial one (last-line-wins for the driver's parser); a signal
+    # after the flip exits silently.  Flag-before-print had the inverse
+    # hole: die inside print() and nothing is on stdout at all.
+    sys.stdout.flush()
+    os.write(1, (json.dumps(result) + "\n").encode())
+    _SIGNAL_STATE["emitted"] = True
 
 
 def run_bench(args, platform: str, degraded: bool) -> dict:
@@ -178,21 +348,19 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
 
     from tpu_life.backends.base import measure_throughput
 
-    def measure(name: str, kwargs: dict) -> tuple[float, int]:
-        """cells/s/chip for one backend config via the shared delta-timing
-        core (`measure_throughput`, also behind `tpu_life bench`)."""
-        backend = get_backend(name, **kwargs)
-        return measure_throughput(
-            backend, board, rule, args.steps, args.base_steps, args.repeats
-        )
-
     kwargs = {"bitpack": not args.no_bitpack}
     if args.block_steps is not None:
         kwargs["block_steps"] = args.block_steps
     if backend_name == "sharded" and args.local_kernel is not None:
         kwargs["local_kernel"] = args.local_kernel
 
-    per_chip, n_chips = measure(backend_name, kwargs)
+    # one backend instance serves both the headline leg and (on TPU) the
+    # parity leg below — rebuilding it would repeat mesh setup and the
+    # multi-minute XLA/Pallas compile inside the hard-deadline budget
+    composed_backend = get_backend(backend_name, **kwargs)
+    per_chip, n_chips = measure_throughput(
+        composed_backend, board, rule, args.steps, args.base_steps, args.repeats
+    )
     result = {
         "metric": "cell_updates_per_sec_per_chip",
         "value": per_chip,
@@ -215,19 +383,70 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
     # north-star config at n=1).  Also measure the single-device pallas
     # kernel and record the ratio: composed-per-chip should hold ~parity
     # with the single-chip kernel (halo overhead only).
+    #
+    # The two legs are INTERLEAVED (VERDICT r4 item 2): the r4 capture
+    # reported parity_ratio 1.23 — a "parity" above 1.0 means the legs ran
+    # in different throughput windows of a tunnel whose chip wobbles ±20%
+    # window to window.  Measuring each repeat as a back-to-back (composed,
+    # single) delta pair and taking the median of per-pair ratios cancels
+    # the drift the sequential layout soaked up; ``parity_window_spread``
+    # (max/min composed delta across pairs) records how much weather the
+    # pairing had to cancel.
     if (
         backend_name == "sharded"
         and platform == "tpu"
         and not args.no_parity
     ):
-        single, _ = measure("pallas", {"bitpack": not args.no_bitpack})
-        result["parity_single_chip"] = single
-        result["parity_ratio"] = per_chip / single if single > 0 else None
-        result["parity_ok"] = per_chip >= 0.8 * single
+        import statistics
+
+        from tpu_life.backends.base import make_runner
+        from tpu_life.utils.timing import paired_delta_seconds_per_step
+
+        single_backend = get_backend("pallas", bitpack=not args.no_bitpack)
+        r_comp = make_runner(composed_backend, board, rule)
+        r_single = make_runner(single_backend, board, rule)
+        pairs = paired_delta_seconds_per_step(
+            r_comp, r_single, args.steps, args.base_steps,
+            repeats=max(3, args.repeats),
+        )
+        if pairs:
+            mesh = getattr(composed_backend, "mesh", None)
+            n_chips_comp = int(mesh.devices.size) if mesh is not None else 1
+            # per-pair ratio: composed per-chip over single-chip throughput,
+            # drift-cancelled because both deltas sit in the same window
+            ratios = [
+                d_single / (d_comp * n_chips_comp) for d_comp, d_single in pairs
+            ]
+            comp_deltas = [d for d, _ in pairs]
+            result["parity_single_chip"] = (
+                args.size * args.size / min(d for _, d in pairs)
+            )
+            result["parity_ratio"] = statistics.median(ratios)
+            result["parity_pairs"] = len(pairs)
+            result["parity_window_spread"] = max(comp_deltas) / min(comp_deltas)
+            result["parity_ok"] = result["parity_ratio"] >= 0.8
+        else:
+            result["parity_ratio"] = None
+            result["parity_ok"] = False
     return result
 
 
 def main() -> None:
+    _install_signal_emitters()
+    if os.environ.get("TPU_LIFE_BENCH_TEST_WEDGE"):
+        # drill hook: simulate the main thread wedged inside a non-returning
+        # C call (device init/execute hang) — Python handlers can never run,
+        # so blocking the signals on this thread and parking forever leaves
+        # the watchdog thread's wakeup-fd path as the only way the JSON line
+        # can get out, which is exactly the property the drill asserts
+        import signal
+
+        signal.pthread_sigmask(
+            signal.SIG_BLOCK, {signal.SIGTERM, signal.SIGALRM}
+        )
+        _SIGNAL_STATE["phase"] = "wedge-drill"
+        while True:
+            time.sleep(3600)
     p = argparse.ArgumentParser()
     p.add_argument("--size", type=int, default=None)
     p.add_argument("--steps", type=int, default=None)
@@ -278,13 +497,16 @@ def main() -> None:
     platform = args.platform or os.environ.get("TPU_LIFE_PLATFORM")
     probe_failed = False
     if platform is None:
+        _SIGNAL_STATE["phase"] = "probe-1"
         platform = _probe_with_retries()
         if platform is None:
             platform = "cpu"
             probe_failed = True
+            _SIGNAL_STATE["probe_failed"] = True
             # keep any child interpreters from re-attempting the wedged
             # plugin's chip claim (it registers itself at startup)
             os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    _SIGNAL_STATE["platform"] = platform
 
     # degraded = not a full-size TPU measurement (chip absent, wedged, or
     # CPU explicitly requested): the shrunken-default CPU number must never
@@ -337,9 +559,13 @@ def main() -> None:
             record["probe_failed"] = True
         return record
 
+    _SIGNAL_STATE.update(
+        backend=args.backend, size=args.size, steps=args.steps, phase="measure"
+    )
     try:
         result = run_bench(args, platform, degraded)
     except Exception as e:  # noqa: BLE001 — the JSON line must always appear
+        _SIGNAL_STATE["phase"] = "cpu-retry"
         if platform != "cpu" and not os.environ.get("TPU_LIFE_BENCH_NO_RETRY"):
             # accelerator path blew up mid-run: re-run the whole bench in a
             # fresh interpreter pinned to CPU (in-process retry would inherit

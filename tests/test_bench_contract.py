@@ -18,19 +18,24 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def bench_proc(*args, env_extra=None, timeout=600):
-    """Run bench.py as a subprocess with the one shared isolation recipe
-    (no fake-device flags, no accelerator plugin, repo on sys.path)."""
+def bench_env(env_extra=None):
+    """THE isolation recipe for bench subprocesses (no fake-device flags,
+    no accelerator plugin, repo on sys.path) — shared by every launcher
+    here so the signal drills and the contract tests can't drift apart."""
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["PALLAS_AXON_POOL_IPS"] = ""  # never touch an accelerator plugin
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.update(env_extra or {})
+    return env
+
+
+def bench_proc(*args, env_extra=None, timeout=600):
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), *args],
         capture_output=True,
         text=True,
         timeout=timeout,
-        env=env,
+        env=bench_env(env_extra),
     )
 
 
@@ -83,6 +88,147 @@ def test_bench_rejects_bad_config_without_fallback():
     assert r.returncode == 2
     assert "unknown rule" in r.stderr
     assert not r.stdout.strip()  # no fake capture line
+
+
+def bench_popen(*args, env_extra=None, stderr_path=None):
+    """Start bench.py without waiting (for the signal-delivery drills)."""
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), *args],
+        stdout=subprocess.PIPE,
+        stderr=open(stderr_path, "w") if stderr_path else subprocess.DEVNULL,
+        text=True,
+        env=bench_env(env_extra),
+    )
+
+
+def wait_for_file_text(path, needle, timeout=60.0):
+    import time
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if os.path.exists(path) and needle in open(path).read():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"{needle!r} never appeared in {path}")
+
+
+@pytest.mark.slow
+def test_bench_sigterm_during_probe_sleep_still_emits(tmp_path):
+    """The r4 failure mode, reproduced and survived: the probe phase is
+    mid-sleep when the harness's `timeout` sends SIGTERM — the degraded
+    JSON line must still appear (BENCH_r04.json was rc=124, parsed: null)."""
+    import signal
+
+    stderr_path = str(tmp_path / "stderr.txt")
+    proc = bench_popen(
+        env_extra={
+            "TPU_LIFE_PROBE_FORCE": "hang",  # fake a wedged-grant probe
+            "TPU_LIFE_PROBE_WAIT_S": "300",
+            "TPU_LIFE_BENCH_DEADLINE_S": "1200",
+        },
+        stderr_path=stderr_path,
+    )
+    try:
+        wait_for_file_text(stderr_path, "retrying in")  # now inside the sleep
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        proc.kill()
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert proc.returncode == 0
+    assert rec["killed"] == "SIGTERM"
+    assert rec["degraded"] is True
+    assert rec["phase"].startswith("probe-wait")
+    assert rec["metric"] == "cell_updates_per_sec_per_chip"
+
+
+@pytest.mark.slow
+def test_bench_wedged_main_thread_still_emits():
+    """The watchdog-thread path: with SIGTERM blocked on the (simulated
+    wedged) main thread, no Python handler can run — the wakeup-fd
+    watchdog must still get the degraded line out before death."""
+    import signal
+    import time
+
+    proc = bench_popen(env_extra={"TPU_LIFE_BENCH_TEST_WEDGE": "1"})
+    try:
+        time.sleep(3)  # let it park in the drill loop
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        proc.kill()
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert proc.returncode == 0
+    assert rec["killed"] == "SIGTERM"
+    assert rec["degraded"] is True
+    assert rec["phase"] == "wedge-drill"
+
+
+@pytest.mark.slow
+def test_bench_sigalrm_hard_deadline_emits(tmp_path):
+    """The SIGALRM backstop: even if every sleep/budget guard were wrong,
+    the hard deadline forces the JSON line out."""
+    stderr_path = str(tmp_path / "stderr.txt")
+    proc = bench_popen(
+        env_extra={
+            "TPU_LIFE_PROBE_FORCE": "hang",
+            "TPU_LIFE_PROBE_WAIT_S": "300",
+            "TPU_LIFE_BENCH_DEADLINE_S": "1200",
+            "TPU_LIFE_BENCH_HARD_DEADLINE_S": "3",
+        },
+        stderr_path=stderr_path,
+    )
+    out, _ = proc.communicate(timeout=60)
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert proc.returncode == 0
+    assert rec["killed"] == "SIGALRM"
+    assert rec["degraded"] is True
+
+
+@pytest.mark.slow
+def test_bench_crash_mode_retries_survive_budget_guard(tmp_path):
+    """A natively short crash-mode gap (30s default, 1s here) must NOT trip
+    the budget-exhausted break — all PROBE_RETRIES attempts run (the
+    BENCH_r01 fast-crash promise, nearly lost to the r5 clamp guard)."""
+    stderr_path = str(tmp_path / "stderr.txt")
+    proc = bench_popen(
+        "--size", "256", "--steps", "40", "--base-steps", "4", "--repeats", "1",
+        env_extra={
+            "TPU_LIFE_PROBE_FORCE": "crash",
+            "TPU_LIFE_PROBE_CRASH_WAIT_S": "1",
+        },
+        stderr_path=stderr_path,
+    )
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["probe_failed"] is True and rec["degraded"] is True
+    retries = [l for l in open(stderr_path).read().splitlines() if "retrying in" in l]
+    assert len(retries) == 3  # attempts 2..4 all ran
+    assert not any("budget exhausted" in l for l in retries)
+
+
+@pytest.mark.slow
+def test_bench_probe_budget_bounds_total_sleep():
+    """With a budget too small for the 300s retry gap the bench must skip
+    the sleep entirely and degrade to a CPU capture — the retry schedule
+    can never again outlast the capture window."""
+    import time
+
+    t0 = time.monotonic()
+    rec = run_bench(
+        "--size", "256", "--steps", "40", "--base-steps", "4", "--repeats", "1",
+        env_extra={
+            "TPU_LIFE_PROBE_FORCE": "hang",
+            "TPU_LIFE_PROBE_WAIT_S": "300",
+            "TPU_LIFE_BENCH_DEADLINE_S": "30",
+        },
+        timeout=240,
+    )
+    assert time.monotonic() - t0 < 240
+    assert rec["probe_failed"] is True
+    assert rec["platform"] == "cpu" and rec["degraded"] is True
+    assert rec["value"] > 0  # a real (if degraded) measurement, not a stub
 
 
 @pytest.mark.slow
