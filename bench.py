@@ -98,12 +98,22 @@ def _die_emitting(signame: str) -> None:
     signal first) and hard-exit 0.  Callable from any thread."""
     import signal
 
+    import threading
+
     lock = _SIGNAL_STATE["emit_lock"]
+    me = threading.get_ident()
     if not lock.acquire(blocking=False):
-        # another emitter (or a racing one) is mid-write; block until the
-        # process dies under us rather than truncating its line with _exit
+        if _SIGNAL_STATE.get("emit_owner") == me:
+            # a second signal nested onto the thread that is already
+            # mid-emit (e.g. SIGALRM fires inside the SIGTERM handler):
+            # blocking here would self-deadlock a non-reentrant lock —
+            # return instead, resuming the outer frame's write + _exit
+            return
+        # another THREAD is mid-write; block until the process dies under
+        # us rather than truncating its line with _exit
         lock.acquire()
         os._exit(0)
+    _SIGNAL_STATE["emit_owner"] = me
     try:
         if not _SIGNAL_STATE.get("emitted"):
             record = {
@@ -170,15 +180,19 @@ def _install_signal_emitters() -> None:
     rfd, wfd = os.pipe()
     os.set_blocking(wfd, False)  # a full pipe must never block the C handler
 
+    deadly = {int(signal.SIGTERM), int(signal.SIGALRM)}
+
     def watchdog():
-        data = os.read(rfd, 1)
-        name = "SIGNAL"
-        if data:
-            try:
-                name = signal.Signals(data[0]).name
-            except ValueError:
-                pass
-        _die_emitting(name)
+        # the wakeup fd sees EVERY Python-handled signal — react only to
+        # the two that mean "the capture window is closing".  A SIGINT
+        # (operator Ctrl-C) must keep its normal KeyboardInterrupt
+        # behavior, not be recorded as a valid degraded capture.
+        while True:
+            data = os.read(rfd, 1)
+            if not data:
+                return
+            if data[0] in deadly:
+                _die_emitting(signal.Signals(data[0]).name)
 
     threading.Thread(target=watchdog, daemon=True, name="emit-watchdog").start()
     signal.set_wakeup_fd(wfd, warn_on_full_buffer=False)
@@ -259,7 +273,12 @@ def _probe_with_retries() -> str | None:
         if attempt + 1 >= PROBE_RETRIES:
             break
         wait = PROBE_RETRY_WAIT_S if mode == "hang" else PROBE_CRASH_WAIT_S
-        budget = deadline - time.monotonic() - PROBE_TIMEOUT_S
+        # reserve room for the probe after the gap: a hang burns the full
+        # probe timeout, a crash returns in seconds — reserving 180 s for
+        # a crash-mode retry would cut the fast-retry schedule on small
+        # deadlines for no reason
+        reserve = PROBE_TIMEOUT_S if mode == "hang" else 15.0
+        budget = deadline - time.monotonic() - reserve
         # clamp the gap so the probe after it still fits the budget; give up
         # only when the CLAMP squeezed a gap below the useful minimum (a
         # natively short crash-mode gap is fine — dense re-probing is only a

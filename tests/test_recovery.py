@@ -161,6 +161,36 @@ def test_run_resumed_past_fault_step_does_not_fire(tmp_path):
     )
 
 
+def test_snapshot_cadence_stays_anchored_across_restarts(tmp_path):
+    """Crossings are computed in ABSOLUTE step space: with sync_every=7 and
+    snapshot_every=10, a restart resuming from the step-14 snapshot must
+    snapshot next at step 21 (first sync point past the global multiple
+    20), not at 28 (a full interval after the resume point — the
+    resume-relative drift of ADVICE r4)."""
+    import os
+
+    _, base = _setup(tmp_path, steps=30)
+    res = run(
+        RunConfig(
+            backend="numpy",
+            snapshot_every=10,
+            sync_every=7,
+            fault_at=16,  # snapshot at 14 exists (first sync >= 10)
+            max_restarts=1,
+            **base,
+        )
+    )
+    assert res.restarts == 1
+    snaps = sorted(
+        int(f.split("_")[1].split(".")[0])
+        for f in os.listdir(tmp_path / "snaps")
+        if f.endswith(".txt")
+    )
+    # pre-fault: 14; post-restart from 14: 21 (past 20) and 30 (past 30,
+    # the final chunk) — NOT 28, which the drifted cadence would produce
+    assert snaps == [14, 21, 30], snaps
+
+
 def test_stale_snapshots_cannot_hijack_recovery(tmp_path):
     # a snapshots/ dir left over from an EARLIER, unrelated run must not be
     # picked up by recovery: only snapshots this run wrote are trusted.

@@ -34,9 +34,11 @@ def atomic_publish(p: Path):
     A crash mid-write must never leave a truncated ``p`` — resume paths
     trust these files — and must not litter orphan tmps either: on any
     failure the tmp is unlinked, on success ``os.replace`` lands the bytes
-    atomically (POSIX rename).
+    atomically (POSIX rename).  The tmp name is per-writer (pid): two runs
+    sharing a snapshot dir, or racing writers of the same step, must not
+    interleave bytes into one tmp and publish a hybrid (ADVICE r4).
     """
-    tmp = p.with_suffix(".tmp")
+    tmp = p.with_suffix(f".{os.getpid()}.tmp")
     try:
         yield tmp
         os.replace(tmp, p)
@@ -49,8 +51,12 @@ def snapshot_path(directory: str | os.PathLike, step: int) -> Path:
 
 
 def write_sidecar(p: Path, step: int, rule: str, height: int, width: int) -> None:
+    # published atomically: snapshot_intact() demotes a snapshot whose
+    # sidecar is unparseable, so a torn sidecar must be impossible even
+    # under racing writers (ADVICE r4)
     meta = {"step": step, "rule": rule, "height": height, "width": width}
-    p.with_suffix(".json").write_text(json.dumps(meta))
+    with atomic_publish(p.with_suffix(".json")) as tmp:
+        tmp.write_text(json.dumps(meta))
 
 
 def save_snapshot(

@@ -188,11 +188,14 @@ def run(cfg: RunConfig) -> RunResult:
 
     # crossing detection: snapshot at the first sync point at-or-past each
     # snapshot_every multiple, so sync_every and snapshot_every need not
-    # divide each other.  Mutable holder because the elastic-recovery loop
-    # rewinds `start` and resets `last_snap` on restart; `written` records
-    # the absolute steps of snapshots THIS run wrote — the only snapshots
-    # recovery will trust as restart sources.
-    state = {"start": start_step, "last_snap": 0, "written": []}
+    # divide each other.  `last_snap` lives in ABSOLUTE step space and
+    # restarts rewind it to the resume step, so the cadence stays anchored
+    # to global snapshot_every multiples across --resume and elastic
+    # recovery instead of drifting a full interval per restart (ADVICE r4).
+    # Mutable holder because the elastic-recovery loop rewinds it;
+    # `written` records the absolute steps of snapshots THIS run wrote —
+    # the only snapshots recovery will trust as restart sources.
+    state = {"start": start_step, "last_snap": start_step, "written": []}
     # retention pruning is a single-writer side effect (racing unlinks in a
     # multi-process job would trip each other); gate it on the lead
     lead_snapshots = _is_lead_process()
@@ -208,10 +211,10 @@ def run(cfg: RunConfig) -> RunResult:
         board_np = get_board() if cfg.verbose else None
         if (
             cfg.snapshot_every > 0
-            and done_local // cfg.snapshot_every
+            and done // cfg.snapshot_every
             > state["last_snap"] // cfg.snapshot_every
         ):
-            state["last_snap"] = done_local
+            state["last_snap"] = done
             if stream:
                 # per-shard snapshot write: the board stays sharded.
                 # Single-process: publish atomically (ckpt.atomic_publish).
@@ -230,7 +233,11 @@ def run(cfg: RunConfig) -> RunResult:
                     backend.write_runner_to_file(
                         recovery.unwrap(runner), p, height, width, rule
                     )
-                ckpt.write_sidecar(p, done, rule.name, height, width)
+                if lead_snapshots:
+                    # the sidecar content is identical on every process;
+                    # N racing writers of one path would only add torn-
+                    # file risk, so it is a single-writer side effect
+                    ckpt.write_sidecar(p, done, rule.name, height, width)
             else:
                 p = ckpt.save_snapshot(
                     cfg.snapshot_dir,
@@ -299,7 +306,7 @@ def run(cfg: RunConfig) -> RunResult:
                         backend = get_backend(backend_name, rule=rule, **backend_kwargs)
                     first_build = False
                     state["start"] = resume_step
-                    state["last_snap"] = 0
+                    state["last_snap"] = resume_step
                     # drop metric records the rewind is about to re-earn
                     recorder.records[:] = [
                         r for r in recorder.records if r["step"] <= resume_step
