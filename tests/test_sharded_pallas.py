@@ -89,8 +89,9 @@ def test_explicit_pallas_rejects_unsupported_configs():
 def test_auto_stays_on_xla_off_tpu():
     """`auto` must not pick Python-speed interpret mode on CPU meshes."""
     b = ShardedBackend(num_devices=2)
-    assert b._resolve_local_kernel(use_bits=True) is None
-    assert b._resolve_local_kernel(use_bits=False) is None
+    rule = get_rule("conway")
+    assert b._resolve_local_kernel(use_bits=True, rule=rule) is None
+    assert b._resolve_local_kernel(use_bits=False, rule=rule) is None
 
 
 # --- the int8 2-D-tiled local kernel (LtL / Generations / unpacked) --------

@@ -2,11 +2,13 @@
 
 The reference's world is clamped: indices outside the board are dead
 (Parallel_Life_MPI.cpp:21-27).  ``rule:T`` glues the edges into a
-board-sized torus instead.  Executors whose layouts assume the clamped
-contract (bitpack, Pallas kernels, the sharded/stripes halo machinery,
-native C) must refuse loudly; the ones that support it must match the
-NumPy oracle bit-for-bit — including on odd, non-lane-aligned widths,
-which is where silent padding would corrupt the wraparound.
+board-sized torus instead.  Life-like torus rules run on the packed
+bitboard (seam carries wrap at the logical width —
+``bitlife.make_torus_hshifts``); executors whose layouts remain
+clamped-only (Pallas kernels, native C) must refuse loudly; every
+supporting executor must match the NumPy oracle bit-for-bit — including
+on odd, non-word/lane-aligned widths, which is where silent padding
+would corrupt the wraparound.
 """
 
 import numpy as np
@@ -102,12 +104,76 @@ def test_clamped_executors_refuse_loudly(rng_board):
 
     rule = get_rule("conway:T")
     board = rng_board(24, 24, seed=23)
+    # the CLAMPED packed step refuses torus rules; the torus variant is a
+    # separate constructor whose shifts wrap (supports_torus)
     assert not bitlife.supports(rule)
+    assert bitlife.supports_torus(rule)
+    with pytest.raises(ValueError, match="total_planes"):
+        bitlife.make_packed_step(rule)
     from tpu_life.ops import native_step
 
     if native_step.build():
         with pytest.raises(ValueError, match="clamped Moore"):
             native_step.run_native(board, rule, 1)
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [(16, 32), (20, 20), (33, 65), (17, 31), (12, 500), (9, 128)],
+    ids=lambda s: f"{s[0]}x{s[1]}",
+)
+def test_packed_torus_step_bit_identical(shape, rng_board):
+    """The packed torus step at every width class: word-aligned, single
+    partial word, multi-word with remainder, the reference's 500."""
+    import jax.numpy as jnp
+
+    from tpu_life.ops import bitlife
+
+    h, w = shape
+    rule = get_rule("conway:T")
+    board = rng_board(h, w, seed=h * 100 + w)
+    got = bitlife.unpack_np(
+        np.asarray(
+            bitlife.multi_step_packed_torus(
+                jnp.asarray(bitlife.pack_np(board)), rule=rule, steps=12, width=w
+            )
+        ),
+        w,
+    )
+    np.testing.assert_array_equal(got, run_np(board, rule, 12))
+
+
+def test_torus_backends_actually_run_packed(rng_board):
+    """Engagement proof (VERDICT r4 item 3 'not TPU-first'): conway:T on
+    the jax and sharded backends stages a uint32 bitboard, not the int8
+    scan it used to fall back to; a multistate torus rule still falls
+    back to int8."""
+    import jax
+
+    from tpu_life.backends.base import get_backend, make_runner
+
+    board = rng_board(24, 33, seed=77)
+    rule = get_rule("conway:T")
+    r = make_runner(get_backend("jax"), board, rule)
+    assert r.x.dtype == jax.numpy.uint32
+    if len(jax.devices()) >= 4:
+        rs = make_runner(get_backend("sharded", num_devices=4), board, rule)
+        assert rs.x.dtype == jax.numpy.uint32
+    gens = get_rule("brians_brain:T")  # 3 states: no bitboard
+    rg = make_runner(get_backend("jax"), board, gens)
+    assert rg.x.dtype == jax.numpy.int8
+
+
+def test_packed_torus_respects_bitpack_flag(rng_board):
+    from tpu_life.backends.base import get_backend, make_runner
+    import jax
+
+    board = rng_board(16, 20, seed=5)
+    rule = get_rule("conway:T")
+    r = make_runner(get_backend("jax", bitpack=False), board, rule)
+    assert r.x.dtype == jax.numpy.int8
+    out_plain = get_backend("jax", bitpack=False).run(board, rule, 7)
+    np.testing.assert_array_equal(out_plain, run_np(board, rule, 7))
 
 
 @pytest.mark.parametrize("ranks", [1, 3, 5])

@@ -133,3 +133,78 @@ def test_bitpack_gate_excludes_von_neumann():
 
     assert not bitlife.supports(get_rule("R1,C2,S2..3,B3,NN"))
     assert bitlife.supports(get_rule("conway"))
+
+
+def test_diamond_gate_bounds():
+    """supports_diamond: 2-state clamped NN with counts fitting 4 planes
+    (r <= 2); multistate, torus, r=3, and Moore rules are excluded."""
+    from tpu_life.ops import bitlife
+
+    assert bitlife.supports_diamond(get_rule("R2,C2,S2..4,B2..3,NN"))
+    assert bitlife.supports_diamond(get_rule("R1,C2,S2..3,B3,NN"))
+    assert bitlife.supports_diamond(get_rule("R2,C2,M1,S3..6,B3..5,NN"))
+    assert not bitlife.supports_diamond(get_rule("R3,C2,S6..10,B6..8,NN"))
+    assert not bitlife.supports_diamond(get_rule("R2,C3,S2..4,B2..3,NN"))
+    assert not bitlife.supports_diamond(get_rule("R2,C2,S2..4,B2..3,NN:T"))
+    assert not bitlife.supports_diamond(get_rule("conway"))
+
+
+@pytest.mark.parametrize(
+    "shape", [(24, 40), (33, 65), (17, 31)], ids=lambda s: f"{s[0]}x{s[1]}"
+)
+def test_packed_diamond_bit_identical(shape, rng_board):
+    """The bit-sliced diamond (VERDICT r4 item 4) against the oracle at
+    every width class, fused over multiple steps."""
+    import jax.numpy as jnp
+
+    from tpu_life.ops import bitlife
+
+    h, w = shape
+    rule = get_rule(VN_SPEC)
+    board = rng_board(h, w, seed=h + w)
+    got = bitlife.unpack_np(
+        np.asarray(
+            bitlife.multi_step_packed_diamond(
+                jnp.asarray(bitlife.pack_np(board)),
+                rule=rule,
+                steps=9,
+                logical_shape=(h, w),
+            )
+        ),
+        w,
+    )
+    np.testing.assert_array_equal(got, run_np(board, rule, 9))
+
+
+def test_diamond_backends_actually_run_packed(rng_board):
+    """Engagement proof: NN r<=2 rules stage uint32 bitboards on the jax
+    and sharded backends (the documented int8-scan shrug is gone); r=3
+    still falls back to int8."""
+    import jax
+
+    from tpu_life.backends.base import get_backend, make_runner
+
+    board = rng_board(24, 33, seed=88)
+    rule = get_rule(VN_SPEC)
+    r = make_runner(get_backend("jax"), board, rule)
+    assert r.x.dtype == jax.numpy.uint32
+    if len(jax.devices()) >= 4:
+        rs = make_runner(get_backend("sharded", num_devices=4), board, rule)
+        assert rs.x.dtype == jax.numpy.uint32
+    r3 = make_runner(get_backend("jax"), board, get_rule("R3,C2,S6..10,B6..8,NN"))
+    assert r3.x.dtype == jax.numpy.int8
+
+
+def test_packed_diamond_sharded_deep_halo_blocking(rng_board):
+    """block_steps > 1 with the packed diamond: radius-2 deep halos in the
+    word domain stay exact across shard seams."""
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    rule = get_rule(VN_SPEC)
+    board = rng_board(40, 37, seed=91)
+    be = get_backend("sharded", num_devices=4, block_steps=3)
+    np.testing.assert_array_equal(be.run(board, rule, 12), run_np(board, rule, 12))

@@ -70,15 +70,19 @@ class DeviceRunner:
         return lambda x=self.x: self._to_np(x)
 
 
-def packed_device_runner(board: np.ndarray, rule: Rule, device) -> DeviceRunner:
-    """DeviceRunner over the bit-sliced board representation (life-like
-    rules): 32 cells per uint32 lane, fused packed scan.  Shared by the
-    ``jax`` backend and the ``pallas`` backend's small-board fallback."""
+def packed_device_runner(
+    board: np.ndarray, rule: Rule, device, advance=None
+) -> DeviceRunner:
+    """DeviceRunner over the bit-sliced board representation: 32 cells per
+    uint32 lane, fused packed scan.  Shared by the ``jax`` backend (Moore,
+    diamond, and torus advance variants) and the ``pallas`` backend's
+    small-board fallback; ``advance`` defaults to the clamped Moore scan."""
     h, w = board.shape
     x = jax.device_put(bitlife.pack_np(np.asarray(board, np.int8)), device)
-    advance = lambda x, n: bitlife.multi_step_packed(
-        x, rule=rule, steps=n, logical_shape=(h, w)
-    )
+    if advance is None:
+        advance = lambda x, n: bitlife.multi_step_packed(
+            x, rule=rule, steps=n, logical_shape=(h, w)
+        )
     return DeviceRunner(
         x,
         advance,
@@ -101,6 +105,28 @@ class JaxBackend:
         logical = (h, w)
         if self.bitpack and bitlife.supports(rule):
             return packed_device_runner(board, rule, self.device)
+        if self.bitpack and bitlife.supports_diamond(rule):
+            # 2-state von Neumann rules run bit-sliced too: the diamond as
+            # stacked shifted row boxes under one CSA reduction
+            return packed_device_runner(
+                board,
+                rule,
+                self.device,
+                advance=lambda x, n: bitlife.multi_step_packed_diamond(
+                    x, rule=rule, steps=n, logical_shape=logical
+                ),
+            )
+        if self.bitpack and bitlife.supports_torus(rule):
+            # torus life-like rules run packed too: roll-based row wrap,
+            # seam carries at the logical width (bitlife.make_torus_hshifts)
+            return packed_device_runner(
+                board,
+                rule,
+                self.device,
+                advance=lambda x, n: bitlife.multi_step_packed_torus(
+                    x, rule=rule, steps=n, width=w
+                ),
+            )
         # torus boards must stay at exact shape: padding would sit between
         # the logical edges the torus glues together (lane alignment is a
         # perf preference; correctness wins)

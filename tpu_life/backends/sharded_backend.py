@@ -138,13 +138,21 @@ class ShardedBackend:
         return jax.make_array_from_callback((h_pad, w_phys), sharding, cb)
 
     def _use_bits(self, rule: Rule) -> bool:
+        if rule.boundary == "torus":
+            # mirrors _prepare_torus (which rejects local_kernel='pallas'
+            # before this matters): life-like torus rules run packed too,
+            # and the streamed reader/writer must agree on the layout
+            return self.bitpack and bitlife.supports_torus(rule)
         if self.local_kernel == "pallas" and self.n_cols > 1:
             # the packed stripe kernel is 1-D only: explicit pallas on a
             # 2-D mesh runs the int8 kernel on the unpacked layout
             return False
         # on a 2-D mesh, word-aligned shard boundaries keep the bitboard
-        # splittable along columns too (ceil(pad/32)-word halos)
-        return self.bitpack and bitlife.supports(rule)
+        # splittable along columns too (ceil(pad/32)-word halos).  The
+        # bit-sliced diamond (supports_diamond) rides the same layout.
+        return self.bitpack and (
+            bitlife.supports(rule) or bitlife.supports_diamond(rule)
+        )
 
     def prepare(self, board: np.ndarray, rule: Rule):
         h, w = board.shape
@@ -216,7 +224,7 @@ class ShardedBackend:
             return self.pallas_interpret
         return self.mesh.devices.flat[0].platform != "tpu"
 
-    def _resolve_local_kernel(self, use_bits: bool) -> str | None:
+    def _resolve_local_kernel(self, use_bits: bool, rule: Rule) -> str | None:
         """Which Pallas kernel the per-shard stepper should be, or None for
         the XLA scan (VERDICT round 1 item 1: multi-chip runs keep
         single-chip throughput).  ``'packed'`` = the bit-sliced stripe kernel
@@ -237,6 +245,15 @@ class ShardedBackend:
         elif self.partition_mode != "shard_map" or self._pallas_interp():
             return None
         if use_bits:
+            if rule.neighborhood == "von_neumann":
+                # the packed diamond runs the XLA scan; no Pallas twin yet
+                if self.local_kernel == "pallas":
+                    raise ValueError(
+                        "the Pallas kernels count Moore boxes only; von "
+                        "Neumann rules need local_kernel='xla' (the packed "
+                        "diamond runs the XLA scan)"
+                    )
+                return None
             # packed stripes are full-width: on a 2-D mesh `auto` keeps the
             # packed XLA scan (8x less HBM) over unpacked int8 Pallas
             return "packed" if self.n_cols == 1 else None
@@ -359,10 +376,12 @@ class ShardedBackend:
 
     def _prepare_torus(self, load_rows, h: int, w: int, rule: Rule):
         """Torus sharding: periodic ppermute ring + column-wrap substeps
-        (`make_sharded_run_torus`).  The board must be EXACT — padding
-        anywhere would sit inside the glued seam — hence the constraints;
-        violations raise with the precise reason instead of silently
-        clamping."""
+        (`make_sharded_run_torus`).  The board must be EXACT in rows —
+        padding rows would sit inside the glued seam — hence the
+        constraints; violations raise with the precise reason instead of
+        silently clamping.  Life-like rules run on the packed bitboard
+        (seam carries wrap at the logical width; VERDICT r4 item 3);
+        other rule families fall back to the int8 wrap-cols scan."""
         if self.n_cols > 1:
             raise ValueError(
                 "torus boundary needs a 1-D (rows) mesh; got a 2-D mesh"
@@ -384,19 +403,28 @@ class ShardedBackend:
             )
         from tpu_life.parallel.halo import make_sharded_run_torus
 
+        use_bits = self._use_bits(rule)
         shard_h = h // self.n
         block_steps = max(
             1, min(self.block_steps, shard_h // max(1, rule.radius))
         )
-        x = self._device_put_stream(load_rows, h, w, h, w, use_bits=False)
+        if use_bits:
+            wp = bitlife.packed_width(w)
+            x = self._device_put_stream(load_rows, h, w, h, wp, use_bits=True)
+            to_np = lambda x: bitlife.unpack_np(np.asarray(x), w)
+            count = bitlife.live_count_packed
+        else:
+            x = self._device_put_stream(load_rows, h, w, h, w, use_bits=False)
+            to_np = lambda x: np.asarray(x)
+            count = bitlife.live_count_cells
         return self._blocked_runner(
             x,
             block_steps,
             lambda bs: make_sharded_run_torus(
-                rule, self.mesh, (h, w), block_steps=bs
+                rule, self.mesh, (h, w), block_steps=bs, packed=use_bits
             ),
-            lambda x: np.asarray(x),
-            bitlife.live_count_cells,
+            to_np,
+            count,
         )
 
     def _prepare_impl(self, load_rows, h: int, w: int, rule: Rule):
@@ -404,7 +432,7 @@ class ShardedBackend:
             return self._prepare_torus(load_rows, h, w, rule)
         logical = (h, w)
         use_bits = self._use_bits(rule)
-        kernel_mode = self._resolve_local_kernel(use_bits)
+        kernel_mode = self._resolve_local_kernel(use_bits, rule)
 
         pallas_tiling = None  # packed stripe kernel (life-like rules)
         int8_tiling = None  # int8 2-D-tiled kernel (LtL / Generations)

@@ -132,6 +132,36 @@ def verify(
 
 
 @lru_cache(maxsize=None)
+def membership_rule_sop(
+    birth: frozenset, survive: frozenset, count_max: int
+) -> tuple[int, tuple[tuple[int, int], ...]]:
+    """(n_count_bits, SOP) for ``alive'(count_b0.., x)`` over RAW counts.
+
+    Unlike :func:`rule_sop` (life-like totals including the center), the
+    count here is exactly what the rule's membership sets test — the
+    neighborhood sum as ``stencil._counts`` produces it, center excluded
+    unless the rule includes it — so this serves any 2-state neighborhood
+    whose maximum count fits the planes (the bit-sliced von Neumann
+    diamond: ``count_max = 2r(r+1)``).  Input bit layout: bits
+    0..n-1 = count planes, bit n = the center cell.  Don't-cares: counts
+    above ``count_max``.
+    """
+    nplanes = max(1, count_max.bit_length())
+    nbits = nplanes + 1
+    minterms, dontcares = set(), set()
+    for x_bit in (0, 1):
+        for count in range(1 << nplanes):
+            idx = count | (x_bit << nplanes)
+            if count > count_max:
+                dontcares.add(idx)
+            elif (count in birth) if x_bit == 0 else (count in survive):
+                minterms.add(idx)
+    sop = minimize(minterms, dontcares, nbits=nbits)
+    verify(sop, minterms, dontcares, nbits=nbits)
+    return nplanes, tuple(sop)
+
+
+@lru_cache(maxsize=None)
 def rule_sop(
     birth: frozenset, survive: frozenset
 ) -> tuple[tuple[int, int], ...]:
