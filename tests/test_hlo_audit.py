@@ -1,0 +1,161 @@
+"""HLO collective audit — the structural guard for the weak-scaling story.
+
+BASELINE.md's >=90% weak-scaling projection rests on an arithmetic premise:
+the compiled sharded step contains exactly the two halo ``ppermute``s per
+block (four on a 2-D mesh) and NO other collective — an accidental
+all-gather introduced by a future sharding/layout change would multiply
+per-step ICI traffic by the board size while every correctness test stayed
+green (VERDICT r4 weak item 5).  So this file compiles every sharded step
+variant on the fake 8-device mesh and asserts the collective census of the
+lowered HLO itself.  The reference's analogous invariant is structural
+too: exactly 2 messages per rank per epoch (Parallel_Life_MPI.cpp:135-145).
+
+The metrics reduction (``live_count_*`` + psum) is deliberately a separate
+compiled function; its all-reduce is audited as such, and its absence from
+the step modules is part of the census here.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_life.models.rules import get_rule
+from tpu_life.ops import bitlife
+from tpu_life.parallel.halo import (
+    make_sharded_run,
+    make_sharded_run_2d,
+    make_sharded_run_torus,
+)
+from tpu_life.parallel.mesh import make_mesh, make_mesh_2d
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 fake devices"
+)
+
+FORBIDDEN = ("all-gather(", "all-reduce(", "all-to-all", "reduce-scatter(")
+
+
+def census(compiled_text: str) -> dict:
+    return {
+        "collective-permute": len(
+            re.findall(r"collective-permute\(", compiled_text)
+        ),
+        **{f: compiled_text.count(f) for f in FORBIDDEN},
+    }
+
+
+def compile_run(run, board_shape, dtype, mesh, spec, num_blocks=3):
+    x = jax.device_put(
+        jnp.zeros(board_shape, dtype), NamedSharding(mesh, spec)
+    )
+    return run.lower(x, num_blocks=num_blocks).compile().as_text()
+
+
+def assert_exact_permutes(txt: str, expected: int, what: str) -> None:
+    c = census(txt)
+    assert c["collective-permute"] == expected, (what, c)
+    for f in FORBIDDEN:
+        assert c[f] == 0, (
+            f"{what}: stray {f.rstrip('(')} in the compiled step — "
+            f"the weak-scaling comm budget no longer holds ({c})"
+        )
+
+
+@pytest.mark.parametrize("packed", [True, False], ids=["packed", "int8"])
+def test_stripe_step_has_exactly_one_ppermute_pair(packed):
+    """1-D stripe, XLA local kernel: one fwd + one bwd halo permute per
+    block and nothing else, packed and int8 alike."""
+    mesh = make_mesh(8)
+    rule = get_rule("conway")
+    h, w = 64, 64
+    run = make_sharded_run(rule, mesh, (h, w), block_steps=2, packed=packed)
+    shape = (h, bitlife.packed_width(w)) if packed else (h, w)
+    dt = jnp.uint32 if packed else jnp.int8
+    txt = compile_run(run, shape, dt, mesh, P("rows", None))
+    assert_exact_permutes(txt, 2, f"stripe packed={packed}")
+
+
+def test_2d_mesh_step_has_exactly_two_ppermute_pairs():
+    """2-D block decomposition: rows pair + row-extended columns pair."""
+    mesh = make_mesh_2d((2, 4))
+    rule = get_rule("conway")
+    h, w = 64, 256  # wide enough for word-aligned column shards
+    run = make_sharded_run_2d(rule, mesh, (h, w), block_steps=2, packed=True)
+    shape = (h, bitlife.packed_width(w))
+    txt = compile_run(run, shape, jnp.uint32, mesh, P("rows", "cols"))
+    assert_exact_permutes(txt, 4, "2-D packed")
+
+
+def test_2d_mesh_int8_step_has_exactly_two_ppermute_pairs():
+    mesh = make_mesh_2d((2, 4))
+    rule = get_rule("bugs")  # LtL r=5: deep halos, same exchange shape
+    h, w = 64, 64
+    run = make_sharded_run_2d(rule, mesh, (h, w), block_steps=1, packed=False)
+    txt = compile_run(run, (h, w), jnp.int8, mesh, P("rows", "cols"))
+    assert_exact_permutes(txt, 4, "2-D int8 LtL")
+
+
+@pytest.mark.parametrize("packed", [True, False], ids=["packed", "int8"])
+def test_torus_ring_has_exactly_one_ppermute_pair(packed):
+    """The closed ring costs the same census as the clamped exchange: the
+    wrap changes the permutation pairs, not the collective count."""
+    mesh = make_mesh(8)
+    rule = get_rule("conway:T")
+    h, w = 64, 64
+    run = make_sharded_run_torus(
+        rule, mesh, (h, w), block_steps=2, packed=packed
+    )
+    shape = (h, bitlife.packed_width(w)) if packed else (h, w)
+    dt = jnp.uint32 if packed else jnp.int8
+    txt = compile_run(run, shape, dt, mesh, P("rows", None))
+    assert_exact_permutes(txt, 2, f"torus packed={packed}")
+
+
+def test_composed_pallas_step_has_exactly_one_ppermute_pair():
+    """The flagship composition (Pallas stripe kernel inside shard_map):
+    the kernel swap must not change the exchange census."""
+    from tpu_life.backends.pallas_backend import make_sharded_pallas_run
+
+    mesh = make_mesh(8)
+    rule = get_rule("conway")
+    # lane-aligned packed width (Mosaic minor-dim rule); shard height 64
+    # comfortably holds the block_rows + 2*halo DMA window
+    h, w = 512, 4096
+    run = make_sharded_pallas_run(
+        rule, mesh, (h, w), block_steps=2, block_rows=32, interpret=True
+    )
+    shape = (h, bitlife.packed_width(w))
+    txt = compile_run(run, shape, jnp.uint32, mesh, P("rows", None))
+    assert_exact_permutes(txt, 2, "composed pallas")
+
+
+def test_diamond_packed_step_has_exactly_one_ppermute_pair():
+    """The bit-sliced von Neumann diamond through the sharded XLA scan."""
+    mesh = make_mesh(8)
+    rule = get_rule("R2,C2,S2..4,B2..3,NN")
+    h, w = 64, 64
+    run = make_sharded_run(rule, mesh, (h, w), block_steps=2, packed=True)
+    shape = (h, bitlife.packed_width(w))
+    txt = compile_run(run, shape, jnp.uint32, mesh, P("rows", None))
+    assert_exact_permutes(txt, 2, "diamond packed")
+
+
+def test_metrics_reduction_is_the_only_allowed_collective_reduce():
+    """live_count_packed on a sharded board: its own compiled function
+    carries the one sanctioned cross-device reduction — and it is NOT part
+    of any step module (asserted above), so --metrics cadence, not board
+    layout, controls reduction traffic."""
+    mesh = make_mesh(8)
+    x = jax.device_put(
+        jnp.zeros((64, 2), jnp.uint32), NamedSharding(mesh, P("rows", None))
+    )
+    txt = jax.jit(bitlife.live_count_packed).lower(x).compile().as_text()
+    # the hi/lo scalar sums lower to all-reduces (psum); no permutes, no
+    # gathers — two scalars cross the wire, never the board
+    assert txt.count("all-gather(") == 0
+    assert census(txt)["collective-permute"] == 0
+    assert txt.count("all-reduce(") >= 1

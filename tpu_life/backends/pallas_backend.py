@@ -929,6 +929,28 @@ class PallasBackend:
         h, w = logical
         if self.bitpack and bitlife.supports(rule):
             return packed_device_runner(board, rule, self.device)
+        if self.bitpack and bitlife.supports_diamond(rule):
+            # 2-state NN rules keep the bit-sliced diamond here too —
+            # `auto` resolves single-chip TPU runs to this backend, so a
+            # missing dispatch would silently re-open the int8 fallback
+            # the diamond executor replaced
+            return packed_device_runner(
+                board,
+                rule,
+                self.device,
+                advance=lambda x, n: bitlife.multi_step_packed_diamond(
+                    x, rule=rule, steps=n, logical_shape=logical
+                ),
+            )
+        if self.bitpack and bitlife.supports_torus(rule):
+            return packed_device_runner(
+                board,
+                rule,
+                self.device,
+                advance=lambda x, n: bitlife.multi_step_packed_torus(
+                    x, rule=rule, steps=n, width=w
+                ),
+            )
         # torus boards stay unpadded (the rolls wrap at the logical edges)
         wp = ceil_to(w, LANE) if rule.boundary == "clamped" else w
         x = jax.device_put(pad_board(board, h, wp), self.device)

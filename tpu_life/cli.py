@@ -108,8 +108,11 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
         "--backend",
         default="auto",
         choices=["auto", "numpy", "native", "jax", "sharded", "stripes", "mpi", "pallas"],
-        help="mpi is EXPERIMENTAL: needs mpiexec + mpi4py (absent from this "
-        "image; exercised in CI only via an injected fake communicator)",
+        help="mpi is EXPERIMENTAL and thread-simulated only: mpiexec/mpi4py "
+        "are absent from this image (libmpi alone ships no launcher), so "
+        "its per-rank logic has only ever run against an injected fake "
+        "communicator; real cross-process messaging is covered by the "
+        "jax.distributed backend tests",
     )
     r.add_argument("--num-devices", type=int, default=None)
     r.add_argument(
@@ -322,7 +325,9 @@ def _info() -> int:
         "jax": "ok",
         "sharded": f"ok ({len(jax.devices())} devices)",
         "stripes": "ok",
-        "mpi": "experimental (mpiexec + mpi4py)",
+        "mpi": "experimental, thread-simulated only (mpiexec + mpi4py "
+        "have never run it; real message passing is covered by the "
+        "two-process jax.distributed test instead)",
         "native": "ok" if native_step.available() else "needs `make -C native`",
         "pallas": "ok",
     }
@@ -333,7 +338,10 @@ def _info() -> int:
     try:
         from mpi4py import MPI  # noqa: F401
     except ImportError:
-        avail["mpi"] = "experimental, unavailable (needs mpi4py)"
+        avail["mpi"] = (
+            "experimental, unavailable here (needs mpi4py; only ever "
+            "exercised thread-simulated via an injected fake communicator)"
+        )
     print("backends:")
     for name in sorted(avail):
         print(f"  {name}: {avail[name]}")
