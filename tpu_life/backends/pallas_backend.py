@@ -550,7 +550,10 @@ def _sharded_epoch_loop(
         # out_shape now *accepts* a vma annotation, but the checker then
         # aborts one level up — dynamic_slice "requires varying manual axes
         # to match, got [{'rows'}, {}, {}]" — and JAX's own error text says
-        # to file an issue and pass check_vma=False as the workaround.  The
+        # to file an issue and pass check_vma=False as the workaround.
+        # Re-verified on jax 0.9.0, 2026-07-30 (round 5): unannotated
+        # out_shape still demands vma, annotated still dies in the
+        # dynamic_slice checker; status unchanged.  The
         # specs still partition the board; only the extra static consistency
         # check is off, and the glider-across-seam + cross-executor
         # bit-identity tests cover the same invariant dynamically.
