@@ -304,6 +304,30 @@ def _probe_with_retries() -> str | None:
     return None
 
 
+def default_tpu_local_kernel(rule_name: str, no_bitpack: bool) -> str | None:
+    """The per-shard kernel the TPU flagship capture should pin, or None
+    for 'auto' (the XLA local kernel).
+
+    The Pallas stripe kernel needs the bit-sliced CLAMPED Moore board
+    (mirrors ``bitlife.supports``, checked here without importing jax):
+    for --no-bitpack, non-life-like, torus, or von Neumann rules the pin
+    must stay off — ``_prepare_torus`` rejects ``local_kernel='pallas'``
+    outright, and a pinned config that raises would send a healthy-TPU
+    capture down the CPU-degrade path.
+    """
+    from tpu_life.models.rules import get_rule
+
+    rule = get_rule(rule_name)
+    bit_packable = (
+        rule.states == 2
+        and rule.radius == 1
+        and not rule.include_center
+        and rule.neighborhood == "moore"
+        and rule.boundary == "clamped"
+    )
+    return "pallas" if bit_packable and not no_bitpack else None
+
+
 def _emit(result: dict) -> None:
     # single os.write AFTER which the emitted flag flips: a signal landing
     # mid-write finds emitted=False and prints its own complete line after
@@ -557,17 +581,9 @@ def main() -> None:
     if args.backend is None:
         args.backend = "sharded" if platform == "tpu" else "jax"
         if platform == "tpu" and args.local_kernel is None:
-            # the Pallas stripe kernel needs the bit-sliced board (mirrors
-            # bitlife.supports, checked here without importing jax): for
-            # --no-bitpack or non-life-like rules leave 'auto' (XLA local
-            # kernel) instead of pinning a config that would raise and send
-            # a healthy-TPU capture down the CPU-degrade path
-            rule = get_rule(args.rule)
-            bit_packable = (
-                rule.states == 2 and rule.radius == 1 and not rule.include_center
+            args.local_kernel = default_tpu_local_kernel(
+                args.rule, args.no_bitpack
             )
-            if bit_packable and not args.no_bitpack:
-                args.local_kernel = "pallas"
 
     def annotate(record: dict) -> dict:
         if probe_failed:

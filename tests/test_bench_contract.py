@@ -185,6 +185,27 @@ def test_bench_sigalrm_hard_deadline_emits(tmp_path):
     assert rec["degraded"] is True
 
 
+def test_bench_tpu_local_kernel_pin_respects_rule_family():
+    """The TPU flagship pin (local_kernel='pallas') applies only to
+    clamped-Moore life-like rules: torus, von Neumann, Generations, LtL,
+    and --no-bitpack must resolve to auto — _prepare_torus rejects
+    local_kernel='pallas', and a pinned config that raises would demote a
+    healthy-TPU capture to the CPU-degrade path."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    assert bench.default_tpu_local_kernel("conway", False) == "pallas"
+    assert bench.default_tpu_local_kernel("highlife", False) == "pallas"
+    assert bench.default_tpu_local_kernel("conway", True) is None
+    assert bench.default_tpu_local_kernel("conway:T", False) is None
+    assert bench.default_tpu_local_kernel("R2,C2,S2..4,B2..3,NN", False) is None
+    assert bench.default_tpu_local_kernel("brians_brain", False) is None
+    assert bench.default_tpu_local_kernel("bugs", False) is None
+
+
 @pytest.mark.slow
 def test_bench_crash_mode_retries_survive_budget_guard(tmp_path):
     """A natively short crash-mode gap (30s default, 1s here) must NOT trip
