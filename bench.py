@@ -440,37 +440,19 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
         and platform == "tpu"
         and not args.no_parity
     ):
-        import statistics
+        from tpu_life.backends.base import measure_parity_interleaved
 
-        from tpu_life.backends.base import make_runner
-        from tpu_life.utils.timing import paired_delta_seconds_per_step
-
-        single_backend = get_backend("pallas", bitpack=not args.no_bitpack)
-        r_comp = make_runner(composed_backend, board, rule)
-        r_single = make_runner(single_backend, board, rule)
-        pairs = paired_delta_seconds_per_step(
-            r_comp, r_single, args.steps, args.base_steps,
-            repeats=max(3, args.repeats),
-        )
-        if pairs:
-            mesh = getattr(composed_backend, "mesh", None)
-            n_chips_comp = int(mesh.devices.size) if mesh is not None else 1
-            # per-pair ratio: composed per-chip over single-chip throughput,
-            # drift-cancelled because both deltas sit in the same window
-            ratios = [
-                d_single / (d_comp * n_chips_comp) for d_comp, d_single in pairs
-            ]
-            comp_deltas = [d for d, _ in pairs]
-            result["parity_single_chip"] = (
-                args.size * args.size / min(d for _, d in pairs)
+        result.update(
+            measure_parity_interleaved(
+                composed_backend,
+                get_backend("pallas", bitpack=not args.no_bitpack),
+                board,
+                rule,
+                args.steps,
+                args.base_steps,
+                repeats=max(3, args.repeats),
             )
-            result["parity_ratio"] = statistics.median(ratios)
-            result["parity_pairs"] = len(pairs)
-            result["parity_window_spread"] = max(comp_deltas) / min(comp_deltas)
-            result["parity_ok"] = result["parity_ratio"] >= 0.8
-        else:
-            result["parity_ratio"] = None
-            result["parity_ok"] = False
+        )
     return result
 
 

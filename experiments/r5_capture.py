@@ -74,9 +74,13 @@ def main() -> None:
         print(f"# WARNING: platform is {platform!r}, not tpu — numbers are "
               "not capture-grade")
 
-    from tpu_life.backends.base import get_backend, make_runner, measure_throughput
+    from tpu_life.backends.base import (
+        get_backend,
+        make_runner,
+        measure_parity_interleaved,
+        measure_throughput,
+    )
     from tpu_life.models.rules import get_rule
-    from tpu_life.utils.timing import paired_delta_seconds_per_step
 
     n = args.size
     rng = np.random.default_rng(0)
@@ -84,10 +88,8 @@ def main() -> None:
     conway = get_rule("conway")
 
     # ---- leg 1: headline + interleaved parity --------------------------------
-    import statistics
-
     composed = get_backend("sharded", local_kernel="pallas")
-    headline, n_chips = measure_throughput(
+    headline, _ = measure_throughput(
         composed, board, conway, args.steps, args.base_steps, args.repeats
     )
     # persist the expensive headline number BEFORE the parity stats can
@@ -98,30 +100,24 @@ def main() -> None:
         "vs_1e11_target": headline / 1e11,
     }
     save(results)
-    r_comp = make_runner(composed, board, conway)
-    r_single = make_runner(get_backend("pallas"), board, conway)
-    pairs = paired_delta_seconds_per_step(
-        r_comp, r_single, args.steps, args.base_steps, repeats=args.repeats
-    )
-    ratios = [ds / (dc * n_chips) for dc, ds in pairs]
-    comp_deltas = [dc for dc, _ in pairs]
-    if pairs:
-        results["legs"]["headline"].update(
-            parity_ratio_median_paired=statistics.median(ratios),
-            parity_ratios=ratios,
-            parity_window_spread=max(comp_deltas) / min(comp_deltas),
-            parity_in_band=0.95 <= statistics.median(ratios) <= 1.05,
+    # THE shared parity methodology (same helper bench.py uses)
+    results["legs"]["headline"].update(
+        measure_parity_interleaved(
+            composed, get_backend("pallas"), board, conway,
+            args.steps, args.base_steps, repeats=args.repeats,
         )
-    else:
-        results["legs"]["headline"]["parity_pairs_all_noise"] = True
-    del r_comp, r_single
+    )
     save(results)
 
     # ---- leg 2: torus vs clamped, packed XLA vs composed Pallas --------------
     torus_rule = get_rule("conway:T")
     legs2 = {}
     for name, backend, rule in [
-        ("torus_packed_xla", get_backend("sharded"), torus_rule),
+        # local_kernel pinned per leg: auto would route the torus to the
+        # new Pallas torus kernel and conflate the wrap cost with the
+        # Pallas-vs-XLA kernel gap the _xla isolate exists to exclude
+        ("torus_packed_xla", get_backend("sharded", local_kernel="xla"), torus_rule),
+        ("torus_pallas", get_backend("sharded", local_kernel="pallas"), torus_rule),
         ("clamped_packed_xla", get_backend("sharded", local_kernel="xla"), conway),
         ("clamped_composed_pallas", get_backend("sharded", local_kernel="pallas"), conway),
     ]:
@@ -133,8 +129,8 @@ def main() -> None:
     legs2["torus_vs_clamped_xla"] = (
         legs2["torus_packed_xla"] / legs2["clamped_packed_xla"]
     )
-    legs2["torus_vs_composed_pallas"] = (
-        legs2["torus_packed_xla"] / legs2["clamped_composed_pallas"]
+    legs2["torus_pallas_vs_composed_pallas"] = (
+        legs2["torus_pallas"] / legs2["clamped_composed_pallas"]
     )
     # the VERDICT criterion isolates the TORUS cost: same XLA local
     # kernel, same packed layout, only the boundary differs — the

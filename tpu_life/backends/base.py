@@ -166,6 +166,49 @@ def measure_throughput(
     return h * w / per_step / n_chips, n_chips
 
 
+def measure_parity_interleaved(
+    composed: "Backend",
+    single: "Backend",
+    board: np.ndarray,
+    rule: Rule,
+    steps: int,
+    base_steps: int,
+    repeats: int = 6,
+) -> dict:
+    """THE parity methodology (VERDICT r4 item 2), shared by ``bench.py``
+    and ``experiments/r5_capture.py`` so their verdicts cannot drift:
+    back-to-back (composed, single) delta pairs cancel chip-window wobble;
+    the reported ratio is the median per-pair composed-per-chip over
+    single-chip throughput.  Returns the ``parity_*`` record fields
+    (``parity_ratio`` None when every pair was timer noise).
+    """
+    import statistics
+
+    from tpu_life.utils.timing import paired_delta_seconds_per_step
+
+    r_comp = make_runner(composed, board, rule)
+    r_single = make_runner(single, board, rule)
+    pairs = paired_delta_seconds_per_step(
+        r_comp, r_single, steps, base_steps, repeats=repeats
+    )
+    if not pairs:
+        return {"parity_ratio": None, "parity_ok": False}
+    mesh = getattr(composed, "mesh", None)
+    n_chips = int(mesh.devices.size) if mesh is not None else 1
+    ratios = [d_single / (d_comp * n_chips) for d_comp, d_single in pairs]
+    comp_deltas = [d for d, _ in pairs]
+    h, w = board.shape
+    ratio = statistics.median(ratios)
+    return {
+        "parity_single_chip": h * w / min(d for _, d in pairs),
+        "parity_ratio": ratio,
+        "parity_pairs": len(pairs),
+        "parity_window_spread": max(comp_deltas) / min(comp_deltas),
+        "parity_ok": ratio >= 0.8,
+        "parity_in_band": 0.95 <= ratio <= 1.05,
+    }
+
+
 BACKENDS: dict[str, Callable[..., Backend]] = {}
 
 

@@ -194,7 +194,12 @@ def _zero_frame(y: jax.Array, fr: int, fc: int) -> jax.Array:
 
 
 def _packed_tile_advance(
-    rule: Rule, tile_shape: tuple[int, int], logical: tuple[int, int], block_steps: int
+    rule: Rule,
+    tile_shape: tuple[int, int],
+    logical: tuple[int, int],
+    block_steps: int,
+    *,
+    torus: bool = False,
 ) -> Callable[[jax.Array, jax.Array | int], jax.Array]:
     """``advance(tile, row0) -> tile`` after ``block_steps`` masked bit-sliced
     substeps, for use *inside* a Pallas kernel on a VMEM-resident tile.
@@ -209,6 +214,15 @@ def _packed_tile_advance(
     (``bitlife._vshift``): wrong only on the halo fringe, which callers
     discard.  Cells beyond the logical board (lane padding, the last partial
     word, halo rows past the edges) are re-masked dead every substep.
+
+    ``torus=True`` swaps the seam semantics (the VMEM twin of
+    ``bitlife.make_torus_hshifts``): the lane-0 carry comes from the last
+    LOGICAL word — bit ``rem-1`` re-aligned to bit 31 when the width is
+    not word-aligned — and the last logical word's top valid bit receives
+    column 0; ``pltpu.roll``'s physical wraparound alone would wrap at the
+    lane-PADDED width, through the dead padding words.  Row wrap arrives
+    as halo rows from the closed ppermute ring, so the row mask drops out
+    (every tile row is real board content) while the column mask stays.
     """
     ext_r, wp = tile_shape
     lh, lw = logical
@@ -216,20 +230,48 @@ def _packed_tile_advance(
     partial = np.uint32((1 << rem_bits) - 1)
     u0 = np.uint32(0)
     ones32 = np.uint32(0xFFFFFFFF)
+    # lane index of the last LOGICAL word and its top valid bit
+    last_idx = full_words if rem_bits else full_words - 1
+    top_bit = (rem_bits or bitlife.WORD) - 1
 
     def advance(tile: jax.Array, row0) -> jax.Array:
         lane = lax.broadcasted_iota(jnp.int32, (ext_r, wp), 1)
         rows = lax.broadcasted_iota(jnp.int32, (ext_r, wp), 0) + row0
         first_lane = lane == 0
         last_lane = lane == wp - 1
+        last_logical = lane == last_idx
 
-        def hshift_left(x):  # L[c] = x[c-1]; no left word at lane 0
-            carry = jnp.where(first_lane, u0, pltpu.roll(x, 1, axis=1))
-            return (x << 1) | (carry >> 31)
+        if torus:
 
-        def hshift_right(x):  # R[c] = x[c+1]; no right word at the last lane
-            carry = jnp.where(last_lane, u0, pltpu.roll(x, wp - 1, axis=1))
-            return (x >> 1) | (carry << 31)
+            def hshift_left(x):  # L[c] = x[(c-1) mod lw]: seam wraps
+                # roll(x, wp - last_idx) puts the last logical word at
+                # lane 0; << re-aligns its top valid bit to bit 31
+                wrap = pltpu.roll(x, wp - last_idx, axis=1) << (31 - top_bit)
+                carry = jnp.where(
+                    first_lane, wrap, pltpu.roll(x, 1, axis=1)
+                )
+                return (x << 1) | (carry >> 31)
+
+            def hshift_right(x):  # R[c] = x[(c+1) mod lw]
+                carry = jnp.where(
+                    last_logical, u0, pltpu.roll(x, wp - 1, axis=1)
+                )
+                base = (x >> 1) | (carry << 31)
+                # roll(x, last_idx) puts word 0 at lane last_idx; its bit 0
+                # becomes the top valid bit of the last logical word
+                wrap0 = pltpu.roll(x, last_idx, axis=1)
+                wrapped = (x >> 1) | ((wrap0 & 1) << top_bit)
+                return jnp.where(last_logical, wrapped, base)
+
+        else:
+
+            def hshift_left(x):  # L[c] = x[c-1]; no left word at lane 0
+                carry = jnp.where(first_lane, u0, pltpu.roll(x, 1, axis=1))
+                return (x << 1) | (carry >> 31)
+
+            def hshift_right(x):  # R[c] = x[c+1]; no right word at the last lane
+                carry = jnp.where(last_lane, u0, pltpu.roll(x, wp - 1, axis=1))
+                return (x >> 1) | (carry << 31)
 
         step = bitlife.make_packed_step(
             rule,
@@ -242,7 +284,10 @@ def _packed_tile_advance(
         colmask = jnp.where(
             lane < full_words, ones32, jnp.where(lane == full_words, partial, u0)
         )
-        mask = jnp.where((rows >= 0) & (rows < lh), colmask, u0)
+        if torus:
+            mask = colmask  # halo rows are wrapped board content: all valid
+        else:
+            mask = jnp.where((rows >= 0) & (rows < lh), colmask, u0)
 
         def body(_, x):
             return step(x) & mask
@@ -325,6 +370,7 @@ def make_pallas_sharded_stripe_block(
     block_rows: int,
     block_steps: int,
     interpret: bool = False,
+    torus: bool = False,
 ) -> Callable[..., jax.Array]:
     """The per-shard twin of :func:`make_pallas_packed_multi_step`.
 
@@ -350,7 +396,9 @@ def make_pallas_sharded_stripe_block(
             f"block_rows {block_rows} < halo depth {fr}: edge-tile DMA "
             "stitching needs block_rows >= fr"
         )
-    advance = _packed_tile_advance(rule, (ext_r, wp), logical, block_steps)
+    advance = _packed_tile_advance(
+        rule, (ext_r, wp), logical, block_steps, torus=torus
+    )
 
     def kernel(row0_ref, top_hbm, x_hbm, bot_hbm, out_hbm, scratch, in_sems, out_sem):
         i = pl.program_id(0)
@@ -453,11 +501,14 @@ def _sharded_epoch_loop(
     col_axis: str | None = None,
     fc: int = 0,
     halo_cols: int = 0,
+    periodic: bool = False,
 ) -> Callable[[jax.Array, int], jax.Array]:
-    """Shared scaffold for the sharded Pallas runs: non-periodic ``ppermute``
-    row halos (skipped entirely on one-shard axes, where both neighbors are
-    off the mesh end — VERDICT r3 item 2), a ``lax.scan`` over deep-halo
-    blocks, and the jit + shard_map wrapper.
+    """Shared scaffold for the sharded Pallas runs: ``ppermute`` row halos
+    — non-periodic by default (skipped entirely on one-shard axes, where
+    both neighbors are off the mesh end — VERDICT r3 item 2), a closed
+    ring with ``periodic=True`` (the packed torus; fc == 0 convention
+    only) — a ``lax.scan`` over deep-halo blocks, and the jit + shard_map
+    wrapper.
 
     Two kernel conventions, switched on ``fc``:
 
@@ -489,8 +540,14 @@ def _sharded_epoch_loop(
     n_r = mesh.shape[row_axis]
     split_cols = col_axis is not None and mesh.shape.get(col_axis, 1) > 1
     n_c = mesh.shape[col_axis] if split_cols else 1
-    fwd_r = [(i, i + 1) for i in range(n_r - 1)]
-    bwd_r = [(i + 1, i) for i in range(n_r - 1)]
+    if periodic:
+        # the closed ring: the wrap pair the clamped exchange omits
+        # (tpu_life.parallel.halo.make_sharded_run_torus's ppermute shape)
+        fwd_r = [(i, (i + 1) % n_r) for i in range(n_r)]
+        bwd_r = [((i + 1) % n_r, i) for i in range(n_r)]
+    else:
+        fwd_r = [(i, i + 1) for i in range(n_r - 1)]
+        bwd_r = [(i + 1, i) for i in range(n_r - 1)]
     fwd_c = [(i, i + 1) for i in range(n_c - 1)]
     bwd_c = [(i + 1, i) for i in range(n_c - 1)]
 
@@ -514,9 +571,16 @@ def _sharded_epoch_loop(
 
         def block(c: jax.Array) -> jax.Array:
             if n_r == 1:
-                top = bot = zero_rows
+                if periodic:
+                    # one shard: its own edges ARE the wrap neighbors
+                    top = c[hl - fr :, :]
+                    bot = c[:fr, :]
+                else:
+                    top = bot = zero_rows
             else:
-                # ppermute zero-fills at the mesh ends = clamped dead boundary
+                # clamped: ppermute zero-fills at the mesh ends = the dead
+                # boundary; periodic: the ring is closed, every shard has
+                # both neighbors
                 top = lax.ppermute(c[hl - fr :, :], row_axis, fwd_r)
                 bot = lax.ppermute(c[:fr, :], row_axis, bwd_r)
             if not fc:
@@ -588,6 +652,7 @@ def make_sharded_pallas_run(
     block_rows: int = 256,
     row_axis: str | None = None,
     interpret: bool = False,
+    torus: bool = False,
 ) -> Callable[[jax.Array, int], jax.Array]:
     """``run(board, num_blocks)``: the sharded epoch loop with the Pallas
     stripe kernel as the local stepper — single-chip kernel throughput on a
@@ -625,9 +690,10 @@ def make_sharded_pallas_run(
             block_rows=block_rows,
             block_steps=block_steps,
             interpret=interpret,
+            torus=torus,
         )
 
-    return _sharded_epoch_loop(mesh, row_axis, fr, make_block)
+    return _sharded_epoch_loop(mesh, row_axis, fr, make_block, periodic=torus)
 
 
 def sharded_pallas_int8_frame(rule: Rule, block_steps: int) -> tuple[int, int]:

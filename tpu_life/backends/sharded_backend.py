@@ -390,11 +390,6 @@ class ShardedBackend:
             raise ValueError(
                 "torus boundary needs partition_mode='shard_map'"
             )
-        if self.local_kernel == "pallas":
-            raise ValueError(
-                "the Pallas kernels count clamped boxes; torus rules need "
-                "local_kernel='xla' (or 'auto')"
-            )
         if h % self.n != 0:
             raise ValueError(
                 f"torus boundary needs the board height ({h}) divisible by "
@@ -405,6 +400,66 @@ class ShardedBackend:
 
         use_bits = self._use_bits(rule)
         shard_h = h // self.n
+
+        # the Pallas stripe kernel has a torus variant (seam carries wrap
+        # at the logical width, closed ppermute ring): take it whenever
+        # the packed layout fits its tiling with NO padded rows (padding
+        # rows would sit inside the glued seam; lane-padding words are
+        # fine — the kernel's wrap addresses the last LOGICAL word).
+        pallas_ok = False
+        tiling = None
+        w_phys = 0
+        if self.local_kernel == "pallas" and not use_bits:
+            # an explicit pallas pin must never silently run the int8 scan
+            raise ValueError(
+                "local_kernel='pallas' on a torus needs the packed "
+                "bitboard (life-like rule + bitpack); use "
+                "local_kernel='xla'"
+            )
+        if use_bits:
+            want_pallas = self.local_kernel == "pallas" or (
+                self.local_kernel in (None, "auto")
+                and self.partition_mode == "shard_map"
+                and not self._pallas_interp()
+            )
+            if want_pallas:
+                rows_exact = shard_h % SUBLANE == 0
+                w_phys = ceil_to(bitlife.packed_width(w), LANE)
+                if rows_exact:
+                    tiling = self._pallas_tiling(h, w_phys, rule, cells=h * w)
+                pallas_ok = tiling is not None and tiling[3] == shard_h
+                if not pallas_ok and self.local_kernel == "pallas":
+                    raise ValueError(
+                        "the Pallas torus stripe kernel needs sublane-exact "
+                        f"shards (board height {h} over {self.n} devices "
+                        f"gives {shard_h}-row shards; need a multiple of "
+                        f"{SUBLANE}) and a VMEM-feasible tiling; use "
+                        "local_kernel='xla'"
+                    )
+
+        if pallas_ok:
+            from tpu_life.backends.pallas_backend import make_sharded_pallas_run
+
+            block_rows, block_steps, _, _ = tiling
+            interp = self._pallas_interp()
+            x = self._device_put_stream(load_rows, h, w, h, w_phys, use_bits=True)
+            wp = bitlife.packed_width(w)
+            return self._blocked_runner(
+                x,
+                block_steps,
+                lambda bs: make_sharded_pallas_run(
+                    rule,
+                    self.mesh,
+                    (h, w),
+                    block_steps=bs,
+                    block_rows=block_rows,
+                    interpret=interp,
+                    torus=True,
+                ),
+                lambda x: bitlife.unpack_np(np.asarray(x)[:, :wp], w),
+                bitlife.live_count_packed,
+            )
+
         block_steps = max(
             1, min(self.block_steps, shard_h // max(1, rule.radius))
         )
