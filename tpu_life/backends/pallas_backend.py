@@ -273,10 +273,30 @@ def _packed_tile_advance(
                 carry = jnp.where(last_lane, u0, pltpu.roll(x, wp - 1, axis=1))
                 return (x >> 1) | (carry << 31)
 
-        step = bitlife.make_packed_step(
-            rule,
-            bitlife.make_total_planes(hshift_left, hshift_right, bitlife._vshift),
-        )
+        if rule.neighborhood == "von_neumann" and not torus:
+            # the bit-sliced diamond in VMEM: shift-by-k lane rolls (the
+            # adjacent-word carry is the same roll(x, 1) for any k <= 32),
+            # board-edge carries clamped like the Moore shifts above
+            # (torus diamonds are excluded upstream: supports_torus is
+            # Moore-only, supports_diamond clamped-only)
+            def hshift_left_by(x, k):
+                carry = jnp.where(first_lane, u0, pltpu.roll(x, 1, axis=1))
+                return (x << k) | (carry >> (32 - k))
+
+            def hshift_right_by(x, k):
+                carry = jnp.where(last_lane, u0, pltpu.roll(x, wp - 1, axis=1))
+                return (x >> k) | (carry << (32 - k))
+
+            step = bitlife.make_packed_diamond_step(
+                rule, hshift_left_by, hshift_right_by, bitlife._vshift_by
+            )
+        else:
+            step = bitlife.make_packed_step(
+                rule,
+                bitlife.make_total_planes(
+                    hshift_left, hshift_right, bitlife._vshift
+                ),
+            )
         # iota/where restatement of the in-board word mask that
         # bitlife.make_masked_packed_step builds from word offsets: a captured
         # constant array is rejected by pallas_call, so the mask is rebuilt
@@ -938,10 +958,13 @@ class PallasBackend:
     # scoped VMEM room for the adder tree's temporaries
     MAX_PACKED_TILE_BYTES = 2 << 20
 
-    def _packed_tiling(self, h: int, w: int) -> tuple[int, int, int] | None:
+    def _packed_tiling(
+        self, h: int, w: int, radius: int = 1
+    ) -> tuple[int, int, int] | None:
         """(block_rows, block_steps, fr) for the packed stripe kernel, or
         None when no full-width stripe fits the VMEM budget (very wide
-        boards fall back to the column-tiled int8 kernel)."""
+        boards fall back to the column-tiled int8 kernel).  ``radius``
+        scales the halo (the bit-sliced diamond runs r=2 stripes too)."""
         wp = ceil_to(bitlife.packed_width(w), LANE)
         ext_budget = self.MAX_PACKED_TILE_BYTES // (wp * 4) // SUBLANE * SUBLANE
         if self._block_steps_arg is None:
@@ -949,9 +972,13 @@ class PallasBackend:
         else:
             want = max(1, self._block_steps_arg)
         for k in range(want, 0, -1):
-            fr = ceil_to(k, SUBLANE)
+            fr = ceil_to(radius * k, SUBLANE)
             block_rows = min(self.block_rows, ext_budget - 2 * fr)
-            if block_rows >= SUBLANE and k <= block_rows // 4 and h >= block_rows:
+            if (
+                block_rows >= SUBLANE
+                and radius * k <= block_rows // 4
+                and h >= block_rows
+            ):
                 return block_rows, k, fr
         return None
 
@@ -1036,10 +1063,19 @@ class PallasBackend:
     def prepare(self, board: np.ndarray, rule: Rule) -> Runner:
         h, w = board.shape
         logical = (h, w)
+        if self.bitpack and bitlife.supports_diamond(rule):
+            # 2-state clamped von Neumann: the stripe kernel runs the
+            # bit-sliced diamond in VMEM (roll shift-by-k planes under the
+            # same CSA reduction); small boards fall back to the fused XLA
+            # packed diamond scan inside _xla_scan_runner
+            tiling = self._packed_tiling(h, w, radius=rule.radius)
+            if tiling is not None:
+                return self._prepare_packed(board, rule, tiling)
+            return self._xla_scan_runner(board, rule, logical)
         if rule.neighborhood != "moore" or rule.boundary != "clamped":
-            # both Pallas kernels count clamped box sums; von Neumann
-            # diamonds and torus wraparound run on the fused XLA scan
-            # (whose stencil supports them)
+            # the remaining Pallas kernels count clamped Moore boxes;
+            # other diamonds and torus wraparound run on the fused XLA
+            # scan (whose stencil supports them) or its packed variants
             return self._xla_scan_runner(board, rule, logical)
         if self.bitpack and bitlife.supports(rule):
             tiling = self._packed_tiling(h, w)

@@ -245,17 +245,10 @@ class ShardedBackend:
         elif self.partition_mode != "shard_map" or self._pallas_interp():
             return None
         if use_bits:
-            if rule.neighborhood == "von_neumann":
-                # the packed diamond runs the XLA scan; no Pallas twin yet
-                if self.local_kernel == "pallas":
-                    raise ValueError(
-                        "the Pallas kernels count Moore boxes only; von "
-                        "Neumann rules need local_kernel='xla' (the packed "
-                        "diamond runs the XLA scan)"
-                    )
-                return None
             # packed stripes are full-width: on a 2-D mesh `auto` keeps the
-            # packed XLA scan (8x less HBM) over unpacked int8 Pallas
+            # packed XLA scan (8x less HBM) over unpacked int8 Pallas.
+            # Covers the bit-sliced diamond too — the stripe kernel runs
+            # von Neumann r<=2 rules via roll shift-by-k planes.
             return "packed" if self.n_cols == 1 else None
         return "int8"
 

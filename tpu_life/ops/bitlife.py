@@ -424,7 +424,12 @@ def _reduce_planes(
     return tuple(out)
 
 
-def make_packed_diamond_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
+def make_packed_diamond_step(
+    rule: Rule,
+    hshift_left_by: Callable | None = None,
+    hshift_right_by: Callable | None = None,
+    vshift_by: Callable | None = None,
+) -> Callable[[jax.Array], jax.Array]:
     """One 2-state von Neumann step on a packed bitboard (clamped).
 
     The diamond is a stack of 2r+1 horizontal boxes of half-width
@@ -444,12 +449,23 @@ def make_packed_diamond_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
     separable" fallback shrug (BASELINE.md r4, von Neumann row).
     Generalizes ``countNeighbours`` (Parallel_Life_MPI.cpp:16-35) to the
     ``NN`` neighborhood the reference never had.
+
+    The three shift callables are pluggable exactly like
+    :func:`make_total_planes`'s: defaults are the XLA pad/concat clamped
+    shifts; the Pallas tile kernel substitutes ``pltpu.roll``-based lane
+    shifts with board-edge carries masked — same reduction, two executors.
     """
     if not supports_diamond(rule):
         raise ValueError(
             f"packed diamond path needs a 2-state clamped von Neumann rule "
             f"with count_max <= 15, got {rule}"
         )
+    if hshift_left_by is None:
+        hshift_left_by = _hshift_left_by
+    if hshift_right_by is None:
+        hshift_right_by = _hshift_right_by
+    if vshift_by is None:
+        vshift_by = _vshift_by
     r = rule.radius
     count_max = 2 * r * (r + 1) + (1 if rule.include_center else 0)
     from tpu_life.ops.boolmin import membership_rule_sop
@@ -462,8 +478,8 @@ def make_packed_diamond_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
         box: dict[int, list[tuple[jax.Array, int]]] = {0: [(x, 0)]}
         arms: list[tuple[jax.Array, int]] = []  # L/R shifts, no center
         for k in range(1, r + 1):
-            arms.append((_hshift_left_by(x, k), 0))
-            arms.append((_hshift_right_by(x, k), 0))
+            arms.append((hshift_left_by(x, k), 0))
+            arms.append((hshift_right_by(x, k), 0))
             if k < r:  # box[r] would be dead: rows use half <= r-1
                 box[k] = _collapse(box[k - 1] + arms[-2:])
         weighted: list[tuple[jax.Array, int]] = []
@@ -475,7 +491,7 @@ def make_packed_diamond_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
                     weighted.append((x, 0))
             else:
                 weighted.extend(
-                    (_vshift_by(p, dy), w) for p, w in box[half]
+                    (vshift_by(p, dy), w) for p, w in box[half]
                 )
         planes = _reduce_planes(weighted)
         planes = planes[:nplanes] + (jnp.zeros_like(x),) * max(
