@@ -144,6 +144,29 @@ def test_diamond_packed_step_has_exactly_one_ppermute_pair():
     assert_exact_permutes(txt, 2, "diamond packed")
 
 
+@pytest.mark.parametrize(
+    "spec, torus",
+    [("conway:T", True), ("R2,C2,S2..4,B2..3,NN", False)],
+    ids=["pallas-torus", "pallas-diamond"],
+)
+def test_composed_pallas_variants_census(spec, torus):
+    """The stripe kernel's torus and diamond modes keep the same
+    collective census as the Moore composition: the kernel swap and the
+    ring closure change permutation pairs, never the collective count."""
+    from tpu_life.backends.pallas_backend import make_sharded_pallas_run
+
+    mesh = make_mesh(8)
+    rule = get_rule(spec)
+    h, w = 512, 4096
+    run = make_sharded_pallas_run(
+        rule, mesh, (h, w), block_steps=2, block_rows=32, interpret=True,
+        torus=torus,
+    )
+    shape = (h, bitlife.packed_width(w))
+    txt = compile_run(run, shape, jnp.uint32, mesh, P("rows", None))
+    assert_exact_permutes(txt, 2, f"composed pallas {spec}")
+
+
 def test_metrics_reduction_is_the_only_allowed_collective_reduce():
     """live_count_packed on a sharded board: its own compiled function
     carries the one sanctioned cross-device reduction — and it is NOT part

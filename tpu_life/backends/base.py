@@ -223,11 +223,14 @@ def register_backend(name: str):
 def get_backend(name: str, *, rule: Rule | None = None, **kwargs) -> Backend:
     """Instantiate a backend by name; ``auto`` prefers accelerated paths.
 
-    ``rule`` is an optional hint for ``auto``: torus rules resolve to a
-    single-device backend even on multi-device hosts, because the sharded
-    torus path carries constraints (1-D mesh, height divisible by the
-    mesh) that ``auto`` cannot guarantee — auto must never raise.  Pass
-    ``--backend sharded`` explicitly to opt into the mesh torus.
+    ``rule`` is an optional hint for ``auto``: on MULTI-device hosts torus
+    rules resolve to a single-device backend, because the sharded torus
+    path carries constraints (1-D mesh, height divisible by the mesh)
+    that ``auto`` cannot guarantee — auto must never raise.  Pass
+    ``--backend sharded`` explicitly to opt into the mesh torus.  On ONE
+    device every constraint holds trivially (h % 1 == 0, the mesh is
+    1-D), so single-device torus runs DO take the sharded backend — on
+    TPU that is the Pallas torus stripe kernel, the fastest torus path.
     """
     # import for registration side effects
     from tpu_life.backends import numpy_backend, jax_backend, sharded_backend  # noqa: F401
@@ -238,6 +241,21 @@ def get_backend(name: str, *, rule: Rule | None = None, **kwargs) -> Backend:
         devices = jax.devices()
         torus = rule is not None and rule.boundary == "torus"
         if len(devices) > 1 and not torus:
+            name = "sharded"
+        elif (
+            torus
+            and len(devices) == 1
+            and devices[0].platform == "tpu"
+            and kwargs.get("partition_mode") in (None, "shard_map")
+            and kwargs.get("local_kernel") != "pallas"
+        ):
+            # n=1 mesh: the MESH torus constraints are vacuous and the
+            # sharded backend carries the Pallas torus kernel (tiling
+            # permitting; it degrades to the packed XLA torus scan
+            # itself).  User-pinned kwargs that can make _prepare_torus
+            # raise (gspmd, an explicit pallas pin on an infeasible
+            # board) keep the old single-device routing instead — auto
+            # must never raise.
             name = "sharded"
         elif devices[0].platform == "tpu":
             # the Pallas deep-halo kernels are the fastest single-chip path
