@@ -217,6 +217,33 @@ def test_pallas_torus_glider_circumnavigates_seams():
     np.testing.assert_array_equal(be.run(b, rule, 64), b)
 
 
+@pytest.mark.slow
+def test_packed_torus_every_width_1_to_40(rng_board):
+    """Exhaustive width sweep across the word-boundary space (1..40 covers
+    sub-word, exact-word, and word+remainder layouts): one packed torus
+    step must equal the oracle at EVERY width — the seam carries special-
+    case rem==0 vs rem>0 and wp==1 vs wp>1, and an off-by-one in any
+    branch shows up at some width in this range."""
+    import jax.numpy as jnp
+
+    from tpu_life.ops import bitlife
+
+    rule = get_rule("conway:T")
+    for w in range(1, 41):
+        board = rng_board(12, w, seed=w)
+        got = bitlife.unpack_np(
+            np.asarray(
+                bitlife.multi_step_packed_torus(
+                    jnp.asarray(bitlife.pack_np(board)), rule=rule, steps=3, width=w
+                )
+            ),
+            w,
+        )
+        np.testing.assert_array_equal(
+            got, run_np(board, rule, 3), err_msg=f"width={w}"
+        )
+
+
 def test_packed_torus_respects_bitpack_flag(rng_board):
     from tpu_life.backends.base import get_backend, make_runner
     import jax

@@ -229,6 +229,34 @@ def test_packed_diamond_bit_identical(spec, shape, rng_board):
     np.testing.assert_array_equal(got, run_np(board, rule, 9))
 
 
+@pytest.mark.slow
+def test_packed_diamond_every_width_1_to_40(rng_board):
+    """Exhaustive width sweep (sub-word through word+remainder): one
+    packed diamond step per width vs the oracle — the k=2 arm shifts
+    cross word boundaries differently at every layout class."""
+    import jax.numpy as jnp
+
+    from tpu_life.ops import bitlife
+
+    rule = get_rule(VN_SPEC)
+    for w in range(1, 41):
+        board = rng_board(12, w, seed=100 + w)
+        got = bitlife.unpack_np(
+            np.asarray(
+                bitlife.multi_step_packed_diamond(
+                    jnp.asarray(bitlife.pack_np(board)),
+                    rule=rule,
+                    steps=3,
+                    logical_shape=(12, w),
+                )
+            ),
+            w,
+        )
+        np.testing.assert_array_equal(
+            got, run_np(board, rule, 3), err_msg=f"width={w}"
+        )
+
+
 def test_pallas_backend_fallback_runs_packed_diamond(rng_board):
     """`auto` resolves single-chip TPU runs to the pallas backend; its
     XLA-scan fallback must stage the packed diamond/torus runners, not the
