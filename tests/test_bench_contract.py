@@ -276,8 +276,9 @@ def test_graft_entry_contract():
     with contextlib.redirect_stdout(buf):
         g.dryrun_multichip(8)
     legs = [l for l in buf.getvalue().splitlines() if l.startswith("dryrun leg")]
-    assert len(legs) == 10, legs
+    assert len(legs) == 11, legs
     assert all(l.endswith(": ok") for l in legs)
-    assert any("packed-torus" in l for l in legs)
+    assert any("packed-torus-1d" in l for l in legs)
     assert any("pallas-torus" in l for l in legs)
     assert any("pallas-diamond" in l for l in legs)
+    assert any("torus-2d-mesh" in l for l in legs)

@@ -99,6 +99,20 @@ def test_2d_mesh_int8_step_has_exactly_two_ppermute_pairs():
     assert_exact_permutes(txt, 4, "2-D int8 LtL")
 
 
+def test_torus_2d_mesh_has_exactly_two_ppermute_pairs():
+    """The fully-ring-closed 2-D torus costs the same census as the
+    clamped 2-D exchange: two pairs, nothing else."""
+    from tpu_life.parallel.halo import make_sharded_run_torus_2d
+
+    mesh = make_mesh_2d((2, 4))
+    rule = get_rule("conway:T")
+    h, w = 64, 256
+    run = make_sharded_run_torus_2d(rule, mesh, (h, w), block_steps=2)
+    shape = (h, bitlife.packed_width(w))
+    txt = compile_run(run, shape, jnp.uint32, mesh, P("rows", "cols"))
+    assert_exact_permutes(txt, 4, "2-D torus packed")
+
+
 @pytest.mark.parametrize("packed", [True, False], ids=["packed", "int8"])
 def test_torus_ring_has_exactly_one_ppermute_pair(packed):
     """The closed ring costs the same census as the clamped exchange: the

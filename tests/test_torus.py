@@ -217,6 +217,78 @@ def test_pallas_torus_glider_circumnavigates_seams():
     np.testing.assert_array_equal(be.run(b, rule, 64), b)
 
 
+@pytest.mark.parametrize("mesh_shape", [(2, 2), (2, 4), (4, 2)])
+def test_torus_2d_mesh_bit_identical(mesh_shape, rng_board):
+    """The 2-D-mesh torus: closed rings on BOTH axes, no in-shard wrap —
+    bit-identical to the oracle across row seams, word-column seams, and
+    the glued board edges at once."""
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < mesh_shape[0] * mesh_shape[1]:
+        pytest.skip("needs enough fake devices")
+    rule = get_rule("conway:T")
+    board = rng_board(32, 128, seed=sum(mesh_shape))
+    be = get_backend("sharded", mesh_shape=mesh_shape)
+    np.testing.assert_array_equal(
+        be.run(board, rule, 10), run_np(board, rule, 10)
+    )
+
+
+def test_torus_2d_mesh_glider_circumnavigates():
+    """256 steps on a 64x64 torus over a (2,2) mesh: the glider moves
+    (+1,+1) per 4 steps, so 256 steps = +64 rows +64 cols — one full
+    circumnavigation across row seams, word-column seams, and both glued
+    edges, landing exactly on its start."""
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    rule = get_rule("conway:T")
+    b = patterns.place(patterns.empty(64, 64), patterns.GLIDER, 30, 30)
+    be = get_backend("sharded", mesh_shape=(2, 2))
+    out = be.run(b, rule, 256)  # 256 steps = +64,+64: full circumnavigation
+    np.testing.assert_array_equal(out, b)
+
+
+def test_torus_2d_mesh_deep_halo_blocking(rng_board):
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    rule = get_rule("conway:T")
+    board = rng_board(24, 64, seed=61)
+    be = get_backend("sharded", mesh_shape=(2, 2), block_steps=4)
+    np.testing.assert_array_equal(
+        be.run(board, rule, 12), run_np(board, rule, 12)
+    )
+
+
+def test_torus_2d_mesh_constraint_errors(rng_board):
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    rule = get_rule("conway:T")
+    # width 24: not word-aligned -> the seam would cut a partial word
+    with pytest.raises(ValueError, match="1-D"):
+        get_backend("sharded", mesh_shape=(2, 2)).run(
+            rng_board(24, 24, seed=29), rule, 1
+        )
+    # multistate torus has no packed path -> 2-D mesh refuses
+    with pytest.raises(ValueError, match="1-D"):
+        get_backend("sharded", mesh_shape=(2, 2)).run(
+            rng_board(24, 64, seed=30, states=3), get_rule("brians_brain:T"), 1
+        )
+
+
 @pytest.mark.slow
 def test_packed_torus_every_width_1_to_40(rng_board):
     """Exhaustive width sweep across the word-boundary space (1..40 covers

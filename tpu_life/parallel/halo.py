@@ -164,6 +164,60 @@ def make_sharded_run_torus(
     return run
 
 
+def make_sharded_run_torus_2d(
+    rule: Rule,
+    mesh: Mesh,
+    logical_shape: tuple[int, int],
+    *,
+    row_axis: str = ROW_AXIS,
+    col_axis: str = COL_AXIS,
+    block_steps: int = 1,
+) -> Callable[[jax.Array, int], jax.Array]:
+    """2-D block decomposition of the TORUS (packed bitboard only).
+
+    The elegant property of the fully-sharded torus: with the board
+    exactly divisible along both axes (rows by the row mesh, packed WORDS
+    by the column mesh, and the width word-aligned so no partial word can
+    sit on a seam), every seam — the board's outer edges included — is an
+    interior seam of a closed ``ppermute`` ring.  The local substep then
+    needs NO wrap logic at all: both rings deliver the true neighbors
+    (corners ride the row-extended column exchange transitively, as in
+    the clamped 2-D run), the plain clamped-shift packed step runs on the
+    halo-extended chunk, and the zero fill at the ext edges only corrupts
+    the fringe each block crops.  Contrast the 1-D torus, which wraps
+    columns in-shard because each stripe holds full rows.
+
+    A thin wrapper over the one 2-D scaffold (``make_sharded_run_2d``
+    with ``torus=True``); callers guarantee exact divisibility
+    (``_prepare_torus_2d`` raises the precise reason otherwise).
+    """
+    lh, lw = logical_shape
+    if lw % bitlife.WORD:
+        raise ValueError(
+            f"2-D torus needs a word-aligned width (got {lw}); a partial "
+            f"last word would sit inside the glued seam"
+        )
+    return make_sharded_run_2d(
+        rule,
+        mesh,
+        logical_shape,
+        row_axis=row_axis,
+        col_axis=col_axis,
+        block_steps=block_steps,
+        packed=True,
+        torus=True,
+    )
+
+
+def get_clamped_twin(rule: Rule):
+    """The same rule with a clamped boundary — the 2-D torus's local
+    substep is boundary-free (halos carry the wrap), so it runs the plain
+    clamped packed step."""
+    from dataclasses import replace
+
+    return replace(rule, boundary="clamped")
+
+
 def make_sharded_run_2d(
     rule: Rule,
     mesh: Mesh,
@@ -173,6 +227,7 @@ def make_sharded_run_2d(
     col_axis: str = COL_AXIS,
     block_steps: int = 1,
     packed: bool = False,
+    torus: bool = False,
 ) -> Callable[[jax.Array, int], jax.Array]:
     """2-D block decomposition: halos exchanged along BOTH mesh axes.
 
@@ -188,6 +243,14 @@ def make_sharded_run_2d(
     traffic, same exchange shape.  On a mesh without a ``col_axis`` (or
     with one shard along it) the column phase drops out and this *is* the
     1-D stripe run.
+
+    ``torus=True`` (packed only; ``make_sharded_run_torus_2d`` is the
+    width-checked entry point): the same scaffold with the rings CLOSED
+    on both axes and NO validity masking — every halo carries true
+    wrapped neighbors (one-shard axes take their own edges), so the
+    plain clamped-shift packed step runs on the ext chunk and the only
+    invalid cells are the ext-edge fringe each block crops.  Callers
+    guarantee exact divisibility along both axes.
     """
     n_r = mesh.shape[row_axis]
     split_cols = col_axis in mesh.shape and mesh.shape[col_axis] > 1
@@ -197,21 +260,38 @@ def make_sharded_run_2d(
     # the packed bitboard (word carries propagate 1 bit/step, so ceil(pad/32)
     # words always hold the pad cells the block needs)
     pad_c = -(-pad // bitlife.WORD) if packed else pad
-    masked_step = (
-        bitlife.make_masked_packed_step(rule, tuple(logical_shape))
-        if packed
-        else make_masked_step(rule, tuple(logical_shape))
-    )
-    fwd_r = [(i, i + 1) for i in range(n_r - 1)]
-    bwd_r = [(i + 1, i) for i in range(n_r - 1)]
-    fwd_c = [(i, i + 1) for i in range(n_c - 1)]
-    bwd_c = [(i + 1, i) for i in range(n_c - 1)]
+    if torus:
+        if not packed:
+            raise ValueError("the 2-D torus scaffold is packed-only")
+        plain_step = bitlife.make_packed_step(get_clamped_twin(rule))
+        masked_step = lambda ext, ro, co: plain_step(ext)  # noqa: E731
+        fwd_r = [(i, (i + 1) % n_r) for i in range(n_r)]
+        bwd_r = [((i + 1) % n_r, i) for i in range(n_r)]
+        fwd_c = [(i, (i + 1) % n_c) for i in range(n_c)]
+        bwd_c = [((i + 1) % n_c, i) for i in range(n_c)]
+    else:
+        masked_step = (
+            bitlife.make_masked_packed_step(rule, tuple(logical_shape))
+            if packed
+            else make_masked_step(rule, tuple(logical_shape))
+        )
+        fwd_r = [(i, i + 1) for i in range(n_r - 1)]
+        bwd_r = [(i + 1, i) for i in range(n_r - 1)]
+        fwd_c = [(i, i + 1) for i in range(n_c - 1)]
+        bwd_c = [(i + 1, i) for i in range(n_c - 1)]
 
     def local_block(chunk: jax.Array) -> jax.Array:
         hl, wl = chunk.shape
         ri = lax.axis_index(row_axis)
-        top = lax.ppermute(chunk[hl - pad :, :], row_axis, fwd_r)
-        bot = lax.ppermute(chunk[:pad, :], row_axis, bwd_r)
+        if torus and n_r == 1:
+            # one shard along the rows: its own edges ARE the wrap pair
+            top = chunk[hl - pad :, :]
+            bot = chunk[:pad, :]
+        else:
+            # clamped: ppermute zero-fills at the mesh ends = the dead
+            # boundary; torus: the ring is closed, every shard has both
+            top = lax.ppermute(chunk[hl - pad :, :], row_axis, fwd_r)
+            bot = lax.ppermute(chunk[:pad, :], row_axis, bwd_r)
         ext = jnp.concatenate([top, chunk, bot], axis=0)
         row_offset = ri * hl - pad
         if split_cols:
@@ -220,15 +300,23 @@ def make_sharded_run_2d(
             right = lax.ppermute(ext[:, :pad_c], col_axis, bwd_c)
             ext = jnp.concatenate([left, ext, right], axis=1)
             col_offset = ci * wl - pad_c
+        elif torus:
+            # one shard along the columns: self-wrap the word columns
+            left = ext[:, wl - pad_c :]
+            right = ext[:, :pad_c]
+            ext = jnp.concatenate([left, ext, right], axis=1)
+            col_offset = -pad_c
         else:
             col_offset = 0
         for _ in range(block_steps):
             ext = masked_step(ext, row_offset, col_offset)
-        col0 = pad_c if split_cols else 0
+        col0 = pad_c if (split_cols or torus) else 0
         return ext[pad : pad + hl, col0 : col0 + wl]
 
     def local_run(chunk: jax.Array, num_blocks: int) -> jax.Array:
-        if chunk.shape[0] < pad or (split_cols and chunk.shape[1] < pad_c):
+        if chunk.shape[0] < pad or (
+            (split_cols or torus) and chunk.shape[1] < pad_c
+        ):
             raise ValueError(
                 f"shard {chunk.shape} smaller than halo depth "
                 f"{(pad, pad_c)}; lower block_steps or use a smaller mesh"
@@ -242,6 +330,17 @@ def make_sharded_run_2d(
 
     @partial(jax.jit, static_argnames="num_blocks", donate_argnums=0)
     def run(board: jax.Array, num_blocks: int) -> jax.Array:
+        if torus:
+            lh, lw = logical_shape
+            wp = bitlife.packed_width(lw)
+            if board.shape != (lh, wp):
+                # exactness IS the correctness contract: padding anywhere
+                # would sit inside the glued seams (trace-time check)
+                raise ValueError(
+                    f"2-D torus board shape {board.shape} != physical "
+                    f"({lh}, {wp}); the torus run takes the exact "
+                    f"unpadded bitboard"
+                )
         return shard_map(
             partial(local_run, num_blocks=num_blocks),
             mesh=mesh,
