@@ -277,16 +277,39 @@ def test_torus_2d_mesh_constraint_errors(rng_board):
     if len(jax.devices()) < 4:
         pytest.skip("needs 4 fake devices")
     rule = get_rule("conway:T")
-    # width 24: not word-aligned -> the seam would cut a partial word
+    # width 24: not word-aligned -> the packed seam would cut a partial word
     with pytest.raises(ValueError, match="1-D"):
         get_backend("sharded", mesh_shape=(2, 2)).run(
             rng_board(24, 24, seed=29), rule, 1
         )
-    # multistate torus has no packed path -> 2-D mesh refuses
+    # int8 torus: width 31 not divisible by the 2-wide column mesh
     with pytest.raises(ValueError, match="1-D"):
         get_backend("sharded", mesh_shape=(2, 2)).run(
-            rng_board(24, 64, seed=30, states=3), get_rule("brians_brain:T"), 1
+            rng_board(24, 31, seed=30, states=3), get_rule("brians_brain:T"), 1
         )
+
+
+@pytest.mark.parametrize(
+    "spec, states",
+    [("brians_brain:T", 3), ("R2,C2,S2..4,B2..3,NN:T", 2)],
+    ids=["generations", "ltl-diamond"],
+)
+def test_torus_2d_mesh_int8_rules(spec, states, rng_board):
+    """Multistate and wide-radius torus rules ride the same closed-ring
+    construction on the int8 board (no word-alignment constraint — just
+    cell divisibility)."""
+    import jax
+
+    from tpu_life.backends.base import get_backend
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 fake devices")
+    rule = get_rule(spec)
+    board = rng_board(24, 44, seed=62, states=states)
+    be = get_backend("sharded", mesh_shape=(2, 2))
+    np.testing.assert_array_equal(
+        be.run(board, rule, 8), run_np(board, rule, 8)
+    )
 
 
 @pytest.mark.slow

@@ -375,44 +375,56 @@ class ShardedBackend:
         mesh, width by the word size."""
         from tpu_life.parallel.halo import make_sharded_run_torus_2d
 
-        if not use_bits:
-            raise ValueError(
-                "the 2-D-mesh torus runs the packed bitboard only "
-                "(life-like rules with bitpack); multistate or wide-radius "
-                "torus rules need a 1-D (rows) mesh"
-            )
         if self.local_kernel == "pallas":
             raise ValueError(
                 "the Pallas torus stripe kernel is 1-D only; the 2-D-mesh "
-                "torus runs the XLA packed step (local_kernel='xla'/'auto')"
+                "torus runs the XLA step (local_kernel='xla'/'auto')"
             )
-        wp = bitlife.packed_width(w)
-        if w % bitlife.WORD != 0 or wp % self.n_cols != 0:
-            raise ValueError(
-                f"2-D-mesh torus needs the width ({w}) divisible by "
-                f"{bitlife.WORD} and its {wp} packed words divisible by the "
-                f"column mesh ({self.n_cols}): any padding would sit inside "
-                f"the glued seam.  Use a 1-D (rows) mesh for this board."
-            )
+        if use_bits:
+            wp = bitlife.packed_width(w)
+            if w % bitlife.WORD != 0 or wp % self.n_cols != 0:
+                raise ValueError(
+                    f"2-D-mesh torus needs the width ({w}) divisible by "
+                    f"{bitlife.WORD} and its {wp} packed words divisible by "
+                    f"the column mesh ({self.n_cols}): any padding would sit "
+                    f"inside the glued seam.  Use a 1-D (rows) mesh for "
+                    f"this board."
+                )
+            w_store, col_unit = wp, bitlife.WORD
+            to_np = lambda x: bitlife.unpack_np(np.asarray(x), w)
+            count = bitlife.live_count_packed
+        else:
+            # multistate / wide-radius torus rules: the same closed-ring
+            # construction on the int8 board — the seam constraint is
+            # plain cell divisibility
+            if w % self.n_cols != 0:
+                raise ValueError(
+                    f"2-D-mesh torus needs the width ({w}) divisible by the "
+                    f"column mesh ({self.n_cols}): padding would sit inside "
+                    f"the glued seam.  Use a 1-D (rows) mesh for this board."
+                )
+            w_store, col_unit = w, 1
+            to_np = lambda x: np.asarray(x)
+            count = bitlife.live_count_cells
         shard_h = h // self.n
         block_steps = max(
             1,
             min(
                 self.block_steps,
                 shard_h // max(1, rule.radius),
-                # the column halo is whole words; keep it within the shard
-                (wp // self.n_cols) * bitlife.WORD // max(1, rule.radius),
+                # the column halo must stay within one shard's storage
+                (w_store // self.n_cols) * col_unit // max(1, rule.radius),
             ),
         )
-        x = self._device_put_stream(load_rows, h, w, h, wp, use_bits=True)
+        x = self._device_put_stream(load_rows, h, w, h, w_store, use_bits)
         return self._blocked_runner(
             x,
             block_steps,
             lambda bs: make_sharded_run_torus_2d(
-                rule, self.mesh, (h, w), block_steps=bs
+                rule, self.mesh, (h, w), block_steps=bs, packed=use_bits
             ),
-            lambda x: bitlife.unpack_np(np.asarray(x), w),
-            bitlife.live_count_packed,
+            to_np,
+            count,
         )
 
     def _prepare_torus(self, load_rows, h: int, w: int, rule: Rule):
@@ -440,9 +452,10 @@ class ShardedBackend:
 
         if self.n_cols > 1:
             # 2-D mesh torus: every seam is an interior seam of the closed
-            # rings (make_sharded_run_torus_2d), which needs the packed
-            # bitboard and exact divisibility in BOTH dims — a partial word
-            # or padded word column would sit inside the glued seam
+            # rings (make_sharded_run_torus_2d) — packed bitboard for
+            # life-like rules, int8 for multistate/wide-radius — with exact
+            # divisibility in BOTH dims (words when packed, cells for
+            # int8): any padding would sit inside the glued seam
             return self._prepare_torus_2d(load_rows, h, w, rule, use_bits)
 
         # the Pallas stripe kernel has a torus variant (seam carries wrap

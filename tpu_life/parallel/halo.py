@@ -40,7 +40,7 @@ except ImportError:  # pragma: no cover
 
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
-from tpu_life.ops.stencil import make_masked_step, make_wrap_cols_step
+from tpu_life.ops.stencil import make_masked_step, make_step, make_wrap_cols_step
 from tpu_life.parallel.mesh import COL_AXIS, ROW_AXIS
 
 
@@ -172,8 +172,9 @@ def make_sharded_run_torus_2d(
     row_axis: str = ROW_AXIS,
     col_axis: str = COL_AXIS,
     block_steps: int = 1,
+    packed: bool = True,
 ) -> Callable[[jax.Array, int], jax.Array]:
-    """2-D block decomposition of the TORUS (packed bitboard only).
+    """2-D block decomposition of the TORUS.
 
     The elegant property of the fully-sharded torus: with the board
     exactly divisible along both axes (rows by the row mesh, packed WORDS
@@ -189,10 +190,13 @@ def make_sharded_run_torus_2d(
 
     A thin wrapper over the one 2-D scaffold (``make_sharded_run_2d``
     with ``torus=True``); callers guarantee exact divisibility
-    (``_prepare_torus_2d`` raises the precise reason otherwise).
+    (``_prepare_torus_2d`` raises the precise reason otherwise).  With
+    ``packed=False`` the same construction runs multistate / wide-radius
+    torus rules on the int8 board — the seam constraint is then plain
+    cell divisibility, no word alignment.
     """
     lh, lw = logical_shape
-    if lw % bitlife.WORD:
+    if packed and lw % bitlife.WORD:
         raise ValueError(
             f"2-D torus needs a word-aligned width (got {lw}); a partial "
             f"last word would sit inside the glued seam"
@@ -204,7 +208,7 @@ def make_sharded_run_torus_2d(
         row_axis=row_axis,
         col_axis=col_axis,
         block_steps=block_steps,
-        packed=True,
+        packed=packed,
         torus=True,
     )
 
@@ -244,13 +248,14 @@ def make_sharded_run_2d(
     with one shard along it) the column phase drops out and this *is* the
     1-D stripe run.
 
-    ``torus=True`` (packed only; ``make_sharded_run_torus_2d`` is the
-    width-checked entry point): the same scaffold with the rings CLOSED
-    on both axes and NO validity masking — every halo carries true
-    wrapped neighbors (one-shard axes take their own edges), so the
-    plain clamped-shift packed step runs on the ext chunk and the only
-    invalid cells are the ext-edge fringe each block crops.  Callers
-    guarantee exact divisibility along both axes.
+    ``torus=True`` (``make_sharded_run_torus_2d`` is the checked entry
+    point): the same scaffold with the rings CLOSED on both axes and NO
+    validity masking — every halo carries true wrapped neighbors
+    (one-shard axes take their own edges), so the clamped twin of the
+    rule runs unmasked on the ext chunk (packed bit step or plain int8
+    stencil step alike) and the only invalid cells are the ext-edge
+    fringe each block crops.  Callers guarantee exact divisibility along
+    both axes (word-granular when packed, cell-granular for int8).
     """
     n_r = mesh.shape[row_axis]
     split_cols = col_axis in mesh.shape and mesh.shape[col_axis] > 1
@@ -261,9 +266,13 @@ def make_sharded_run_2d(
     # words always hold the pad cells the block needs)
     pad_c = -(-pad // bitlife.WORD) if packed else pad
     if torus:
-        if not packed:
-            raise ValueError("the 2-D torus scaffold is packed-only")
-        plain_step = bitlife.make_packed_step(get_clamped_twin(rule))
+        # boundary-free local substep: the closed rings deliver every
+        # neighbor, so the CLAMPED twin of the rule runs unmasked (packed
+        # bit step or plain int8 stencil step alike)
+        twin = get_clamped_twin(rule)
+        plain_step = (
+            bitlife.make_packed_step(twin) if packed else make_step(twin)
+        )
         masked_step = lambda ext, ro, co: plain_step(ext)  # noqa: E731
         fwd_r = [(i, (i + 1) % n_r) for i in range(n_r)]
         bwd_r = [((i + 1) % n_r, i) for i in range(n_r)]
@@ -332,14 +341,13 @@ def make_sharded_run_2d(
     def run(board: jax.Array, num_blocks: int) -> jax.Array:
         if torus:
             lh, lw = logical_shape
-            wp = bitlife.packed_width(lw)
-            if board.shape != (lh, wp):
+            phys = (lh, bitlife.packed_width(lw) if packed else lw)
+            if board.shape != phys:
                 # exactness IS the correctness contract: padding anywhere
                 # would sit inside the glued seams (trace-time check)
                 raise ValueError(
                     f"2-D torus board shape {board.shape} != physical "
-                    f"({lh}, {wp}); the torus run takes the exact "
-                    f"unpadded bitboard"
+                    f"{phys}; the torus run takes the exact unpadded board"
                 )
         return shard_map(
             partial(local_run, num_blocks=num_blocks),
