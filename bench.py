@@ -339,15 +339,17 @@ def _emit(result: dict) -> None:
     _SIGNAL_STATE["emitted"] = True
 
 
-def run_bench(args, platform: str, degraded: bool) -> dict:
-    # Pin the platform ONLY on an explicit user override (--platform or
-    # TPU_LIFE_PLATFORM).  The round-3 capture died precisely because we
-    # pinned the *probed* value: under the axon plugin the default backend
-    # reports device.platform == "tpu" while `jax_platforms="tpu"` kills
-    # backend init ("No jellyfish device found") — the plugin registers
-    # under a different platform name than its devices report.  Unpinned
-    # init is what the probe itself measured, so leave it alone and verify
-    # the resulting backend afterwards instead (VERDICT r3 item 1).
+def _pin_and_verify(args, platform: str) -> tuple[str, bool]:
+    """(actual_platform, pinned?) — the shared init discipline of every
+    bench mode.  Pin the platform ONLY on an explicit user override
+    (--platform or TPU_LIFE_PLATFORM).  The round-3 capture died precisely
+    because we pinned the *probed* value: under the axon plugin the
+    default backend reports device.platform == "tpu" while
+    `jax_platforms="tpu"` kills backend init ("No jellyfish device found")
+    — the plugin registers under a different platform name than its
+    devices report.  Unpinned init is what the probe itself measured, so
+    leave it alone and verify the resulting backend afterwards instead
+    (VERDICT r3 item 1)."""
     pinned = args.platform or os.environ.get("TPU_LIFE_PLATFORM")
     if pinned is None and platform == "cpu":
         # the probe failed (or degraded us to CPU): pin the always-valid cpu
@@ -362,9 +364,6 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
 
     import jax
 
-    from tpu_life.backends.base import get_backend
-    from tpu_life.models.rules import get_rule
-
     # post-init verification: the platform the backend actually gave us.
     # Recorded alongside the probed value; a mismatch (probe said tpu,
     # process came up cpu) downgrades the capture to degraded rather than
@@ -375,6 +374,76 @@ def run_bench(args, platform: str, degraded: bool) -> dict:
             f"platform mismatch: probe/request said {platform!r} but the "
             f"default backend initialized as {actual!r}"
         )
+    return actual, bool(pinned)
+
+
+def run_serve_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_serve capture: staggered sessions through the
+    continuous-batching service — sessions/sec and batch occupancy, so the
+    serving path enters the perf trajectory alongside the kernel number."""
+    actual, pinned = _pin_and_verify(args, platform)
+
+    from tpu_life.models.patterns import random_board
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    n = args.serve_size
+    sessions = args.serve_sessions
+    steps = args.serve_steps
+    svc = SimulationService(
+        ServeConfig(
+            capacity=args.serve_capacity,
+            chunk_steps=args.serve_chunk_steps,
+            max_queue=max(sessions, 1),
+            backend=args.backend,
+        )
+    )
+    boards = [
+        random_board(n, n, seed=i) for i in range(min(sessions, 8))
+    ]  # a few distinct boards reused: board gen must not dominate the bench
+    # staggered admission: half up front, the rest trickling in while the
+    # batch runs — the continuous-batching shape, not a static batch
+    sids = [
+        svc.submit(boards[i % len(boards)], args.rule, steps)
+        for i in range(sessions // 2)
+    ]
+    t0 = time.monotonic()
+    for i in range(sessions // 2, sessions):
+        svc.pump()
+        sids.append(svc.submit(boards[i % len(boards)], args.rule, steps))
+    svc.drain()
+    elapsed = time.monotonic() - t0
+    stats = svc.stats()
+    done = stats["done"]
+    return {
+        "metric": "serve_sessions_per_sec",
+        "value": done / elapsed if elapsed > 0 else 0.0,
+        "unit": "sessions/s",
+        "rule": args.rule,
+        "platform": platform,
+        "platform_actual": actual,
+        "platform_pinned": pinned,
+        "backend": args.backend,
+        "size": n,
+        "steps": steps,
+        "sessions": sessions,
+        "done": done,
+        "failed": stats["failed"],
+        "batch_capacity": args.serve_capacity,
+        "chunk_steps": args.serve_chunk_steps,
+        "batch_occupancy_mean": stats["batch_occupancy_mean"],
+        "cell_updates_per_sec": done * steps * n * n / elapsed
+        if elapsed > 0
+        else 0.0,
+        "rounds": stats["rounds"],
+        "degraded": degraded,
+    }
+
+
+def run_bench(args, platform: str, degraded: bool) -> dict:
+    actual, pinned = _pin_and_verify(args, platform)
+
+    from tpu_life.backends.base import get_backend
+    from tpu_life.models.rules import get_rule
 
     rule = get_rule(args.rule)
     n = args.size
@@ -508,6 +577,21 @@ def main() -> None:
     p.add_argument("--repeats", type=int, default=6)
     p.add_argument("--platform", default=None)
     p.add_argument("--no-bitpack", action="store_true")
+    # the BENCH_serve capture: measure the continuous-batching service
+    # (sessions/sec, batch occupancy) instead of raw kernel throughput
+    p.add_argument("--serve", action="store_true",
+                   help="serving-path bench: staggered sessions through "
+                   "tpu_life.serve (emits serve_sessions_per_sec)")
+    p.add_argument("--serve-sessions", type=int, default=None,
+                   help="sessions to push through the service (default 32, "
+                   "12 degraded)")
+    p.add_argument("--serve-size", type=int, default=None,
+                   help="per-session board edge (default 512, 128 degraded)")
+    p.add_argument("--serve-steps", type=int, default=None,
+                   help="per-session step budget (default 128, 32 degraded)")
+    p.add_argument("--serve-capacity", type=int, default=8,
+                   help="batch slots (the acceptance-config default)")
+    p.add_argument("--serve-chunk-steps", type=int, default=16)
     args = p.parse_args()
 
     # fail fast on pure config errors — they must never trigger the
@@ -548,6 +632,9 @@ def main() -> None:
         "--backend": args.backend,
         "--block-steps": args.block_steps,
         "--local-kernel": args.local_kernel,
+        "--serve-sessions": args.serve_sessions,
+        "--serve-size": args.serve_size,
+        "--serve-steps": args.serve_steps,
     }
     if args.size is None:
         args.size = 16384 if on_accel else DEGRADED_SIZE
@@ -555,17 +642,30 @@ def main() -> None:
         args.steps = 1000 if on_accel else DEGRADED_STEPS
     if args.base_steps is None:
         args.base_steps = 100 if on_accel else DEGRADED_BASE_STEPS
-    if args.steps <= args.base_steps:
+    if not args.serve and args.steps <= args.base_steps:
         p.error("--steps must be greater than --base-steps (delta timing)")
+    # serve workload knobs follow the same accel/degraded split: the CPU
+    # fallback must finish in seconds while still filling the batch
+    if args.serve_sessions is None:
+        args.serve_sessions = 32 if on_accel else 12
+    if args.serve_size is None:
+        args.serve_size = 512 if on_accel else 128
+    if args.serve_steps is None:
+        args.serve_steps = 128 if on_accel else 32
     # resolve the backend up front (after snapshotting what the user pinned)
     # so every emitted record — success or failure — names what actually ran
-    # (ADVICE r2 item 3): the composed flagship path on TPU, jax elsewhere
+    # (ADVICE r2 item 3): the composed flagship path on TPU, jax elsewhere.
+    # The serve bench defaults to the vmapped jax engine on every platform
+    # (the batched path is the thing being measured).
     if args.backend is None:
-        args.backend = "sharded" if platform == "tpu" else "jax"
-        if platform == "tpu" and args.local_kernel is None:
-            args.local_kernel = default_tpu_local_kernel(
-                args.rule, args.no_bitpack
-            )
+        if args.serve:
+            args.backend = "jax"
+        else:
+            args.backend = "sharded" if platform == "tpu" else "jax"
+            if platform == "tpu" and args.local_kernel is None:
+                args.local_kernel = default_tpu_local_kernel(
+                    args.rule, args.no_bitpack
+                )
 
     def annotate(record: dict) -> dict:
         if probe_failed:
@@ -580,7 +680,10 @@ def main() -> None:
         backend=args.backend, size=args.size, steps=args.steps, phase="measure"
     )
     try:
-        result = run_bench(args, platform, degraded)
+        if args.serve:
+            result = run_serve_bench(args, platform, degraded)
+        else:
+            result = run_bench(args, platform, degraded)
     except Exception as e:  # noqa: BLE001 — the JSON line must always appear
         _SIGNAL_STATE["phase"] = "cpu-retry"
         if platform != "cpu" and not os.environ.get("TPU_LIFE_BENCH_NO_RETRY"):
@@ -606,6 +709,12 @@ def main() -> None:
                     cmd += [flag, str(value)]
             if args.no_bitpack:
                 cmd.append("--no-bitpack")
+            if args.serve:
+                # the retry must measure the same MODE, not fall back to
+                # the kernel bench and mislabel the record
+                cmd.append("--serve")
+                cmd += ["--serve-capacity", str(args.serve_capacity)]
+                cmd += ["--serve-chunk-steps", str(args.serve_chunk_steps)]
             try:
                 r = subprocess.run(
                     cmd, capture_output=True, text=True, timeout=1800, env=env
@@ -618,23 +727,26 @@ def main() -> None:
                 return
             except Exception as e2:  # noqa: BLE001
                 e = RuntimeError(f"{e!r}; cpu retry failed: {e2!r}")
-        _emit(
-            annotate(
-                {
-                    "metric": "cell_updates_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": "cells/s/chip",
-                    "vs_baseline": 0.0,
-                    "platform": platform,
-                    "backend": args.backend,
-                    "size": args.size,
-                    "steps": args.steps,
-                    "n_chips": 0,
-                    "degraded": True,
-                    "error": repr(e)[:500],
-                }
-            )
-        )
+        failure = {
+            "metric": "serve_sessions_per_sec"
+            if args.serve
+            else "cell_updates_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "sessions/s" if args.serve else "cells/s/chip",
+            "platform": platform,
+            "backend": args.backend,
+            "size": args.serve_size if args.serve else args.size,
+            "steps": args.serve_steps if args.serve else args.steps,
+            "degraded": True,
+            "error": repr(e)[:500],
+        }
+        if args.serve:
+            failure["sessions"] = args.serve_sessions
+            failure["batch_capacity"] = args.serve_capacity
+        else:
+            failure["vs_baseline"] = 0.0
+            failure["n_chips"] = 0
+        _emit(annotate(failure))
         return
     _emit(annotate(result))
 

@@ -90,6 +90,26 @@ def test_bench_rejects_bad_config_without_fallback():
     assert not r.stdout.strip()  # no fake capture line
 
 
+@pytest.mark.slow
+def test_bench_serve_emits_serving_record_on_cpu():
+    """The BENCH_serve hook: `--serve` measures the continuous-batching
+    service and emits the serving-path record (sessions/sec + batch
+    occupancy) with the same one-JSON-line honesty contract."""
+    rec = run_bench(
+        "--serve", "--platform", "cpu",
+        "--serve-sessions", "10", "--serve-size", "48", "--serve-steps", "8",
+        "--serve-chunk-steps", "4",
+    )
+    assert rec["metric"] == "serve_sessions_per_sec"
+    assert rec["unit"] == "sessions/s"
+    assert rec["value"] > 0
+    assert rec["sessions"] == 10 and rec["done"] == 10 and rec["failed"] == 0
+    assert rec["batch_capacity"] == 8
+    assert 0.0 < rec["batch_occupancy_mean"] <= 1.0
+    assert rec["platform"] == "cpu" and rec["degraded"] is True
+    assert rec["backend"] == "jax"  # the vmapped serve engine
+
+
 def bench_popen(*args, env_extra=None, stderr_path=None):
     """Start bench.py without waiting (for the signal-delivery drills)."""
     return subprocess.Popen(

@@ -69,6 +69,37 @@ def test_sharded_live_count_matches_host(rng_board, bitpack):
     assert r.live_count() == host_count(run_np(board, rule, 10))
 
 
+def test_record_chunk_zero_elapsed_reports_zero_rates(tmp_path):
+    """elapsed == 0 must yield 0.0 rates, not NaN: NaN is not valid JSON
+    and used to poison the JSONL sink for strict consumers."""
+    import json
+
+    from tpu_life.runtime.metrics import MetricsRecorder
+
+    sink = tmp_path / "metrics.jsonl"
+    rec = MetricsRecorder(100, True, sink=str(sink))
+    rec.record_chunk(5, 0.0, 42)
+    assert rec.records[0]["steps_per_sec"] == 0.0
+    assert rec.records[0]["cell_updates_per_sec"] == 0.0
+    # the sink line is already flushed (no close needed) and strict-parses
+    parsed = json.loads(sink.read_text().strip(), parse_constant=lambda c: 1 / 0)
+    assert parsed["steps_per_sec"] == 0.0
+
+
+def test_sink_flushes_each_record(tmp_path):
+    """A tailing consumer sees every record as soon as it is recorded —
+    the handle is flushed per record, not at close."""
+    from tpu_life.runtime.metrics import MetricsRecorder
+
+    sink = tmp_path / "metrics.jsonl"
+    rec = MetricsRecorder(10, True, sink=str(sink))
+    rec.record_chunk(1, 0.5, 3)
+    assert len(sink.read_text().splitlines()) == 1  # visible pre-close
+    rec.record({"kind": "serve", "queue_depth": 0})
+    assert len(sink.read_text().splitlines()) == 2
+    rec.close()
+
+
 def test_host_runner_live_count(rng_board):
     board = rng_board(30, 30, seed=2)
     r = make_runner(NumpyBackend(), board, get_rule("conway"))

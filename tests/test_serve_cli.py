@@ -1,0 +1,109 @@
+"""The serve/submit CLI front-end: spool file in, boards + summary out."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_life.cli import main
+from tpu_life.io.codec import read_board, write_board, write_config
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+
+
+@pytest.fixture
+def spool(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    write_config(tmp_path / "grid_size_data.txt", 20, 15, 8)
+    return tmp_path
+
+
+def summary_line(capsys) -> dict:
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_submit_then_serve_round_trip(spool, capsys):
+    b1 = random_board(20, 15, seed=1)
+    b2 = random_board(20, 15, seed=2)
+    write_board(spool / "a.txt", b1)
+    write_board(spool / "b.txt", b2)
+    # geometry from the contract config file, like `run`
+    assert main(["submit", "--input-file", "a.txt"]) == 0
+    # explicit overrides + named output
+    assert (
+        main(
+            [
+                "submit", "--input-file", "b.txt", "--steps", "13",
+                "--rule", "highlife", "--output-file", "b_out.txt",
+            ]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    assert main(["serve", "--capacity", "2", "--chunk-steps", "3"]) == 0
+    summary = summary_line(capsys)
+    assert summary["sessions"] == 2
+    assert summary["done"] == 2 and summary["failed"] == 0
+    assert summary["failures"] == []
+    assert summary["sessions_per_sec"] > 0
+
+    np.testing.assert_array_equal(
+        read_board(spool / "serve_out" / "s000000.txt", 20, 15),
+        run_np(b1, get_rule("conway"), 8),
+    )
+    np.testing.assert_array_equal(
+        read_board(spool / "b_out.txt", 20, 15),
+        run_np(b2, get_rule("highlife"), 13),
+    )
+
+
+def test_serve_more_requests_than_queue_applies_backpressure(spool, capsys):
+    """The CLI is a well-behaved client: with max-queue below the request
+    count it pumps between submits instead of dropping requests."""
+    boards = [random_board(20, 15, seed=10 + i) for i in range(6)]
+    for i, b in enumerate(boards):
+        write_board(spool / f"in{i}.txt", b)
+        assert main(["submit", "--input-file", f"in{i}.txt", "--steps", "5"]) == 0
+    capsys.readouterr()
+    assert (
+        main(["serve", "--capacity", "2", "--max-queue", "2", "--chunk-steps", "2"])
+        == 0
+    )
+    summary = summary_line(capsys)
+    assert summary["done"] == 6
+    for i, b in enumerate(boards):
+        got = read_board(spool / "serve_out" / f"s{i:06d}.txt", 20, 15)
+        np.testing.assert_array_equal(got, run_np(b, get_rule("conway"), 5))
+
+
+def test_serve_reports_failures_and_exits_nonzero(spool, capsys):
+    write_board(spool / "a.txt", random_board(20, 15, seed=3))
+    assert main(["submit", "--input-file", "a.txt", "--id", "doomed"]) == 0
+    capsys.readouterr()
+    # a zero-second default timeout expires every session before it runs
+    assert main(["serve", "--timeout", "0"]) == 1
+    summary = summary_line(capsys)
+    assert summary["failed"] == 1 and summary["done"] == 0
+    (failure,) = summary["failures"]
+    assert failure["id"] == "doomed"
+    assert "SessionTimeout" in failure["error"]
+
+
+def test_serve_missing_spool_is_a_user_error(spool):
+    with pytest.raises(FileNotFoundError, match="tpu-life submit"):
+        main(["serve", "--requests", "nowhere.jsonl"])
+
+
+def test_serve_metrics_file_is_valid_jsonl(spool, capsys):
+    write_board(spool / "a.txt", random_board(20, 15, seed=4))
+    assert main(["submit", "--input-file", "a.txt"]) == 0
+    capsys.readouterr()
+    assert main(["serve", "--metrics-file", "serve_metrics.jsonl"]) == 0
+    recs = [
+        json.loads(line)
+        for line in (spool / "serve_metrics.jsonl").read_text().splitlines()
+    ]
+    assert recs and all(r["kind"] == "serve" for r in recs)
+    assert recs[-1]["sessions_done"] == 1

@@ -1,0 +1,160 @@
+"""Batched-vs-sequential equivalence: the serve engine's core promise.
+
+N sessions packed through the continuous-batching engine must produce
+boards bit-identical to N independent ``runtime.driver.run`` calls — the
+serving layer may change *when* lattices step, never *what* they compute.
+Covers life (2-state bit-packable) and an int8 Generations rule, uneven
+per-session step budgets, staggered admission, and the acceptance
+criterion: capacity 8, 20 staggered sessions, exactly one compile per
+compile key.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.config import RunConfig
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.runtime import driver
+from tpu_life.serve import ServeConfig, SimulationService
+
+
+def driver_run_board(tmp_path, board: np.ndarray, rule: str, steps: int, tag: str):
+    """One independent sequential run through the real driver pipeline."""
+    from tpu_life.io.codec import read_board, write_board
+
+    h, w = board.shape
+    inp = tmp_path / f"in_{tag}.txt"
+    out = tmp_path / f"out_{tag}.txt"
+    write_board(inp, board)
+    res = driver.run(
+        RunConfig(
+            height=h,
+            width=w,
+            steps=steps,
+            input_file=str(inp),
+            output_file=str(out),
+            rule=rule,
+            backend="numpy",
+        )
+    )
+    assert res.board is not None
+    # the returned board and the written file are the same artifact
+    np.testing.assert_array_equal(res.board, read_board(out, h, w))
+    return res.board
+
+
+@pytest.mark.parametrize("backend", ["jax", "numpy"])
+def test_twenty_staggered_sessions_one_compile(tmp_path, backend):
+    """THE acceptance test: capacity 8, 20 staggered sessions with uneven
+    budgets complete with exactly one compile per compile key, and every
+    result is bit-identical to an independent driver.run."""
+    svc = SimulationService(
+        ServeConfig(capacity=8, chunk_steps=7, max_queue=64, backend=backend)
+    )
+    rule = "conway"
+    boards = [random_board(24, 19, density=0.4, seed=100 + i) for i in range(20)]
+    budgets = [1 + (7 * i) % 43 for i in range(20)]  # uneven, 1..43
+
+    # staggered admission: a third up front, the rest trickling in while
+    # the batch is already running (continuous batching, not static)
+    sids = []
+    for i in range(6):
+        sids.append(svc.submit(boards[i], rule, budgets[i]))
+    svc.pump()
+    for i in range(6, 13):
+        sids.append(svc.submit(boards[i], rule, budgets[i]))
+        svc.pump()
+    for i in range(13, 20):
+        sids.append(svc.submit(boards[i], rule, budgets[i]))
+    svc.drain()
+
+    counts = svc.scheduler.compile_counts()
+    assert len(counts) == 1  # one geometry + rule + backend = one key
+    if backend == "jax":
+        # 20 sessions churned through 8 slots: still exactly ONE compile
+        assert list(counts.values()) == [1]
+
+    for sid, board, steps in zip(sids, boards, budgets):
+        expect = driver_run_board(tmp_path, board, rule, steps, sid)
+        np.testing.assert_array_equal(svc.result(sid), expect)
+
+
+def test_int8_generations_rule_matches_driver(tmp_path):
+    """The int8 multistate path (brians_brain, 3 states) through the
+    vmapped engine, uneven budgets, against driver.run."""
+    svc = SimulationService(ServeConfig(capacity=4, chunk_steps=5, backend="jax"))
+    boards = [
+        random_board(18, 22, states=3, seed=7 + i) for i in range(6)
+    ]
+    budgets = [3, 11, 4, 17, 8, 1]
+    sids = [
+        svc.submit(b, "brians_brain", n) for b, n in zip(boards, budgets)
+    ]
+    svc.drain()
+    for sid, board, steps in zip(sids, boards, budgets):
+        expect = driver_run_board(tmp_path, board, "brians_brain", steps, sid)
+        np.testing.assert_array_equal(svc.result(sid), expect)
+    assert list(svc.scheduler.compile_counts().values()) == [1]
+
+
+def test_mixed_compile_keys_isolate_batches():
+    """Sessions of different (rule, geometry) never share a batch; each
+    key compiles once and results stay exact."""
+    svc = SimulationService(ServeConfig(capacity=4, chunk_steps=6, backend="jax"))
+    life_boards = [random_board(16, 16, seed=i) for i in range(3)]
+    brain_boards = [random_board(20, 12, states=3, seed=50 + i) for i in range(3)]
+    life = [svc.submit(b, "conway", 9 + i) for i, b in enumerate(life_boards)]
+    brain = [svc.submit(b, "brians_brain", 5 + i) for i, b in enumerate(brain_boards)]
+    svc.drain()
+    counts = svc.scheduler.compile_counts()
+    assert len(counts) == 2
+    assert all(v == 1 for v in counts.values())
+    for sid, b, n in zip(life, life_boards, [9, 10, 11]):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(b, get_rule("conway"), n)
+        )
+    for sid, b, n in zip(brain, brain_boards, [5, 6, 7]):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(b, get_rule("brians_brain"), n)
+        )
+
+
+def test_torus_rule_serves_exactly():
+    """Boundary variants ride the compile key too: a ':T' torus session
+    batches separately from clamped ones and stays bit-exact."""
+    svc = SimulationService(ServeConfig(capacity=2, chunk_steps=4, backend="jax"))
+    b = random_board(14, 14, seed=3)
+    sid_t = svc.submit(b, "conway:T", 10)
+    sid_c = svc.submit(b, "conway", 10)
+    svc.drain()
+    np.testing.assert_array_equal(
+        svc.result(sid_t), run_np(b, get_rule("conway:T"), 10)
+    )
+    np.testing.assert_array_equal(
+        svc.result(sid_c), run_np(b, get_rule("conway"), 10)
+    )
+    assert len(svc.scheduler.compile_counts()) == 2
+
+
+def test_property_random_workloads_match_truth():
+    """Property sweep: random geometry/budget workloads through the numpy
+    and jax engines both equal the ground-truth executor."""
+    rng = np.random.default_rng(0)
+    for backend in ("numpy", "jax"):
+        svc = SimulationService(
+            ServeConfig(capacity=3, chunk_steps=int(rng.integers(1, 9)), backend=backend)
+        )
+        boards, budgets, sids = [], [], []
+        for i in range(7):
+            b = random_board(12, 15, seed=int(rng.integers(0, 1 << 16)))
+            n = int(rng.integers(0, 30))
+            boards.append(b)
+            budgets.append(n)
+            sids.append(svc.submit(b, "highlife", n))
+        svc.drain()
+        for sid, b, n in zip(sids, boards, budgets):
+            np.testing.assert_array_equal(
+                svc.result(sid), run_np(b, get_rule("highlife"), n)
+            )

@@ -1,0 +1,360 @@
+"""Scheduler edge cases: backpressure, deadlines, cancellation, and the
+continuous-batching join — the policies that make serve a service rather
+than a loop over boards."""
+
+import numpy as np
+import pytest
+
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.serve import (
+    QueueFull,
+    ServeConfig,
+    ServeError,
+    SessionFailed,
+    SessionState,
+    SimulationService,
+    UnknownSession,
+)
+
+
+class FakeClock:
+    """Deterministic clock so deadline tests never sleep."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make_service(clock=None, **cfg):
+    defaults = dict(capacity=2, chunk_steps=4, max_queue=4, backend="numpy")
+    defaults.update(cfg)
+    kwargs = {"clock": clock} if clock is not None else {}
+    return SimulationService(ServeConfig(**defaults), **kwargs)
+
+
+# -- backpressure -----------------------------------------------------------
+
+
+def test_queue_full_rejects_with_typed_error():
+    svc = make_service(max_queue=2, capacity=1)
+    b = random_board(8, 8, seed=0)
+    svc.submit(b, "conway", 10)
+    svc.submit(b, "conway", 10)
+    with pytest.raises(QueueFull) as exc_info:
+        svc.submit(b, "conway", 10)
+    assert isinstance(exc_info.value, ServeError)  # the catchable family
+    # the rejected request left no trace: memory stays bounded
+    assert len(svc.store) == 2
+    assert len(svc.scheduler.queue) == 2
+
+
+def test_queue_reopens_after_drain_progress():
+    svc = make_service(max_queue=1, capacity=1, chunk_steps=16)
+    b = random_board(8, 8, seed=1)
+    first = svc.submit(b, "conway", 4)
+    with pytest.raises(QueueFull):
+        svc.submit(b, "conway", 4)
+    svc.pump()  # first admitted (and finished: 4 <= chunk 16)
+    second = svc.submit(b, "conway", 4)  # queue has room again
+    svc.drain()
+    for sid in (first, second):
+        assert svc.poll(sid).state is SessionState.DONE
+
+
+# -- per-request timeout ----------------------------------------------------
+
+
+def test_timeout_expires_queued_session():
+    clk = FakeClock()
+    svc = make_service(clock=clk, capacity=1)
+    b = random_board(8, 8, seed=2)
+    runner = svc.submit(b, "conway", 1000, timeout_s=100.0)
+    queued = svc.submit(b, "conway", 1000, timeout_s=5.0)
+    svc.pump()  # runner takes the only slot; queued waits
+    clk.t = 6.0
+    svc.pump()
+    view = svc.poll(queued)
+    assert view.state is SessionState.FAILED
+    assert "SessionTimeout" in view.error
+    assert svc.poll(runner).state is SessionState.RUNNING
+
+
+def test_timeout_evicts_running_session_and_frees_slot():
+    clk = FakeClock()
+    svc = make_service(clock=clk, capacity=1, chunk_steps=2)
+    b = random_board(8, 8, seed=3)
+    hog = svc.submit(b, "conway", 10_000, timeout_s=10.0)
+    waiter = svc.submit(b, "conway", 4)
+    svc.pump()
+    assert svc.poll(hog).state is SessionState.RUNNING
+    clk.t = 11.0
+    svc.drain()
+    hog_view = svc.poll(hog)
+    assert hog_view.state is SessionState.FAILED
+    assert "SessionTimeout" in hog_view.error
+    assert hog_view.steps_done > 0  # it ran before the deadline hit
+    # the evicted slot went back to the waiting tenant
+    waiter_view = svc.poll(waiter)
+    assert waiter_view.state is SessionState.DONE
+    np.testing.assert_array_equal(
+        waiter_view.result, run_np(b, get_rule("conway"), 4)
+    )
+
+
+def test_result_of_timed_out_session_raises_typed_error():
+    clk = FakeClock()
+    svc = make_service(clock=clk)
+    sid = svc.submit(random_board(8, 8, seed=4), "conway", 100, timeout_s=1.0)
+    clk.t = 2.0
+    svc.drain()
+    with pytest.raises(SessionFailed, match="SessionTimeout"):
+        svc.result(sid)
+
+
+# -- cancel -----------------------------------------------------------------
+
+
+def test_cancel_queued_session():
+    svc = make_service(capacity=1)
+    b = random_board(8, 8, seed=5)
+    runner = svc.submit(b, "conway", 100)
+    queued = svc.submit(b, "conway", 100)
+    svc.pump()
+    assert svc.cancel(queued) is True
+    assert svc.poll(queued).state is SessionState.CANCELLED
+    assert svc.cancel(queued) is False  # already terminal
+
+
+def test_cancel_mid_run_frees_slot_and_keeps_batch_going():
+    svc = make_service(capacity=2, chunk_steps=3, backend="jax")
+    b1 = random_board(10, 10, seed=6)
+    b2 = random_board(10, 10, seed=7)
+    b3 = random_board(10, 10, seed=8)
+    victim = svc.submit(b1, "conway", 1000)
+    survivor = svc.submit(b2, "conway", 9)
+    waiter = svc.submit(b3, "conway", 6)  # queued behind a full batch
+    svc.pump()
+    view = svc.poll(victim)
+    assert view.state is SessionState.RUNNING and view.steps_done == 3
+    assert svc.cancel(victim) is True
+    svc.drain()
+    assert svc.poll(victim).state is SessionState.CANCELLED
+    assert svc.poll(victim).steps_done == 3  # partial progress recorded
+    np.testing.assert_array_equal(
+        svc.result(survivor), run_np(b2, get_rule("conway"), 9)
+    )
+    # the cancelled slot was reused by the waiter
+    np.testing.assert_array_equal(
+        svc.result(waiter), run_np(b3, get_rule("conway"), 6)
+    )
+
+
+# -- continuous batching ----------------------------------------------------
+
+
+def test_session_joins_half_full_running_batch_without_recompile():
+    """The continuous-batching property, asserted via the engine's compile
+    counter: late sessions enter a running batch with zero new compiles."""
+    svc = make_service(capacity=4, chunk_steps=5, backend="jax")
+    boards = [random_board(11, 13, seed=20 + i) for i in range(4)]
+    early = [svc.submit(boards[i], "conway", 40) for i in range(2)]
+    svc.pump()  # batch half full and RUNNING; the step program compiled
+    (engine,) = svc.scheduler.engines.values()
+    assert engine.compile_count == 1
+    assert engine.occupancy() == 2
+    late = [svc.submit(boards[2 + i], "conway", 12) for i in range(2)]
+    svc.pump()
+    assert engine.occupancy() == 4  # joined the live batch
+    assert engine.compile_count == 1  # ...without recompiling
+    svc.drain()
+    assert engine.compile_count == 1
+    for sid, b, n in zip(early + late, boards, [40, 40, 12, 12]):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(b, get_rule("conway"), n)
+        )
+
+
+def test_slot_churn_reuses_slots():
+    """Many short sessions through few slots: every slot is recycled and
+    the engine never grows beyond its fixed capacity."""
+    svc = make_service(capacity=2, chunk_steps=8, backend="jax", max_queue=16)
+    boards = [random_board(9, 9, seed=30 + i) for i in range(10)]
+    sids = [svc.submit(b, "conway", 5) for b in boards]
+    svc.drain()
+    (engine,) = svc.scheduler.engines.values()
+    assert engine.occupancy() == 0
+    assert engine.compile_count == 1
+    for sid, b in zip(sids, boards):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(b, get_rule("conway"), 5)
+        )
+
+
+# -- per-slot failure isolation --------------------------------------------
+
+
+def test_one_failing_session_does_not_kill_the_batch():
+    """Acceptance criterion: a single session's failure marks only that
+    session FAILED while the rest of the batch finishes exactly."""
+    svc = make_service(capacity=4, chunk_steps=4, backend="jax")
+    boards = [random_board(10, 12, seed=40 + i) for i in range(4)]
+    good = [svc.submit(boards[i], "conway", 20) for i in range(3)]
+    bad = svc.submit(boards[3], "conway", 20, fault_at=9)
+    svc.drain()
+    bad_view = svc.poll(bad)
+    assert bad_view.state is SessionState.FAILED
+    assert "InjectedFault" in bad_view.error
+    for sid, b in zip(good, boards):
+        view = svc.poll(sid)
+        assert view.state is SessionState.DONE
+        np.testing.assert_array_equal(view.result, run_np(b, get_rule("conway"), 20))
+    # the failed slot was reclaimed
+    (engine,) = svc.scheduler.engines.values()
+    assert engine.occupancy() == 0
+
+
+def test_failed_slot_is_reusable_afterwards():
+    svc = make_service(capacity=1, chunk_steps=4, backend="jax")
+    b = random_board(8, 8, seed=50)
+    bad = svc.submit(b, "conway", 10, fault_at=2)
+    after = svc.submit(b, "conway", 6)
+    svc.drain()
+    assert svc.poll(bad).state is SessionState.FAILED
+    np.testing.assert_array_equal(
+        svc.result(after), run_np(b, get_rule("conway"), 6)
+    )
+
+
+# -- API edges --------------------------------------------------------------
+
+
+def test_zero_step_session_completes_at_admission():
+    svc = make_service()
+    b = random_board(8, 8, seed=60)
+    sid = svc.submit(b, "conway", 0)
+    view = svc.poll(sid)
+    assert view.state is SessionState.DONE
+    np.testing.assert_array_equal(view.result, b)
+
+
+def test_unknown_session_raises():
+    svc = make_service()
+    with pytest.raises(UnknownSession):
+        svc.poll("s999999")
+    with pytest.raises(UnknownSession):
+        svc.cancel("nope")
+
+
+def test_bad_config_rejected_at_construction():
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    for bad in (
+        dict(max_queue=0),
+        dict(capacity=0),
+        dict(chunk_steps=0),
+    ):
+        with pytest.raises(ValueError):
+            SimulationService(ServeConfig(**bad))
+
+
+def test_submit_validates_board_states():
+    svc = make_service()
+    bad = np.full((8, 8), 5, dtype=np.int8)
+    with pytest.raises(ValueError, match="state 5"):
+        svc.submit(bad, "conway", 3)
+    negative = np.full((8, 8), -1, dtype=np.int8)
+    with pytest.raises(ValueError, match="negative"):
+        svc.submit(negative, "conway", 3)
+    assert len(svc.store) == 0  # rejected before storage
+
+
+def test_release_idle_engines_frees_and_recompiles_on_return():
+    svc = make_service(capacity=2, chunk_steps=8, backend="jax")
+    b = random_board(9, 9, seed=80)
+    svc.submit(b, "conway", 4)
+    svc.drain()
+    assert len(svc.scheduler.engines) == 1
+    assert svc.release_idle_engines() == 1
+    assert len(svc.scheduler.engines) == 0
+    # returning traffic rebuilds the engine (one fresh compile) and stays exact
+    sid = svc.submit(b, "conway", 4)
+    svc.drain()
+    np.testing.assert_array_equal(
+        svc.result(sid), run_np(b, get_rule("conway"), 4)
+    )
+    assert list(svc.scheduler.compile_counts().values()) == [1]
+
+
+def test_release_keeps_busy_engines():
+    svc = make_service(capacity=1, chunk_steps=2, backend="numpy")
+    b = random_board(8, 8, seed=81)
+    sid = svc.submit(b, "conway", 50)
+    svc.pump()  # running
+    assert svc.release_idle_engines() == 0  # busy engines are untouchable
+    svc.drain()
+    np.testing.assert_array_equal(
+        svc.result(sid), run_np(b, get_rule("conway"), 50)
+    )
+
+
+def test_package_root_import_stays_jax_free():
+    """`import tpu_life` (and the serve lazy re-export machinery) must not
+    drag jax in: jax-free CLI paths (submit/gen/pattern) and rules-only
+    library use pay that second otherwise."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys; import tpu_life; "
+        "assert 'jax' not in sys.modules, 'root import pulled jax'; "
+        "from tpu_life import ServeConfig; "  # the lazy attribute resolves
+        "import tpu_life.serve; "
+        "assert 'jax' not in sys.modules, 'serve import pulled jax'; "
+        "print('ok')"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ok" in r.stdout
+
+
+def test_result_while_in_flight_raises():
+    svc = make_service(capacity=1)
+    sid = svc.submit(random_board(8, 8, seed=61), "conway", 100)
+    with pytest.raises(ValueError, match="poll later"):
+        svc.result(sid)
+
+
+def test_serve_metrics_record_queue_and_occupancy(tmp_path):
+    """Per-round serve metrics carry queue depth, batch occupancy and a
+    finite sessions/sec, and the JSONL sink is valid line-delimited JSON."""
+    import json
+    import math
+
+    sink = tmp_path / "serve_metrics.jsonl"
+    svc = make_service(
+        capacity=2, chunk_steps=4, backend="numpy",
+        metrics=True, metrics_file=str(sink),
+    )
+    b = random_board(8, 8, seed=70)
+    for _ in range(4):
+        svc.submit(b, "conway", 6)
+    svc.drain()
+    assert svc.recorder.records, "serve pumps must emit records"
+    for rec in svc.recorder.records:
+        assert rec["kind"] == "serve"
+        assert 0.0 <= rec["batch_occupancy"] <= 1.0
+        assert math.isfinite(rec["sessions_per_sec"])
+    # sink flushed per record, every line parses
+    lines = sink.read_text().strip().splitlines()
+    assert len(lines) == len(svc.recorder.records)
+    parsed = [json.loads(line) for line in lines]
+    assert parsed[-1]["sessions_done"] == 4

@@ -18,10 +18,34 @@ Mapping of the reference's layers (SURVEY.md §1) onto this package:
 - L4 storage / I/O  -> byte-exact board codec + per-shard offset I/O
   (``tpu_life.io``)
 - L5 driver / CLI   -> ``tpu_life.runtime.driver`` + ``tpu_life.cli``
+- L6 serving        -> ``tpu_life.serve``: multi-tenant continuous-batching
+  session service (no reference analogue — the reference runs one board
+  per process; this is the ROADMAP's "serving heavy traffic" layer)
 """
 
 from tpu_life.version import __version__
 from tpu_life.models.rules import Rule, parse_rule, get_rule
 from tpu_life.config import RunConfig
 
-__all__ = ["__version__", "Rule", "parse_rule", "get_rule", "RunConfig"]
+
+def __getattr__(name):
+    # serve is re-exported lazily (PEP 562): its import chain reaches the
+    # driver and therefore jax, and jax-free paths (`tpu_life submit`,
+    # `gen`, `pattern`, rules-only library use) must not pay ~1s of jax
+    # import for an attribute they never touch
+    if name in ("ServeConfig", "SimulationService"):
+        from tpu_life import serve
+
+        return getattr(serve, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "__version__",
+    "Rule",
+    "parse_rule",
+    "get_rule",
+    "RunConfig",
+    "ServeConfig",
+    "SimulationService",
+]

@@ -78,6 +78,65 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sharded backend only (ignored elsewhere, and "
                    "recorded as null in the JSON)")
 
+    srv = sub.add_parser(
+        "serve",
+        help="multi-tenant batched serving: run every request in a JSONL "
+        "spool file through the continuous-batching service",
+    )
+    srv.add_argument(
+        "--requests",
+        default="serve_requests.jsonl",
+        metavar="JSONL",
+        help="request spool file (one JSON object per line; see "
+        "`tpu-life submit` and docs/SERVING.md)",
+    )
+    srv.add_argument(
+        "--output-dir",
+        default="serve_out",
+        help="where results land for requests without an output_file "
+        "(<output-dir>/<session-id>.txt, contract board format)",
+    )
+    srv.add_argument("--capacity", type=int, default=8,
+                     help="batch slots per compile key")
+    srv.add_argument("--chunk-steps", type=int, default=16,
+                     help="device steps per scheduling round")
+    srv.add_argument("--max-queue", type=int, default=64,
+                     help="bounded admission queue (backpressure threshold)")
+    srv.add_argument(
+        "--serve-backend",
+        default="jax",
+        choices=["jax", "numpy", "sharded", "stripes", "pallas", "native"],
+        help="engine executor: jax/numpy run a true batch axis, the rest "
+        "loop over slots (one Runner per session)",
+    )
+    srv.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
+                     help="default per-request deadline")
+    srv.add_argument("--metrics-file", default=None, metavar="JSONL",
+                     help="append per-round serve metrics as JSON lines")
+    srv.add_argument("--platform", default=None,
+                     help="force a JAX platform (cpu/tpu), like `run --platform`")
+    srv.add_argument("--profile", default=None, metavar="TRACE_DIR")
+    srv.add_argument("--verbose", "-v", action="store_true")
+
+    sm = sub.add_parser(
+        "submit",
+        help="append one simulation request to the serve spool file "
+        "(board + rule + step budget)",
+    )
+    sm.add_argument("--requests", default="serve_requests.jsonl", metavar="JSONL")
+    sm.add_argument("--input-file", default="data.txt")
+    sm.add_argument("--config-file", default="grid_size_data.txt",
+                    help="geometry fallback for unset --height/--width/--steps")
+    sm.add_argument("--height", type=int, default=None)
+    sm.add_argument("--width", type=int, default=None)
+    sm.add_argument("--steps", type=int, default=None)
+    sm.add_argument("--rule", default="conway")
+    sm.add_argument("--output-file", default=None,
+                    help="where `serve` writes this request's result "
+                    "(default: <output-dir>/<session-id>.txt)")
+    sm.add_argument("--timeout", type=float, default=None, metavar="SECONDS")
+    sm.add_argument("--id", default=None, help="client request tag echoed in the summary")
+
     g = sub.add_parser("gen", help="generate a random board + config")
     g.add_argument("--height", type=int, required=True)
     g.add_argument("--width", type=int, required=True)
@@ -241,6 +300,9 @@ def main(argv: list[str] | None = None) -> int:
         return _gen(args)
     if args.command == "pattern":
         return _pattern(parser, args)
+    if args.command == "submit":
+        # pure file append: no device ever touched, so no watchdog needed
+        return _submit(args)
 
     from tpu_life.utils.platform import devices_with_watchdog, ensure_platform
 
@@ -258,6 +320,8 @@ def main(argv: list[str] | None = None) -> int:
         # after the watchdog: _bench queries devices, and a wedged plugin
         # must degrade into the message above, not a hang
         return _bench(args)
+    if args.command == "serve":
+        return _serve(args)
     cfg = RunConfig(
         height=args.height,
         width=args.width,
@@ -417,6 +481,152 @@ def _bench(args) -> int:
         )
     )
     return 0
+
+
+def _submit(args) -> int:
+    """Append one request line to the serve spool — the client half of the
+    file-based front-end (`serve` is the server half).  Geometry falls back
+    to the contract config file exactly like `run` does."""
+    import json
+    from pathlib import Path
+
+    from tpu_life.config import RunConfig
+
+    height, width, steps = RunConfig(
+        height=args.height,
+        width=args.width,
+        steps=args.steps,
+        config_file=args.config_file,
+    ).resolved_geometry()
+    req = {
+        "input_file": args.input_file,
+        "height": height,
+        "width": width,
+        "steps": steps,
+        "rule": args.rule,
+    }
+    if args.output_file is not None:
+        req["output_file"] = args.output_file
+    if args.timeout is not None:
+        req["timeout_s"] = args.timeout
+    if args.id is not None:
+        req["id"] = args.id
+    p = Path(args.requests)
+    if p.parent != Path("."):
+        p.parent.mkdir(parents=True, exist_ok=True)
+    with open(p, "a") as f:
+        f.write(json.dumps(req) + "\n")
+        f.flush()
+    print(f"queued {args.input_file} ({height}x{width}, {steps} steps) -> {p}")
+    return 0
+
+
+def _serve(args) -> int:
+    """The serving loop: spool file in, result boards + one summary JSON
+    line out.  Exit 0 when every session completed, 1 when any failed —
+    the summary line carries the per-session detail either way."""
+    import json
+    from pathlib import Path
+
+    from tpu_life.io.codec import read_board, write_board
+    from tpu_life.runtime.metrics import configure_logging
+    from tpu_life.serve import ServeConfig, SessionState, SimulationService
+
+    configure_logging(args.verbose)
+    spool = Path(args.requests)
+    if not spool.exists():
+        raise FileNotFoundError(
+            f"request spool {args.requests!r} not found; queue requests "
+            f"with `tpu-life submit` first"
+        )
+    requests = []
+    with open(spool) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                requests.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{args.requests}:{lineno}: bad request line: {e}"
+                ) from e
+
+    svc = SimulationService(
+        ServeConfig(
+            capacity=args.capacity,
+            chunk_steps=args.chunk_steps,
+            max_queue=args.max_queue,
+            backend=args.serve_backend,
+            default_timeout_s=args.timeout,
+            metrics=True,
+            metrics_file=args.metrics_file,
+            profile=args.profile,
+        )
+    )
+    # admit respecting backpressure: when the bounded queue fills, pump
+    # until it drains enough to take the next request — the CLI is a
+    # well-behaved client of its own service
+    from tpu_life.serve import QueueFull
+
+    submitted: list[tuple[str, dict]] = []
+    for i, req in enumerate(requests):
+        board = read_board(req["input_file"], req["height"], req["width"])
+        while True:
+            try:
+                sid = svc.submit(
+                    board,
+                    req.get("rule", "conway"),
+                    int(req["steps"]),
+                    timeout_s=req.get("timeout_s"),
+                )
+                break
+            except QueueFull:
+                svc.pump()
+        submitted.append((sid, req))
+    svc.drain()
+    svc.close()  # metrics sink handle + idle engines
+
+    out_dir = Path(args.output_dir)
+    failures = []
+    written = 0
+    for sid, req in submitted:
+        view = svc.poll(sid)
+        if view.state is SessionState.DONE:
+            out = Path(req.get("output_file") or out_dir / f"{sid}.txt")
+            out.parent.mkdir(parents=True, exist_ok=True)
+            write_board(out, view.result)
+            written += 1
+        else:
+            failures.append(
+                {
+                    "session": sid,
+                    "id": req.get("id"),
+                    "state": view.state.value,
+                    "error": view.error,
+                }
+            )
+    stats = svc.stats()
+    print(
+        json.dumps(
+            {
+                "mode": "serve",
+                "backend": args.serve_backend,
+                "capacity": args.capacity,
+                "chunk_steps": args.chunk_steps,
+                "sessions": len(submitted),
+                "done": stats["done"],
+                "failed": stats["failed"],
+                "written": written,
+                "rounds": stats["rounds"],
+                "elapsed_s": stats["elapsed_s"],
+                "sessions_per_sec": stats["sessions_per_sec"],
+                "batch_occupancy_mean": stats["batch_occupancy_mean"],
+                "failures": failures,
+            }
+        )
+    )
+    return 0 if not failures else 1
 
 
 def _pattern(parser, args) -> int:

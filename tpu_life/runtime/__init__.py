@@ -1,3 +1,12 @@
-from tpu_life.runtime.driver import run, RunResult
-
 __all__ = ["run", "RunResult"]
+
+
+def __getattr__(name):
+    # lazy (PEP 562): driver's import chain reaches jax via parallel.mesh,
+    # and jax-free consumers (the serve scheduler importing only the
+    # recovery submodule, `tpu_life submit`/`gen`) must not pay for it
+    if name in __all__:
+        from tpu_life.runtime import driver
+
+        return getattr(driver, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -392,6 +392,9 @@ def run(cfg: RunConfig) -> RunResult:
             write_board(tmp, board)
 
     elapsed = timer.elapsed
+    # the sink handle is persistent + flushed per record; close it here so
+    # repeated in-process runs don't accumulate open fds until GC
+    recorder.close()
     if lead:
         # Contract parity: the reference's lead-rank report
         # (Parallel_Life_MPI.cpp:234-236).

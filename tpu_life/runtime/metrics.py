@@ -38,6 +38,37 @@ class MetricsRecorder:
         self.start_step = start_step  # rates count only this run's steps
         self.records: list[dict] = []
         self.sink = sink  # append each record as a JSON line here
+        self._sink_handle = None  # lazily opened, flushed per record
+
+    def record(self, rec: dict) -> None:
+        """Append an arbitrary record (and mirror it to the JSONL sink).
+
+        The generic entry point: ``record_chunk`` builds the per-chunk
+        simulation record, the serving layer emits per-round queue/batch
+        records — both land in the same ``records`` list and sink file.
+        """
+        if not self.enabled:
+            return
+        self.records.append(rec)
+        self._write_sink(rec)
+
+    def _write_sink(self, rec: dict) -> None:
+        # one persistent append handle, flushed per record: a JSONL
+        # consumer tailing the sink sees each complete line as soon as the
+        # chunk that produced it syncs, and a killed run loses nothing
+        if not self.sink:
+            return
+        import json
+
+        if self._sink_handle is None:
+            self._sink_handle = open(self.sink, "a")
+        self._sink_handle.write(json.dumps(rec) + "\n")
+        self._sink_handle.flush()
+
+    def close(self) -> None:
+        if self._sink_handle is not None:
+            self._sink_handle.close()
+            self._sink_handle = None
 
     def record_chunk(self, step: int, elapsed: float, live: int) -> None:
         """Record one host-sync chunk.  ``live`` comes from the runner's
@@ -47,21 +78,20 @@ class MetricsRecorder:
         if not self.enabled:
             return
         done = step - self.start_step
+        # rates report 0.0 (not NaN) when no time has elapsed: NaN is not
+        # valid JSON, so a single zero-elapsed chunk used to poison the
+        # JSONL sink for strict parsers downstream
         rec = {
             "step": step,
             "elapsed_s": elapsed,
             "live_cells": live,
-            "steps_per_sec": done / elapsed if elapsed > 0 else float("nan"),
+            "steps_per_sec": done / elapsed if elapsed > 0 else 0.0,
             "cell_updates_per_sec": done * self.cell_count / elapsed
             if elapsed > 0
-            else float("nan"),
+            else 0.0,
         }
         self.records.append(rec)
-        if self.sink:
-            import json
-
-            with open(self.sink, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+        self._write_sink(rec)
         log.info(
             "step=%d live=%d steps/s=%.2f cells/s=%.3e",
             step,

@@ -1,0 +1,54 @@
+"""tpu_life.serve: the multi-tenant batched simulation service.
+
+The first piece of the repo shaped like an inference stack rather than a
+batch job (ROADMAP north star: "serving heavy traffic").  Many concurrent
+sessions — (board, rule, step budget) each — are packed into fixed-
+capacity batches by compatible compile key and advanced by one compiled
+vmapped step per chunk, with continuous batching (sessions join and leave
+between host-sync chunks, zero recompilation), a bounded admission queue
+(typed backpressure), per-request deadlines, and per-slot failure
+isolation.
+
+Quick start::
+
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    svc = SimulationService(ServeConfig(capacity=8, backend="jax"))
+    sid = svc.submit(board, "conway", steps=100)
+    svc.drain()
+    final = svc.result(sid)
+
+See docs/SERVING.md for the architecture and the batching/compile-key
+rules, and ``tpu-life serve`` / ``tpu-life submit`` for the CLI front-end.
+"""
+
+from tpu_life.serve.engine import CompileKey, compile_key_for, make_engine
+from tpu_life.serve.errors import (
+    QueueFull,
+    ServeError,
+    SessionFailed,
+    SessionTimeout,
+    UnknownSession,
+)
+from tpu_life.serve.scheduler import RoundStats, Scheduler
+from tpu_life.serve.service import ServeConfig, SimulationService
+from tpu_life.serve.sessions import Session, SessionState, SessionStore, SessionView
+
+__all__ = [
+    "CompileKey",
+    "QueueFull",
+    "RoundStats",
+    "Scheduler",
+    "ServeConfig",
+    "ServeError",
+    "Session",
+    "SessionFailed",
+    "SessionState",
+    "SessionStore",
+    "SessionTimeout",
+    "SessionView",
+    "SimulationService",
+    "UnknownSession",
+    "compile_key_for",
+    "make_engine",
+]

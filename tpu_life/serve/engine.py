@@ -1,0 +1,290 @@
+"""Batched step engines: many tenant lattices through one compiled step.
+
+The Ising-on-TPU lesson (PAPERS.md, arXiv:1903.11714) is that stencil
+workloads only saturate an accelerator when many independent lattices ride
+one compiled program.  An engine owns a **fixed-capacity padded batch**:
+a ``(capacity, h, w)`` int8 array plus a per-slot ``remaining`` step
+vector.  Continuous batching falls out of two properties:
+
+- the compiled chunk function has *constant shapes* — capacity, board
+  geometry and chunk length never vary — so sessions can join and leave
+  between host-sync chunks with **zero recompilation** (the acceptance
+  test asserts ``compile_count == 1`` across 20 staggered sessions);
+- per-slot step budgets are enforced *inside* the compiled scan by a
+  freeze mask (``remaining > 0``): every step, slots whose budget is spent
+  keep their board unchanged.  One fused scan therefore advances each
+  slot by exactly ``min(chunk_steps, remaining[slot])`` steps — uneven
+  budgets with bit-identical results to independent sequential runs.
+
+Three executors behind one interface, mirroring the Backend split:
+
+- :class:`VmapEngine`  — ``jax`` backend: ``vmap`` of the XLA stencil step
+  under one jit/scan, the device path;
+- :class:`HostBatchEngine` — ``numpy`` backend: the ground-truth executor
+  on the same batch layout;
+- :class:`SlotLoopEngine` — any other backend (sharded / pallas / native /
+  stripes): one ``Runner`` per slot via the existing ``make_runner`` seam,
+  advanced slot by slot.  Slower, but keeps the whole backend matrix
+  servable without new kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_life.models.rules import Rule
+
+
+@dataclass(frozen=True)
+class CompileKey:
+    """What must match for two sessions to share one compiled batch.
+
+    Admission groups sessions by this key (scheduler.py); each key owns
+    one engine, one compiled program, one set of slots.  ``Rule`` is a
+    frozen hashable value, so the key is usable as a dict key directly.
+    """
+
+    rule: Rule
+    shape: tuple[int, int]  # (height, width)
+    dtype: str  # board element type ("int8" today)
+    backend: str  # executor family ("jax" / "numpy" / "sharded" / ...)
+
+
+def compile_key_for(rule: Rule, board: np.ndarray, backend: str) -> CompileKey:
+    return CompileKey(
+        rule=rule,
+        shape=(int(board.shape[0]), int(board.shape[1])),
+        dtype=str(board.dtype),
+        backend=backend,
+    )
+
+
+class EngineBase:
+    """Slot bookkeeping shared by every executor.
+
+    ``compile_count`` counts builds of the batched step program — the
+    expensive event continuous batching exists to avoid.  Tests assert it
+    stays at 1 per engine no matter how many sessions churn through.
+    """
+
+    def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
+        self.key = key
+        self.capacity = capacity
+        self.chunk_steps = chunk_steps
+        self.compile_count = 0
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self._remaining = np.zeros(capacity, dtype=np.int64)
+
+    # -- slot lifecycle ----------------------------------------------------
+    def acquire(self) -> int | None:
+        """Claim a free slot (None when the batch is full)."""
+        return self._free.pop() if self._free else None
+
+    def release(self, slot: int) -> None:
+        """Return a slot to the pool; its lattice is dead weight until the
+        next load (the freeze mask already ignores it: remaining == 0)."""
+        self._remaining[slot] = 0
+        self._clear_slot(slot)
+        self._free.append(slot)
+
+    def occupancy(self) -> int:
+        return self.capacity - len(self._free)
+
+    def load(self, slot: int, board: np.ndarray, steps: int) -> None:
+        """Stage a session's lattice into ``slot`` with ``steps`` budget."""
+        h, w = self.key.shape
+        if board.shape != (h, w):
+            raise ValueError(
+                f"board shape {board.shape} does not match engine key {self.key.shape}"
+            )
+        self._remaining[slot] = steps
+        self._load_slot(slot, np.asarray(board, np.int8), steps)
+
+    def remaining(self, slot: int) -> int:
+        return int(self._remaining[slot])
+
+    # -- the batched chunk -------------------------------------------------
+    def advance_chunk(self) -> dict[int, int]:
+        """Advance every occupied slot by ``min(chunk_steps, remaining)``
+        steps in one batched dispatch; returns {slot: steps_advanced}."""
+        advanced = {
+            s: min(self.chunk_steps, int(r))
+            for s, r in enumerate(self._remaining)
+            if r > 0
+        }
+        if advanced:
+            self._advance_impl()
+            self._remaining = np.maximum(self._remaining - self.chunk_steps, 0)
+        return advanced
+
+    # -- executor hooks ----------------------------------------------------
+    def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
+        raise NotImplementedError
+
+    def _clear_slot(self, slot: int) -> None:
+        raise NotImplementedError
+
+    def _advance_impl(self) -> None:
+        raise NotImplementedError
+
+    def fetch(self, slot: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class VmapEngine(EngineBase):
+    """The device path: one jitted ``lax.scan`` over the whole batch.
+
+    The batch axis is a plain ``jax.vmap`` over the existing single-board
+    stencil step (``ops.stencil.make_step``) — the same jaxpr every
+    single-session backend runs, so bit-identity with ``driver.run`` is
+    inherited, not re-proven.  Boards stay device-resident between chunks;
+    slot loads go through one jitted dynamic-update program (slot index
+    traced, so joining a running batch never triggers a retrace).
+    """
+
+    def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
+        super().__init__(key, capacity, chunk_steps)
+        import jax
+        import jax.numpy as jnp
+
+        h, w = key.shape
+        self._jnp = jnp
+        self._boards = jax.device_put(
+            jnp.zeros((capacity, h, w), dtype=jnp.int8)
+        )
+        self._rem_dev = jax.device_put(jnp.zeros(capacity, dtype=jnp.int32))
+
+        # slot writer: slot index and budget are traced scalars, so every
+        # load/evict reuses one compiled program regardless of which slot
+        def set_slot(boards, rem, slot, board, steps):
+            return boards.at[slot].set(board), rem.at[slot].set(steps)
+
+        self._set_slot = jax.jit(set_slot, donate_argnums=(0, 1))
+        self._chunk = None  # built lazily on first advance
+
+    def _build_chunk(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_life.ops.stencil import make_step
+
+        step = jax.vmap(make_step(self.key.rule))
+        length = self.chunk_steps
+
+        def chunk(boards, rem):
+            def body(carry, _):
+                bs, r = carry
+                stepped = step(bs)
+                live = (r > 0)[:, None, None]
+                bs = jnp.where(live, stepped, bs)
+                return (bs, jnp.maximum(r - 1, 0)), None
+
+            (boards, rem), _ = jax.lax.scan(
+                body, (boards, rem), None, length=length
+            )
+            return boards, rem
+
+        self.compile_count += 1
+        return jax.jit(chunk, donate_argnums=(0, 1))
+
+    def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
+        jnp = self._jnp
+        self._boards, self._rem_dev = self._set_slot(
+            self._boards,
+            self._rem_dev,
+            jnp.int32(slot),
+            jnp.asarray(board, jnp.int8),
+            jnp.int32(steps),
+        )
+
+    def _clear_slot(self, slot: int) -> None:
+        h, w = self.key.shape
+        self._load_slot(slot, np.zeros((h, w), np.int8), 0)
+
+    def _advance_impl(self) -> None:
+        if self._chunk is None:
+            self._chunk = self._build_chunk()
+        self._boards, self._rem_dev = self._chunk(self._boards, self._rem_dev)
+
+    def fetch(self, slot: int) -> np.ndarray:
+        return np.asarray(self._boards[slot])
+
+
+class HostBatchEngine(EngineBase):
+    """The numpy executor on the same batch layout — the serving twin of
+    ``NumpyBackend``, and the truth executor the equivalence tests pin
+    the device engine against."""
+
+    def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
+        super().__init__(key, capacity, chunk_steps)
+        h, w = key.shape
+        self._boards = np.zeros((capacity, h, w), dtype=np.int8)
+
+    def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
+        self._boards[slot] = board
+
+    def _clear_slot(self, slot: int) -> None:
+        self._boards[slot] = 0
+
+    def _advance_impl(self) -> None:
+        from tpu_life.ops.reference import step_np
+
+        rule = self.key.rule
+        for slot, rem in enumerate(self._remaining):
+            n = min(self.chunk_steps, int(rem))
+            b = self._boards[slot]
+            for _ in range(n):
+                b = step_np(b, rule)
+            self._boards[slot] = b
+
+    def fetch(self, slot: int) -> np.ndarray:
+        return self._boards[slot].copy()
+
+
+class SlotLoopEngine(EngineBase):
+    """Fallback for backends with no batch axis (sharded / pallas / native
+    / stripes): one device-resident ``Runner`` per slot via the existing
+    ``make_runner`` seam, advanced slot by slot each chunk.  Compilation
+    is the backend's business (each runner compiles its own step), so
+    ``compile_count`` stays 0 here by design."""
+
+    def __init__(self, key: CompileKey, capacity: int, chunk_steps: int, backend):
+        super().__init__(key, capacity, chunk_steps)
+        self._backend = backend
+        self._runners: dict[int, object] = {}
+
+    def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
+        from tpu_life.backends.base import make_runner
+
+        self._runners[slot] = make_runner(self._backend, board, self.key.rule)
+
+    def _clear_slot(self, slot: int) -> None:
+        self._runners.pop(slot, None)
+
+    def _advance_impl(self) -> None:
+        for slot, rem in enumerate(self._remaining):
+            n = min(self.chunk_steps, int(rem))
+            if n > 0:
+                self._runners[slot].advance(n)
+
+    def fetch(self, slot: int) -> np.ndarray:
+        return self._runners[slot].fetch()
+
+
+def make_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
+    """Engine factory, dispatched on the key's executor family."""
+    if key.backend == "jax":
+        return VmapEngine(key, capacity, chunk_steps)
+    if key.backend == "numpy":
+        return HostBatchEngine(key, capacity, chunk_steps)
+    from tpu_life.backends.base import get_backend
+
+    return SlotLoopEngine(
+        key, capacity, chunk_steps, get_backend(key.backend, rule=key.rule)
+    )

@@ -1,0 +1,43 @@
+"""Typed serving errors — the contract boundary of ``tpu_life.serve``.
+
+The reference program has exactly one failure mode: the process dies.  A
+serving layer needs *typed* rejections a caller can branch on: a full
+queue is backpressure (retry later, shed load upstream), a bad board is a
+client error (never retry), an unknown session id is a protocol bug.
+Everything subclasses :class:`ServeError` so front-ends can catch the
+whole family in one clause while tests assert the precise type.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(Exception):
+    """Base class for every error the serving layer raises on purpose."""
+
+
+class QueueFull(ServeError):
+    """Backpressure: the admission queue is at capacity.
+
+    Raised by ``submit`` *synchronously* — the request was never stored, so
+    rejecting it bounds memory.  The caller should retry after draining or
+    shed the request upstream.
+    """
+
+
+class SessionTimeout(ServeError):
+    """A session exceeded its per-request deadline.
+
+    Never raised to the submitter directly; recorded as the FAILED
+    session's ``error`` so ``poll`` can report it (the submitter may long
+    since have gone away — the timeout exists to reclaim its slot).
+    """
+
+
+class UnknownSession(ServeError):
+    """``poll``/``cancel``/``result`` named a session id that was never
+    issued by this service instance."""
+
+
+class SessionFailed(ServeError):
+    """Raised by ``result`` when the session terminated without a board
+    (FAILED or CANCELLED); carries the session's recorded error string."""

@@ -1,0 +1,240 @@
+"""Admission and slot scheduling: the queue/engine split.
+
+The MPMD-serving lesson (PAPERS.md, arXiv:2412.14374) is to separate
+request *ingestion* from device *stepping*: requests land in a bounded
+FIFO queue, a scheduling round moves compatible sessions into batch
+slots, and the engines advance whatever is resident.  Policies:
+
+- **Backpressure**: the queue is bounded (``max_queue``); an enqueue past
+  capacity raises :class:`~tpu_life.serve.errors.QueueFull` *before* the
+  session is stored, so a misbehaving client cannot grow memory.
+- **Admission**: sessions are grouped by :class:`CompileKey`; each key
+  lazily gets one engine with ``capacity`` slots.  Within a key the order
+  is strict FIFO; across keys the queue is scanned in submission order so
+  a full engine for one key never head-of-line-blocks another key's
+  sessions (per-key FIFO, globally work-conserving).
+- **Deadline-aware eviction**: a session past its deadline is failed with
+  :class:`SessionTimeout` wherever it is — dropped from the queue, or
+  evicted from its running slot so the batch's capacity goes back to
+  tenants that can still meet theirs.
+- **Per-slot failure isolation**: a failing session (the ``fault_at``
+  drill, or any RECOVERABLE error surfacing during its slot operations —
+  ``runtime.recovery`` semantics) marks only that session FAILED and
+  frees its slot; the rest of the batch keeps stepping.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from tpu_life.runtime import recovery
+from tpu_life.runtime.metrics import log
+from tpu_life.serve.engine import CompileKey, EngineBase, make_engine
+from tpu_life.serve.errors import QueueFull, SessionTimeout
+from tpu_life.serve.sessions import Session, SessionState
+
+
+@dataclass
+class RoundStats:
+    """What one scheduling round did — the metrics payload."""
+
+    admitted: int = 0
+    completed: int = 0
+    failed: int = 0
+    evicted: int = 0
+    steps_advanced: int = 0
+    queue_depth: int = 0
+    occupancy: int = 0  # occupied slots across engines after the round
+    slots: int = 0  # total allocated slots across engines
+
+
+@dataclass
+class Scheduler:
+    capacity: int = 8  # batch slots per engine (per compile key)
+    chunk_steps: int = 16  # device steps per host-sync scheduling round
+    max_queue: int = 64  # bounded admission queue (backpressure)
+    clock: object = time.monotonic
+
+    queue: deque = field(default_factory=deque)
+    engines: dict = field(default_factory=dict)  # CompileKey -> EngineBase
+    running: dict = field(default_factory=dict)  # CompileKey -> {slot: Session}
+
+    # -- ingestion ---------------------------------------------------------
+    def ensure_admission(self) -> None:
+        """Raise :class:`QueueFull` when the bounded queue is at capacity.
+
+        Exposed separately so the service can reject a submission *before*
+        constructing and storing the session — backpressure that bounds
+        memory, not just slots.
+        """
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(
+                f"admission queue full ({self.max_queue} sessions queued); "
+                f"retry after the batch drains"
+            )
+
+    def enqueue(self, session: Session) -> None:
+        self.ensure_admission()
+        self.queue.append(session)
+
+    def remove_queued(self, session: Session) -> bool:
+        try:
+            self.queue.remove(session)
+            return True
+        except ValueError:
+            return False
+
+    def evict_running(self, session: Session) -> bool:
+        """Free a RUNNING session's slot (cancel / deadline); the caller
+        sets the session's terminal state."""
+        for key, slots in self.running.items():
+            for slot, s in list(slots.items()):
+                if s is session:
+                    del slots[slot]
+                    self.engines[key].release(slot)
+                    return True
+        return False
+
+    # -- one scheduling round ---------------------------------------------
+    def round(self, keyer) -> RoundStats:
+        """Expire deadlines, admit from the queue, advance every engine one
+        chunk, retire finished slots.  ``keyer(session) -> CompileKey``.
+        """
+        stats = RoundStats()
+        now = self.clock()
+        self._expire(now, stats)
+        self._admit(keyer, stats)
+        # occupancy is sampled when the batch STEPS (post-admit, pre-
+        # advance): sampling after retirement would report an always-empty
+        # batch whenever sessions finish within one round
+        stats.occupancy = sum(e.occupancy() for e in self.engines.values())
+        stats.slots = sum(e.capacity for e in self.engines.values())
+        self._advance(stats)
+        stats.queue_depth = len(self.queue)
+        return stats
+
+    def _expire(self, now: float, stats: RoundStats) -> None:
+        # queued sessions past deadline: drop before they ever cost a slot
+        for s in [s for s in self.queue if s.deadline is not None and now >= s.deadline]:
+            self.queue.remove(s)
+            e = SessionTimeout(
+                f"deadline expired after {s.steps_done} steps (queued)"
+            )
+            s.fail(f"{type(e).__name__}: {e}")
+            stats.failed += 1
+            log.info("serve: session %s timed out in queue", s.sid)
+        # running sessions past deadline: evict — their slot goes back to
+        # tenants that can still meet their deadlines
+        for key, slots in self.running.items():
+            for slot, s in list(slots.items()):
+                if s.deadline is not None and now >= s.deadline:
+                    del slots[slot]
+                    self.engines[key].release(slot)
+                    e = SessionTimeout(
+                        f"deadline expired after {s.steps_done} steps (running)"
+                    )
+                    s.fail(f"{type(e).__name__}: {e}")
+                    stats.failed += 1
+                    stats.evicted += 1
+                    log.info("serve: session %s evicted (deadline)", s.sid)
+
+    def _admit(self, keyer, stats: RoundStats) -> None:
+        deferred = []
+        while self.queue:
+            s = self.queue.popleft()
+            key = keyer(s)
+            engine = self.engines.get(key)
+            if engine is None:
+                engine = self.engines[key] = make_engine(
+                    key, self.capacity, self.chunk_steps
+                )
+                self.running[key] = {}
+            slot = engine.acquire()
+            if slot is None:
+                # this key's batch is full: defer, keep scanning.  Later
+                # sessions of the SAME key also find it full and defer in
+                # order (per-key FIFO holds); other keys stay unblocked.
+                deferred.append(s)
+                continue
+            try:
+                engine.load(slot, s.board, s.steps_remaining)
+            except recovery.RECOVERABLE as e:
+                engine.release(slot)
+                s.fail(f"load failed: {e}")
+                stats.failed += 1
+                continue
+            s.state = SessionState.RUNNING
+            s.slot = slot
+            self.running[key][slot] = s
+            stats.admitted += 1
+        self.queue.extend(deferred)
+
+    def _advance(self, stats: RoundStats) -> None:
+        for key, engine in self.engines.items():
+            slots = self.running[key]
+            if not slots:
+                continue
+            # the fault-injection drill fires where a real per-slot device
+            # failure would: before the chunk that crosses fault_at.  Only
+            # the faulty tenant dies; its slot frees, the batch continues.
+            for slot, s in list(slots.items()):
+                to_run = min(engine.chunk_steps, s.steps_remaining)
+                if not (s.fault_at and s.steps_done < s.fault_at <= s.steps_done + to_run):
+                    continue
+                e = recovery.InjectedFault(
+                    f"injected per-slot device failure crossing step {s.fault_at}"
+                )
+                assert isinstance(e, recovery.RECOVERABLE)
+                del slots[slot]
+                engine.release(slot)
+                s.fail(f"{type(e).__name__}: {e}")
+                stats.failed += 1
+                log.info("serve: session %s failed in slot %d: %s", s.sid, slot, e)
+            if not slots:
+                continue
+            advanced = engine.advance_chunk()
+            for slot, n in advanced.items():
+                s = slots.get(slot)
+                if s is None:
+                    continue  # slot freed above; engine already ignores it
+                s.steps_done += n
+                stats.steps_advanced += n
+                if s.steps_remaining == 0:
+                    del slots[slot]
+                    try:
+                        board = engine.fetch(slot)
+                    except recovery.RECOVERABLE as e:
+                        engine.release(slot)
+                        s.fail(f"fetch failed: {e}")
+                        stats.failed += 1
+                        continue
+                    engine.release(slot)
+                    s.finish(board)
+                    stats.completed += 1
+
+    def release_idle_engines(self) -> int:
+        """Drop engines with no resident sessions; returns how many.
+
+        Engines are created lazily per CompileKey and a long-lived service
+        with varied client geometries would otherwise accumulate one
+        (capacity, h, w) device batch + compiled program per key forever.
+        Releasing an idle engine frees its device memory at the cost of a
+        recompile if that key's traffic returns — so this is an explicit
+        API for quiet periods, never called automatically mid-burst.
+        """
+        # a queued session for a released key just rebuilds the engine next
+        # round (one recompile) — no need to scan the queue here
+        idle_keys = [k for k, slots in self.running.items() if not slots]
+        for k in idle_keys:
+            del self.engines[k]
+            del self.running[k]
+        return len(idle_keys)
+
+    # -- introspection -----------------------------------------------------
+    def idle(self) -> bool:
+        return not self.queue and all(not s for s in self.running.values())
+
+    def compile_counts(self) -> dict[CompileKey, int]:
+        return {k: e.compile_count for k, e in self.engines.items()}

@@ -1,0 +1,256 @@
+"""The public serving API: ``submit / poll / cancel / drain``.
+
+:class:`SimulationService` is the in-process serving core — the piece of
+the repo whose shape is an inference stack rather than a batch job.  A
+network front-end would be a thin shell over exactly these four verbs;
+the CLI's ``serve`` / ``submit`` modes are the first such shell.
+
+Execution is cooperative: ``pump()`` runs one scheduling round (expire
+deadlines -> admit from the queue -> one batched device chunk per engine
+-> retire finished sessions), ``drain()`` pumps until idle.  Cooperative
+beats background threads here for the same reason the driver is a
+synchronous loop: every test and every caller sees a deterministic
+interleaving, and the host-sync chunk boundary is already the natural
+scheduling quantum (sessions join and leave the batch only there).
+
+Observability rides the existing runtime seams: every pump emits a
+``MetricsRecorder`` record (queue depth, batch occupancy, sessions/sec),
+and ``drain`` runs under ``runtime.profiling.maybe_profile`` so a serve
+trace lands in the same XProf tooling as a batch run.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_life.models.rules import Rule, get_rule
+from tpu_life.runtime.metrics import MetricsRecorder, log
+from tpu_life.runtime.profiling import maybe_profile
+from tpu_life.serve.engine import CompileKey, compile_key_for
+from tpu_life.serve.scheduler import RoundStats, Scheduler
+from tpu_life.serve.sessions import (
+    SessionState,
+    SessionStore,
+    SessionView,
+    TERMINAL,
+)
+
+
+@dataclass
+class ServeConfig:
+    capacity: int = 8  # batch slots per compile key
+    chunk_steps: int = 16  # device steps per scheduling round
+    max_queue: int = 64  # bounded admission queue (backpressure)
+    backend: str = "jax"  # engine executor: jax | numpy | sharded | pallas | ...
+    default_timeout_s: float | None = None  # per-request deadline default
+    metrics: bool = False  # record per-pump serve metrics
+    metrics_file: str | None = None  # JSONL sink (implies metrics)
+    profile: str | None = None  # jax.profiler trace dir for drain()
+
+
+class SimulationService:
+    def __init__(self, config: ServeConfig | None = None, *, clock=time.monotonic):
+        self.config = config or ServeConfig()
+        if self.config.max_queue < 1:
+            # a zero-length queue can never admit anything: every submit
+            # would bounce and a retry-on-QueueFull client would spin
+            raise ValueError(
+                f"max_queue must be >= 1, got {self.config.max_queue}"
+            )
+        # fail at construction, not at the first admission's lazy engine
+        # build (EngineBase re-checks, but by then sessions are queued)
+        if self.config.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {self.config.capacity}")
+        if self.config.chunk_steps < 1:
+            raise ValueError(
+                f"chunk_steps must be >= 1, got {self.config.chunk_steps}"
+            )
+        self.clock = clock
+        self.store = SessionStore()
+        self.scheduler = Scheduler(
+            capacity=self.config.capacity,
+            chunk_steps=self.config.chunk_steps,
+            max_queue=self.config.max_queue,
+            clock=clock,
+        )
+        self.recorder = MetricsRecorder(
+            0,
+            self.config.metrics,
+            sink=self.config.metrics_file,
+        )
+        self._t0 = clock()
+        self._completed = 0
+        self._rounds = 0
+        self._occupancy_sum = 0.0  # for mean batch occupancy in stats()
+
+    # -- the four verbs ----------------------------------------------------
+    def submit(
+        self,
+        board: np.ndarray,
+        rule: Rule | str,
+        steps: int,
+        *,
+        timeout_s: float | None = None,
+        fault_at: int = 0,
+    ) -> str:
+        """Admit one simulation request; returns its session id.
+
+        Validates exactly what the driver validates (2-D int8 board, every
+        state within the rule's range, non-negative budget) and raises
+        :class:`QueueFull` when the bounded queue is at capacity — the
+        request is rejected before anything is stored, so backpressure
+        bounds memory, not just slots.
+        """
+        if isinstance(rule, str):
+            rule = get_rule(rule)
+        board = np.asarray(board, dtype=np.int8)
+        if board.ndim != 2:
+            raise ValueError(f"board must be 2-D, got shape {board.shape}")
+        max_state = int(board.max(initial=0))
+        if max_state >= rule.states:
+            raise ValueError(
+                f"board contains state {max_state} but rule {rule.name!r} "
+                f"has only {rule.states} states (0..{rule.states - 1})"
+            )
+        min_state = int(board.min(initial=0))
+        if min_state < 0:
+            # the driver's file codec cannot produce negatives, but a
+            # library caller's array can — reject rather than simulate junk
+            raise ValueError(
+                f"board contains negative state {min_state}; states are "
+                f"0..{rule.states - 1}"
+            )
+        if steps < 0:
+            raise ValueError(f"steps must be >= 0, got {steps}")
+        # backpressure check BEFORE the session exists anywhere
+        self.scheduler.ensure_admission()
+        now = self.clock()
+        if timeout_s is None:
+            timeout_s = self.config.default_timeout_s
+        s = self.store.create(
+            board=board.copy(),
+            rule=rule,
+            steps=steps,
+            submitted_at=now,
+            deadline=None if timeout_s is None else now + timeout_s,
+            fault_at=fault_at,
+        )
+        if steps == 0:
+            # nothing to run: complete at admission, never costs a slot
+            s.finish(board.copy())
+            self._completed += 1
+        else:
+            self.scheduler.enqueue(s)
+        log.debug("serve: submitted %s (%s, %d steps)", s.sid, rule.name, steps)
+        return s.sid
+
+    def poll(self, sid: str) -> SessionView:
+        return self.store.view(sid)
+
+    def result(self, sid: str) -> np.ndarray:
+        return self.store.result(sid)
+
+    def cancel(self, sid: str) -> bool:
+        """Stop a session wherever it is; True if this call stopped it.
+
+        Cancelling a RUNNING session frees its batch slot at the next
+        round boundary semantics: the slot is released immediately, the
+        engine's freeze mask stops stepping it, and the partial board is
+        discarded (``steps_done`` records how far it got).
+        """
+        s = self.store.get(sid)
+        if s.state in TERMINAL:
+            return False
+        if s.state is SessionState.QUEUED:
+            self.scheduler.remove_queued(s)
+        else:
+            self.scheduler.evict_running(s)
+        s.cancel()
+        return True
+
+    def drain(self, max_rounds: int | None = None) -> int:
+        """Pump until every admitted session reaches a terminal state;
+        returns the number of rounds run.  ``max_rounds`` bounds a stuck
+        drain (it raises rather than spinning forever)."""
+        rounds = 0
+        with maybe_profile(self.config.profile):
+            while not self.scheduler.idle():
+                self.pump()
+                rounds += 1
+                if max_rounds is not None and rounds >= max_rounds:
+                    if not self.scheduler.idle():
+                        raise RuntimeError(
+                            f"drain did not converge in {max_rounds} rounds "
+                            f"({len(self.scheduler.queue)} queued)"
+                        )
+                    break
+        return rounds
+
+    # -- the scheduling quantum -------------------------------------------
+    def pump(self) -> RoundStats:
+        """One scheduling round; the only place device work happens."""
+        cfg = self.config
+
+        def keyer(s) -> CompileKey:
+            return compile_key_for(s.rule, s.board, cfg.backend)
+
+        stats = self.scheduler.round(keyer)
+        self._completed += stats.completed
+        self._rounds += 1
+        occ = stats.occupancy / stats.slots if stats.slots else 0.0
+        self._occupancy_sum += occ
+        elapsed = self.clock() - self._t0
+        self.recorder.record(
+            {
+                "kind": "serve",
+                "elapsed_s": elapsed,
+                "queue_depth": stats.queue_depth,
+                "batch_occupancy": occ,
+                "admitted": stats.admitted,
+                "completed": stats.completed,
+                "failed": stats.failed,
+                "steps_advanced": stats.steps_advanced,
+                "sessions_done": self._completed,
+                "sessions_per_sec": self._completed / elapsed
+                if elapsed > 0
+                else 0.0,
+            }
+        )
+        return stats
+
+    def release_idle_engines(self) -> int:
+        """Free engines (device batch + compiled program) whose keys have
+        no resident sessions — for quiet periods of a long-lived service;
+        returning traffic for a released key costs one recompile."""
+        return self.scheduler.release_idle_engines()
+
+    def close(self) -> None:
+        """Release held resources: the metrics sink handle and every idle
+        engine.  The service remains usable afterwards (the sink reopens
+        on the next record)."""
+        self.recorder.close()
+        self.scheduler.release_idle_engines()
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        elapsed = self.clock() - self._t0
+        return {
+            "sessions": len(self.store),
+            "queued": self.store.count(SessionState.QUEUED),
+            "running": self.store.count(SessionState.RUNNING),
+            "done": self.store.count(SessionState.DONE),
+            "failed": self.store.count(SessionState.FAILED),
+            "cancelled": self.store.count(SessionState.CANCELLED),
+            "rounds": self._rounds,
+            "elapsed_s": elapsed,
+            "sessions_per_sec": self._completed / elapsed if elapsed > 0 else 0.0,
+            "batch_occupancy_mean": self._occupancy_sum / self._rounds
+            if self._rounds
+            else 0.0,
+            "compile_counts": {
+                repr(k): v for k, v in self.scheduler.compile_counts().items()
+            },
+        }

@@ -1,0 +1,166 @@
+"""Session lifecycle: one tenant's simulation request from birth to result.
+
+The reference runs one board per process invocation; here a *session* is
+the unit of multi-tenancy — (board, rule, step budget) plus lifecycle
+state.  The state machine is small and strictly forward::
+
+    QUEUED ──> RUNNING ──> DONE
+       │          │ └────> FAILED     (per-slot failure / deadline eviction)
+       │          └──────> CANCELLED  (cancel mid-run frees the slot)
+       ├────────> FAILED              (deadline expired while queued)
+       └────────> CANCELLED           (cancel before admission)
+
+Terminal states keep either a result board (DONE) or an error string
+(FAILED / CANCELLED) — never both.  ``steps_done`` advances in host-sync
+chunk increments, the serving analogue of the driver's chunked epoch loop
+(``backends.base.drive_runner``).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_life.models.rules import Rule
+from tpu_life.serve.errors import SessionFailed, UnknownSession
+
+
+class SessionState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+#: States from which a session can never move again.
+TERMINAL = frozenset(
+    {SessionState.DONE, SessionState.FAILED, SessionState.CANCELLED}
+)
+
+
+@dataclass
+class Session:
+    sid: str
+    board: np.ndarray  # input board (int8, owned copy)
+    rule: Rule
+    steps: int  # total step budget
+    state: SessionState = SessionState.QUEUED
+    steps_done: int = 0
+    result: np.ndarray | None = None
+    error: str | None = None
+    submitted_at: float = 0.0
+    deadline: float | None = None  # absolute clock time; None = no timeout
+    # fault-injection drill (mirrors RunConfig.fault_at): raise a simulated
+    # per-slot device failure when the session would cross this step — the
+    # fixture behind the "one bad tenant must not kill the batch" tests
+    fault_at: int = 0
+    slot: int | None = None  # batch slot while RUNNING
+
+    @property
+    def steps_remaining(self) -> int:
+        return max(0, self.steps - self.steps_done)
+
+    def finish(self, board: np.ndarray) -> None:
+        self.state = SessionState.DONE
+        self.result = board
+        self.slot = None
+
+    def fail(self, error: str) -> None:
+        self.state = SessionState.FAILED
+        self.error = error
+        self.slot = None
+
+    def cancel(self) -> None:
+        self.state = SessionState.CANCELLED
+        self.error = "cancelled by client"
+        self.slot = None
+
+
+@dataclass(frozen=True)
+class SessionView:
+    """Immutable snapshot returned by ``poll`` — callers never see (or
+    mutate) the live Session the scheduler is driving."""
+
+    sid: str
+    state: SessionState
+    steps: int
+    steps_done: int
+    result: np.ndarray | None
+    error: str | None
+
+    @property
+    def finished(self) -> bool:
+        return self.state in TERMINAL
+
+
+class SessionStore:
+    """Issues ids and owns every session this service ever admitted.
+
+    Terminal sessions stay resident so late ``poll`` calls still resolve;
+    ``forget`` lets a long-lived service reclaim delivered results
+    (without it a months-running process grows without bound — the
+    serving twin of the driver's snapshot-retention concern).
+    """
+
+    def __init__(self):
+        self._sessions: dict[str, Session] = {}
+        self._counter = itertools.count()
+
+    def create(self, **kwargs) -> Session:
+        sid = f"s{next(self._counter):06d}"
+        s = Session(sid=sid, **kwargs)
+        self._sessions[sid] = s
+        return s
+
+    def get(self, sid: str) -> Session:
+        try:
+            return self._sessions[sid]
+        except KeyError:
+            raise UnknownSession(f"unknown session id {sid!r}") from None
+
+    def view(self, sid: str) -> SessionView:
+        s = self.get(sid)
+        return SessionView(
+            sid=s.sid,
+            state=s.state,
+            steps=s.steps,
+            steps_done=s.steps_done,
+            result=s.result,
+            error=s.error,
+        )
+
+    def result(self, sid: str) -> np.ndarray:
+        """The DONE session's final board, or a typed error explaining why
+        there is none (still in flight -> UnknownSession is wrong, so an
+        unfinished session raises SessionFailed with a 'not finished'
+        message only from FAILED/CANCELLED; in-flight raises ValueError)."""
+        s = self.get(sid)
+        if s.state is SessionState.DONE:
+            assert s.result is not None
+            return s.result
+        if s.state in TERMINAL:
+            raise SessionFailed(
+                f"session {sid} {s.state.value}: {s.error or 'no result'}"
+            )
+        raise ValueError(f"session {sid} still {s.state.value}; poll later")
+
+    def forget(self, sid: str) -> None:
+        """Drop a TERMINAL session (delivered results are the caller's now)."""
+        s = self.get(sid)
+        if s.state not in TERMINAL:
+            raise ValueError(f"cannot forget live session {sid} ({s.state.value})")
+        del self._sessions[sid]
+
+    def count(self, state: SessionState) -> int:
+        return sum(1 for s in self._sessions.values() if s.state is state)
+
+    def live(self) -> list[Session]:
+        """Sessions not yet in a terminal state, in submission order."""
+        return [s for s in self._sessions.values() if s.state not in TERMINAL]
+
+    def __len__(self) -> int:
+        return len(self._sessions)
