@@ -28,10 +28,50 @@ import pytest
 from tpu_life.models.patterns import random_board
 
 
+def _stripe_path_unavailable() -> str | None:
+    """Skip reason when the composed Pallas stripe path cannot run here.
+
+    The sharded-Pallas composition (pallas_backend.make_sharded_pallas_run
+    and the sharded backend's ``local_kernel='pallas'``) calls jax's
+    top-level ``shard_map`` with ``check_vma`` — present from jax 0.6; the
+    pre-0.6 ``jax.experimental.shard_map`` would reject the call, so there
+    is no fallback (ADVICE r2).  On environments pinned to an older jax the
+    affected tests are a *capability* gap, not a regression: gate them
+    behind ``requires_tpu_interpret`` instead of letting tier-1 carry ~49
+    permanent failures (ISSUE 2 satellite; baseline recorded in CHANGES.md).
+    """
+    try:
+        from jax import shard_map  # noqa: F401  (the probe IS the import)
+    except ImportError as e:
+        return (
+            f"composed Pallas stripe path unavailable on this jax "
+            f"({jax.__version__}): {e}"
+        )
+    return None
+
+
+_STRIPE_SKIP_REASON = _stripe_path_unavailable()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running end-to-end tests (subprocesses, goldens)"
     )
+    config.addinivalue_line(
+        "markers",
+        "requires_tpu_interpret: needs the composed Pallas stripe path "
+        "(jax with top-level shard_map — 0.6+ — for interpret mode on "
+        "CPU, or a real TPU); skipped when the capability probe fails",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if _STRIPE_SKIP_REASON is None:
+        return
+    skip = pytest.mark.skip(reason=_STRIPE_SKIP_REASON)
+    for item in items:
+        if "requires_tpu_interpret" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture

@@ -1,0 +1,489 @@
+"""tpu_life.autotune: the measured autotuner + persistent config cache.
+
+Covers the ISSUE 2 acceptance surface: cache round-trip / atomic write /
+schema-version invalidation, deterministic winner selection under injected
+fake timings, cost-model monotonicity (the blocksweep k>=32 cliff), the
+serve read path's never-measure guarantee, and the CLI tune -> run
+resolve-from-cache flow with the zero-measured-trials probe.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_life import autotune
+from tpu_life.autotune import cache, cost_model, runner, space
+from tpu_life.autotune.space import TuneKey, TunedConfig
+from tpu_life.models.rules import get_rule
+
+
+@pytest.fixture
+def cache_file(tmp_path, monkeypatch):
+    """An isolated autotune cache; the env override is the same seam CI
+    and fleet images use."""
+    p = tmp_path / "autotune.json"
+    monkeypatch.setenv(cache.ENV_VAR, str(p))
+    return p
+
+
+@pytest.fixture(autouse=True)
+def _reset_probe():
+    autotune.reset_trial_count()
+    yield
+    autotune.reset_trial_count()
+
+
+def make_key(**kw) -> TuneKey:
+    base = dict(
+        device_kind="cpu",
+        device_count=8,
+        rule_name="B3/S23",
+        radius=1,
+        states=2,
+        neighborhood="moore",
+        boundary="clamped",
+        shape_bucket=(4096, 4096),
+        bitpack_ok=True,
+    )
+    base.update(kw)
+    return TuneKey(**base)
+
+
+# --- key / space -------------------------------------------------------------
+
+
+def test_shape_bucket_pow2_ceil_with_floor():
+    assert space.shape_bucket(100, 4096) == (128, 4096)
+    assert space.shape_bucket(129, 4097) == (256, 8192)
+    assert space.shape_bucket(1, 1) == (128, 128)
+    with pytest.raises(ValueError):
+        space.shape_bucket(0, 64)
+
+
+def test_tune_key_for_matches_live_platform():
+    import jax
+
+    key = autotune.tune_key_for(get_rule("conway"), (70, 150))
+    assert key.device_kind == jax.devices()[0].platform
+    assert key.device_count == len(jax.devices())
+    assert key.shape_bucket == (128, 256)
+    assert key.bitpack_ok
+    # the id is the cache identity: stable and fully determined
+    assert key.id() == autotune.tune_key_for(get_rule("conway"), (80, 130)).id()
+
+
+def test_enumerate_candidates_cpu_space():
+    cands = space.enumerate_candidates(make_key(), backend_set=("jax", "sharded"))
+    backends = {c.backend for c in cands}
+    assert backends == {"jax", "sharded"}
+    ks = sorted(c.block_steps for c in cands if c.backend == "sharded")
+    assert ks == sorted(space.BLOCK_STEPS_GRID)
+    # pallas never proposed off-TPU (interpret mode is not a candidate)
+    assert "pallas" not in backends
+    with pytest.raises(ValueError):
+        space.enumerate_candidates(make_key(), backend_set=("warp",))
+
+
+def test_enumerate_candidates_tpu_space_includes_pallas():
+    cands = space.enumerate_candidates(make_key(device_kind="tpu"))
+    assert {c.backend for c in cands} >= {"jax", "sharded", "pallas"}
+    assert any(
+        c.backend == "sharded" and c.local_kernel == "pallas" for c in cands
+    )
+
+
+def test_enumerate_candidates_torus_divisibility():
+    key = make_key(boundary="torus", device_count=8)
+    # 70 rows don't divide an 8-way mesh: sharded drops out, jax remains
+    cands = space.enumerate_candidates(
+        key, backend_set=("jax", "sharded"), shape=(70, 150)
+    )
+    assert {c.backend for c in cands} == {"jax"}
+    cands = space.enumerate_candidates(
+        key, backend_set=("jax", "sharded"), shape=(64, 150)
+    )
+    assert "sharded" in {c.backend for c in cands}
+
+
+def test_tuned_config_round_trip_and_kwargs():
+    cfg = TunedConfig("sharded", 8, "pallas", True, 0)
+    assert TunedConfig.from_dict(cfg.to_dict()) == cfg
+    kw = cfg.backend_kwargs()
+    assert kw["block_steps"] == 8 and kw["local_kernel"] == "pallas"
+    assert "block_steps" not in TunedConfig("jax").backend_kwargs()
+
+
+# --- cost model --------------------------------------------------------------
+
+
+def test_cost_model_reproduces_blocksweep_cliff():
+    """The committed sweep's shape (RESULTS_blocksweep_r4.json): k=8 and
+    k=16 are the noise-band optimum for radius-1 rules; k>=32 degrades
+    monotonically (recomputed fringe)."""
+    key = make_key(device_count=1)
+
+    def cost(k):
+        return cost_model.estimate_cost(key, TunedConfig("sharded", k, "xla"))
+
+    assert cost(32) > cost(8) and cost(32) > cost(16)
+    assert cost(64) > cost(32)  # monotone past the cliff
+    assert cost(1) > cost(8)  # unblocked pays full HBM traffic
+    grid_best = min(space.BLOCK_STEPS_GRID, key=cost)
+    assert grid_best in (8, 16)
+
+
+def test_cost_model_radius_steepens_the_fringe():
+    # wider radius -> recomputed fringe grows faster with k: the cliff
+    # past the optimum stays, and deep blocking (k=32) never wins at r=5
+    r5 = make_key(device_count=1, radius=5, bitpack_ok=False)
+
+    def cost(key, k):
+        return cost_model.estimate_cost(
+            key, TunedConfig("sharded", k, "xla", bitpack=False)
+        )
+
+    assert cost(r5, 64) > cost(r5, 32) > cost(r5, 16)  # the cliff holds
+    assert min(space.BLOCK_STEPS_GRID, key=lambda k: cost(r5, k)) in (8, 16)
+    # at fixed k, more radius = more fringe = more cost
+    r1 = make_key(device_count=1, radius=1, bitpack_ok=False)
+    assert cost(r5, 16) > cost(r1, 16)
+
+
+def test_cost_model_prefers_packed_and_never_numpy():
+    key = make_key()
+    packed = TunedConfig("jax", None, "auto", True)
+    unpacked = TunedConfig("jax", None, "auto", False)
+    assert cost_model.estimate_cost(key, packed) < cost_model.estimate_cost(
+        key, unpacked
+    )
+    cands = [TunedConfig("numpy", None, "auto", False), packed]
+    assert cost_model.choose(key, cands) == packed
+
+
+# --- cache -------------------------------------------------------------------
+
+
+def test_cache_round_trip(cache_file):
+    key = make_key()
+    cfg = TunedConfig("sharded", 8, "xla", True, 0)
+    assert cache.get(key) is None
+    cache.put(key, cfg, source="measured", seconds_per_step=1e-3, trials=3)
+    entry = cache.get(key)
+    assert entry is not None
+    assert TunedConfig.from_dict(entry["config"]) == cfg
+    assert entry["source"] == "measured"
+    # a second key coexists; the first survives the read-modify-write
+    key2 = make_key(shape_bucket=(128, 128))
+    cache.put(key2, TunedConfig("jax"), source="measured")
+    assert cache.get(key) is not None and cache.get(key2) is not None
+
+
+def test_cache_atomic_write_leaves_no_temp_files(cache_file):
+    cache.put(make_key(), TunedConfig("jax"), source="measured")
+    siblings = [p.name for p in cache_file.parent.iterdir()]
+    assert cache_file.name in siblings
+    assert not [n for n in siblings if ".tmp" in n]
+    # the published file is complete, valid JSON with the schema stamp
+    raw = json.loads(cache_file.read_text())
+    assert raw["schema"] == cache.SCHEMA_VERSION
+
+
+def test_cache_schema_version_invalidates_wholesale(cache_file):
+    key = make_key()
+    cache.put(key, TunedConfig("jax"), source="measured")
+    raw = json.loads(cache_file.read_text())
+    raw["schema"] = cache.SCHEMA_VERSION + 1
+    cache_file.write_text(json.dumps(raw))
+    # a different schema means different semantics: the whole file is stale
+    assert cache.load() == {}
+    assert cache.get(key) is None
+    # writing through the stale file re-publishes the current schema
+    cache.put(key, TunedConfig("jax"), source="measured")
+    assert json.loads(cache_file.read_text())["schema"] == cache.SCHEMA_VERSION
+
+
+def test_cache_corrupt_file_and_malformed_entries_degrade(cache_file):
+    cache_file.write_text("{ not json")
+    assert cache.load() == {}  # never raises: the cache is an accelerator
+    key = make_key()
+    cache.put(key, TunedConfig("jax"), source="measured")
+    raw = json.loads(cache_file.read_text())
+    raw["entries"]["bogus-key"] = {"config": {"no_backend": True}}
+    cache_file.write_text(json.dumps(raw))
+    loaded = cache.load()
+    assert key.id() in loaded and "bogus-key" not in loaded
+
+
+def test_cache_invalidate(cache_file):
+    key = make_key()
+    cache.put(key, TunedConfig("jax"), source="measured")
+    assert cache.invalidate(key) == 1
+    assert cache.get(key) is None
+    cache.put(key, TunedConfig("jax"), source="measured")
+    assert cache.invalidate() == 1 and cache.load() == {}
+
+
+def test_cache_env_and_explicit_path(tmp_path, monkeypatch):
+    monkeypatch.setenv(cache.ENV_VAR, str(tmp_path / "env.json"))
+    assert cache.cache_path() == tmp_path / "env.json"
+    assert cache.cache_path(tmp_path / "x.json") == tmp_path / "x.json"
+    monkeypatch.delenv(cache.ENV_VAR)
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+    assert cache.cache_path() == tmp_path / "xdg" / "tpu_life" / "autotune.json"
+
+
+# --- trials / winner selection ----------------------------------------------
+
+
+def test_deterministic_winner_under_fake_timings(cache_file):
+    """Injected timings make selection a pure function: argmin of the
+    median, first-wins on ties, independent of wall clock."""
+    key = make_key(shape_bucket=(128, 128))
+    rule = get_rule("conway")
+    cands = space.enumerate_candidates(key, backend_set=("jax", "sharded"))
+    timing = {c: 5e-3 for c in cands}
+    winner = next(c for c in cands if c.backend == "sharded" and c.block_steps == 8)
+    timing[winner] = 1e-3
+    res = autotune.tune(
+        key,
+        rule,
+        shape=(32, 32),
+        backend_set=("jax", "sharded"),
+        measure=lambda cfg, board, r: timing[cfg],
+    )
+    assert res.best == winner and res.source == "measured"
+    # tie-break: equal times -> first candidate in enumeration order
+    res2 = autotune.tune(
+        key,
+        rule,
+        shape=(32, 32),
+        backend_set=("jax", "sharded"),
+        measure=lambda cfg, board, r: 2e-3,
+    )
+    assert res2.best == cands[0]
+    # the winner was persisted and now resolves from cache
+    got, source = autotune.resolve(key)
+    assert source == "cache" and got == cands[0]
+
+
+def test_per_candidate_failure_isolation(cache_file):
+    """A crashing candidate is recorded infeasible and never aborts the
+    search; an all-crash sweep raises with the collected errors."""
+    key = make_key(shape_bucket=(128, 128))
+    rule = get_rule("conway")
+
+    def measure(cfg, board, r):
+        if cfg.backend == "sharded":
+            raise RuntimeError("mesh exploded")
+        return 1e-3
+
+    res = autotune.tune(
+        key, rule, shape=(32, 32), backend_set=("jax", "sharded"), measure=measure
+    )
+    infeasible = [r for r in res.results if not r.ok]
+    assert infeasible and all("mesh exploded" in r.error for r in infeasible)
+    assert res.best.backend == "jax"
+
+    def all_fail(cfg, board, r):
+        raise RuntimeError("no device")
+
+    with pytest.raises(RuntimeError, match="every candidate failed"):
+        autotune.tune(
+            key, rule, shape=(32, 32), backend_set=("jax",), measure=all_fail
+        )
+
+
+def test_measured_trials_increment_the_probe(cache_file):
+    """Real (non-injected) trials tick the trial counter — the probe the
+    zero-measurement assertions below rely on."""
+    key = autotune.tune_key_for(get_rule("conway"), (64, 64))
+    res = autotune.tune(
+        key, "conway", shape=(64, 64), backend_set=("jax",), trials=2, steps=2,
+        warmup_steps=1,
+    )
+    assert res.source == "measured"
+    assert autotune.trial_count() >= 2
+    assert res.cache_file == str(cache.cache_path())
+
+
+# --- resolve: the read path --------------------------------------------------
+
+
+def test_resolve_miss_uses_cost_model_and_never_measures(cache_file):
+    key = make_key()
+    cfg, source = autotune.resolve(key, shape=(4096, 4096))
+    assert source == "cost_model"
+    assert cfg.backend in ("jax", "sharded")
+    assert autotune.trial_count() == 0
+    assert not cache_file.exists()  # the read path never writes either
+
+
+def test_resolve_backend_kwargs_explicit_pins_win(cache_file):
+    """The shared bench/CLI merge rule: tuned knobs fill in via setdefault,
+    a knob already pinned in kwargs (an explicit flag) beats the cache."""
+    rule = get_rule("conway")
+    key = autotune.tune_key_for(rule, (64, 64))
+    cache.put(key, TunedConfig("sharded", 32, "pallas"), source="measured")
+    kwargs = {"bitpack": True, "local_kernel": "xla"}  # the user's pins
+    backend_name, tuned, source = autotune.resolve_backend_kwargs(
+        rule, (64, 64), kwargs
+    )
+    assert (backend_name, source) == ("sharded", "cache")
+    assert kwargs["local_kernel"] == "xla"  # pin survived the merge
+    assert kwargs["block_steps"] == 32  # unpinned knob came from the cache
+    assert autotune.trial_count() == 0
+
+
+def test_resolve_modes(cache_file):
+    key = make_key()
+    cached = TunedConfig("sharded", 16, "xla", True, 0)
+    cache.put(key, cached, source="measured")
+    assert autotune.resolve(key) == (cached, "cache")
+    # off: cost model only, the cache is deliberately ignored
+    cfg, source = autotune.resolve(key, mode="off")
+    assert source == "cost_model"
+    with pytest.raises(ValueError, match="tune_mode"):
+        autotune.resolve(key, mode="always")
+
+
+# --- serve integration: resolve, never measure -------------------------------
+
+
+def test_serve_tuned_backend_resolves_without_measuring(cache_file):
+    """ServeConfig(backend='tuned'): per-CompileKey resolution goes through
+    the cache/cost-model read path only — serving latency never pays
+    tuning cost, even on a cold cache."""
+    from tpu_life.ops.reference import run_np
+    from tpu_life.serve import ServeConfig, SessionState, SimulationService
+
+    rng = np.random.default_rng(7)
+    board = rng.integers(0, 2, size=(48, 64), dtype=np.int8)
+    svc = SimulationService(
+        ServeConfig(backend="tuned", capacity=2, chunk_steps=8)
+    )
+    sid = svc.submit(board, "conway", 12)
+    svc.drain()
+    view = svc.poll(sid)
+    assert view.state is SessionState.DONE
+    np.testing.assert_array_equal(
+        view.result, run_np(board, get_rule("conway"), 12)
+    )
+    assert autotune.trial_count() == 0  # the never-measure guarantee
+    # warm cache path: identical guarantee, now serving the tuned entry
+    key = autotune.tune_key_for(get_rule("conway"), (48, 64))
+    cache.put(key, TunedConfig("numpy"), source="measured")
+    svc2 = SimulationService(
+        ServeConfig(backend="tuned", capacity=2, chunk_steps=8)
+    )
+    sid2 = svc2.submit(board, "conway", 5)
+    svc2.drain()
+    assert svc2.poll(sid2).state is SessionState.DONE
+    assert autotune.trial_count() == 0
+
+
+# --- driver / CLI: tune offline, run from cache ------------------------------
+
+
+def test_cli_tune_then_run_resolves_from_cache(cache_file, tmp_path, monkeypatch):
+    """The acceptance flow: `tpu-life tune` persists a cache entry; a
+    subsequent `tpu-life run --backend tuned` resolves from it with ZERO
+    measured trials (the trial-count probe)."""
+    from tpu_life import cli
+
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(
+        [
+            "tune",
+            "--backend-set",
+            "jax,sharded",
+            "--size",
+            "64",
+            "--trials",
+            "3",
+            "--steps",
+            "2",
+            "--warmup-steps",
+            "1",
+        ]
+    )
+    assert rc == 0
+    assert cache_file.exists()
+    assert autotune.trial_count() > 0  # the tune itself measured
+
+    rc = cli.main(["gen", "--height", "64", "--width", "64", "--steps", "4"])
+    assert rc == 0
+    autotune.reset_trial_count()
+    rc = cli.main(["run", "--backend", "tuned"])
+    assert rc == 0
+    assert autotune.trial_count() == 0  # resolved from cache, zero trials
+    # the run really happened: contract output exists and is loadable
+    from tpu_life.io.codec import read_board
+    from tpu_life.ops.reference import run_np
+
+    board = read_board(tmp_path / "data.txt", 64, 64)
+    np.testing.assert_array_equal(
+        read_board(tmp_path / "output.txt", 64, 64),
+        run_np(board, get_rule("conway"), 4),
+    )
+
+
+def test_driver_tune_mode_measure_populates_cache(cache_file, tmp_path, monkeypatch):
+    """tune_mode='measure': a cache miss runs the search inline, persists
+    the winner, and the next run is a pure cache hit."""
+    from tpu_life.config import RunConfig
+    from tpu_life.io.codec import write_board, write_config
+    from tpu_life.runtime.driver import run
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.default_rng(3)
+    board = rng.integers(0, 2, size=(64, 64), dtype=np.int8)
+    write_board("data.txt", board)
+    write_config("grid_size_data.txt", 64, 64, 3)
+    result = run(RunConfig(backend="tuned", tune_mode="measure"))
+    assert result.steps_run == 3
+    assert autotune.trial_count() > 0
+    key = autotune.tune_key_for(get_rule("conway"), (64, 64))
+    assert cache.get(key) is not None
+    autotune.reset_trial_count()
+    result2 = run(RunConfig(backend="tuned", output_file="out2.txt"))
+    assert autotune.trial_count() == 0
+    np.testing.assert_array_equal(result.board, result2.board)
+
+
+def test_driver_explicit_flags_beat_the_cache(cache_file, tmp_path, monkeypatch):
+    """--block-steps / --local-kernel pins win over the cached knobs; the
+    cached backend choice still applies."""
+    from tpu_life.config import RunConfig
+    from tpu_life.io.codec import write_board, write_config
+    from tpu_life.ops.reference import run_np
+    from tpu_life.runtime.driver import run
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.default_rng(5)
+    board = rng.integers(0, 2, size=(40, 56), dtype=np.int8)
+    write_board("data.txt", board)
+    write_config("grid_size_data.txt", 40, 56, 4)
+    key = autotune.tune_key_for(get_rule("conway"), (40, 56))
+    cache.put(key, TunedConfig("sharded", 32, "xla"), source="measured")
+    result = run(RunConfig(backend="tuned", block_steps=2, output_file=None))
+    assert result.backend == "sharded"
+    assert autotune.trial_count() == 0
+    np.testing.assert_array_equal(
+        result.board, run_np(board, get_rule("conway"), 4)
+    )
+
+
+def test_run_config_rejects_bad_tune_mode(tmp_path, monkeypatch):
+    from tpu_life.config import RunConfig
+    from tpu_life.runtime.driver import run
+
+    monkeypatch.chdir(tmp_path)
+    from tpu_life.io.codec import write_board, write_config
+
+    write_board("data.txt", np.zeros((8, 8), np.int8))
+    write_config("grid_size_data.txt", 8, 8, 1)
+    with pytest.raises(ValueError, match="tune_mode"):
+        run(RunConfig(backend="tuned", tune_mode="sometimes"))

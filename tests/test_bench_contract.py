@@ -273,10 +273,12 @@ def test_bench_probe_budget_bounds_total_sleep():
 
 
 @pytest.mark.slow
+@pytest.mark.requires_tpu_interpret
 def test_graft_entry_contract():
     """entry() returns a jittable fn + args; dryrun_multichip passes on the
     fake 8-device mesh and prints one ok line per leg (the artifact the
-    judge reads — ADVICE r3)."""
+    judge reads — ADVICE r3).  The composed-Pallas legs need the stripe
+    path (conftest capability probe), hence the marker."""
     sys.path.insert(0, REPO)
     try:
         import __graft_entry__ as g

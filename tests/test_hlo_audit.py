@@ -129,6 +129,7 @@ def test_torus_ring_has_exactly_one_ppermute_pair(packed):
     assert_exact_permutes(txt, 2, f"torus packed={packed}")
 
 
+@pytest.mark.requires_tpu_interpret
 def test_composed_pallas_step_has_exactly_one_ppermute_pair():
     """The flagship composition (Pallas stripe kernel inside shard_map):
     the kernel swap must not change the exchange census."""
@@ -163,6 +164,7 @@ def test_diamond_packed_step_has_exactly_one_ppermute_pair():
     [("conway:T", True), ("R2,C2,S2..4,B2..3,NN", False)],
     ids=["pallas-torus", "pallas-diamond"],
 )
+@pytest.mark.requires_tpu_interpret
 def test_composed_pallas_variants_census(spec, torus):
     """The stripe kernel's torus and diamond modes keep the same
     collective census as the Moore composition: the kernel swap and the

@@ -170,6 +170,7 @@ def test_neighborhood_topology_axes_agree(seed):
 
 
 @pytest.mark.parametrize("seed", range(6))
+@pytest.mark.requires_tpu_interpret
 def test_pallas_stripe_kernel_modes_agree(seed):
     """Random rules through the Pallas stripe kernel's three modes (Moore
     clamped, Moore torus ring, diamond r<=2) in interpret mode: the VMEM

@@ -165,6 +165,7 @@ def test_torus_backends_actually_run_packed(rng_board):
 
 
 @pytest.mark.parametrize("width", [65, 96, 128], ids=lambda w: f"w{w}")
+@pytest.mark.requires_tpu_interpret
 def test_pallas_torus_stripe_kernel_bit_identical(width, rng_board):
     """The Pallas stripe kernel's torus variant (seam carries wrap at the
     LOGICAL width even under lane padding; closed ring): bit-identical to
@@ -186,6 +187,7 @@ def test_pallas_torus_stripe_kernel_bit_identical(width, rng_board):
     np.testing.assert_array_equal(out, run_np(board, rule, 12))
 
 
+@pytest.mark.requires_tpu_interpret
 def test_pallas_torus_single_shard_own_edges(rng_board):
     """n=1 mesh: the shard's own edges are the wrap neighbors (no
     ppermute) — the headline single-chip torus configuration."""
@@ -200,6 +202,7 @@ def test_pallas_torus_single_shard_own_edges(rng_board):
     np.testing.assert_array_equal(out, run_np(board, rule, 10))
 
 
+@pytest.mark.requires_tpu_interpret
 def test_pallas_torus_glider_circumnavigates_seams():
     """64 steps on a 16-wide torus over 2 shards lands the glider exactly
     back: both seam kinds (ring wrap + in-row wrap) at once."""

@@ -126,6 +126,7 @@ def test_explicit_pallas_local_kernel_refuses_with_the_real_reason(rng_board):
     [VN_SPEC, "R1,C2,S2..3,B3,NN", "R2,C2,M1,S3..6,B3..5,NN"],
     ids=["r2", "r1", "m1-center"],
 )
+@pytest.mark.requires_tpu_interpret
 def test_pallas_stripe_kernel_runs_diamonds(spec, rng_board):
     """The Pallas stripe kernel's diamond mode (roll shift-by-k planes):
     bit-identical across shard seams with deep r-scaled halos."""

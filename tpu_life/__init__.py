@@ -21,6 +21,10 @@ Mapping of the reference's layers (SURVEY.md §1) onto this package:
 - L6 serving        -> ``tpu_life.serve``: multi-tenant continuous-batching
   session service (no reference analogue — the reference runs one board
   per process; this is the ROADMAP's "serving heavy traffic" layer)
+- L7 autotuning     -> ``tpu_life.autotune``: measured knob search with a
+  persistent per-device config cache (no reference analogue — the
+  reference has three config ints; this is how the framework picks its
+  dozen performance knobs per device/rule/shape, docs/AUTOTUNE.md)
 """
 
 from tpu_life.version import __version__

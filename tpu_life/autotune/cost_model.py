@@ -1,0 +1,88 @@
+"""Analytic cost fallback: rank candidates without touching a device.
+
+The read path (``autotune.resolve``) must never measure — serving latency
+cannot pay tuning cost, and a cache miss on a fresh machine still needs an
+answer.  This model estimates *relative cost per cell-update* from the two
+effects the committed sweeps isolated:
+
+- **HBM traffic** amortizes over the deep-halo blocking factor ``k``: one
+  board read + write per ``k``-step block, so the per-step traffic term is
+  ``TRAFFIC / k`` (x8 for unpacked int8 boards vs the bit-sliced layout);
+- **recomputed fringe** grows with ``k``: each blocked step recomputes a
+  halo ring ``radius`` deeper than the last, so the per-step overhead term
+  is ``FRINGE * radius * k``.
+
+``cost(k) = COMPUTE + TRAFFIC/k + FRINGE * radius * k`` with constants
+fitted to experiments/RESULTS_blocksweep_r4.json (normalized inverse
+throughput of the composed sharded+pallas path at 16384^2 Conway, k in
+{4,8,16,32,64}): the fit puts the minimum in the k=8..16 noise band and
+reproduces the measured monotone degradation at k >= 32 — the cliff the
+sweep found (k=64 ran 26% slower than k=8).  Absolute numbers are
+meaningless (the chip's window wobbles +-20%); only the ordering is used.
+"""
+
+from __future__ import annotations
+
+from tpu_life.autotune.space import TuneKey, TunedConfig
+
+# fitted to RESULTS_blocksweep_r4.json (see module docstring): relative
+# per-cell-update cost = COMPUTE + TRAFFIC/k + FRINGE * radius * k
+COMPUTE = 0.837
+TRAFFIC = 0.795
+FRINGE = 0.008
+
+# unpacked int8 boards move 8x the bytes of the bit-sliced layout
+# (32 cells/uint32 word vs 8 cells/8 bytes — backends/jax_backend.py)
+UNPACKED_TRAFFIC_FACTOR = 8.0
+
+# per-backend structural overheads, relative to the blocked sharded path:
+# jax has no deep-halo blocking (every step is one HBM pass, k == 1);
+# pallas == sharded-at-n=1 (same VMEM blocking trade); numpy is the truth
+# executor, ~3 orders off any compiled path
+NUMPY_PENALTY = 1000.0
+
+# defaults a backend applies when block_steps is None (mirrors each
+# backend's own default: sharded XLA exchanges every step, the Pallas
+# deep-halo kernels block 8 steps per HBM pass)
+_DEFAULT_K = {"jax": 1, "sharded": 1, "pallas": 8}
+
+
+def effective_block_steps(cfg: TunedConfig) -> int:
+    if cfg.backend == "jax" or cfg.backend == "numpy":
+        return 1  # no deep-halo blocking: one HBM pass per step
+    if cfg.block_steps is not None:
+        return max(1, cfg.block_steps)
+    if cfg.backend == "sharded" and cfg.local_kernel == "pallas":
+        return 8  # the Pallas local kernel's own deep-halo default
+    return _DEFAULT_K.get(cfg.backend, 1)
+
+
+def estimate_cost(key: TuneKey, cfg: TunedConfig) -> float:
+    """Relative cost per cell-update of ``cfg`` in situation ``key``
+    (lower is better; only the ordering is meaningful)."""
+    if cfg.backend == "numpy":
+        return NUMPY_PENALTY
+    k = effective_block_steps(cfg)
+    traffic = TRAFFIC
+    if not (cfg.bitpack and key.bitpack_ok):
+        traffic *= UNPACKED_TRAFFIC_FACTOR
+    cost = COMPUTE + traffic / k + FRINGE * key.radius * k
+    if cfg.backend == "sharded" and key.device_count > 1:
+        # per-chip throughput holds ~parity with the single-chip kernel
+        # (BASELINE.md parity leg), so total cost divides by the mesh —
+        # with a small halo-exchange tax per extra device ring
+        cost = cost / key.device_count + 0.02
+    if cfg.backend in ("pallas", "sharded") and cfg.local_kernel == "pallas":
+        # measured: the compiled deep-halo kernel edges out the XLA scan
+        # at equal k (RESULTS_blocksweep_r4_confirm.json) — a nudge, so a
+        # *measured* XLA win still beats an assumed Pallas one
+        cost *= 0.97
+    return cost
+
+
+def choose(key: TuneKey, candidates: list[TunedConfig]) -> TunedConfig:
+    """The cost model's pick: argmin cost, first-wins on exact ties so the
+    choice is deterministic for a fixed candidate order."""
+    if not candidates:
+        raise ValueError("choose() needs at least one candidate")
+    return min(candidates, key=lambda c: estimate_cost(key, c))

@@ -1,0 +1,155 @@
+"""Measured trials: time each candidate on the live device, pick the min.
+
+Methodology matches ``bench.py`` in spirit but is budgeted for a search
+loop rather than one armored headline capture: per candidate the board is
+staged once (the ``make_runner`` seam — the same path the driver runs),
+one warmup advance absorbs compilation, then ``trials`` timed advances are
+taken and the **median** seconds/step reported — the median rides out the
+chip's window-to-window wobble better than the mean over so few samples.
+
+Failure isolation is per candidate: a candidate whose backend refuses to
+construct (mesh divisibility, kernel constraints) or crashes mid-trial is
+recorded as infeasible with its error string and the search continues —
+one broken configuration must never abort the sweep that would route
+around it.
+
+``trial_count()`` is the measurement probe: every timed trial the process
+runs increments it, so tests (and the serve read path's never-measure
+guarantee) can assert exactly how many device measurements an operation
+performed.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_life.autotune.space import TuneKey, TunedConfig
+from tpu_life.models.rules import Rule
+
+# measurement probe (see module docstring); mutable holder so callers keep
+# a live view through the module, not a stale int import
+_MEASURED = {"trials": 0}
+
+
+def trial_count() -> int:
+    """Timed trials this process has run (the never-measure probe)."""
+    return _MEASURED["trials"]
+
+
+def reset_trial_count() -> None:
+    _MEASURED["trials"] = 0
+
+
+@dataclass
+class TrialResult:
+    config: TunedConfig
+    seconds_per_step: float | None  # None => infeasible
+    error: str | None = None
+    samples: list[float] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.seconds_per_step is not None
+
+
+def make_trial_board(key: TuneKey, shape: tuple[int, int]) -> np.ndarray:
+    """A representative random board: ~50% density, seeded so every
+    candidate (and every re-tune) measures the same workload."""
+    rng = np.random.default_rng(0)
+    h, w = shape
+    board = rng.integers(0, 2, size=(h, w), dtype=np.int8)
+    if key.states > 2:
+        board *= rng.integers(1, key.states, size=(h, w), dtype=np.int8)
+    return board
+
+
+def _measure(
+    cfg: TunedConfig,
+    board: np.ndarray,
+    rule: Rule,
+    *,
+    steps: int,
+    warmup_steps: int,
+    trials: int,
+) -> tuple[float, list[float]]:
+    """(median seconds/step, raw samples) of one candidate on the device."""
+    from tpu_life.backends.base import get_backend, make_runner
+
+    backend = get_backend(cfg.backend, rule=rule, **cfg.backend_kwargs())
+    runner = make_runner(backend, board, rule)
+    runner.advance(warmup_steps)  # absorbs compilation + staging
+    runner.sync()
+    samples: list[float] = []
+    for _ in range(max(1, trials)):
+        _MEASURED["trials"] += 1
+        t0 = time.perf_counter()
+        runner.advance(steps)
+        runner.sync()
+        samples.append((time.perf_counter() - t0) / steps)
+    return statistics.median(samples), samples
+
+
+def default_trial_steps(device_kind: str) -> tuple[int, int]:
+    """(steps per timed trial, warmup steps).  TPU trials need enough steps
+    that the fused work dwarfs per-dispatch tunnel jitter; CPU trials at
+    4096^2 are compute-bound at a handful of steps."""
+    return (64, 16) if device_kind == "tpu" else (4, 2)
+
+
+def run_trials(
+    key: TuneKey,
+    candidates: list[TunedConfig],
+    board: np.ndarray,
+    rule: Rule,
+    *,
+    trials: int = 3,
+    steps: int | None = None,
+    warmup_steps: int | None = None,
+    measure=None,
+    on_trial=None,
+) -> list[TrialResult]:
+    """Measure every candidate; infeasible ones are recorded, never raised.
+
+    ``measure`` injects a fake timing function for tests
+    (``measure(cfg, board, rule) -> seconds_per_step``); ``on_trial`` is a
+    progress callback ``(index, total, TrialResult)`` for the CLI table.
+    """
+    d_steps, d_warm = default_trial_steps(key.device_kind)
+    steps = d_steps if steps is None else steps
+    warmup_steps = d_warm if warmup_steps is None else warmup_steps
+    results: list[TrialResult] = []
+    for i, cfg in enumerate(candidates):
+        try:
+            if measure is not None:
+                sps = float(measure(cfg, board, rule))
+                res = TrialResult(cfg, sps, samples=[sps])
+            else:
+                sps, samples = _measure(
+                    cfg,
+                    board,
+                    rule,
+                    steps=steps,
+                    warmup_steps=warmup_steps,
+                    trials=trials,
+                )
+                res = TrialResult(cfg, sps, samples=samples)
+        except Exception as e:  # noqa: BLE001 — per-candidate isolation
+            res = TrialResult(cfg, None, error=f"{type(e).__name__}: {e}")
+        results.append(res)
+        if on_trial is not None:
+            on_trial(i, len(candidates), res)
+    return results
+
+
+def best_result(results: list[TrialResult]) -> TrialResult | None:
+    """The winner: minimum median seconds/step over feasible results,
+    first-wins on exact ties (deterministic for a fixed candidate order).
+    None when every candidate was infeasible."""
+    ok = [r for r in results if r.ok]
+    if not ok:
+        return None
+    return min(ok, key=lambda r: r.seconds_per_step)

@@ -1,0 +1,265 @@
+"""The tuning space: what a tuned configuration is, and which ones are legal.
+
+The config surface has a dozen performance-critical knobs (``RunConfig``)
+whose best values depend on device kind, rule shape, and board geometry —
+the blocksweep study (experiments/RESULTS_blocksweep_r4.json) showed the
+deep-halo blocking factor alone swings throughput ~35% and that its optimum
+is device- and radius-dependent.  This module defines the two value types
+the autotuner trades in:
+
+- :class:`TuneKey` — the *situation*: device kind + count, rule structure
+  (name, radius, states, neighborhood, boundary), the padded board-shape
+  bucket, and bit-slicing eligibility.  Two runs with equal keys want the
+  same knobs, so the key is the unit of cache identity.
+- :class:`TunedConfig` — the *decision*: backend, ``block_steps``,
+  ``local_kernel``, ``bitpack``, ``sync_every`` — exactly the RunConfig
+  knobs the measured sweeps showed matter.
+
+``enumerate_candidates`` produces the legal cross-product for a key,
+reusing each backend's own constraints (Pallas compiles only on TPU,
+``local_kernel='pallas'`` needs the packed 1-D-mesh path, torus boards
+need exact row divisibility) so a candidate that cannot construct is
+never proposed in the first place.  ``runner.run_trials`` still isolates
+per-candidate failures — constraints here are an optimization, not the
+safety net.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+
+from tpu_life.models.rules import Rule
+
+# block_steps grid: brackets the measured optimum (k=8, blocksweep r4) and
+# includes the degradation region (k>=32) so a measured sweep re-verifies
+# the cliff on new hardware instead of assuming it
+BLOCK_STEPS_GRID = (1, 4, 8, 16, 32)
+
+# shape buckets never go below one TPU tile in either dimension: configs
+# don't change meaningfully inside a tile, and tiny boards would otherwise
+# explode the cache with one entry per toy shape
+MIN_BUCKET = 128
+
+
+@dataclass(frozen=True)
+class TuneKey:
+    """Cache identity: everything the best config is allowed to depend on."""
+
+    device_kind: str  # jax platform of the target devices ("cpu" / "tpu")
+    device_count: int
+    rule_name: str
+    radius: int
+    states: int
+    neighborhood: str  # "moore" | "von_neumann"
+    boundary: str  # "clamped" | "torus"
+    shape_bucket: tuple[int, int]  # padded (h, w) bucket, power-of-two ceil
+    bitpack_ok: bool  # bit-sliced path eligible for this rule family
+
+    def id(self) -> str:
+        """Stable string form — the JSON cache's entry key."""
+        h, w = self.shape_bucket
+        return (
+            f"{self.device_kind}x{self.device_count}"
+            f"|{self.rule_name}|r{self.radius}s{self.states}"
+            f"|{self.neighborhood}|{self.boundary}"
+            f"|{h}x{w}|bp{int(self.bitpack_ok)}"
+        )
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["shape_bucket"] = list(self.shape_bucket)
+        return d
+
+
+@dataclass(frozen=True)
+class TunedConfig:
+    """The knob settings a key resolves to — a RunConfig fragment."""
+
+    backend: str
+    block_steps: int | None = None  # None keeps the backend's own default
+    local_kernel: str = "auto"  # sharded backend only
+    bitpack: bool = True
+    sync_every: int = 0  # 0 = one fused run (never swept; host-sync cadence
+    # belongs to snapshots/metrics, not throughput)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TunedConfig":
+        return cls(
+            backend=str(d["backend"]),
+            block_steps=None if d.get("block_steps") is None else int(d["block_steps"]),
+            local_kernel=str(d.get("local_kernel", "auto")),
+            bitpack=bool(d.get("bitpack", True)),
+            sync_every=int(d.get("sync_every", 0)),
+        )
+
+    def backend_kwargs(self) -> dict:
+        """kwargs for ``get_backend`` realizing this decision.  Backends
+        tolerate unknown kwargs (``**_``), so the full set always passes."""
+        kw: dict = {"bitpack": self.bitpack, "local_kernel": self.local_kernel}
+        if self.block_steps is not None:
+            kw["block_steps"] = self.block_steps
+        return kw
+
+    def describe(self) -> str:
+        k = "-" if self.block_steps is None else str(self.block_steps)
+        return (
+            f"{self.backend} k={k} local_kernel={self.local_kernel} "
+            f"bitpack={int(self.bitpack)} sync_every={self.sync_every}"
+        )
+
+
+def tuned_record(backend: str, kwargs: dict) -> dict:
+    """The BENCH-record ``"tuned"`` payload: the knob set a ``get_backend``
+    call site actually ran, in the TunedConfig schema — one source of
+    truth for the bench/CLI perf records, so the record fields cannot
+    drift from the cache schema."""
+    return TunedConfig(
+        backend=backend,
+        block_steps=kwargs.get("block_steps"),
+        local_kernel=kwargs.get("local_kernel") or "auto",
+        bitpack=bool(kwargs.get("bitpack", True)),
+        sync_every=int(kwargs.get("sync_every", 0)),
+    ).to_dict()
+
+
+def shape_bucket(height: int, width: int) -> tuple[int, int]:
+    """Pad each dimension up to the next power of two (floor MIN_BUCKET).
+
+    Boards inside one bucket share halo/traffic proportions closely enough
+    that one tuned config serves them all; the bucket also bounds cache
+    cardinality to ~log^2 of the shape space.
+    """
+
+    def up(n: int) -> int:
+        b = MIN_BUCKET
+        while b < n:
+            b <<= 1
+        return b
+
+    if height < 1 or width < 1:
+        raise ValueError(f"board shape must be positive, got {height}x{width}")
+    return up(height), up(width)
+
+
+def _bitpack_eligible(rule: Rule) -> bool:
+    """Bit-sliced path eligibility from rule structure alone (mirrors
+    ``bitlife.supports_family`` + the diamond/torus variants) — kept
+    import-light so key construction never needs jax."""
+    if rule.states != 2 or rule.include_center:
+        return False
+    if rule.neighborhood == "moore":
+        return rule.radius == 1  # clamped and torus both run packed
+    # von Neumann diamond: 4 count planes => radius <= 2, clamped only
+    return rule.boundary == "clamped" and rule.radius <= 2
+
+
+def tune_key_for(
+    rule: Rule,
+    shape: tuple[int, int],
+    *,
+    device_kind: str | None = None,
+    device_count: int | None = None,
+) -> TuneKey:
+    """Build the key for tuning ``rule`` on a ``shape`` board.
+
+    Device kind/count default to the live jax platform — the only part of
+    the key that touches the runtime, overridable so tests and offline
+    tooling can build keys for hardware they are not on.
+    """
+    if device_kind is None or device_count is None:
+        import jax
+
+        devices = jax.devices()
+        device_kind = device_kind or devices[0].platform
+        device_count = device_count or len(devices)
+    h, w = int(shape[0]), int(shape[1])
+    return TuneKey(
+        device_kind=str(device_kind),
+        device_count=int(device_count),
+        rule_name=rule.name,
+        radius=rule.radius,
+        states=rule.states,
+        neighborhood=rule.neighborhood,
+        boundary=rule.boundary,
+        shape_bucket=shape_bucket(h, w),
+        bitpack_ok=_bitpack_eligible(rule),
+    )
+
+
+def default_backend_set(device_kind: str) -> tuple[str, ...]:
+    """Backends worth measuring on this device kind.  Pallas compiles only
+    on TPU (interpret mode elsewhere is Python-speed — measuring it would
+    just burn the trial budget); numpy is the truth executor, never a
+    performance candidate."""
+    if device_kind == "tpu":
+        return ("jax", "sharded", "pallas")
+    return ("jax", "sharded")
+
+
+def enumerate_candidates(
+    key: TuneKey,
+    *,
+    backend_set: tuple[str, ...] | list[str] | None = None,
+    shape: tuple[int, int] | None = None,
+) -> list[TunedConfig]:
+    """The legal candidate list for ``key``, in deterministic order.
+
+    Each backend contributes the knob combinations it actually honors:
+
+    - ``jax``: no blocking knobs — one candidate (plus the unpacked int8
+      variant when the rule is bitpack-eligible, so a measured sweep can
+      re-verify the packed path wins rather than assume it);
+    - ``sharded``: ``block_steps`` grid x ``local_kernel`` (the Pallas
+      stripe kernel only on TPU packed 1-D clamped boards — mirroring
+      ``bench.default_tpu_local_kernel``); torus rules drop out entirely
+      when the exact ``shape`` rows don't divide the mesh;
+    - ``pallas``: ``block_steps`` grid, TPU only (the compiled kernel).
+
+    ``shape`` is the exact board shape when known — used only for
+    feasibility checks that depend on exact (not bucketed) geometry.
+    """
+    backends = tuple(backend_set or default_backend_set(key.device_kind))
+    on_tpu = key.device_kind == "tpu"
+    out: list[TunedConfig] = []
+    for backend in backends:
+        if backend == "jax":
+            out.append(TunedConfig("jax", None, "auto", key.bitpack_ok, 0))
+            if key.bitpack_ok:
+                out.append(TunedConfig("jax", None, "auto", False, 0))
+        elif backend == "sharded":
+            if key.boundary == "torus":
+                h = shape[0] if shape is not None else key.shape_bucket[0]
+                if h % key.device_count != 0:
+                    continue  # exact rows must divide the mesh — infeasible
+            kernels = ["xla"]
+            if (
+                on_tpu
+                and key.bitpack_ok
+                and key.boundary == "clamped"
+                and key.neighborhood == "moore"
+            ):
+                kernels.append("pallas")
+            for kernel in kernels:
+                for k in BLOCK_STEPS_GRID:
+                    out.append(
+                        TunedConfig("sharded", k, kernel, key.bitpack_ok, 0)
+                    )
+        elif backend == "pallas":
+            if not on_tpu:
+                continue  # interpret mode: correctness path, not a candidate
+            if key.boundary == "torus" and not key.bitpack_ok:
+                continue  # no int8 torus kernel
+            for k in BLOCK_STEPS_GRID:
+                out.append(TunedConfig("pallas", k, "auto", key.bitpack_ok, 0))
+        elif backend == "numpy":
+            out.append(TunedConfig("numpy", None, "auto", False, 0))
+        else:
+            raise ValueError(f"unknown backend {backend!r} in backend_set")
+    if not out:
+        raise ValueError(
+            f"no feasible candidates for {key.id()} with backends {backends}"
+        )
+    return out

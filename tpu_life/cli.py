@@ -55,6 +55,39 @@ def build_parser() -> argparse.ArgumentParser:
                      help="rule string stamped into the exported RLE header "
                      "(record what the board was actually evolved under)")
 
+    t = sub.add_parser(
+        "tune",
+        help="measured autotuning: search the (backend, block_steps, "
+        "local_kernel, bitpack) space for this device + rule + board "
+        "shape and persist the winner to the autotune cache",
+    )
+    t.add_argument("--size", type=int, default=4096,
+                   help="square board edge for the trial workload")
+    t.add_argument("--height", type=int, default=None,
+                   help="trial board height (overrides --size)")
+    t.add_argument("--width", type=int, default=None,
+                   help="trial board width (overrides --size)")
+    t.add_argument("--rule", default="conway")
+    t.add_argument("--backend-set", default=None, metavar="B1,B2",
+                   help="comma list of backends to search (default: "
+                   "jax,sharded,pallas on TPU; jax,sharded elsewhere)")
+    t.add_argument("--trials", type=int, default=3,
+                   help="timed repetitions per candidate (median wins)")
+    t.add_argument("--steps", type=int, default=None,
+                   help="steps per timed trial (default: platform-scaled)")
+    t.add_argument("--warmup-steps", type=int, default=None,
+                   help="untimed steps absorbing compilation per candidate")
+    t.add_argument("--dry-run", action="store_true",
+                   help="enumerate candidates and rank by the analytic "
+                   "cost model only — no measurement, nothing persisted "
+                   "(the CI smoke path)")
+    t.add_argument("--cache-file", default=None, metavar="JSON",
+                   help="autotune cache location (default "
+                   "~/.cache/tpu_life/autotune.json or "
+                   "$TPU_LIFE_AUTOTUNE_CACHE)")
+    t.add_argument("--platform", default=None,
+                   help="force a JAX platform (cpu/tpu), like `run --platform`")
+
     b = sub.add_parser(
         "bench",
         help="quick throughput measurement: cells/s/chip vs the 1e11 target",
@@ -105,9 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument(
         "--serve-backend",
         default="jax",
-        choices=["jax", "numpy", "sharded", "stripes", "pallas", "native"],
+        choices=["jax", "tuned", "numpy", "sharded", "stripes", "pallas", "native"],
         help="engine executor: jax/numpy run a true batch axis, the rest "
-        "loop over slots (one Runner per session)",
+        "loop over slots (one Runner per session); tuned resolves per "
+        "CompileKey through the autotune cache (read path only — an "
+        "untuned key takes the cost-model pick, never a measurement)",
     )
     srv.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                      help="default per-request deadline")
@@ -166,8 +201,10 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument(
         "--backend",
         default="auto",
-        choices=["auto", "numpy", "native", "jax", "sharded", "stripes", "mpi", "pallas"],
-        help="mpi is EXPERIMENTAL and thread-simulated only: mpiexec/mpi4py "
+        choices=["auto", "tuned", "numpy", "native", "jax", "sharded", "stripes", "mpi", "pallas"],
+        help="tuned resolves backend + perf knobs through the autotune "
+        "cache (see `tpu-life tune` and --tune-mode); "
+        "mpi is EXPERIMENTAL and thread-simulated only: mpiexec/mpi4py "
         "are absent from this image (libmpi alone ships no launcher), so "
         "its per-rank logic has only ever run against an injected fake "
         "communicator; real cross-process messaging is covered by the "
@@ -204,6 +241,15 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
         "kernel (life-like rules, 1-D meshes) or the int8 2-D-tiled kernel "
         "(Larger-than-Life / Generations, any mesh); explicit pallas on a "
         "2-D mesh runs life-like rules through the int8 kernel unpacked",
+    )
+    r.add_argument(
+        "--tune-mode",
+        default="cache",
+        choices=["off", "cache", "measure"],
+        help="autotune resolution for --backend tuned: off = analytic "
+        "cost model only; cache = cache hit else cost model (never "
+        "measures); measure = cache hit else run the measured search now "
+        "and persist it",
     )
     r.add_argument("--sync-every", type=int, default=0)
     r.add_argument(
@@ -320,6 +366,8 @@ def main(argv: list[str] | None = None) -> int:
         # after the watchdog: _bench queries devices, and a wedged plugin
         # must degrade into the message above, not a hang
         return _bench(args)
+    if args.command == "tune":
+        return _tune(args)
     if args.command == "serve":
         return _serve(args)
     cfg = RunConfig(
@@ -337,6 +385,7 @@ def main(argv: list[str] | None = None) -> int:
         block_steps=args.block_steps,
         partition_mode=args.partition_mode,
         local_kernel=args.local_kernel,
+        tune_mode=args.tune_mode,
         sync_every=args.sync_every,
         stream_io=args.stream_io,
         pad_lanes=not args.no_pad_lanes,
@@ -454,9 +503,22 @@ def _bench(args) -> int:
         # has no local-kernel concept), so `--backend auto` resolving to
         # sharded still honors and truthfully labels the flag
         kwargs["local_kernel"] = args.local_kernel
+    from tpu_life.autotune import tuned_record
+
+    backend_name = args.backend
+    tuned_source = "flags"
+    if backend_name == "tuned":
+        # read-path resolution (cache hit or cost model — never measures);
+        # knobs already pinned in kwargs by explicit flags win over the
+        # cached ones (the shared merge rule, autotune.resolve_backend_kwargs)
+        from tpu_life import autotune
+
+        backend_name, _, tuned_source = autotune.resolve_backend_kwargs(
+            rule, (n, n), kwargs
+        )
     # the rule hint keeps `auto` infallible (e.g. torus rules resolve to a
     # single-device backend), matching the driver's resolution
-    backend = get_backend(args.backend, rule=rule, **kwargs)
+    backend = get_backend(backend_name, rule=rule, **kwargs)
     per_chip, n_chips = measure_throughput(
         backend, board, rule, args.steps, args.base_steps, args.repeats
     )
@@ -477,6 +539,86 @@ def _bench(args) -> int:
                 "size": n,
                 "steps": args.steps,
                 "n_chips": n_chips,
+                # reproducibility: the full resolved knob set + where it
+                # came from ("flags" | "cache" | "cost_model")
+                "tuned": tuned_record(
+                    getattr(backend, "name", backend_name), kwargs
+                ),
+                "tuned_source": tuned_source,
+            }
+        )
+    )
+    return 0
+
+
+def _tune(args) -> int:
+    """The offline tuning search: a table of trials to stderr-adjacent
+    stdout rows, one JSON summary line last (machine-parseable like
+    `bench`), the winner persisted to the autotune cache.
+
+    ``--dry-run`` ranks by the analytic cost model only — candidate
+    enumeration and ordering are exercised, no device measurement happens
+    and nothing is written: the CI smoke path on CPU.
+    """
+    import json
+
+    from tpu_life import autotune
+    from tpu_life.models.rules import get_rule
+
+    rule = get_rule(args.rule)
+    h = args.height if args.height is not None else args.size
+    w = args.width if args.width is not None else args.size
+    key = autotune.tune_key_for(rule, (h, w))
+    backend_set = (
+        tuple(s for s in args.backend_set.split(",") if s)
+        if args.backend_set
+        else None
+    )
+
+    unit = "cost" if args.dry_run else "s/step"
+    print(f"# tune {key.id()}  trials={args.trials} ({unit})")
+
+    def on_trial(i, total, res):
+        if res.ok:
+            cells = h * w / res.seconds_per_step
+            val = f"{res.seconds_per_step:.3e}  ({cells:.3e} cells/s)"
+        else:
+            val = f"infeasible: {res.error}"
+        print(f"  [{i + 1}/{total}] {res.config.describe():<55s} {val}")
+
+    result = autotune.tune(
+        key,
+        rule,
+        shape=(h, w),
+        backend_set=backend_set,
+        trials=args.trials,
+        steps=args.steps,
+        warmup_steps=args.warmup_steps,
+        dry_run=args.dry_run,
+        cache_file=args.cache_file,
+        on_trial=on_trial,
+    )
+    if args.dry_run:
+        for i, res in enumerate(result.results):
+            print(
+                f"  [{i + 1}/{len(result.results)}] "
+                f"{res.config.describe():<55s} cost={res.seconds_per_step:.3f}"
+            )
+    best = autotune.runner.best_result(result.results)
+    print(
+        json.dumps(
+            {
+                "mode": "tune",
+                "key": key.id(),
+                "best": result.best.to_dict(),
+                "source": result.source,
+                "candidates": len(result.results),
+                "infeasible": sum(1 for r in result.results if not r.ok),
+                "seconds_per_step": best.seconds_per_step
+                if best is not None and not args.dry_run
+                else None,
+                "trials": args.trials,
+                "cache_file": result.cache_file,
             }
         )
     )

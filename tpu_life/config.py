@@ -32,7 +32,14 @@ class RunConfig:
     bug_compat: bool = False  # replicate the shipped binary's effective B/S2 rule
 
     # execution
-    backend: str = "auto"  # auto | numpy | native | jax | sharded | stripes | mpi | pallas
+    # "tuned" resolves backend + perf knobs through tpu_life.autotune
+    # (cache hit -> tuned config; miss -> analytic cost model / measured
+    # search per tune_mode below)
+    backend: str = "auto"  # auto | tuned | numpy | native | jax | sharded | stripes | mpi | pallas
+    # autotune resolution mode for backend="tuned": "off" = cost model only
+    # (no cache I/O), "cache" = cache hit else cost model (never measures),
+    # "measure" = cache hit else run the measured search now and persist it
+    tune_mode: str = "cache"  # off | cache | measure
     num_devices: int | None = None
     mesh_shape: tuple[int, int] | None = None  # 2-D rows x cols mesh (sharded)
     # CA steps per halo exchange / HBM pass (deep halos); None keeps each

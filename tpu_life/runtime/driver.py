@@ -73,6 +73,30 @@ def run(cfg: RunConfig) -> RunResult:
     timer = Timer()  # spans I/O too, like the reference's Wtime bracket
 
     backend_name = cfg.backend
+    tuned = None  # TunedConfig once "tuned" resolves, else None
+    if backend_name == "tuned":
+        # autotune resolution: cache hit -> tuned knobs; miss -> analytic
+        # cost model, or (tune_mode="measure") the measured search, which
+        # persists its winner so the next run is a cache hit.  Resolution
+        # happens BEFORE the mesh-shape check so a tuned pick of the
+        # sharded backend composes with an explicit --mesh-shape.
+        from tpu_life import autotune
+
+        key = autotune.tune_key_for(rule, (height, width))
+        tuned, source = autotune.resolve(
+            key, mode=cfg.tune_mode, shape=(height, width)
+        )
+        if source != "cache" and cfg.tune_mode == "measure":
+            result = autotune.tune(key, rule, shape=(height, width))
+            tuned, source = result.best, "measured"
+        log.info(
+            "autotune: %s -> %s (%s)", key.id(), tuned.describe(), source
+        )
+        backend_name = tuned.backend
+    elif cfg.tune_mode not in ("off", "cache", "measure"):
+        raise ValueError(
+            f"tune_mode must be off|cache|measure, got {cfg.tune_mode!r}"
+        )
     if cfg.mesh_shape is not None:
         # a mesh shape only means something to the sharded backend — don't
         # let `auto` resolve elsewhere and silently ignore it
@@ -92,6 +116,15 @@ def run(cfg: RunConfig) -> RunResult:
     )
     if cfg.block_steps is not None:
         backend_kwargs["block_steps"] = cfg.block_steps
+    if tuned is not None:
+        # tuned knobs fill in wherever the user left the default; an
+        # explicit flag (--block-steps, --local-kernel, --no-bitpack)
+        # always wins over the cache — tuning informs, never overrides
+        if cfg.block_steps is None and tuned.block_steps is not None:
+            backend_kwargs["block_steps"] = tuned.block_steps
+        if cfg.local_kernel == "auto":
+            backend_kwargs["local_kernel"] = tuned.local_kernel
+        backend_kwargs["bitpack"] = cfg.bitpack and tuned.bitpack
     backend = get_backend(backend_name, rule=rule, **backend_kwargs)
 
     # Board source: a contract-format file (+ completed steps when resuming).
@@ -179,6 +212,8 @@ def run(cfg: RunConfig) -> RunResult:
     )
 
     chunk = cfg.sync_every
+    if chunk <= 0 and tuned is not None and tuned.sync_every > 0:
+        chunk = tuned.sync_every
     if cfg.snapshot_every > 0:
         chunk = (
             cfg.snapshot_every

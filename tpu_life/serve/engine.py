@@ -278,13 +278,36 @@ class SlotLoopEngine(EngineBase):
 
 
 def make_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
-    """Engine factory, dispatched on the key's executor family."""
-    if key.backend == "jax":
+    """Engine factory, dispatched on the key's executor family.
+
+    ``backend == "tuned"`` resolves the executor through the autotune
+    cache per CompileKey — **read path only** (cache hit or analytic cost
+    model): serving latency must never pay measurement cost, so an
+    untuned key degrades to the cost-model pick, it does not trigger a
+    trial sweep.  Run ``tpu-life tune`` offline to populate the cache.
+    """
+    backend_name = key.backend
+    backend_kwargs: dict = {}
+    if backend_name == "tuned":
+        from tpu_life import autotune
+        from tpu_life.runtime.metrics import log
+
+        tk = autotune.tune_key_for(key.rule, key.shape)
+        tuned, source = autotune.resolve(tk, mode="cache", shape=key.shape)
+        log.info(
+            "serve: autotune %s -> %s (%s)", tk.id(), tuned.describe(), source
+        )
+        backend_name = tuned.backend
+        backend_kwargs = tuned.backend_kwargs()
+    if backend_name == "jax":
         return VmapEngine(key, capacity, chunk_steps)
-    if key.backend == "numpy":
+    if backend_name == "numpy":
         return HostBatchEngine(key, capacity, chunk_steps)
     from tpu_life.backends.base import get_backend
 
     return SlotLoopEngine(
-        key, capacity, chunk_steps, get_backend(key.backend, rule=key.rule)
+        key,
+        capacity,
+        chunk_steps,
+        get_backend(backend_name, rule=key.rule, **backend_kwargs),
     )

@@ -106,7 +106,10 @@ class SimulationService:
         """
         if isinstance(rule, str):
             rule = get_rule(rule)
-        board = np.asarray(board, dtype=np.int8)
+        # validate BEFORE the int8 cast: a wider-dtype caller array with
+        # state 256 would wrap to 0 and sail through a post-cast check —
+        # simulated junk, not a rejection
+        board = np.asarray(board)
         if board.ndim != 2:
             raise ValueError(f"board must be 2-D, got shape {board.shape}")
         max_state = int(board.max(initial=0))
@@ -123,6 +126,7 @@ class SimulationService:
                 f"board contains negative state {min_state}; states are "
                 f"0..{rule.states - 1}"
             )
+        board = board.astype(np.int8)
         if steps < 0:
             raise ValueError(f"steps must be >= 0, got {steps}")
         # backpressure check BEFORE the session exists anywhere
