@@ -49,6 +49,13 @@ import time
 
 import numpy as np
 
+# obs is jax-free by design, so the import is safe before any device
+# touch; RUN_ID correlates this capture with any trace/metrics artifacts
+# the measured run writes, and with the perf-trajectory BENCH_*.json files
+from tpu_life.obs import TELEMETRY_SCHEMA, new_run_id
+
+RUN_ID = new_run_id()
+
 TARGET = 1e11  # cell-updates/sec/chip north-star (BASELINE.json)
 
 # workload when the accelerator is unavailable: small enough that the XLA
@@ -129,6 +136,8 @@ def _die_emitting(signame: str) -> None:
                 "degraded": True,
                 "killed": signame,
                 "phase": _SIGNAL_STATE.get("phase"),
+                "run_id": RUN_ID,
+                "telemetry_schema": TELEMETRY_SCHEMA,
             }
             if _SIGNAL_STATE.get("probe_failed"):
                 record["probe_failed"] = True
@@ -334,6 +343,11 @@ def _emit(result: dict) -> None:
     # our partial one (last-line-wins for the driver's parser); a signal
     # after the flip exits silently.  Flag-before-print had the inverse
     # hole: die inside print() and nothing is on stdout at all.
+    #
+    # every record carries the telemetry identity (setdefault: a CPU-retry
+    # record keeps the CHILD's run_id — that is the process that measured)
+    result.setdefault("run_id", RUN_ID)
+    result.setdefault("telemetry_schema", TELEMETRY_SCHEMA)
     sys.stdout.flush()
     os.write(1, (json.dumps(result) + "\n").encode())
     _SIGNAL_STATE["emitted"] = True

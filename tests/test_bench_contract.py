@@ -65,6 +65,10 @@ def test_bench_emits_contract_record_on_cpu():
     assert rec["degraded"] is True
     assert rec["n_chips"] == 1
     assert rec["size"] == 256 and rec["steps"] == 40
+    # telemetry identity: BENCH records join with trace/metrics artifacts
+    # on run_id, versioned by the shared schema stamp
+    assert isinstance(rec["run_id"], str) and len(rec["run_id"]) == 12
+    assert rec["telemetry_schema"] == 1
 
 
 @pytest.mark.slow
@@ -108,6 +112,8 @@ def test_bench_serve_emits_serving_record_on_cpu():
     assert 0.0 < rec["batch_occupancy_mean"] <= 1.0
     assert rec["platform"] == "cpu" and rec["degraded"] is True
     assert rec["backend"] == "jax"  # the vmapped serve engine
+    assert isinstance(rec["run_id"], str) and len(rec["run_id"]) == 12
+    assert rec["telemetry_schema"] == 1
 
 
 def bench_popen(*args, env_extra=None, stderr_path=None):
@@ -160,6 +166,8 @@ def test_bench_sigterm_during_probe_sleep_still_emits(tmp_path):
     assert rec["degraded"] is True
     assert rec["phase"].startswith("probe-wait")
     assert rec["metric"] == "cell_updates_per_sec_per_chip"
+    # even the signal-path emitter stamps the telemetry identity
+    assert len(rec["run_id"]) == 12 and rec["telemetry_schema"] == 1
 
 
 @pytest.mark.slow
@@ -203,6 +211,23 @@ def test_bench_sigalrm_hard_deadline_emits(tmp_path):
     assert proc.returncode == 0
     assert rec["killed"] == "SIGALRM"
     assert rec["degraded"] is True
+
+
+def test_bench_module_carries_telemetry_identity():
+    """Fast (non-subprocess) half of the run_id satellite: the bench module
+    generates one RUN_ID per process and pins the shared schema version, so
+    every emit path — success, failure, signal — stamps the same identity."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    from tpu_life.obs import TELEMETRY_SCHEMA
+
+    assert bench.TELEMETRY_SCHEMA == TELEMETRY_SCHEMA == 1
+    assert isinstance(bench.RUN_ID, str) and len(bench.RUN_ID) == 12
+    int(bench.RUN_ID, 16)  # hex — joinable with obs.new_run_id() artifacts
 
 
 def test_bench_tpu_local_kernel_pin_respects_rule_family():

@@ -194,8 +194,13 @@ def test_metrics_file_sink(tmp_path):
         json.loads(ln)
         for ln in (tmp_path / "m.jsonl").read_text().splitlines()
     ]
-    assert [ln["step"] for ln in lines] == [2, 4, 6]
-    assert lines == res.metrics
+    # the per-chunk stream mirrors RunResult.metrics exactly; close()
+    # appends the registry snapshot (kind:"metric") after it
+    chunks = [ln for ln in lines if "step" in ln]
+    assert [ln["step"] for ln in chunks] == [2, 4, 6]
+    assert chunks == res.metrics
+    assert all(ln.get("kind") == "metric" for ln in lines[len(chunks):])
+    assert len({ln["run_id"] for ln in lines}) == 1  # one correlation id
 
 
 def test_metrics_recorded(tmp_path):

@@ -3,6 +3,8 @@ reduction"): counts are exact vs the host computation, and enabling
 ``--metrics`` on a streamed sharded run never materializes the global board.
 """
 
+import logging
+
 import numpy as np
 import pytest
 
@@ -98,6 +100,106 @@ def test_sink_flushes_each_record(tmp_path):
     rec.record({"kind": "serve", "queue_depth": 0})
     assert len(sink.read_text().splitlines()) == 2
     rec.close()
+
+
+def test_sink_parent_dirs_created_at_construction(tmp_path):
+    """A sink in a not-yet-existing directory is fine — parents are created
+    and the handle opened AT CONSTRUCTION, before any compute is spent."""
+    from tpu_life.runtime.metrics import MetricsRecorder
+
+    sink = tmp_path / "deep" / "nested" / "metrics.jsonl"
+    rec = MetricsRecorder(10, True, sink=str(sink))
+    assert sink.exists()  # opened eagerly, not at the first record
+    rec.record_chunk(1, 0.5, 3)
+    rec.close()
+    assert len(sink.read_text().splitlines()) >= 1
+
+
+def test_sink_open_failure_is_fail_fast(tmp_path):
+    """An unopenable sink must raise at construction — after compute has
+    started is too late (the old lazy open lost whole runs to a typo)."""
+    from tpu_life.runtime.metrics import MetricsRecorder
+
+    blocker = tmp_path / "file.txt"
+    blocker.write_text("i am a file, not a directory")
+    with pytest.raises(OSError):
+        MetricsRecorder(10, True, sink=str(blocker / "sub" / "m.jsonl"))
+
+
+def test_records_carry_ts_and_run_id(tmp_path):
+    """Every record is stamped with a wall-clock ts (aligning JSONL lines
+    with trace/profiler timelines) and the invocation's run_id."""
+    import json
+    import time
+
+    from tpu_life.runtime.metrics import MetricsRecorder
+
+    sink = tmp_path / "m.jsonl"
+    t0 = time.time()
+    rec = MetricsRecorder(10, True, sink=str(sink), run_id="runid0000001")
+    rec.record_chunk(2, 0.5, 3)
+    rec.record({"kind": "serve", "queue_depth": 1})
+    rec.close()
+    lines = [json.loads(line) for line in sink.read_text().splitlines()]
+    assert all(r["run_id"] == "runid0000001" for r in lines)
+    assert all(t0 <= r["ts"] <= time.time() for r in lines)
+    # close() appended the registry snapshot to the same sink
+    assert any(r.get("kind") == "metric" for r in lines)
+
+
+def test_sink_reopens_after_close():
+    """close() flushes and releases the handle, but a recorder that keeps
+    recording reopens the sink in append mode — close-then-continue keeps
+    its records (the documented long-lived-service contract)."""
+    import json
+    import tempfile
+
+    from tpu_life.runtime.metrics import MetricsRecorder
+
+    with tempfile.TemporaryDirectory() as d:
+        sink = f"{d}/m.jsonl"
+        rec = MetricsRecorder(10, True, sink=sink)
+        rec.record_chunk(1, 0.5, 3)
+        rec.close()
+        before = len(open(sink).read().splitlines())
+        rec.record({"kind": "serve", "queue_depth": 0})
+        lines = open(sink).read().splitlines()
+        assert len(lines) == before + 1
+        assert json.loads(lines[-1])["queue_depth"] == 0
+        rec.close()
+
+
+def test_recorder_registry_tracks_chunk_histogram():
+    """The recorder sits on the obs registry: chunk durations land in a
+    histogram, steps in a counter — per-chunk DELTAS, not cumulatives."""
+    from tpu_life.runtime.metrics import MetricsRecorder
+
+    rec = MetricsRecorder(10, True, labels={"backend": "jax", "rule": "x"})
+    rec.record_chunk(4, 1.0, 3)   # delta 1.0s, 4 steps
+    rec.record_chunk(8, 3.0, 3)   # delta 2.0s, 4 steps
+    snap = {r["metric"]: r for r in rec.registry.snapshot()}
+    assert snap["run_chunk_seconds"]["count"] == 2
+    assert snap["run_chunk_seconds"]["sum"] == pytest.approx(3.0)
+    assert snap["run_chunk_seconds"]["labels"] == {"backend": "jax", "rule": "x"}
+    assert snap["run_steps_total"]["value"] == 8.0
+
+
+def test_configure_logging_does_not_duplicate_to_root(caplog):
+    """The tpu_life logger has its own handler, so records must not ALSO
+    propagate to the root logger — under pytest (whose caplog handler sits
+    at the root) every line used to appear twice."""
+    from tpu_life.runtime.metrics import configure_logging, log
+
+    configure_logging(verbose=False)
+    assert log.propagate is False
+    with caplog.at_level(logging.INFO):
+        log.info("obs-propagation-probe")
+    # caplog captures at the ROOT logger; a non-propagating record must
+    # not reach it (the tpu_life handler still emits it to stderr)
+    assert "obs-propagation-probe" not in caplog.text
+    # idempotent: a second configure never stacks a second handler
+    configure_logging(verbose=True)
+    assert len(log.handlers) == 1
 
 
 def test_host_runner_live_count(rng_board):

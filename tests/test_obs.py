@@ -1,0 +1,495 @@
+"""tpu_life.obs: unified telemetry — spans, registry, stats read-back.
+
+Covers the obs contract points: trace files are valid Chrome-trace JSON
+with stack-disciplined B/E pairs, histogram quantiles match hand-computed
+values on known samples, label cardinality is capped, disabled telemetry
+has zero per-step Python cost (probe counter, mirroring
+``autotune.trial_count()``), and ``tpu-life stats`` reproduces a golden
+summary from a committed fixture sink.
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from tpu_life import obs
+from tpu_life.cli import main
+from tpu_life.config import RunConfig
+from tpu_life.obs import stats as obs_stats
+from tpu_life.obs.registry import Histogram, MetricsRegistry
+from tpu_life.runtime import driver
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """No test may leak an active tracer (or inherit one)."""
+    obs.stop_tracing()
+    obs.reset_span_count()
+    yield
+    obs.stop_tracing()
+
+
+def assert_nested(events):
+    """B/E stack discipline per (pid, tid): every E closes the newest
+    open B of the same name, and nothing stays open."""
+    stacks = {}
+    for e in events:
+        key = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        elif e["ph"] == "E":
+            stack = stacks.get(key)
+            assert stack, f"E {e['name']!r} without an open B"
+            assert stack.pop() == e["name"], f"mis-nested E {e['name']!r}"
+    leftovers = {k: v for k, v in stacks.items() if v}
+    assert not leftovers, f"unclosed spans: {leftovers}"
+
+
+# -- trace spans -----------------------------------------------------------
+def test_tracer_writes_valid_nested_chrome_trace(tmp_path):
+    t = obs.start_tracing(str(tmp_path / "t.json"), run_id="abc123abc123")
+    with obs.span("outer", phase="demo"):
+        with obs.span("inner"):
+            obs.instant("marker", note=1)
+        obs.complete("after-the-fact", 0.001, 0.002, step=4)
+    obs.async_begin("wait", "s0", steps=8)
+    obs.async_end("wait", "s0")
+    path = obs.stop_tracing(t)
+
+    doc = json.loads(open(path).read())  # strict: the file IS json
+    assert doc["otherData"]["run_id"] == "abc123abc123"
+    assert doc["otherData"]["telemetry_schema"] == obs.TELEMETRY_SCHEMA
+    events = doc["traceEvents"]
+    assert_nested(events)
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+    assert len(by_ph["B"]) == len(by_ph["E"]) == 2
+    assert by_ph["X"][0]["dur"] == pytest.approx(1000.0)  # 1 ms in us
+    assert by_ph["b"][0]["id"] == by_ph["e"][0]["id"] == "s0"
+    # the probe counted exactly the two real span entries
+    assert obs.span_count() == 2
+
+
+def test_span_nesting_survives_exceptions(tmp_path):
+    t = obs.start_tracing(str(tmp_path / "t.json"))
+    with pytest.raises(RuntimeError):
+        with obs.span("outer"):
+            with obs.span("inner"):
+                raise RuntimeError("boom")
+    doc = json.loads(open(obs.stop_tracing(t)).read())
+    assert_nested(doc["traceEvents"])  # both E events still emitted
+
+
+def test_disabled_span_is_shared_nullcontext_and_probe_free():
+    before = obs.span_count()
+    s1 = obs.span("anything", big=list(range(3)))
+    s2 = obs.span("else")
+    assert s1 is s2  # the shared nullcontext — no per-call allocation
+    with s1:
+        pass
+    obs.complete("x", 0, 1)
+    obs.instant("y")
+    obs.async_begin("z", "1")
+    obs.async_end("z", "1")
+    assert obs.span_count() == before
+    assert obs.now() == 0.0
+
+
+# -- registry --------------------------------------------------------------
+def test_histogram_quantiles_against_known_samples():
+    h = Histogram(buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 3.5, 5.0):
+        h.observe(v)
+    assert h.count == 5 and h.sum == pytest.approx(13.5)
+    assert h.min == 0.5 and h.max == 5.0
+    # rank q*count walks cumulative bucket counts; linear interpolation
+    # inside the target bucket, clamped to the observed extremes
+    assert h.quantile(0.0) == 0.5  # exact at the extremes
+    assert h.quantile(1.0) == 5.0  # +Inf bucket reports the observed max
+    assert h.quantile(0.2) == pytest.approx(1.0)  # rank 1.0 -> bucket (0,1]
+    assert h.quantile(0.5) == pytest.approx(2.5)  # rank 2.5 -> bucket (2,4]
+    assert h.quantile(0.8) == pytest.approx(4.0)  # rank 4.0 -> bucket edge
+
+
+def test_histogram_empty_and_single_sample():
+    h = Histogram()
+    assert h.quantile(0.5) is None
+    h.observe(0.3)
+    # one sample: every quantile clamps to it exactly
+    assert h.quantile(0.5) == 0.3
+    assert h.quantile(0.99) == 0.3
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_label_cardinality_cap_collapses_to_overflow():
+    reg = MetricsRegistry()
+    c = reg.counter("victim_total", labels=("session",), max_series=3)
+    for i in range(10):
+        c.labels(session=f"s{i}").inc()
+    series = c.series()
+    assert len(series) == 4  # 3 real + the shared overflow bucket
+    overflow = [v for labels, v in series if labels["session"] == "__overflow__"]
+    assert len(overflow) == 1 and overflow[0].value == 7.0  # s3..s9 collapsed
+    # memory stays bounded no matter how many more labels arrive
+    for i in range(100, 200):
+        c.labels(session=f"s{i}").inc()
+    assert len(c.series()) == 4
+
+
+def test_registry_registration_is_idempotent_but_typed():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", labels=("k",))
+    assert reg.counter("x_total", labels=("k",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", labels=("k",))  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("x_total")  # label mismatch
+    with pytest.raises(ValueError):
+        a.labels(wrong="v")  # unknown label name
+    with pytest.raises(ValueError):
+        a.labels(k="v").inc(-1)  # counters only go up
+
+
+def test_prom_text_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("jobs_total", "jobs seen", labels=("rule",)).labels(
+        rule='B3/S23"x'
+    ).inc(3)
+    reg.gauge("depth", "queue depth").set(2)
+    h = reg.histogram("wait_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.prom_text()
+    assert '# TYPE jobs_total counter' in text
+    assert 'jobs_total{rule="B3/S23\\"x"} 3' in text  # escaped quote
+    assert "depth 2" in text.splitlines()
+    # histogram buckets are CUMULATIVE in prom exposition
+    assert 'wait_seconds_bucket{le="0.1"} 1' in text
+    assert 'wait_seconds_bucket{le="1"} 2' in text
+    assert 'wait_seconds_bucket{le="+Inf"} 3' in text
+    assert "wait_seconds_sum" in text and "wait_seconds_count 3" in text
+
+
+def test_registry_snapshot_records_are_json_safe():
+    reg = MetricsRegistry()
+    reg.histogram("h_seconds").observe(0.01)
+    reg.counter("c_total").inc()
+    recs = reg.snapshot(run_id="rid0")
+    assert all(r["kind"] == "metric" and r["run_id"] == "rid0" for r in recs)
+    json.dumps(recs)  # no NaN / Infinity / non-string keys
+
+
+# -- driver integration ----------------------------------------------------
+def test_run_trace_and_metrics_share_run_id(tmp_path, monkeypatch):
+    """The acceptance shape: one `run` produces a Perfetto-loadable trace
+    whose chunk spans and JSONL records carry one run_id."""
+    monkeypatch.chdir(tmp_path)
+    res = driver.run(
+        RunConfig(
+            height=24,
+            width=24,
+            steps=8,
+            sync_every=2,
+            output_file=None,
+            metrics_file="m.jsonl",
+            trace_events="t.json",
+        )
+    )
+    assert res.run_id
+    doc = json.loads(open("t.json").read())
+    assert doc["otherData"]["run_id"] == res.run_id
+    events = doc["traceEvents"]
+    assert_nested(events)
+    names = {e["name"] for e in events}
+    assert {
+        "run",
+        "config-resolve",
+        "backend-build",
+        "stage",
+        "drive",
+        "chunk",
+        "gather",
+    } <= names
+    chunks = [e for e in events if e["name"] == "chunk"]
+    assert len(chunks) == 4 and all(e["ph"] == "X" for e in chunks)
+    assert [e["args"]["step"] for e in chunks] == [2, 4, 6, 8]
+
+    recs = [json.loads(line) for line in open("m.jsonl")]
+    assert recs and all(r["run_id"] == res.run_id for r in recs)
+    assert all("ts" in r for r in recs)
+    kinds = {r.get("kind", "chunk") for r in recs}
+    assert kinds == {"chunk", "metric"}  # per-chunk stream + snapshot
+    snap = {r["metric"]: r for r in recs if r.get("kind") == "metric"}
+    assert snap["run_backend_builds_total"]["value"] == 1.0
+    assert snap["run_chunk_seconds"]["count"] == 4
+    assert snap["run_steps_total"]["value"] == 8.0
+    # RunResult.metrics stays the per-chunk stream (never-gather invariant
+    # owners rely on its shape)
+    assert [m["step"] for m in res.metrics] == [2, 4, 6, 8]
+
+
+def test_disabled_telemetry_has_zero_overhead(tmp_path, monkeypatch):
+    """Tracing + metrics both off: no records, no span entries (the probe,
+    mirroring autotune.trial_count()), no active tracer."""
+    monkeypatch.chdir(tmp_path)
+    obs.reset_span_count()
+    res = driver.run(
+        RunConfig(height=16, width=16, steps=4, output_file=None)
+    )
+    assert res.metrics == []
+    assert obs.span_count() == 0
+    assert obs.active_tracer() is None
+
+
+def test_snapshot_and_recovery_spans_appear(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    res = driver.run(
+        RunConfig(
+            height=16,
+            width=16,
+            steps=8,
+            sync_every=2,
+            snapshot_every=2,
+            output_file=None,
+            trace_events="t.json",
+            fault_at=5,
+            max_restarts=1,
+        )
+    )
+    assert res.restarts == 1
+    doc = json.loads(open("t.json").read())
+    assert_nested(doc["traceEvents"])
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "snapshot-write" in names
+    assert "recovery-rewind" in names
+
+
+# -- serve integration -----------------------------------------------------
+def test_serve_queue_wait_quantiles_non_degenerate():
+    """A drain of >= 20 staggered sessions yields real queue-wait spread:
+    p95 > p50 > 0 (the acceptance bar), and the per-round records carry
+    the live quantile fields."""
+    from tpu_life.models.patterns import random_board
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    t = {"v": 0.0}
+    svc = SimulationService(
+        ServeConfig(
+            capacity=4, chunk_steps=4, max_queue=64, backend="numpy",
+            metrics=True,
+        ),
+        clock=lambda: t["v"],
+    )
+    boards = [random_board(8, 8, seed=i) for i in range(4)]
+    sids = [svc.submit(boards[i % 4], "conway", 12) for i in range(24)]
+    while not svc.scheduler.idle():
+        svc.pump()
+        t["v"] += 1.0
+    stats = svc.stats()
+    assert stats["done"] == 24
+    assert stats["queue_wait_p95"] > stats["queue_wait_p50"] > 0.0
+    assert stats["queue_wait_p99"] >= stats["queue_wait_p95"]
+    assert stats["completion_p95"] > stats["completion_p50"] > 0.0
+    last = svc.recorder.records[-1]
+    assert last["queue_wait_p95"] == stats["queue_wait_p95"]
+    assert last["run_id"] == svc.run_id
+    # every terminal outcome was counted
+    snap = {
+        (r["metric"], tuple(sorted(r["labels"].items()))): r
+        for r in svc.registry.snapshot()
+    }
+    assert snap[("serve_sessions_submitted_total", ())]["value"] == 24.0
+    done_key = ("serve_sessions_finished_total", (("state", "done"),))
+    assert snap[done_key]["value"] == 24.0
+    assert snap[("serve_queue_wait_seconds", ())]["count"] == 24
+    assert [svc.result(s).shape for s in sids]  # results all intact
+
+
+def test_serve_rejection_counter_and_trace(tmp_path):
+    from tpu_life.models.patterns import random_board
+    from tpu_life.serve import QueueFull, ServeConfig, SimulationService
+
+    svc = SimulationService(
+        ServeConfig(
+            capacity=1, chunk_steps=4, max_queue=2, backend="numpy",
+            metrics=True, trace_events=str(tmp_path / "serve.json"),
+            prom_file=str(tmp_path / "serve.prom"),
+        )
+    )
+    board = random_board(8, 8, seed=0)
+    for _ in range(2):
+        svc.submit(board, "conway", 8)
+    with pytest.raises(QueueFull):
+        svc.submit(board, "conway", 8)
+    svc.drain()
+    svc.close()
+    assert svc.stats()["rejections"] == 1.0
+    doc = json.loads(open(tmp_path / "serve.json").read())
+    assert doc["otherData"]["run_id"] == svc.run_id
+    assert_nested(doc["traceEvents"])
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"serve.round", "serve.admit", "serve.step-chunk", "serve.retire",
+            "queue-wait"} <= names
+    # every async queue-wait interval that opened was closed
+    opens = [e for e in doc["traceEvents"] if e["ph"] == "b"]
+    closes = [e for e in doc["traceEvents"] if e["ph"] == "e"]
+    assert {e["id"] for e in opens} == {e["id"] for e in closes}
+    prom = open(tmp_path / "serve.prom").read()
+    assert "serve_admission_rejections_total 1" in prom
+    assert "serve_queue_wait_seconds_count 2" in prom
+
+
+def test_traced_service_coexists_with_ambient_tracer(tmp_path):
+    """A traced service OWNS its tracer: its events land in ITS file even
+    while another tracer holds the process-global slot, and the ambient
+    trace stays free of serve events — run_id correlation survives
+    concurrent traced invocations in one process."""
+    from tpu_life.models.patterns import random_board
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    ambient = obs.start_tracing(str(tmp_path / "ambient.json"))
+    svc = SimulationService(
+        ServeConfig(
+            capacity=2, chunk_steps=4, backend="numpy",
+            trace_events=str(tmp_path / "svc.json"),
+        )
+    )
+    svc.submit(random_board(8, 8, seed=0), "conway", 4)
+    svc.drain()
+    svc.close()
+    with obs.span("ambient-phase"):
+        pass
+    obs.stop_tracing(ambient)
+
+    svc_doc = json.loads(open(tmp_path / "svc.json").read())
+    amb_doc = json.loads(open(tmp_path / "ambient.json").read())
+    svc_names = {e["name"] for e in svc_doc["traceEvents"]}
+    amb_names = {e["name"] for e in amb_doc["traceEvents"]}
+    assert {"serve.round", "queue-wait"} <= svc_names
+    assert svc_doc["otherData"]["run_id"] == svc.run_id
+    assert "ambient-phase" in amb_names
+    assert not {"serve.round", "queue-wait"} & amb_names  # nothing stolen
+
+
+def test_serve_cli_flushes_telemetry_on_failure(tmp_path, monkeypatch, capsys):
+    """A serve run that dies mid-flight still writes its trace and prom
+    files — the failed run is the one whose artifacts matter most."""
+    from tpu_life.io.codec import write_board, write_config
+
+    monkeypatch.chdir(tmp_path)
+    from tpu_life.models.patterns import random_board
+
+    write_config(tmp_path / "grid_size_data.txt", 8, 8, 4)
+    write_board(tmp_path / "ok.txt", random_board(8, 8, seed=1))
+    assert main(["submit", "--input-file", "ok.txt"]) == 0
+    assert main(["submit", "--input-file", "missing.txt"]) == 0  # spooled fine
+    capsys.readouterr()
+    with pytest.raises(FileNotFoundError):
+        main(["serve", "--trace-events", "t.json", "--prom-file", "p.prom",
+              "--metrics-file", "m.jsonl"])
+    assert (tmp_path / "t.json").exists()  # trace buffer flushed by close()
+    assert (tmp_path / "p.prom").exists()
+    recs = [json.loads(line) for line in open("m.jsonl")]
+    assert any(r.get("kind") == "metric" for r in recs)  # snapshot flushed
+
+
+# -- stats read-back -------------------------------------------------------
+GOLDEN_RENDER = """\
+metrics summary — 5 records, run_id fixture0run01
+run:
+  chunks=3  final_step=12  elapsed_s=2
+  steps/s=6 (max 8)  cells/s=1536 (max 2048)
+metrics:
+  run_chunk_seconds  [backend=jax,rule=B3/S23]  count=3  p50=0.625  p95=0.9625  p99=0.9925
+  run_steps_total    [backend=jax,rule=B3/S23]  counter=12"""
+
+
+def test_stats_summarize_golden_fixture():
+    records = obs_stats.load_records(os.path.join(FIXTURES, "metrics_run.jsonl"))
+    s = obs_stats.summarize(records)
+    assert s["records"] == 5
+    assert s["run_ids"] == ["fixture0run01"]
+    assert s["run"] == {
+        "chunks": 3,
+        "final_step": 12,
+        "elapsed_s": 2.0,
+        "steps_per_sec": 6.0,
+        "steps_per_sec_max": 8.0,
+        "cell_updates_per_sec": 1536.0,
+        "cell_updates_per_sec_max": 2048.0,
+        "live_cells_final": 90,
+    }
+    hist = next(m for m in s["metrics"] if m["type"] == "histogram")
+    assert hist["p50"] == 0.625 and hist["p95"] == 0.9625
+    assert obs_stats.render(s) == GOLDEN_RENDER
+
+
+def test_stats_cli_golden_output(capsys):
+    fixture = os.path.join(FIXTURES, "metrics_run.jsonl")
+    assert main(["stats", fixture]) == 0
+    assert capsys.readouterr().out.rstrip("\n") == GOLDEN_RENDER
+    assert main(["stats", fixture, "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["run"]["steps_per_sec"] == 6.0
+    assert s["run_ids"] == ["fixture0run01"]
+
+
+def test_stats_quantile_fallback_from_buckets():
+    """A snapshot record without precomputed p* fields re-derives them
+    from its bucket counts (older/hand-written sinks)."""
+    rec = {
+        "kind": "metric", "metric": "h", "type": "histogram",
+        "count": 5, "sum": 13.5, "min": 0.5, "max": 5.0,
+        "buckets": {"1.0": 1, "2.0": 1, "4.0": 2, "+Inf": 1},
+    }
+    q = obs_stats.hist_quantiles(rec)
+    assert q["p50"] == pytest.approx(2.5)  # same rule as Histogram.quantile
+    assert q["p99"] == 5.0
+
+
+def test_stats_serve_records_and_rejection_rate(tmp_path):
+    sink = tmp_path / "serve.jsonl"
+    rows = [
+        {"kind": "serve", "elapsed_s": 1.0, "queue_depth": 3,
+         "batch_occupancy": 0.5, "admitted": 4, "completed": 2, "failed": 0,
+         "steps_advanced": 64, "sessions_done": 2, "sessions_per_sec": 2.0},
+        {"kind": "serve", "elapsed_s": 2.0, "queue_depth": 0,
+         "batch_occupancy": 1.0, "admitted": 2, "completed": 4, "failed": 1,
+         "steps_advanced": 64, "sessions_done": 6, "sessions_per_sec": 3.0},
+        {"kind": "metric", "metric": "serve_sessions_submitted_total",
+         "type": "counter", "labels": {}, "value": 6.0},
+        {"kind": "metric", "metric": "serve_admission_rejections_total",
+         "type": "counter", "labels": {}, "value": 2.0},
+    ]
+    sink.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    s = obs_stats.summarize(obs_stats.load_records(str(sink)))
+    assert s["serve"]["rounds"] == 2
+    assert s["serve"]["sessions_per_sec"] == 3.0
+    assert s["serve"]["batch_occupancy_mean"] == pytest.approx(0.75)
+    assert s["serve"]["queue_depth_max"] == 3
+    assert s["serve"]["rejection_rate"] == pytest.approx(2 / 8)
+
+
+def test_stats_tolerates_torn_final_line(tmp_path):
+    """A killed writer leaves a half-line at the tail; stats must read the
+    complete prefix rather than refusing the file."""
+    sink = tmp_path / "m.jsonl"
+    sink.write_text(
+        json.dumps({"step": 2, "elapsed_s": 1.0, "steps_per_sec": 2.0}) + "\n"
+        + '{"step": 4, "elapsed'
+    )
+    s = obs_stats.summarize(obs_stats.load_records(str(sink)))
+    assert s["run"]["final_step"] == 2
+    # but a torn line in the MIDDLE is a corrupt file -> loud error
+    sink.write_text('{"bad\n' + json.dumps({"step": 2}) + "\n")
+    with pytest.raises(ValueError, match="bad metrics line"):
+        obs_stats.load_records(str(sink))

@@ -105,5 +105,13 @@ def test_serve_metrics_file_is_valid_jsonl(spool, capsys):
         json.loads(line)
         for line in (spool / "serve_metrics.jsonl").read_text().splitlines()
     ]
-    assert recs and all(r["kind"] == "serve" for r in recs)
-    assert recs[-1]["sessions_done"] == 1
+    assert recs and all(r["kind"] in ("serve", "metric") for r in recs)
+    rounds = [r for r in recs if r["kind"] == "serve"]
+    assert rounds and rounds[-1]["sessions_done"] == 1
+    # per-round records now carry live histogram quantiles, and close()
+    # appends the registry snapshot to the same sink (docs/SERVING.md)
+    assert "queue_wait_p50" in rounds[-1]
+    snapshot = {r["metric"] for r in recs if r["kind"] == "metric"}
+    assert "serve_queue_wait_seconds" in snapshot
+    # one run_id correlates every line
+    assert len({r["run_id"] for r in recs}) == 1

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from tpu_life import obs
 from tpu_life.autotune.space import TuneKey, TunedConfig
 from tpu_life.models.rules import Rule
 
@@ -123,22 +124,25 @@ def run_trials(
     warmup_steps = d_warm if warmup_steps is None else warmup_steps
     results: list[TrialResult] = []
     for i, cfg in enumerate(candidates):
-        try:
-            if measure is not None:
-                sps = float(measure(cfg, board, rule))
-                res = TrialResult(cfg, sps, samples=[sps])
-            else:
-                sps, samples = _measure(
-                    cfg,
-                    board,
-                    rule,
-                    steps=steps,
-                    warmup_steps=warmup_steps,
-                    trials=trials,
-                )
-                res = TrialResult(cfg, sps, samples=samples)
-        except Exception as e:  # noqa: BLE001 — per-candidate isolation
-            res = TrialResult(cfg, None, error=f"{type(e).__name__}: {e}")
+        # a span per candidate: a traced `run --tune-mode measure` (or
+        # `tpu-life tune` under tracing) shows where the search time went
+        with obs.span("autotune.trial", candidate=cfg.describe()):
+            try:
+                if measure is not None:
+                    sps = float(measure(cfg, board, rule))
+                    res = TrialResult(cfg, sps, samples=[sps])
+                else:
+                    sps, samples = _measure(
+                        cfg,
+                        board,
+                        rule,
+                        steps=steps,
+                        warmup_steps=warmup_steps,
+                        trials=trials,
+                    )
+                    res = TrialResult(cfg, sps, samples=samples)
+            except Exception as e:  # noqa: BLE001 — per-candidate isolation
+                res = TrialResult(cfg, None, error=f"{type(e).__name__}: {e}")
         results.append(res)
         if on_trial is not None:
             on_trial(i, len(candidates), res)

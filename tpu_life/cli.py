@@ -148,10 +148,30 @@ def build_parser() -> argparse.ArgumentParser:
                      help="default per-request deadline")
     srv.add_argument("--metrics-file", default=None, metavar="JSONL",
                      help="append per-round serve metrics as JSON lines")
+    srv.add_argument("--trace-events", default=None, metavar="FILE",
+                     help="write Chrome trace-event JSON (Perfetto): round "
+                     "spans (admit/step-chunk/retire) + per-session "
+                     "queue-wait intervals, run_id-correlated with the "
+                     "metrics sink")
+    srv.add_argument("--prom-file", default=None, metavar="FILE",
+                     help="write a Prometheus text-exposition snapshot of "
+                     "the serve metrics registry at shutdown")
     srv.add_argument("--platform", default=None,
                      help="force a JAX platform (cpu/tpu), like `run --platform`")
     srv.add_argument("--profile", default=None, metavar="TRACE_DIR")
     srv.add_argument("--verbose", "-v", action="store_true")
+
+    st = sub.add_parser(
+        "stats",
+        help="summarize a metrics JSONL file (run or serve): throughput "
+        "aggregates, histogram quantiles, occupancy, rejection rate",
+    )
+    st.add_argument("metrics_file", metavar="JSONL",
+                    help="sink written by `run --metrics-file` or "
+                    "`serve --metrics-file`")
+    st.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object instead of "
+                    "the human table")
 
     sm = sub.add_parser(
         "submit",
@@ -189,6 +209,10 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument("--config-file", default="grid_size_data.txt")
     r.add_argument("--input-file", default="data.txt")
     r.add_argument("--output-file", default="output.txt")
+    r.add_argument("--size", type=int, default=None,
+                   help="square board: shorthand for --height N --width N "
+                   "(explicit --height/--width win); with --steps and no "
+                   "input file, runs a seeded random board")
     r.add_argument("--height", type=int, default=None)
     r.add_argument("--width", type=int, default=None)
     r.add_argument("--steps", type=int, default=None)
@@ -311,6 +335,15 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
         "take time to clear)",
     )
     r.add_argument("--profile", default=None, metavar="TRACE_DIR")
+    r.add_argument(
+        "--trace-events",
+        default=None,
+        metavar="FILE",
+        help="write Chrome trace-event JSON (Perfetto-loadable): host-phase "
+        "spans — config-resolve, compile, staging, each host-sync chunk, "
+        "snapshots, recovery — stamped with the run's correlation id "
+        "(docs/OBSERVABILITY.md)",
+    )
     r.add_argument("--metrics", action="store_true")
     r.add_argument(
         "--metrics-file",
@@ -349,6 +382,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "submit":
         # pure file append: no device ever touched, so no watchdog needed
         return _submit(args)
+    if args.command == "stats":
+        # pure file read — the read-back toolchain never needs a device
+        return _stats(args)
 
     from tpu_life.utils.platform import devices_with_watchdog, ensure_platform
 
@@ -371,8 +407,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "serve":
         return _serve(args)
     cfg = RunConfig(
-        height=args.height,
-        width=args.width,
+        height=args.height if args.height is not None else args.size,
+        width=args.width if args.width is not None else args.size,
         steps=args.steps,
         config_file=args.config_file,
         input_file=args.input_file,
@@ -399,6 +435,7 @@ def main(argv: list[str] | None = None) -> int:
         fault_count=args.fault_count,
         restart_wait_s=args.restart_wait,
         profile=args.profile,
+        trace_events=args.trace_events,
         metrics=args.metrics,
         metrics_file=args.metrics_file,
         verbose=args.verbose,
@@ -625,6 +662,23 @@ def _tune(args) -> int:
     return 0
 
 
+def _stats(args) -> int:
+    """The read-back half of the telemetry loop (docs/OBSERVABILITY.md):
+    ingest a metrics JSONL sink — run chunks, serve rounds, registry
+    snapshot records in any mix — and report the aggregates."""
+    import json
+
+    from tpu_life.obs import stats as obs_stats
+
+    records = obs_stats.load_records(args.metrics_file)
+    summary = obs_stats.summarize(records)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(obs_stats.render(summary))
+    return 0
+
+
 def _submit(args) -> int:
     """Append one request line to the serve spool — the client half of the
     file-based front-end (`serve` is the server half).  Geometry falls back
@@ -704,6 +758,8 @@ def _serve(args) -> int:
             metrics=True,
             metrics_file=args.metrics_file,
             profile=args.profile,
+            trace_events=args.trace_events,
+            prom_file=args.prom_file,
         )
     )
     # admit respecting backpressure: when the bounded queue fills, pump
@@ -712,22 +768,27 @@ def _serve(args) -> int:
     from tpu_life.serve import QueueFull
 
     submitted: list[tuple[str, dict]] = []
-    for i, req in enumerate(requests):
-        board = read_board(req["input_file"], req["height"], req["width"])
-        while True:
-            try:
-                sid = svc.submit(
-                    board,
-                    req.get("rule", "conway"),
-                    int(req["steps"]),
-                    timeout_s=req.get("timeout_s"),
-                )
-                break
-            except QueueFull:
-                svc.pump()
-        submitted.append((sid, req))
-    svc.drain()
-    svc.close()  # metrics sink handle + idle engines
+    try:
+        for i, req in enumerate(requests):
+            board = read_board(req["input_file"], req["height"], req["width"])
+            while True:
+                try:
+                    sid = svc.submit(
+                        board,
+                        req.get("rule", "conway"),
+                        int(req["steps"]),
+                        timeout_s=req.get("timeout_s"),
+                    )
+                    break
+                except QueueFull:
+                    svc.pump()
+            submitted.append((sid, req))
+        svc.drain()
+    finally:
+        # a failed serve still flushes its telemetry — trace buffer, prom
+        # snapshot, registry snapshot, sink handle; the failed run is the
+        # one whose artifacts matter most
+        svc.close()
 
     out_dir = Path(args.output_dir)
     failures = []
@@ -753,6 +814,7 @@ def _serve(args) -> int:
         json.dumps(
             {
                 "mode": "serve",
+                "run_id": stats["run_id"],
                 "backend": args.serve_backend,
                 "capacity": args.capacity,
                 "chunk_steps": args.chunk_steps,
@@ -764,6 +826,10 @@ def _serve(args) -> int:
                 "elapsed_s": stats["elapsed_s"],
                 "sessions_per_sec": stats["sessions_per_sec"],
                 "batch_occupancy_mean": stats["batch_occupancy_mean"],
+                "queue_wait_p50": stats["queue_wait_p50"],
+                "queue_wait_p95": stats["queue_wait_p95"],
+                "completion_p50": stats["completion_p50"],
+                "rejections": stats["rejections"],
                 "failures": failures,
             }
         )

@@ -80,6 +80,10 @@ class RunConfig:
     # take a while to clear; 0 keeps drills and tests instant
     restart_wait_s: float = 0.0
     profile: str | None = None  # jax.profiler trace directory
+    # Chrome trace-event JSON file (Perfetto-loadable): host-phase spans —
+    # config-resolve, compile, staging, each host-sync chunk, snapshots,
+    # recovery — stamped with the run's correlation id (docs/OBSERVABILITY.md)
+    trace_events: str | None = None
     verbose: bool = False
     metrics: bool = False  # per-chunk live-cell counts + throughput
     # append each metrics record as a JSON line here (implies metrics)

@@ -1,0 +1,84 @@
+"""tpu_life.obs: unified telemetry — spans, metrics, and read-back.
+
+The reference's observability was one ``MPI_Wtime`` bracket; the repo had
+grown three disconnected signals (``MetricsRecorder`` JSONL, a whole-run
+``jax.profiler`` wrapper, bare log lines) with no shared identity.  This
+package ties them together around one generated ``run_id``:
+
+- :mod:`tpu_life.obs.trace` — Chrome trace-event spans (Perfetto-loadable,
+  ``--trace-events FILE``) bracketing every host phase: driver
+  config-resolve / compile / staging / chunks / snapshots / recovery,
+  serve rounds (admit / step-chunk / retire / per-session queue wait),
+  autotune trials.  Disabled tracing is a shared ``nullcontext`` — zero
+  per-step Python cost, asserted via the :func:`span_count` probe.
+- :mod:`tpu_life.obs.registry` — ``Counter`` / ``Gauge`` / ``Histogram``
+  families with bounded-cardinality labels, exported both as records in
+  the metrics JSONL sink and as a Prometheus text snapshot
+  (``serve --prom-file``).
+- :mod:`tpu_life.obs.stats` — the read-back toolchain behind
+  ``tpu-life stats``: one JSONL file in, throughput aggregates and
+  histogram quantiles out (``--json`` for machines).
+
+Correlation model: the driver / serve service / bench each generate one
+``run_id`` per invocation and stamp it into every trace file, every JSONL
+record and every BENCH record they emit, so the artifacts of one run join
+on one key.  ``TELEMETRY_SCHEMA`` versions the shared vocabulary.
+
+This module imports neither jax nor numpy — the CLI's jax-free paths
+(``stats``, ``submit``) and ``bench.py``'s signal emitters can use it
+before (or without) any device touch.
+"""
+
+from tpu_life.obs.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Family,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from tpu_life.obs.trace import (
+    TELEMETRY_SCHEMA,
+    Tracer,
+    activate,
+    active_tracer,
+    ensure_parent,
+    async_begin,
+    async_end,
+    complete,
+    instant,
+    new_run_id,
+    now,
+    reset_span_count,
+    span,
+    span_count,
+    start_tracing,
+    stop_tracing,
+)
+from tpu_life.obs import stats
+
+__all__ = [
+    "TELEMETRY_SCHEMA",
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Family",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Tracer",
+    "activate",
+    "active_tracer",
+    "async_begin",
+    "ensure_parent",
+    "async_end",
+    "complete",
+    "instant",
+    "new_run_id",
+    "now",
+    "reset_span_count",
+    "span",
+    "span_count",
+    "start_tracing",
+    "stop_tracing",
+    "stats",
+]

@@ -1,0 +1,231 @@
+"""The read-back toolchain: metrics JSONL in, summary out.
+
+The sink files the runtime writes (``run --metrics-file``, ``serve
+--metrics-file``) were append-only artifacts nothing in the repo could
+read back; ``tpu-life stats`` closes the loop.  It ingests one JSONL file
+— any mix of per-chunk run records (``step`` / ``steps_per_sec``),
+per-round serve records (``kind: "serve"``) and end-of-run registry
+snapshots (``kind: "metric"``) — and reports the aggregates a human (or
+``--json``, a machine) asks first: step and cell throughput, histogram
+quantiles (p50/p95/p99), batch occupancy, admission rejection rate.
+
+Quantiles prefer the precomputed ``p50/p95/p99`` fields a snapshot record
+carries; a record without them (hand-written, older schema) falls back to
+re-deriving from its bucket counts with the same interpolation rule as
+:meth:`tpu_life.obs.registry.Histogram.quantile`.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+
+def load_records(path: str) -> list[dict]:
+    """Parse a metrics JSONL file (blank lines and ``#`` comments skipped);
+    a malformed line raises with its line number — a truncated tail line
+    from a killed run is the one exception, tolerated with a warning field
+    left to the caller (it is the expected artifact of a mid-write kill)."""
+    records: list[dict] = []
+    lines = Path(path).read_text().splitlines()
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if lineno == len(lines):
+                break  # torn final line: a killed writer, not a bad file
+            raise ValueError(f"{path}:{lineno}: bad metrics line: {e}") from e
+    return records
+
+
+def _quantile_from_buckets(rec: dict, q: float) -> float | None:
+    """Re-derive a quantile from a snapshot record's bucket counts —
+    the fallback when the precomputed field is absent."""
+    count = rec.get("count", 0)
+    if not count:
+        return None
+    finite = sorted(
+        (float(b), c) for b, c in rec.get("buckets", {}).items() if b != "+Inf"
+    )
+    rank = q * count
+    cum = 0
+    lo = 0.0
+    lo_clamp = rec.get("min", 0.0) or 0.0
+    hi_clamp = rec.get("max")
+    for hi, c in finite:
+        if c:
+            if cum + c >= rank:
+                est = lo + (hi - lo) * (rank - cum) / c
+                est = max(est, lo_clamp)
+                return min(est, hi_clamp) if hi_clamp is not None else est
+            cum += c
+        lo = hi
+    return hi_clamp
+
+
+def hist_quantiles(rec: dict) -> dict:
+    """{"p50", "p95", "p99"} of a histogram snapshot record."""
+    out = {}
+    for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+        v = rec.get(name)
+        out[name] = v if v is not None else _quantile_from_buckets(rec, q)
+    return out
+
+
+def _labels_id(labels: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def summarize(records: list[dict]) -> dict:
+    """The summary dict behind both output modes of ``tpu-life stats``."""
+    chunks = [r for r in records if "step" in r and "kind" not in r]
+    rounds = [r for r in records if r.get("kind") == "serve"]
+    metrics = [r for r in records if r.get("kind") == "metric"]
+
+    summary: dict = {
+        "records": len(records),
+        "run_ids": sorted({r["run_id"] for r in records if r.get("run_id")}),
+    }
+
+    if chunks:
+        last = chunks[-1]
+        rates = [r["steps_per_sec"] for r in chunks if r.get("steps_per_sec")]
+        cell_rates = [
+            r["cell_updates_per_sec"]
+            for r in chunks
+            if r.get("cell_updates_per_sec")
+        ]
+        summary["run"] = {
+            "chunks": len(chunks),
+            "final_step": last["step"],
+            "elapsed_s": last.get("elapsed_s"),
+            # the per-chunk steps_per_sec is cumulative (done / elapsed), so
+            # the final record IS the whole-run average; max is the best
+            # window the run ever sustained
+            "steps_per_sec": last.get("steps_per_sec"),
+            "steps_per_sec_max": max(rates) if rates else 0.0,
+            "cell_updates_per_sec": last.get("cell_updates_per_sec"),
+            "cell_updates_per_sec_max": max(cell_rates) if cell_rates else 0.0,
+            "live_cells_final": last.get("live_cells"),
+        }
+
+    if rounds:
+        last = rounds[-1]
+        occ = [r.get("batch_occupancy", 0.0) for r in rounds]
+        summary["serve"] = {
+            "rounds": len(rounds),
+            "elapsed_s": last.get("elapsed_s"),
+            "sessions_done": last.get("sessions_done"),
+            "sessions_per_sec": last.get("sessions_per_sec"),
+            "steps_advanced": sum(r.get("steps_advanced", 0) for r in rounds),
+            "admitted": sum(r.get("admitted", 0) for r in rounds),
+            "completed": sum(r.get("completed", 0) for r in rounds),
+            "failed": sum(r.get("failed", 0) for r in rounds),
+            "batch_occupancy_mean": sum(occ) / len(occ),
+            "queue_depth_max": max(r.get("queue_depth", 0) for r in rounds),
+        }
+
+    if metrics:
+        summary["metrics"] = []
+        counters = {}
+        for rec in metrics:
+            entry = {
+                "metric": rec["metric"],
+                "type": rec["type"],
+                "labels": rec.get("labels", {}),
+            }
+            if rec["type"] == "histogram":
+                entry.update(
+                    count=rec.get("count"),
+                    sum=rec.get("sum"),
+                    min=rec.get("min"),
+                    max=rec.get("max"),
+                    **hist_quantiles(rec),
+                )
+            else:
+                entry["value"] = rec.get("value")
+                counters[(rec["metric"], _labels_id(rec.get("labels", {})))] = (
+                    rec.get("value") or 0.0
+                )
+            summary["metrics"].append(entry)
+        # admission rejection rate: rejected / offered, when both counters
+        # are present in the snapshot
+        rejected = sum(
+            v for (name, _), v in counters.items()
+            if name == "serve_admission_rejections_total"
+        )
+        submitted = sum(
+            v for (name, _), v in counters.items()
+            if name == "serve_sessions_submitted_total"
+        )
+        if submitted or rejected:
+            summary.setdefault("serve", {})["rejection_rate"] = (
+                rejected / (submitted + rejected) if (submitted + rejected) else 0.0
+            )
+
+    return summary
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v == 0:
+            return "0"
+        if abs(v) >= 1e5 or 0 < abs(v) < 1e-3:
+            return f"{v:.3e}"
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render(summary: dict) -> str:
+    """The human table (``--json`` bypasses this)."""
+    lines: list[str] = []
+    rid = summary.get("run_ids") or []
+    lines.append(
+        f"metrics summary — {summary['records']} records, "
+        f"run_id {', '.join(rid) if rid else '<none>'}"
+    )
+    run = summary.get("run")
+    if run:
+        lines.append("run:")
+        lines.append(
+            f"  chunks={run['chunks']}  final_step={run['final_step']}  "
+            f"elapsed_s={_fmt(run['elapsed_s'])}"
+        )
+        lines.append(
+            f"  steps/s={_fmt(run['steps_per_sec'])} "
+            f"(max {_fmt(run['steps_per_sec_max'])})  "
+            f"cells/s={_fmt(run['cell_updates_per_sec'])} "
+            f"(max {_fmt(run['cell_updates_per_sec_max'])})"
+        )
+    serve = summary.get("serve")
+    if serve:
+        lines.append("serve:")
+        if "rounds" in serve:
+            lines.append(
+                f"  rounds={serve['rounds']}  done={_fmt(serve.get('sessions_done'))}  "
+                f"sessions/s={_fmt(serve.get('sessions_per_sec'))}  "
+                f"occupancy={_fmt(serve.get('batch_occupancy_mean'))}  "
+                f"queue_depth_max={_fmt(serve.get('queue_depth_max'))}"
+            )
+        if "rejection_rate" in serve:
+            lines.append(f"  rejection_rate={_fmt(serve['rejection_rate'])}")
+    mets = summary.get("metrics")
+    if mets:
+        lines.append("metrics:")
+        name_w = max(len(m["metric"]) for m in mets)
+        for m in mets:
+            label = _labels_id(m["labels"])
+            tag = f"{m['metric']:<{name_w}}" + (f"  [{label}]" if label else "")
+            if m["type"] == "histogram":
+                lines.append(
+                    f"  {tag}  count={_fmt(m['count'])}  p50={_fmt(m['p50'])}  "
+                    f"p95={_fmt(m['p95'])}  p99={_fmt(m['p99'])}"
+                )
+            else:
+                lines.append(f"  {tag}  {m['type']}={_fmt(m['value'])}")
+    return "\n".join(lines)

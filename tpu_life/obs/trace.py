@@ -1,0 +1,297 @@
+"""Run-correlated trace spans in Chrome trace-event JSON.
+
+The reference's entire tracing story is one ``MPI_Wtime`` bracket
+(Parallel_Life_MPI.cpp:199,233); ``--profile`` grew that into a whole-run
+``jax.profiler`` trace, but the *host-side phase structure* — config
+resolution, compilation, staging, each host-sync chunk, snapshot writes,
+recovery rewinds, serve scheduling rounds, autotune trials — stayed
+invisible.  This module makes it a first-class artifact: a
+:class:`Tracer` collects Chrome trace events (the format Perfetto and
+``chrome://tracing`` load directly) and writes them as one JSON object
+``{"traceEvents": [...], "otherData": {"run_id": ...}}``.
+
+Design rules:
+
+- **Disabled tracing is free.**  The module-level :func:`span` returns a
+  shared ``nullcontext`` when no tracer is active — no event dict, no
+  timestamp read, no probe increment.  The fused device loop never sees a
+  per-step Python callback either way; spans bracket *host* phases only.
+- **Run identity.**  Every tracer carries a ``run_id`` (also stamped into
+  metrics JSONL records and BENCH records), so the trace file, the
+  metrics sink and the bench artifact from one invocation join on one key.
+- **Probe counter.**  ``span_count()`` counts real span entries the way
+  ``autotune.trial_count()`` counts device measurements — the
+  disabled-telemetry overhead tests assert it stays at zero.
+
+Event vocabulary (all timestamps in microseconds since tracer start):
+
+- ``ph: "B"/"E"`` — nested duration spans (:meth:`Tracer.span`); strictly
+  stack-disciplined per thread, so the pairs always nest.
+- ``ph: "X"``     — complete events with an explicit duration
+  (:meth:`Tracer.complete`) — the per-chunk records, emitted after the
+  fact from the driver's chunk callback.
+- ``ph: "b"/"e"`` — async (non-nested) spans keyed by ``id``
+  (:meth:`Tracer.async_begin` / :meth:`Tracer.async_end`) — per-session
+  queue-wait intervals in the serve layer, which overlap freely.
+- ``ph: "i"``     — instant markers (:meth:`Tracer.instant`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from contextlib import contextmanager, nullcontext
+from pathlib import Path
+
+#: Version of the telemetry record vocabulary (trace event args, metrics
+#: JSONL fields, BENCH stamp).  Bump when a consumer-visible field changes
+#: meaning, so perf-trajectory tooling can join records across PRs safely.
+TELEMETRY_SCHEMA = 1
+
+
+def new_run_id() -> str:
+    """A fresh correlation id: 12 hex chars, unique per invocation."""
+    return uuid.uuid4().hex[:12]
+
+
+def ensure_parent(path) -> None:
+    """Create a file's parent directories (the shared exporter prelude)."""
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+
+
+# the span probe, mirroring autotune.runner._MEASURED: a mutable holder so
+# tests hold a live view through the module, not a stale int import
+_PROBE = {"spans": 0}
+
+
+def span_count() -> int:
+    """Spans actually entered by an active tracer in this process — the
+    disabled-telemetry overhead probe (zero when tracing never enabled)."""
+    return _PROBE["spans"]
+
+
+def reset_span_count() -> None:
+    _PROBE["spans"] = 0
+
+
+class Tracer:
+    """Collects Chrome trace events in memory; :meth:`write` emits the file.
+
+    In-memory buffering keeps the hot path to one dict append; the driver
+    and the serve service call :meth:`write` from a ``finally`` so a failed
+    run still leaves its partial trace on disk.
+    """
+
+    def __init__(self, path: str, run_id: str | None = None):
+        self.path = str(path)
+        self.run_id = run_id or new_run_id()
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+        self._events: list[dict] = []
+
+    # -- clocks -----------------------------------------------------------
+    def now(self) -> float:
+        """Seconds since tracer start (the clock every event lives on)."""
+        return time.perf_counter() - self._t0
+
+    def _ts(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    # -- event emitters ---------------------------------------------------
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """A nested B/E duration span around the enclosed block."""
+        _PROBE["spans"] += 1
+        tid = threading.get_ident()
+        self._events.append(
+            {
+                "name": name,
+                "ph": "B",
+                "ts": self._ts(),
+                "pid": self._pid,
+                "tid": tid,
+                "args": attrs,
+            }
+        )
+        try:
+            yield self
+        finally:
+            self._events.append(
+                {
+                    "name": name,
+                    "ph": "E",
+                    "ts": self._ts(),
+                    "pid": self._pid,
+                    "tid": tid,
+                }
+            )
+
+    def complete(self, name: str, start_s: float, end_s: float, **attrs) -> None:
+        """A complete (ph ``X``) event for an interval measured after the
+        fact — ``start_s``/``end_s`` are on this tracer's :meth:`now` clock."""
+        self._events.append(
+            {
+                "name": name,
+                "ph": "X",
+                "ts": start_s * 1e6,
+                "dur": max(0.0, end_s - start_s) * 1e6,
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                "args": attrs,
+            }
+        )
+
+    def instant(self, name: str, **attrs) -> None:
+        self._events.append(
+            {
+                "name": name,
+                "ph": "i",
+                "s": "p",  # process-scoped marker
+                "ts": self._ts(),
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                "args": attrs,
+            }
+        )
+
+    def async_begin(self, name: str, aid: str, **attrs) -> None:
+        """Open an async interval (``ph: "b"``) keyed by ``aid`` — for
+        overlapping non-nested intervals like per-session queue waits."""
+        self._events.append(
+            {
+                "name": name,
+                "cat": name,
+                "ph": "b",
+                "id": aid,
+                "ts": self._ts(),
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                "args": attrs,
+            }
+        )
+
+    def async_end(self, name: str, aid: str, **attrs) -> None:
+        self._events.append(
+            {
+                "name": name,
+                "cat": name,
+                "ph": "e",
+                "id": aid,
+                "ts": self._ts(),
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                "args": attrs,
+            }
+        )
+
+    # -- output -----------------------------------------------------------
+    def write(self) -> str:
+        """Write the Chrome-trace JSON object; returns the path written."""
+        ensure_parent(self.path)
+        doc = {
+            "traceEvents": self._events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "run_id": self.run_id,
+                "telemetry_schema": TELEMETRY_SCHEMA,
+            },
+        }
+        with open(self.path, "w") as f:
+            json.dump(doc, f)
+        return self.path
+
+
+# -- the module-level switchboard ------------------------------------------
+# one active tracer per process (the driver and the serve service each own
+# one invocation); disabled == None == every entry point below is a no-op
+
+_NULL = nullcontext()
+_ACTIVE: Tracer | None = None
+
+
+def active_tracer() -> Tracer | None:
+    return _ACTIVE
+
+
+def start_tracing(path: str, run_id: str | None = None) -> Tracer:
+    """Activate a tracer writing to ``path``; returns it (pass back to
+    :func:`stop_tracing`).  Starting over an already-active tracer replaces
+    it — the previous owner's ``stop_tracing(tracer)`` still writes its
+    file, it just stops receiving new events."""
+    global _ACTIVE
+    _ACTIVE = Tracer(path, run_id)
+    return _ACTIVE
+
+
+@contextmanager
+def activate(tracer: Tracer | None):
+    """Make ``tracer`` the active one for the enclosed block, restoring the
+    previous tracer after — how a long-lived owner (the serve service)
+    routes the emissions of everything it calls into ITS file without
+    claiming the process-global slot between rounds.  ``tracer=None``
+    leaves the ambient tracer untouched (a no-op scope)."""
+    global _ACTIVE
+    if tracer is None:
+        yield None
+        return
+    prev = _ACTIVE
+    _ACTIVE = tracer
+    try:
+        yield tracer
+    finally:
+        _ACTIVE = prev
+
+
+def stop_tracing(tracer: Tracer | None = None) -> str | None:
+    """Write and deactivate (``tracer=None`` stops whichever is active).
+    Returns the path written, or None when there was nothing to stop."""
+    global _ACTIVE
+    t = tracer if tracer is not None else _ACTIVE
+    if t is None:
+        return None
+    if _ACTIVE is t:
+        _ACTIVE = None
+    return t.write()
+
+
+def span(name: str, **attrs):
+    """A span on the active tracer, or a free shared ``nullcontext``."""
+    t = _ACTIVE
+    if t is None:
+        return _NULL
+    return t.span(name, **attrs)
+
+
+def complete(name: str, start_s: float, end_s: float, **attrs) -> None:
+    t = _ACTIVE
+    if t is not None:
+        t.complete(name, start_s, end_s, **attrs)
+
+
+def instant(name: str, **attrs) -> None:
+    t = _ACTIVE
+    if t is not None:
+        t.instant(name, **attrs)
+
+
+def async_begin(name: str, aid: str, **attrs) -> None:
+    t = _ACTIVE
+    if t is not None:
+        t.async_begin(name, aid, **attrs)
+
+
+def async_end(name: str, aid: str, **attrs) -> None:
+    t = _ACTIVE
+    if t is not None:
+        t.async_end(name, aid, **attrs)
+
+
+def now() -> float:
+    """The active tracer's clock (seconds), or 0.0 when tracing is off —
+    callers that measure intervals for :func:`complete` events can call it
+    unconditionally."""
+    t = _ACTIVE
+    return t.now() if t is not None else 0.0

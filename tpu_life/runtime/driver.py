@@ -5,19 +5,31 @@ Sequence (mirrors §3.1 of SURVEY.md, with the barriers dissolved):
 read config -> load board (or resume) -> pick backend -> fused epoch
 loop with optional snapshot/metric chunking -> write output -> report
 ``Total time = <s>`` from the lead process.
+
+Telemetry (docs/OBSERVABILITY.md): every invocation generates one
+``run_id`` stamped into the metrics JSONL records and the
+``--trace-events`` Chrome trace, whose spans bracket each host phase —
+config-resolve, backend-build (compilation), stage (initial transfer),
+each host-sync chunk, snapshot writes, recovery rewinds, the final
+gather/output.  With tracing and metrics both off the chunk callback is
+None and the fused loop runs with zero per-step Python cost, exactly as
+before.
 """
 
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from pathlib import Path
 
 import numpy as np
 
+from tpu_life import obs
 from tpu_life.backends.base import drive_runner, get_backend, make_runner
 from tpu_life.config import RunConfig
 from tpu_life.io.codec import read_board, write_board
+from tpu_life.models.patterns import random_board
 from tpu_life.models.rules import get_rule
 from tpu_life.parallel.mesh import init_distributed
 from tpu_life.runtime import checkpoint as ckpt
@@ -41,6 +53,7 @@ class RunResult:
     rule: str
     metrics: list[dict] = field(default_factory=list)
     restarts: int = 0  # recoveries taken by the elastic-recovery loop
+    run_id: str = ""  # correlation id shared by metrics/trace artifacts
 
 
 def _single_process() -> bool:
@@ -67,8 +80,27 @@ def run(cfg: RunConfig) -> RunResult:
     # analogue (Parallel_Life_MPI.cpp:195-197).  Must precede any device
     # query, hence before backend construction below.
     init_distributed()
-    height, width, steps = cfg.resolved_geometry()
-    rule = get_rule(cfg.effective_rule())
+    run_id = obs.new_run_id()
+    # the trace file is a single-writer side effect, lead-only like the
+    # metrics sink; obs.span/complete degrade to no-ops on peers
+    tracer = (
+        obs.start_tracing(cfg.trace_events, run_id=run_id)
+        if cfg.trace_events and _is_lead_process()
+        else None
+    )
+    try:
+        with obs.span("run", run_id=run_id, backend=cfg.backend, rule=cfg.rule):
+            return _run(cfg, run_id)
+    finally:
+        if tracer is not None:
+            obs.stop_tracing(tracer)
+            log.info("trace events -> %s (run_id=%s)", tracer.path, run_id)
+
+
+def _run(cfg: RunConfig, run_id: str) -> RunResult:
+    with obs.span("config-resolve"):
+        height, width, steps = cfg.resolved_geometry()
+        rule = get_rule(cfg.effective_rule())
 
     timer = Timer()  # spans I/O too, like the reference's Wtime bracket
 
@@ -82,13 +114,14 @@ def run(cfg: RunConfig) -> RunResult:
         # sharded backend composes with an explicit --mesh-shape.
         from tpu_life import autotune
 
-        key = autotune.tune_key_for(rule, (height, width))
-        tuned, source = autotune.resolve(
-            key, mode=cfg.tune_mode, shape=(height, width)
-        )
-        if source != "cache" and cfg.tune_mode == "measure":
-            result = autotune.tune(key, rule, shape=(height, width))
-            tuned, source = result.best, "measured"
+        with obs.span("autotune-resolve"):
+            key = autotune.tune_key_for(rule, (height, width))
+            tuned, source = autotune.resolve(
+                key, mode=cfg.tune_mode, shape=(height, width)
+            )
+            if source != "cache" and cfg.tune_mode == "measure":
+                result = autotune.tune(key, rule, shape=(height, width))
+                tuned, source = result.best, "measured"
         log.info(
             "autotune: %s -> %s (%s)", key.id(), tuned.describe(), source
         )
@@ -125,7 +158,16 @@ def run(cfg: RunConfig) -> RunResult:
         if cfg.local_kernel == "auto":
             backend_kwargs["local_kernel"] = tuned.local_kernel
         backend_kwargs["bitpack"] = cfg.bitpack and tuned.bitpack
-    backend = get_backend(backend_name, rule=rule, **backend_kwargs)
+    registry = obs.MetricsRegistry()
+    builds = registry.counter(
+        "run_backend_builds_total",
+        "backend (re)builds — each one is a compilation event",
+        labels=("backend",),
+    )
+    with obs.span("backend-build", backend=backend_name):
+        backend = get_backend(backend_name, rule=rule, **backend_kwargs)
+    resolved_backend = getattr(backend, "name", backend_name)
+    builds.labels(backend=resolved_backend).inc()
 
     # Board source: a contract-format file (+ completed steps when resuming).
     # Streamed per-shard straight onto the mesh when supported — the 65536^2
@@ -137,6 +179,25 @@ def run(cfg: RunConfig) -> RunResult:
             cfg.resume, height, width
         )
         log.info("resuming from %s at step %d", input_path, start_step)
+    elif (
+        cfg.height is not None
+        and cfg.width is not None
+        and cfg.steps is not None
+        and not Path(input_path).exists()
+    ):
+        # fully flag-specified geometry with no input file: an exploratory
+        # run (`run --size 512 --steps 64`) — stage a seeded random board
+        # instead of failing, like `gen` piped into `run`.  Contract mode
+        # (geometry from the config file) keeps failing loudly on a missing
+        # data file.
+        log.info(
+            "input file %r absent; using a seeded random board (%dx%d, "
+            "density 0.5, seed 0)",
+            input_path,
+            height,
+            width,
+        )
+        input_path = None
 
     can_stream = hasattr(backend, "prepare_from_file")
     stream = (
@@ -152,6 +213,11 @@ def run(cfg: RunConfig) -> RunResult:
         raise ValueError(
             "--stream-io needs the sharded backend "
             f"(got backend {backend_name!r})"
+        )
+    if stream and input_path is None:
+        raise ValueError(
+            "stream_io needs an input file to stream from; "
+            f"{cfg.input_file!r} does not exist"
         )
     if (
         stream
@@ -175,26 +241,32 @@ def run(cfg: RunConfig) -> RunResult:
     fault_fired: list[bool] = []
 
     def build_runner(source, start):
-        """(runner, host_board|None) staged from a contract-format file.
+        """(runner, host_board|None) staged from a contract-format file
+        (``source=None``: the seeded random board of an exploratory run).
 
         Called once up front and again after each elastic-recovery restart
         (with the rebuilt ``backend`` binding from the enclosing scope)."""
-        if stream:
-            r = backend.prepare_from_file(source, height, width, rule)
-            b = None
-        else:
-            b = read_board(source, height, width)
-            max_state = int(b.max(initial=0))
-            if max_state >= rule.states:
-                raise ValueError(
-                    f"board contains state {max_state} but rule {rule.name!r} "
-                    f"has only {rule.states} states (0..{rule.states - 1})"
+        with obs.span("stage", resume_step=start):
+            if stream:
+                r = backend.prepare_from_file(source, height, width, rule)
+                b = None
+            else:
+                if source is None:
+                    b = random_board(height, width, states=rule.states, seed=0)
+                else:
+                    b = read_board(source, height, width)
+                    max_state = int(b.max(initial=0))
+                    if max_state >= rule.states:
+                        raise ValueError(
+                            f"board contains state {max_state} but rule "
+                            f"{rule.name!r} has only {rule.states} states "
+                            f"(0..{rule.states - 1})"
+                        )
+                r = make_runner(backend, b, rule)
+            if cfg.fault_at > 0:
+                r = recovery.FaultingRunner(
+                    r, start, cfg.fault_at, fault_fired, cfg.fault_count
                 )
-            r = make_runner(backend, b, rule)
-        if cfg.fault_at > 0:
-            r = recovery.FaultingRunner(
-                r, start, cfg.fault_at, fault_fired, cfg.fault_count
-            )
         return r, b
 
     remaining = max(0, steps - start_step)
@@ -209,6 +281,9 @@ def run(cfg: RunConfig) -> RunResult:
         # It is a raw append log — recovery rewinds may repeat steps there
         # (RunResult.metrics is the deduplicated record)
         sink=cfg.metrics_file if _is_lead_process() else None,
+        run_id=run_id,
+        registry=registry,
+        labels={"backend": resolved_backend, "rule": rule.name},
     )
 
     chunk = cfg.sync_every
@@ -230,13 +305,24 @@ def run(cfg: RunConfig) -> RunResult:
     # Mutable holder because the elastic-recovery loop rewinds it;
     # `written` records the absolute steps of snapshots THIS run wrote —
     # the only snapshots recovery will trust as restart sources.
-    state = {"start": start_step, "last_snap": start_step, "written": []}
+    state = {
+        "start": start_step,
+        "last_snap": start_step,
+        "written": [],
+        "chunk_t0": 0.0,  # trace clock at the last chunk boundary
+    }
     # retention pruning is a single-writer side effect (racing unlinks in a
     # multi-process job would trip each other); gate it on the lead
     lead_snapshots = _is_lead_process()
 
     def on_chunk(done_local: int, get_board) -> None:
         done = state["start"] + done_local
+        # the chunk's trace record is a complete (ph "X") event spanning
+        # since the previous boundary — emitted after the fact because the
+        # chunked loop owns the advance, not this callback
+        t_end = obs.now()
+        obs.complete("chunk", state["chunk_t0"], t_end, step=done)
+        state["chunk_t0"] = t_end
         if recorder.enabled:
             # live count via the runner's on-device sharded reduction — two
             # scalars cross to the host, never the board (SURVEY.md §5), so
@@ -250,45 +336,46 @@ def run(cfg: RunConfig) -> RunResult:
             > state["last_snap"] // cfg.snapshot_every
         ):
             state["last_snap"] = done
-            if stream:
-                # per-shard snapshot write: the board stays sharded.
-                # Single-process: publish atomically (ckpt.atomic_publish).
-                # Multi-process: every process pwrites its shards into ONE
-                # file, so a rename dance cannot work — the collective
-                # write goes direct, and resolve_resume compensates by
-                # skipping truncated snapshots (ckpt.snapshot_intact).
-                Path(cfg.snapshot_dir).mkdir(parents=True, exist_ok=True)
-                p = ckpt.snapshot_path(cfg.snapshot_dir, done)
-                if _single_process():
-                    with ckpt.atomic_publish(p) as tmp:
+            with obs.span("snapshot-write", step=done):
+                if stream:
+                    # per-shard snapshot write: the board stays sharded.
+                    # Single-process: publish atomically (ckpt.atomic_publish).
+                    # Multi-process: every process pwrites its shards into ONE
+                    # file, so a rename dance cannot work — the collective
+                    # write goes direct, and resolve_resume compensates by
+                    # skipping truncated snapshots (ckpt.snapshot_intact).
+                    Path(cfg.snapshot_dir).mkdir(parents=True, exist_ok=True)
+                    p = ckpt.snapshot_path(cfg.snapshot_dir, done)
+                    if _single_process():
+                        with ckpt.atomic_publish(p) as tmp:
+                            backend.write_runner_to_file(
+                                recovery.unwrap(runner), tmp, height, width, rule
+                            )
+                    else:
                         backend.write_runner_to_file(
-                            recovery.unwrap(runner), tmp, height, width, rule
+                            recovery.unwrap(runner), p, height, width, rule
                         )
+                    if lead_snapshots:
+                        # the sidecar content is identical on every process;
+                        # N racing writers of one path would only add torn-
+                        # file risk, so it is a single-writer side effect
+                        ckpt.write_sidecar(p, done, rule.name, height, width)
                 else:
-                    backend.write_runner_to_file(
-                        recovery.unwrap(runner), p, height, width, rule
+                    p = ckpt.save_snapshot(
+                        cfg.snapshot_dir,
+                        done,
+                        board_np if board_np is not None else get_board(),
+                        rule=rule.name,
                     )
-                if lead_snapshots:
-                    # the sidecar content is identical on every process;
-                    # N racing writers of one path would only add torn-
-                    # file risk, so it is a single-writer side effect
-                    ckpt.write_sidecar(p, done, rule.name, height, width)
-            else:
-                p = ckpt.save_snapshot(
-                    cfg.snapshot_dir,
-                    done,
-                    board_np if board_np is not None else get_board(),
-                    rule=rule.name,
-                )
-            state["written"].append(done)
-            log.info("snapshot step=%d -> %s", done, p)
-            if cfg.keep_snapshots > 0 and lead_snapshots:
-                # retention manages only THIS run's snapshots, and the
-                # kept list replaces state["written"] so elastic recovery
-                # never targets a pruned file
-                state["written"] = ckpt.prune_snapshots(
-                    cfg.snapshot_dir, cfg.keep_snapshots, state["written"]
-                )
+                state["written"].append(done)
+                log.info("snapshot step=%d -> %s", done, p)
+                if cfg.keep_snapshots > 0 and lead_snapshots:
+                    # retention manages only THIS run's snapshots, and the
+                    # kept list replaces state["written"] so elastic recovery
+                    # never targets a pruned file
+                    state["written"] = ckpt.prune_snapshots(
+                        cfg.snapshot_dir, cfg.keep_snapshots, state["written"]
+                    )
         if cfg.verbose and board_np is not None:
             log.debug("board at step %d:\n%s", done, dump_board(board_np))
 
@@ -299,6 +386,10 @@ def run(cfg: RunConfig) -> RunResult:
             or cfg.metrics
             or cfg.metrics_file
             or cfg.verbose
+            # chunk trace events need the boundary callback too; like the
+            # recorder's enablement this is config-driven, so it stays
+            # uniform across processes
+            or cfg.trace_events
         )
         else None
     )
@@ -336,24 +427,37 @@ def run(cfg: RunConfig) -> RunResult:
             try:
                 if pending is not None:
                     source, resume_step = pending
-                    if not first_build:
-                        # a failure poisoned the old backend: start fresh
-                        backend = get_backend(backend_name, rule=rule, **backend_kwargs)
-                    first_build = False
-                    state["start"] = resume_step
-                    state["last_snap"] = resume_step
-                    # drop metric records the rewind is about to re-earn
-                    recorder.records[:] = [
-                        r for r in recorder.records if r["step"] <= resume_step
-                    ]
-                    runner, board = build_runner(source, resume_step)
+                    rewind_span = (
+                        nullcontext()
+                        if first_build
+                        else obs.span(
+                            "recovery-rewind", step=resume_step, restart=restarts
+                        )
+                    )
+                    with rewind_span:
+                        if not first_build:
+                            # a failure poisoned the old backend: start fresh
+                            backend = get_backend(
+                                backend_name, rule=rule, **backend_kwargs
+                            )
+                            builds.labels(backend=resolved_backend).inc()
+                        first_build = False
+                        state["start"] = resume_step
+                        state["last_snap"] = resume_step
+                        # drop metric records the rewind is about to re-earn
+                        recorder.records[:] = [
+                            r for r in recorder.records if r["step"] <= resume_step
+                        ]
+                        runner, board = build_runner(source, resume_step)
                     pending = None
-                drive_runner(
-                    runner,
-                    max(0, steps - state["start"]),
-                    chunk_steps=chunk,
-                    callback=callback,
-                )
+                state["chunk_t0"] = obs.now()
+                with obs.span("drive", steps=max(0, steps - state["start"])):
+                    drive_runner(
+                        runner,
+                        max(0, steps - state["start"]),
+                        chunk_steps=chunk,
+                        callback=callback,
+                    )
                 # the terminal device interactions — the final host gather
                 # (non-stream) / the per-shard streamed output write — are
                 # as killable as any step, so they sit inside the recovery
@@ -365,27 +469,29 @@ def run(cfg: RunConfig) -> RunResult:
                         # documented resume source — publish it atomically
                         # too (single-process; the multi-process collective
                         # write goes direct, like snapshots)
-                        out_p = Path(cfg.output_file)
-                        out_p.parent.mkdir(parents=True, exist_ok=True)
-                        if _single_process():
-                            with ckpt.atomic_publish(out_p) as tmp:
+                        with obs.span("output-write", streamed=True):
+                            out_p = Path(cfg.output_file)
+                            out_p.parent.mkdir(parents=True, exist_ok=True)
+                            if _single_process():
+                                with ckpt.atomic_publish(out_p) as tmp:
+                                    backend.write_runner_to_file(
+                                        recovery.unwrap(runner),
+                                        tmp,
+                                        height,
+                                        width,
+                                        rule,
+                                    )
+                            else:
                                 backend.write_runner_to_file(
                                     recovery.unwrap(runner),
-                                    tmp,
+                                    out_p,
                                     height,
                                     width,
                                     rule,
                                 )
-                        else:
-                            backend.write_runner_to_file(
-                                recovery.unwrap(runner),
-                                out_p,
-                                height,
-                                width,
-                                rule,
-                            )
                 else:
-                    board = runner.fetch()
+                    with obs.span("gather"):
+                        board = runner.fetch()
                 break
             except recovery.RECOVERABLE as e:
                 if restarts >= max_restarts:
@@ -418,16 +524,18 @@ def run(cfg: RunConfig) -> RunResult:
     # remains, a pure host-side write
     lead = _is_lead_process()
     if cfg.output_file and not stream and lead:
-        out_p = Path(cfg.output_file)
-        out_p.parent.mkdir(parents=True, exist_ok=True)
-        # whole-board write: single writer, like rank 0 owning the
-        # host-materialized result; atomic because output.txt is itself a
-        # documented resume source (output format == input format)
-        with ckpt.atomic_publish(out_p) as tmp:
-            write_board(tmp, board)
+        with obs.span("output-write", streamed=False):
+            out_p = Path(cfg.output_file)
+            out_p.parent.mkdir(parents=True, exist_ok=True)
+            # whole-board write: single writer, like rank 0 owning the
+            # host-materialized result; atomic because output.txt is itself a
+            # documented resume source (output format == input format)
+            with ckpt.atomic_publish(out_p) as tmp:
+                write_board(tmp, board)
 
     elapsed = timer.elapsed
-    # the sink handle is persistent + flushed per record; close it here so
+    # close() flushes the registry snapshot (compile counts, chunk-duration
+    # histogram) into the sink and releases the persistent handle so
     # repeated in-process runs don't accumulate open fds until GC
     recorder.close()
     if lead:
@@ -438,8 +546,9 @@ def run(cfg: RunConfig) -> RunResult:
         board=board,
         steps_run=remaining,
         elapsed_s=elapsed,
-        backend=getattr(backend, "name", cfg.backend),
+        backend=resolved_backend,
         rule=rule.name,
         metrics=recorder.records,
         restarts=restarts,
+        run_id=run_id,
     )

@@ -5,14 +5,26 @@ The reference's observability is per-rank write confirmations and one
 emitting step index, live-cell count, steps/sec and cell-updates/sec at each
 host-sync chunk, plus the same final ``Total time = <s>`` line for contract
 parity (SURVEY.md §6a item 5).
+
+Since the obs refactor the recorder sits on :class:`tpu_life.obs.
+MetricsRegistry`: every record is stamped with the invocation's ``run_id``
+and a wall-clock ``ts`` (so JSONL lines align with trace-event and
+profiler timelines), per-chunk durations feed a histogram, and
+:meth:`MetricsRecorder.close` appends the registry snapshot (``kind:
+"metric"`` records) to the same sink — one file ``tpu-life stats`` reads
+back whole.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
+import time
 
 import numpy as np
+
+from tpu_life import obs
 
 log = logging.getLogger("tpu_life")
 
@@ -22,6 +34,10 @@ def configure_logging(verbose: bool) -> None:
         h = logging.StreamHandler(sys.stderr)
         h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(message)s"))
         log.addHandler(h)
+    # we attach our own handler, so records must not ALSO propagate to the
+    # root logger — under pytest (or any app with a root handler) every
+    # line used to print twice
+    log.propagate = False
     log.setLevel(logging.DEBUG if verbose else logging.INFO)
 
 
@@ -32,23 +48,55 @@ class MetricsRecorder:
         enabled: bool,
         start_step: int = 0,
         sink: str | None = None,
+        run_id: str | None = None,
+        registry: obs.MetricsRegistry | None = None,
+        labels: dict | None = None,
     ):
         self.cell_count = cell_count
         self.enabled = enabled or sink is not None
         self.start_step = start_step  # rates count only this run's steps
         self.records: list[dict] = []
+        self.run_id = run_id or obs.new_run_id()
+        self.registry = registry if registry is not None else obs.MetricsRegistry()
         self.sink = sink  # append each record as a JSON line here
-        self._sink_handle = None  # lazily opened, flushed per record
+        self._sink_handle = None  # persistent handle, flushed per record
+        if sink:
+            # open eagerly: a missing parent directory must fail HERE, at
+            # construction, not minutes later when the first chunk syncs
+            # (the old lazy open discarded a whole run's compute on a typo)
+            obs.ensure_parent(sink)
+            self._sink_handle = open(sink, "a")
+        # bounded labels (backend, rule) on the run instruments; the chunk
+        # histogram answers "how even are my host-sync chunks" and the step
+        # counter makes multi-run sinks aggregable
+        self._labels = dict(labels or {})
+        labelnames = tuple(self._labels)
+        self._chunk_seconds = self.registry.histogram(
+            "run_chunk_seconds",
+            "wall seconds per host-sync chunk",
+            labels=labelnames,
+        )
+        self._steps_total = self.registry.counter(
+            "run_steps_total", "simulation steps completed", labels=labelnames
+        )
+        self._last_elapsed = 0.0
+        self._last_done = 0
+
+    def _inst(self, family):
+        return family.labels(**self._labels) if self._labels else family
 
     def record(self, rec: dict) -> None:
         """Append an arbitrary record (and mirror it to the JSONL sink).
 
         The generic entry point: ``record_chunk`` builds the per-chunk
         simulation record, the serving layer emits per-round queue/batch
-        records — both land in the same ``records`` list and sink file.
+        records — both land in the same ``records`` list and sink file,
+        stamped with the run's correlation id and a wall-clock ``ts``.
         """
         if not self.enabled:
             return
+        rec.setdefault("run_id", self.run_id)
+        rec.setdefault("ts", time.time())
         self.records.append(rec)
         self._write_sink(rec)
 
@@ -58,15 +106,26 @@ class MetricsRecorder:
         # chunk that produced it syncs, and a killed run loses nothing
         if not self.sink:
             return
-        import json
-
         if self._sink_handle is None:
+            # a recorder that keeps recording after close() reopens the
+            # sink (append) — close-then-continue keeps its records
             self._sink_handle = open(self.sink, "a")
         self._sink_handle.write(json.dumps(rec) + "\n")
         self._sink_handle.flush()
 
+    def flush_registry(self) -> None:
+        """Append the registry snapshot (``kind: "metric"`` records) to the
+        sink.  Snapshot lines go to the sink only — ``records`` (and so
+        ``RunResult.metrics``) stays the per-chunk stream it always was."""
+        if not self.sink:
+            return
+        for rec in self.registry.snapshot(run_id=self.run_id):
+            rec["ts"] = time.time()
+            self._write_sink(rec)
+
     def close(self) -> None:
         if self._sink_handle is not None:
+            self.flush_registry()
             self._sink_handle.close()
             self._sink_handle = None
 
@@ -90,8 +149,15 @@ class MetricsRecorder:
             if elapsed > 0
             else 0.0,
         }
-        self.records.append(rec)
-        self._write_sink(rec)
+        self._inst(self._chunk_seconds).observe(
+            max(0.0, elapsed - self._last_elapsed)
+        )
+        self._last_elapsed = max(self._last_elapsed, elapsed)
+        # counters take per-chunk deltas (done is cumulative; a recovery
+        # rewind may send it backwards — clamp, never double-count)
+        self._inst(self._steps_total).inc(max(0, done - self._last_done))
+        self._last_done = max(self._last_done, done)
+        self.record(rec)
         log.info(
             "step=%d live=%d steps/s=%.2f cells/s=%.3e",
             step,

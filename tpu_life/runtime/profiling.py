@@ -3,19 +3,26 @@
 ``--profile DIR`` wraps the run in a ``jax.profiler`` trace viewable in
 XProf/Perfetto — the per-phase breakdown the reference's single
 ``MPI_Wtime`` bracket (Parallel_Life_MPI.cpp:199,233) can't give.
+
+Composes with ``--trace-events`` span tracing (tpu_life.obs): when both
+are on, the device trace's extent appears as a ``jax-profile`` span in
+the host trace, so the two timelines can be aligned by run_id + offset.
 """
 
 from __future__ import annotations
 
 from contextlib import contextmanager, nullcontext
 
+from tpu_life import obs
+
 
 @contextmanager
 def _trace(trace_dir: str):
     import jax
 
-    with jax.profiler.trace(trace_dir):
-        yield
+    with obs.span("jax-profile", trace_dir=trace_dir):
+        with jax.profiler.trace(trace_dir):
+            yield
 
 
 def maybe_profile(trace_dir: str | None):

@@ -172,8 +172,18 @@ class VmapEngine(EngineBase):
         import jax
         import jax.numpy as jnp
 
+        from tpu_life import obs
         from tpu_life.ops.stencil import make_step
 
+        # the build itself is cheap; the first advance pays the XLA
+        # compile — the span marks the event so a serve trace shows which
+        # round took the compilation hit for which key
+        obs.instant(
+            "serve.compile",
+            rule=self.key.rule.name,
+            shape=f"{self.key.shape[0]}x{self.key.shape[1]}",
+            backend=self.key.backend,
+        )
         step = jax.vmap(make_step(self.key.rule))
         length = self.chunk_steps
 

@@ -29,6 +29,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from tpu_life import obs
 from tpu_life.runtime import recovery
 from tpu_life.runtime.metrics import log
 from tpu_life.serve.engine import CompileKey, EngineBase, make_engine
@@ -60,6 +61,10 @@ class Scheduler:
     queue: deque = field(default_factory=deque)
     engines: dict = field(default_factory=dict)  # CompileKey -> EngineBase
     running: dict = field(default_factory=dict)  # CompileKey -> {slot: Session}
+    # telemetry observer (duck-typed; the service implements it): notified
+    # on admission (with the measured queue wait) and on every terminal
+    # transition the scheduler performs (with the submit-to-finish latency)
+    observer: object | None = None
 
     # -- ingestion ---------------------------------------------------------
     def ensure_admission(self) -> None:
@@ -104,8 +109,9 @@ class Scheduler:
         """
         stats = RoundStats()
         now = self.clock()
-        self._expire(now, stats)
-        self._admit(keyer, stats)
+        with obs.span("serve.admit"):
+            self._expire(now, stats)
+            self._admit(keyer, stats)
         # occupancy is sampled when the batch STEPS (post-admit, pre-
         # advance): sampling after retirement would report an always-empty
         # batch whenever sessions finish within one round
@@ -123,6 +129,7 @@ class Scheduler:
                 f"deadline expired after {s.steps_done} steps (queued)"
             )
             s.fail(f"{type(e).__name__}: {e}")
+            self._notify_finished(s)
             stats.failed += 1
             log.info("serve: session %s timed out in queue", s.sid)
         # running sessions past deadline: evict — their slot goes back to
@@ -136,6 +143,7 @@ class Scheduler:
                         f"deadline expired after {s.steps_done} steps (running)"
                     )
                     s.fail(f"{type(e).__name__}: {e}")
+                    self._notify_finished(s)
                     stats.failed += 1
                     stats.evicted += 1
                     log.info("serve: session %s evicted (deadline)", s.sid)
@@ -163,10 +171,16 @@ class Scheduler:
             except recovery.RECOVERABLE as e:
                 engine.release(slot)
                 s.fail(f"load failed: {e}")
+                self._notify_finished(s)
                 stats.failed += 1
                 continue
             s.state = SessionState.RUNNING
             s.slot = slot
+            s.admitted_at = self.clock()
+            if self.observer is not None:
+                self.observer.session_admitted(
+                    s, max(0.0, s.admitted_at - s.submitted_at)
+                )
             self.running[key][slot] = s
             stats.admitted += 1
         self.queue.extend(deferred)
@@ -190,29 +204,44 @@ class Scheduler:
                 del slots[slot]
                 engine.release(slot)
                 s.fail(f"{type(e).__name__}: {e}")
+                self._notify_finished(s)
                 stats.failed += 1
                 log.info("serve: session %s failed in slot %d: %s", s.sid, slot, e)
             if not slots:
                 continue
-            advanced = engine.advance_chunk()
-            for slot, n in advanced.items():
-                s = slots.get(slot)
-                if s is None:
-                    continue  # slot freed above; engine already ignores it
-                s.steps_done += n
-                stats.steps_advanced += n
-                if s.steps_remaining == 0:
-                    del slots[slot]
-                    try:
-                        board = engine.fetch(slot)
-                    except recovery.RECOVERABLE as e:
+            with obs.span(
+                "serve.step-chunk", occupied=len(slots), steps=engine.chunk_steps
+            ):
+                advanced = engine.advance_chunk()
+            with obs.span("serve.retire"):
+                for slot, n in advanced.items():
+                    s = slots.get(slot)
+                    if s is None:
+                        continue  # slot freed above; engine already ignores it
+                    s.steps_done += n
+                    stats.steps_advanced += n
+                    if s.steps_remaining == 0:
+                        del slots[slot]
+                        try:
+                            board = engine.fetch(slot)
+                        except recovery.RECOVERABLE as e:
+                            engine.release(slot)
+                            s.fail(f"fetch failed: {e}")
+                            self._notify_finished(s)
+                            stats.failed += 1
+                            continue
                         engine.release(slot)
-                        s.fail(f"fetch failed: {e}")
-                        stats.failed += 1
-                        continue
-                    engine.release(slot)
-                    s.finish(board)
-                    stats.completed += 1
+                        s.finish(board)
+                        self._notify_finished(s)
+                        stats.completed += 1
+
+    def _notify_finished(self, session: Session) -> None:
+        """Tell the observer a session the scheduler drove reached a
+        terminal state, with its submit-to-finish latency."""
+        if self.observer is not None:
+            self.observer.session_finished(
+                session, max(0.0, self.clock() - session.submitted_at)
+            )
 
     def release_idle_engines(self) -> int:
         """Drop engines with no resident sessions; returns how many.

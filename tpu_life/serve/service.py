@@ -13,23 +13,32 @@ synchronous loop: every test and every caller sees a deterministic
 interleaving, and the host-sync chunk boundary is already the natural
 scheduling quantum (sessions join and leave the batch only there).
 
-Observability rides the existing runtime seams: every pump emits a
-``MetricsRecorder`` record (queue depth, batch occupancy, sessions/sec),
-and ``drain`` runs under ``runtime.profiling.maybe_profile`` so a serve
-trace lands in the same XProf tooling as a batch run.
+Observability rides the unified obs layer (docs/OBSERVABILITY.md): the
+service generates one ``run_id``, every pump emits a ``MetricsRecorder``
+record (queue depth, batch occupancy, sessions/sec, live queue-wait /
+completion-latency quantiles), a labeled registry tracks the counters and
+histograms behind those quantiles (exported to the JSONL sink at close
+and to ``--prom-file`` as a Prometheus snapshot), ``--trace-events``
+brackets every scheduling round with admit / step-chunk / retire spans
+plus per-session async queue-wait intervals, and ``drain`` still runs
+under ``runtime.profiling.maybe_profile`` so a device trace lands in the
+same tooling as a batch run.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass
+from pathlib import Path
 
 import numpy as np
 
+from tpu_life import obs
 from tpu_life.models.rules import Rule, get_rule
 from tpu_life.runtime.metrics import MetricsRecorder, log
 from tpu_life.runtime.profiling import maybe_profile
 from tpu_life.serve.engine import CompileKey, compile_key_for
+from tpu_life.serve.errors import QueueFull
 from tpu_life.serve.scheduler import RoundStats, Scheduler
 from tpu_life.serve.sessions import (
     SessionState,
@@ -49,6 +58,11 @@ class ServeConfig:
     metrics: bool = False  # record per-pump serve metrics
     metrics_file: str | None = None  # JSONL sink (implies metrics)
     profile: str | None = None  # jax.profiler trace dir for drain()
+    # Chrome trace-event JSON (Perfetto): round spans + per-session
+    # queue-wait intervals, correlated with metrics records via run_id
+    trace_events: str | None = None
+    prom_file: str | None = None  # Prometheus text snapshot, written at close
+    run_id: str | None = None  # correlation id (generated when unset)
 
 
 class SimulationService:
@@ -69,17 +83,81 @@ class SimulationService:
                 f"chunk_steps must be >= 1, got {self.config.chunk_steps}"
             )
         self.clock = clock
+        self.run_id = self.config.run_id or obs.new_run_id()
         self.store = SessionStore()
         self.scheduler = Scheduler(
             capacity=self.config.capacity,
             chunk_steps=self.config.chunk_steps,
             max_queue=self.config.max_queue,
             clock=clock,
+            observer=self,
         )
+        self.registry = obs.MetricsRegistry()
         self.recorder = MetricsRecorder(
             0,
             self.config.metrics,
             sink=self.config.metrics_file,
+            run_id=self.run_id,
+            registry=self.registry,
+        )
+        # the serve instrument set (docs/OBSERVABILITY.md): queue pressure,
+        # batch health, admission outcomes, and the two latency
+        # distributions a multi-tenant service is judged by
+        self._g_queue_depth = self.registry.gauge(
+            "serve_queue_depth", "sessions waiting for a batch slot"
+        )
+        self._g_occupancy = self.registry.gauge(
+            "serve_batch_occupancy", "occupied slot fraction at the last step"
+        )
+        self._c_submitted = self.registry.counter(
+            "serve_sessions_submitted_total", "sessions accepted by submit()"
+        )
+        self._c_rejections = self.registry.counter(
+            "serve_admission_rejections_total",
+            "submissions bounced by queue backpressure (QueueFull)",
+        )
+        self._c_finished = self.registry.counter(
+            "serve_sessions_finished_total",
+            "sessions reaching a terminal state, by outcome",
+            labels=("state",),
+        )
+        self._h_queue_wait = self.registry.histogram(
+            "serve_queue_wait_seconds", "submit-to-admission wait"
+        )
+        self._h_latency = self.registry.histogram(
+            "serve_completion_seconds", "submit-to-terminal-state latency"
+        )
+        # engine compile counts by CompileKey bucket (rule:HxW:backend —
+        # a closed set in any sane deployment; the cap bounds the rest)
+        self._g_compiles = self.registry.gauge(
+            "serve_engine_compile_count",
+            "compiled batch programs per engine",
+            labels=("compile_key",),
+        )
+        # prime the unlabeled series so a snapshot taken before the first
+        # event still shows them (a zero rejection counter is information;
+        # an absent one is a question)
+        for fam in (
+            self._g_queue_depth,
+            self._g_occupancy,
+            self._c_submitted,
+            self._c_rejections,
+            self._h_queue_wait,
+            self._h_latency,
+        ):
+            fam.labels()
+        # the service OWNS its tracer rather than claiming the process-
+        # global slot: emissions are routed through obs.activate() per
+        # round, so a concurrently traced driver.run (or second service)
+        # in the same process cannot steal this service's events — every
+        # span lands in the file carrying its own run_id.  With no tracer
+        # of our own, activate() is a no-op and emissions join whatever
+        # ambient tracer is active (an untraced service inside a traced
+        # driver contributes to the driver's timeline).
+        self._tracer = (
+            obs.Tracer(self.config.trace_events, run_id=self.run_id)
+            if self.config.trace_events
+            else None
         )
         self._t0 = clock()
         self._completed = 0
@@ -129,8 +207,14 @@ class SimulationService:
         board = board.astype(np.int8)
         if steps < 0:
             raise ValueError(f"steps must be >= 0, got {steps}")
-        # backpressure check BEFORE the session exists anywhere
-        self.scheduler.ensure_admission()
+        # backpressure check BEFORE the session exists anywhere; a bounce
+        # is an admission outcome worth counting (rejection rate is the
+        # first overload signal), so the counter ticks before the raise
+        try:
+            self.scheduler.ensure_admission()
+        except QueueFull:
+            self._c_rejections.inc()
+            raise
         now = self.clock()
         if timeout_s is None:
             timeout_s = self.config.default_timeout_s
@@ -142,12 +226,19 @@ class SimulationService:
             deadline=None if timeout_s is None else now + timeout_s,
             fault_at=fault_at,
         )
+        self._c_submitted.inc()
         if steps == 0:
             # nothing to run: complete at admission, never costs a slot
             s.finish(board.copy())
+            self._c_finished.labels(state=s.state.value).inc()
+            self._h_latency.observe(0.0)
             self._completed += 1
         else:
             self.scheduler.enqueue(s)
+            # the per-session queue-wait interval: an async (overlapping)
+            # trace span, closed at admission or terminal-in-queue
+            with obs.activate(self._tracer):
+                obs.async_begin("queue-wait", s.sid, steps=steps)
         log.debug("serve: submitted %s (%s, %d steps)", s.sid, rule.name, steps)
         return s.sid
 
@@ -173,14 +264,31 @@ class SimulationService:
         else:
             self.scheduler.evict_running(s)
         s.cancel()
+        with obs.activate(self._tracer):
+            self.session_finished(s, max(0.0, self.clock() - s.submitted_at))
         return True
+
+    # -- scheduler telemetry observer ---------------------------------------
+    def session_admitted(self, session, wait_s: float) -> None:
+        """Scheduler hook: a session got its batch slot after ``wait_s``."""
+        self._h_queue_wait.observe(wait_s)
+        obs.async_end("queue-wait", session.sid)
+
+    def session_finished(self, session, latency_s: float) -> None:
+        """Scheduler hook: a session reached a terminal state (done /
+        failed / cancelled) ``latency_s`` after submission."""
+        self._c_finished.labels(state=session.state.value).inc()
+        self._h_latency.observe(latency_s)
+        if session.admitted_at is None:
+            # it died waiting: close the still-open queue-wait interval
+            obs.async_end("queue-wait", session.sid, outcome=session.state.value)
 
     def drain(self, max_rounds: int | None = None) -> int:
         """Pump until every admitted session reaches a terminal state;
         returns the number of rounds run.  ``max_rounds`` bounds a stuck
         drain (it raises rather than spinning forever)."""
         rounds = 0
-        with maybe_profile(self.config.profile):
+        with obs.activate(self._tracer), maybe_profile(self.config.profile):
             while not self.scheduler.idle():
                 self.pump()
                 rounds += 1
@@ -201,12 +309,18 @@ class SimulationService:
         def keyer(s) -> CompileKey:
             return compile_key_for(s.rule, s.board, cfg.backend)
 
-        stats = self.scheduler.round(keyer)
+        with obs.activate(self._tracer), obs.span("serve.round", round=self._rounds):
+            stats = self.scheduler.round(keyer)
         self._completed += stats.completed
         self._rounds += 1
         occ = stats.occupancy / stats.slots if stats.slots else 0.0
         self._occupancy_sum += occ
+        self._g_queue_depth.set(stats.queue_depth)
+        self._g_occupancy.set(occ)
+        for key, count in self.scheduler.compile_counts().items():
+            self._g_compiles.labels(compile_key=_key_bucket(key)).set(count)
         elapsed = self.clock() - self._t0
+        qw, lat = self._h_queue_wait, self._h_latency
         self.recorder.record(
             {
                 "kind": "serve",
@@ -221,6 +335,14 @@ class SimulationService:
                 "sessions_per_sec": self._completed / elapsed
                 if elapsed > 0
                 else 0.0,
+                # live distribution snapshots (null until first sample):
+                # the per-round record carries its histograms' quantiles so
+                # a tailing consumer sees latency drift round by round
+                "queue_wait_p50": qw.quantile(0.5),
+                "queue_wait_p95": qw.quantile(0.95),
+                "queue_wait_p99": qw.quantile(0.99),
+                "completion_p50": lat.quantile(0.5),
+                "completion_p95": lat.quantile(0.95),
             }
         )
         return stats
@@ -232,16 +354,33 @@ class SimulationService:
         return self.scheduler.release_idle_engines()
 
     def close(self) -> None:
-        """Release held resources: the metrics sink handle and every idle
-        engine.  The service remains usable afterwards (the sink reopens
-        on the next record)."""
+        """Flush telemetry and release held resources: the registry
+        snapshot lands in the JSONL sink, the Prometheus snapshot in
+        ``prom_file``, the trace file is written, idle engines freed."""
         self.recorder.close()
+        if self.config.prom_file:
+            obs.ensure_parent(self.config.prom_file)
+            Path(self.config.prom_file).write_text(self.registry.prom_text())
+            log.info("prometheus snapshot -> %s", self.config.prom_file)
+        if self._tracer is not None:
+            obs.stop_tracing(self._tracer)
+            log.info(
+                "trace events -> %s (run_id=%s)", self._tracer.path, self.run_id
+            )
+            self._tracer = None
         self.scheduler.release_idle_engines()
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
         elapsed = self.clock() - self._t0
         return {
+            "run_id": self.run_id,
+            "queue_wait_p50": self._h_queue_wait.quantile(0.5),
+            "queue_wait_p95": self._h_queue_wait.quantile(0.95),
+            "queue_wait_p99": self._h_queue_wait.quantile(0.99),
+            "completion_p50": self._h_latency.quantile(0.5),
+            "completion_p95": self._h_latency.quantile(0.95),
+            "rejections": self._c_rejections.value,
             "sessions": len(self.store),
             "queued": self.store.count(SessionState.QUEUED),
             "running": self.store.count(SessionState.RUNNING),
@@ -258,3 +397,10 @@ class SimulationService:
                 repr(k): v for k, v in self.scheduler.compile_counts().items()
             },
         }
+
+
+def _key_bucket(key: CompileKey) -> str:
+    """The bounded label a CompileKey becomes in the registry:
+    ``rule:HxW:backend`` — small closed sets by construction."""
+    h, w = key.shape
+    return f"{key.rule.name}:{h}x{w}:{key.backend}"

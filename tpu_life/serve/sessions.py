@@ -53,6 +53,7 @@ class Session:
     result: np.ndarray | None = None
     error: str | None = None
     submitted_at: float = 0.0
+    admitted_at: float | None = None  # when the scheduler gave it a slot
     deadline: float | None = None  # absolute clock time; None = no timeout
     # fault-injection drill (mirrors RunConfig.fault_at): raise a simulated
     # per-slot device failure when the session would cross this step — the
