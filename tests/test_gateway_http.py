@@ -1,0 +1,289 @@
+"""End-to-end localhost HTTP: the full client -> gateway -> engine path.
+
+The acceptance spine of the gateway PR: a real ``ThreadingHTTPServer`` on
+an ephemeral port, a real pump thread, the real urllib client — 20
+staggered sessions return boards byte-identical to ``driver.run``, the
+engine compiles once per CompileKey under concurrent HTTP traffic,
+overload is a typed 429 with ``Retry-After`` (never a hang or a 500),
+and ``/readyz`` flips to 503 during a graceful drain.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_life.config import RunConfig
+from tpu_life.gateway import Gateway, GatewayConfig
+from tpu_life.gateway.client import GatewayClient, GatewayError
+from tpu_life.models.patterns import random_board
+from tpu_life.runtime import driver
+from tpu_life.serve import ServeConfig, SimulationService
+
+
+@pytest.fixture
+def make_gateway():
+    """Factory fixture: start a gateway on an ephemeral port, always
+    drain + close at teardown (sockets and pump threads must not leak
+    across tests)."""
+    gateways = []
+
+    def _make(serve_cfg: ServeConfig, gw_cfg: GatewayConfig | None = None):
+        svc = SimulationService(serve_cfg)
+        gw = Gateway(svc, gw_cfg or GatewayConfig(port=0))
+        gw.start()
+        gateways.append(gw)
+        client = GatewayClient(f"http://127.0.0.1:{gw.port}", retries=0)
+        return gw, client
+
+    yield _make
+    for gw in gateways:
+        gw.begin_drain()
+        gw.wait(timeout=30)
+        gw.close()
+
+
+def driver_run_board(tmp_path, board, rule, steps, tag):
+    """One independent sequential run through the real driver pipeline."""
+    from tpu_life.io.codec import write_board
+
+    h, w = board.shape
+    inp = tmp_path / f"in_{tag}.txt"
+    write_board(inp, board)
+    res = driver.run(
+        RunConfig(
+            height=h,
+            width=w,
+            steps=steps,
+            input_file=str(inp),
+            output_file=str(tmp_path / f"out_{tag}.txt"),
+            rule=rule,
+            backend="numpy",
+        )
+    )
+    assert res.board is not None
+    return res.board
+
+
+def test_twenty_staggered_sessions_byte_equal_driver(make_gateway, tmp_path):
+    """THE acceptance test over HTTP: 20 staggered sessions through the
+    jax engine behind the gateway — results byte-equal ``driver.run``,
+    exactly one compile per CompileKey despite concurrent handler
+    threads and a live pump."""
+    gw, client = make_gateway(
+        ServeConfig(capacity=8, chunk_steps=7, max_queue=64, backend="jax")
+    )
+    boards = [random_board(24, 19, density=0.4, seed=200 + i) for i in range(20)]
+    budgets = [1 + (7 * i) % 43 for i in range(20)]
+
+    # staggered: submissions race the pump thread admitting/advancing the
+    # earlier ones — continuous batching over a network surface
+    retrying = GatewayClient(f"http://127.0.0.1:{gw.port}", retries=8)
+    sids = [
+        retrying.submit(board=b, rule="conway", steps=n)
+        for b, n in zip(boards, budgets)
+    ]
+    for sid in sids:
+        view = retrying.wait(sid, timeout=120)
+        assert view["state"] == "done", view
+
+    for sid, board, steps in zip(sids, boards, budgets):
+        got = retrying.result_board(sid)
+        expect = driver_run_board(tmp_path, board, "conway", steps, sid)
+        np.testing.assert_array_equal(got, expect)
+        assert got.tobytes() == expect.tobytes()  # byte-equal, literally
+
+    counts = gw.service.scheduler.compile_counts()
+    assert list(counts.values()) == [1]  # one key, ONE compile
+
+    # the per-route instrument set saw the traffic (tentpole obs work)
+    metrics = retrying.metrics()
+    assert 'gateway_requests_total{route="/v1/sessions",method="POST",status="201"} 20' in metrics
+    assert "gateway_request_seconds_bucket" in metrics
+
+
+def test_rate_limit_is_429_with_retry_after(make_gateway):
+    """A 1-token bucket: first submit admitted, second bounced with 429 +
+    Retry-After — and the client's retry loop rides it out."""
+    slow_refill = 0.5  # tokens/s -> 2s Retry-After scale
+    gw, client = make_gateway(
+        ServeConfig(capacity=2, chunk_steps=2, backend="numpy"),
+        GatewayConfig(port=0, api_rate=slow_refill, api_burst=1.0),
+    )
+    assert client.submit(size=8, steps=1) == "s000000"
+    with pytest.raises(GatewayError) as exc:
+        client.submit(size=8, steps=1)
+    assert exc.value.status == 429
+    assert exc.value.code == "rate_limited"
+    assert exc.value.retry_after is not None and exc.value.retry_after >= 1
+    # 429 counts in the registry, and distinct API keys have distinct buckets
+    other = GatewayClient(
+        f"http://127.0.0.1:{gw.port}", api_key="tenant-b", retries=0
+    )
+    assert other.submit(size=8, steps=1) == "s000001"
+    assert "gateway_rate_limited_total 1" in client.metrics()
+    # a retrying client eventually gets through (honoring Retry-After;
+    # capped real sleeps so the bucket actually refills at 0.5 tokens/s)
+    import time
+
+    patient = GatewayClient(
+        f"http://127.0.0.1:{gw.port}",
+        retries=3,
+        sleep=lambda s: time.sleep(min(s, 3.0)),
+    )
+    sid = patient.submit(size=8, steps=1)
+    assert sid == "s000002"
+
+
+def test_load_shedding_rejects_before_enqueue(make_gateway):
+    """Queue depth past high water -> 503 overloaded, before the service
+    ever sees the request (the obs gauge is the shed input)."""
+    gw, client = make_gateway(
+        ServeConfig(capacity=1, chunk_steps=1, backend="numpy"),
+        GatewayConfig(port=0, shed_high_water=2.0),
+    )
+    # force the sustained-pressure signal a busy pump would have produced
+    gw.service.registry.gauge("serve_queue_depth").set(5)
+    submitted_before = gw.service._c_submitted.value
+    with pytest.raises(GatewayError) as exc:
+        client.submit(size=8, steps=1)
+    assert exc.value.status == 503
+    assert exc.value.code == "overloaded"
+    assert exc.value.retry_after is not None
+    assert gw.service._c_submitted.value == submitted_before  # shed pre-enqueue
+    gw.service.registry.gauge("serve_queue_depth").set(0)
+
+
+def test_readyz_flips_to_503_during_drain(make_gateway):
+    """Graceful drain: admission closes and /readyz answers 503 while the
+    in-flight session still steps to completion."""
+    gw, client = make_gateway(
+        ServeConfig(capacity=2, chunk_steps=1, backend="numpy")
+    )
+    assert client.readyz()["ready"] is True
+    sid = client.submit(size=48, steps=500)  # long enough to straddle drain
+    gw.begin_drain()
+    with pytest.raises(GatewayError) as exc:
+        client.readyz()
+    assert exc.value.status == 503 and exc.value.code == "draining"
+    with pytest.raises(GatewayError) as exc:
+        client.submit(size=8, steps=1)
+    assert exc.value.status == 503 and exc.value.code == "draining"
+    assert gw.wait(timeout=60), "drain must terminate"
+    # the straddling session finished (drain never drops in-flight work)
+    view = gw.service.poll(sid)
+    assert view.state.value == "done" and view.steps_done == 500
+
+
+def test_session_lifecycle_and_typed_errors(make_gateway):
+    gw, client = make_gateway(
+        ServeConfig(capacity=2, chunk_steps=2, backend="numpy")
+    )
+    # unknown session -> 404
+    with pytest.raises(GatewayError) as exc:
+        client.poll("s999999")
+    assert exc.value.status == 404 and exc.value.code == "unknown_session"
+    # a budget far past what the pump can finish in this test's lifetime
+    # keeps the session observably in flight for the 409/cancel sequence
+    sid = client.submit(size=32, steps=200_000)
+    with pytest.raises(GatewayError) as exc:
+        client.result(sid)
+    assert exc.value.status == 409 and exc.value.code == "not_finished"
+    assert exc.value.retry_after is not None  # "poll later" is a retry hint
+    assert client.cancel(sid) is True
+    assert client.cancel(sid) is False  # second cancel: already terminal
+    assert client.poll(sid)["state"] == "cancelled"
+    # a cancelled session's result -> 410 gone, never retried
+    with pytest.raises(GatewayError) as exc:
+        client.result(sid)
+    assert exc.value.status == 410 and exc.value.code == "session_failed"
+
+
+def test_http_hygiene_bad_bodies_and_routes(make_gateway):
+    """Malformed traffic gets typed JSON errors with correct statuses."""
+    gw, client = make_gateway(
+        ServeConfig(capacity=1, chunk_steps=1, backend="numpy"),
+        GatewayConfig(port=0, max_body=512),
+    )
+    base = f"http://127.0.0.1:{gw.port}"
+
+    def status_of(method, path, data=None, headers=None):
+        req = urllib.request.Request(
+            base + path, data=data, method=method, headers=headers or {}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    status, body = status_of("GET", "/nope")
+    assert status == 404 and body["error"]["code"] == "not_found"
+    status, body = status_of("DELETE", "/healthz")
+    assert status == 405 and body["error"]["code"] == "method_not_allowed"
+    status, body = status_of("POST", "/v1/sessions", data=b"{not json")
+    assert status == 400 and body["error"]["code"] == "invalid_json"
+    big = json.dumps({"board": ["0" * 600], "steps": 1}).encode()
+    status, body = status_of("POST", "/v1/sessions", data=big)
+    assert status == 413 and body["error"]["code"] == "payload_too_large"
+    status, body = status_of(
+        "POST", "/v1/sessions", data=json.dumps({"steps": 1}).encode()
+    )
+    assert status == 400 and body["error"]["code"] == "invalid_request"
+    # an invalid board state for the rule -> 400 from the shared validation
+    status, body = status_of(
+        "POST",
+        "/v1/sessions",
+        data=json.dumps({"board": ["09"], "steps": 1}).encode(),
+    )
+    assert status == 400
+    # every response carries the correlating run_id (tentpole obs work)
+    assert body["run_id"] == gw.service.run_id
+    # liveness stays green through all of it
+    assert client.healthz()["status"] == "ok"
+    # unrouted paths share ONE metrics label — a scanner cannot mint
+    # unbounded series in the shared registry
+    status_of("GET", "/another/bogus/path")
+    metrics = client.metrics()
+    assert 'route="unmatched"' in metrics
+    assert "/nope" not in metrics and "/another/bogus/path" not in metrics
+
+
+def test_pump_crash_is_not_a_clean_drain(make_gateway):
+    """A crashed pump must surface (pump_error set, CLI exits 1), never
+    impersonate a graceful drain."""
+    gw, client = make_gateway(
+        ServeConfig(capacity=1, chunk_steps=1, backend="numpy")
+    )
+
+    def boom():
+        raise RuntimeError("injected pump crash")
+
+    gw.service.pump = boom
+    client.submit(size=8, steps=5)
+    assert gw.wait(timeout=15), "crash must still terminate the gateway"
+    assert gw.pump_error is not None
+    assert "injected pump crash" in str(gw.pump_error)
+
+
+def test_queue_full_maps_to_503_not_hang(make_gateway):
+    """The bounded queue behind the shed valve: hammering past max_queue
+    yields typed 503 queue_full, and nothing wedges."""
+    gw, client = make_gateway(
+        ServeConfig(capacity=1, chunk_steps=1, max_queue=2, backend="numpy"),
+        # shedding off: this test targets the QueueFull backstop itself
+        GatewayConfig(port=0, shed_high_water=0.0),
+    )
+    outcomes = {"ok": 0, "queue_full": 0}
+    for _ in range(30):
+        try:
+            client.submit(size=16, steps=300)
+            outcomes["ok"] += 1
+        except GatewayError as e:
+            assert e.status == 503 and e.code == "queue_full"
+            assert e.retry_after is not None
+            outcomes["queue_full"] += 1
+    assert outcomes["queue_full"] > 0, "the bounded queue must push back"
+    assert outcomes["ok"] >= 2  # slots + queue admitted some

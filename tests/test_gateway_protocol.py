@@ -1,0 +1,200 @@
+"""Gateway contract units: wire vocabulary, admission valves, error map.
+
+No sockets here — the protocol and limits are plain functions/classes so
+the contract is testable at unit speed; tests/test_gateway_http.py covers
+the full localhost HTTP path.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.gateway import protocol
+from tpu_life.gateway.errors import ApiError, from_serve_error
+from tpu_life.gateway.limits import KeyedBuckets, LoadShedder, TokenBucket
+from tpu_life.models.patterns import random_board
+from tpu_life.serve.errors import (
+    Draining,
+    QueueFull,
+    SessionFailed,
+    UnknownSession,
+)
+from tpu_life.serve.sessions import SessionState, SessionView
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- parse_submit ----------------------------------------------------------
+def test_inline_board_rows_of_strings():
+    spec = protocol.parse_submit(
+        {"board": ["010", "101"], "rule": "conway", "steps": 4}
+    )
+    np.testing.assert_array_equal(
+        spec.board, np.array([[0, 1, 0], [1, 0, 1]], dtype=np.int8)
+    )
+    assert spec.board.dtype == np.int8
+    assert (spec.rule, spec.steps, spec.timeout_s) == ("conway", 4, None)
+
+
+def test_inline_board_nested_lists_and_timeout():
+    spec = protocol.parse_submit(
+        {"board": [[0, 1], [1, 0]], "steps": 0, "timeout_s": 2}
+    )
+    np.testing.assert_array_equal(spec.board, [[0, 1], [1, 0]])
+    assert spec.timeout_s == 2.0
+
+
+def test_seeded_geometry_matches_random_board():
+    spec = protocol.parse_submit({"size": 16, "steps": 3, "seed": 9})
+    np.testing.assert_array_equal(spec.board, random_board(16, 16, seed=9))
+    # explicit height wins over the square shorthand
+    spec = protocol.parse_submit({"size": 16, "height": 4, "steps": 3})
+    assert spec.board.shape == (4, 16)
+
+
+def test_seeded_geometry_respects_rule_states():
+    spec = protocol.parse_submit(
+        {"size": 12, "steps": 1, "rule": "brians_brain"}
+    )
+    assert int(spec.board.max()) <= 2  # 3-state rule seeds states 0..2
+
+
+@pytest.mark.parametrize(
+    "payload, code",
+    [
+        ({"steps": 1}, "invalid_request"),  # no board, no geometry
+        ({"board": [], "steps": 1}, "invalid_board"),
+        ({"board": ["01", "0"], "steps": 1}, "invalid_board"),  # ragged
+        ({"board": ["0x"], "steps": 1}, "invalid_board"),  # non-digit
+        ({"board": ["0¹1"], "steps": 1}, "invalid_board"),  # unicode digit
+        ({"board": [[0, True]], "steps": 1}, "invalid_board"),  # bool cell
+        ({"board": [7], "steps": 1}, "invalid_board"),  # row not str/list
+        ({"board": ["09"], "steps": 1}, "invalid_board"),  # state 9 > conway
+        ({"board": ["01"]}, "invalid_request"),  # steps missing
+        ({"board": ["01"], "steps": -1}, "invalid_request"),
+        ({"board": ["01"], "steps": True}, "invalid_request"),  # bool steps
+        ({"board": ["01"], "steps": 1, "rule": "nope!"}, "unknown_rule"),
+        ({"board": ["01"], "steps": 1, "timeout_s": "x"}, "invalid_request"),
+        ({"size": 9000, "steps": 1}, "board_too_large"),  # 81M > MAX_CELLS
+        ({"size": 8, "steps": 1, "density": 1.5}, "invalid_request"),
+        ({"size": 0, "steps": 1}, "invalid_request"),
+        (["not", "an", "object"], "invalid_request"),
+    ],
+)
+def test_submit_rejections_are_typed_400s(payload, code):
+    with pytest.raises(ApiError) as exc:
+        protocol.parse_submit(payload)
+    assert exc.value.status == 400
+    assert exc.value.code == code
+
+
+# -- result rendering ------------------------------------------------------
+def test_raw_result_round_trips_byte_exact():
+    board = random_board(17, 23, seed=4)
+    payload = protocol.render_result(board, "raw", "conway")
+    got = protocol.decode_result(payload)
+    np.testing.assert_array_equal(got, board)
+    assert got.dtype == np.int8
+
+
+def test_rle_result_parses_back():
+    from tpu_life.io.rle import parse_rle
+
+    board = random_board(9, 11, seed=1)
+    payload = protocol.render_result(board, "rle", "conway")
+    cells, meta = parse_rle(payload["rle"])
+    np.testing.assert_array_equal(cells, board)
+    assert meta["rule"] == "conway"
+
+
+def test_unknown_format_is_typed_400():
+    with pytest.raises(ApiError) as exc:
+        protocol.render_result(random_board(4, 4), "xml", "conway")
+    assert exc.value.code == "invalid_format"
+
+
+def test_render_view_progress():
+    view = SessionView(
+        sid="s1",
+        state=SessionState.RUNNING,
+        steps=10,
+        steps_done=4,
+        result=None,
+        error=None,
+        rule="conway",
+    )
+    body = protocol.render_view(view)
+    assert body["progress"] == pytest.approx(0.4)
+    assert body["finished"] is False
+    assert body["rule"] == "conway"
+
+
+# -- token buckets ---------------------------------------------------------
+def test_token_bucket_burst_then_refill():
+    clock = FakeClock()
+    b = TokenBucket(rate=2.0, burst=3.0, clock=clock)
+    assert [b.acquire() for _ in range(3)] == [0.0, 0.0, 0.0]
+    wait = b.acquire()
+    assert wait == pytest.approx(0.5)  # 1 token at 2 tokens/s
+    clock.advance(0.5)
+    assert b.acquire() == 0.0
+
+
+def test_token_bucket_disabled_when_rate_zero():
+    b = TokenBucket(rate=0.0, burst=0.0, clock=FakeClock())
+    assert all(b.acquire() == 0.0 for _ in range(100))
+
+
+def test_keyed_buckets_isolate_keys_and_cap_memory():
+    clock = FakeClock()
+    kb = KeyedBuckets(rate=1.0, burst=1.0, clock=clock, max_keys=2)
+    assert kb.acquire("a") == 0.0
+    assert kb.acquire("a") > 0.0  # a's bucket is dry
+    assert kb.acquire("b") == 0.0  # b unaffected
+    # a third key evicts the least-recently-used ("a"); a returning "a"
+    # starts fresh — more permissive, never unbounded memory
+    assert kb.acquire("c") == 0.0
+    assert kb.acquire("a") == 0.0
+    assert len(kb._buckets) == 2
+
+
+def test_load_shedder_threshold_and_disable():
+    depth = {"v": 0.0}
+    s = LoadShedder(lambda: depth["v"], high_water=4.0)
+    assert s.check() is None
+    depth["v"] = 4.0
+    shed = s.check()
+    assert shed is not None and shed[0] == 4.0
+    off = LoadShedder(lambda: 1e9, high_water=0.0)
+    assert not off.enabled and off.check() is None
+
+
+# -- error mapping ---------------------------------------------------------
+@pytest.mark.parametrize(
+    "exc, status, code",
+    [
+        (QueueFull("full"), 503, "queue_full"),
+        (Draining("draining"), 503, "draining"),
+        (UnknownSession("who"), 404, "unknown_session"),
+        (SessionFailed("dead"), 410, "session_failed"),
+        (ValueError("bad board"), 400, "invalid_request"),
+    ],
+)
+def test_serve_errors_map_to_http(exc, status, code):
+    e = from_serve_error(exc)
+    assert (e.status, e.code) == (status, code)
+    if status == 503:
+        assert e.retry_after is not None  # the retry contract
+
+
+def test_unmapped_exceptions_propagate():
+    with pytest.raises(KeyError):
+        from_serve_error(KeyError("not a serve error"))

@@ -96,6 +96,43 @@ def test_serve_missing_spool_is_a_user_error(spool):
         main(["serve", "--requests", "nowhere.jsonl"])
 
 
+def test_submit_size_seeded_board_is_self_contained(tmp_path, monkeypatch, capsys):
+    """The `run --size` shorthand, ported: fully flag-specified geometry
+    with no input file queues a seeded random board — no data.txt, no
+    grid_size_data.txt, nothing pre-existing (the bugfix ride-along)."""
+    monkeypatch.chdir(tmp_path)  # deliberately NO config or board files
+    assert main(["submit", "--size", "18", "--steps", "7"]) == 0
+    assert main(
+        ["submit", "--size", "18", "--steps", "4", "--seed", "9",
+         "--rule", "highlife", "--output-file", "seeded_out.txt"]
+    ) == 0
+    capsys.readouterr()
+    assert main(["serve", "--serve-backend", "numpy", "--capacity", "2"]) == 0
+    summary = summary_line(capsys)
+    assert summary["done"] == 2 and summary["failed"] == 0
+    from tpu_life.models.patterns import random_board
+
+    np.testing.assert_array_equal(
+        read_board(tmp_path / "serve_out" / "s000000.txt", 18, 18),
+        run_np(random_board(18, 18, seed=0), get_rule("conway"), 7),
+    )
+    np.testing.assert_array_equal(
+        read_board(tmp_path / "seeded_out.txt", 18, 18),
+        run_np(random_board(18, 18, seed=9), get_rule("highlife"), 4),
+    )
+
+
+def test_submit_contract_mode_still_fails_loudly_without_board(tmp_path, monkeypatch):
+    """Geometry from the config file (not fully flag-specified) keeps
+    requiring a real board file at serve time — a typo'd path must not
+    silently become random noise."""
+    monkeypatch.chdir(tmp_path)
+    write_config(tmp_path / "grid_size_data.txt", 10, 10, 5)
+    assert main(["submit", "--input-file", "missing.txt"]) == 0
+    with pytest.raises(FileNotFoundError):
+        main(["serve", "--serve-backend", "numpy"])
+
+
 def test_serve_metrics_file_is_valid_jsonl(spool, capsys):
     write_board(spool / "a.txt", random_board(20, 15, seed=4))
     assert main(["submit", "--input-file", "a.txt"]) == 0

@@ -161,6 +161,98 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--profile", default=None, metavar="TRACE_DIR")
     srv.add_argument("--verbose", "-v", action="store_true")
 
+    gw = sub.add_parser(
+        "gateway",
+        help="HTTP front door over the batched simulation service: JSON "
+        "API with rate limiting, load shedding and graceful drain "
+        "(docs/GATEWAY.md)",
+    )
+    gw.add_argument("--host", default="127.0.0.1")
+    gw.add_argument("--port", type=int, default=8000,
+                    help="listen port (0 = ephemeral; the bound port is "
+                    "printed in the startup JSON line)")
+    gw.add_argument("--capacity", type=int, default=8,
+                    help="batch slots per compile key")
+    gw.add_argument("--chunk-steps", type=int, default=16,
+                    help="device steps per scheduling round")
+    gw.add_argument("--max-queue", type=int, default=64,
+                    help="bounded admission queue (backpressure threshold)")
+    gw.add_argument(
+        "--serve-backend",
+        default="jax",
+        choices=["jax", "tuned", "numpy", "sharded", "stripes", "pallas", "native"],
+        help="engine executor (same semantics as `serve --serve-backend`)",
+    )
+    gw.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
+                    help="default per-request deadline")
+    gw.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
+                    help="per-API-key token-bucket refill rate; 0 disables "
+                    "rate limiting (the X-API-Key header names the key)")
+    gw.add_argument("--api-burst", type=float, default=10.0,
+                    help="token-bucket capacity (max burst per key)")
+    gw.add_argument("--shed-high-water", type=float, default=None,
+                    metavar="DEPTH",
+                    help="queue-depth load-shedding threshold (default: "
+                    "80%% of --max-queue; 0 disables)")
+    gw.add_argument("--max-body", type=int, default=None, metavar="BYTES",
+                    help="request-body size bound (413 past it)")
+    gw.add_argument("--metrics-file", default=None, metavar="JSONL",
+                    help="append per-round serve metrics as JSON lines")
+    gw.add_argument("--prom-file", default=None, metavar="FILE",
+                    help="atomically rewrite a Prometheus text snapshot "
+                    "every scheduling round (file-scraper twin of the "
+                    "live GET /metrics)")
+    gw.add_argument("--trace-events", default=None, metavar="FILE",
+                    help="write Chrome trace-event JSON for the serve "
+                    "rounds (docs/OBSERVABILITY.md)")
+    gw.add_argument("--platform", default=None,
+                    help="force a JAX platform (cpu/tpu), like `run --platform`")
+    gw.add_argument("--verbose", "-v", action="store_true")
+
+    cl = sub.add_parser(
+        "client",
+        help="talk to a running gateway: submit boards, poll, fetch "
+        "results, cancel (jax-free; retries 429/503 with backoff)",
+    )
+    cl.add_argument(
+        "action",
+        choices=["submit", "poll", "result", "cancel", "health"],
+    )
+    cl.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="gateway base URL")
+    cl.add_argument("--api-key", default=None,
+                    help="sent as X-API-Key (the rate-limiting identity)")
+    cl.add_argument("--session", default=None, metavar="SID",
+                    help="session id for poll/result/cancel")
+    cl.add_argument("--input-file", default=None, metavar="BOARD",
+                    help="contract-format board to submit inline (geometry "
+                    "from --height/--width or --config-file)")
+    cl.add_argument("--config-file", default="grid_size_data.txt",
+                    help="geometry fallback when --input-file is used "
+                    "without explicit --height/--width/--steps")
+    cl.add_argument("--size", type=int, default=None,
+                    help="square seeded board: submit with no input file "
+                    "at all (the server seeds it)")
+    cl.add_argument("--height", type=int, default=None)
+    cl.add_argument("--width", type=int, default=None)
+    cl.add_argument("--steps", type=int, default=None)
+    cl.add_argument("--rule", default="conway")
+    cl.add_argument("--seed", type=int, default=None,
+                    help="seed for a server-seeded board")
+    cl.add_argument("--density", type=float, default=None)
+    cl.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
+                    help="per-request deadline submitted with the session")
+    cl.add_argument("--wait", action="store_true",
+                    help="submit: block (polling) until the session is "
+                    "terminal; with --output-file also fetch the result")
+    cl.add_argument("--output-file", default=None,
+                    help="result: write the board in contract format "
+                    "(default: RLE to stdout)")
+    cl.add_argument("--format", default="rle", choices=["rle", "raw"],
+                    help="result payload encoding when printing")
+    cl.add_argument("--retries", type=int, default=4,
+                    help="retry budget for 429/503/unreachable responses")
+
     st = sub.add_parser(
         "stats",
         help="summarize a metrics JSONL file (run or serve): throughput "
@@ -182,9 +274,16 @@ def build_parser() -> argparse.ArgumentParser:
     sm.add_argument("--input-file", default="data.txt")
     sm.add_argument("--config-file", default="grid_size_data.txt",
                     help="geometry fallback for unset --height/--width/--steps")
+    sm.add_argument("--size", type=int, default=None,
+                    help="square board: shorthand for --height N --width N "
+                    "(explicit --height/--width win); with --steps and no "
+                    "input file, queues a seeded random board — like "
+                    "`run --size`, no pre-existing files needed")
     sm.add_argument("--height", type=int, default=None)
     sm.add_argument("--width", type=int, default=None)
     sm.add_argument("--steps", type=int, default=None)
+    sm.add_argument("--seed", type=int, default=0,
+                    help="seed for the no-input-file random board")
     sm.add_argument("--rule", default="conway")
     sm.add_argument("--output-file", default=None,
                     help="where `serve` writes this request's result "
@@ -385,6 +484,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "stats":
         # pure file read — the read-back toolchain never needs a device
         return _stats(args)
+    if args.command == "client":
+        # pure HTTP: the gateway owns the devices, the client only needs
+        # numpy + urllib — runs anywhere, no watchdog, no jax
+        return _client(parser, args)
 
     from tpu_life.utils.platform import devices_with_watchdog, ensure_platform
 
@@ -406,6 +509,8 @@ def main(argv: list[str] | None = None) -> int:
         return _tune(args)
     if args.command == "serve":
         return _serve(args)
+    if args.command == "gateway":
+        return _gateway(args)
     cfg = RunConfig(
         height=args.height if args.height is not None else args.size,
         width=args.width if args.width is not None else args.size,
@@ -682,25 +787,51 @@ def _stats(args) -> int:
 def _submit(args) -> int:
     """Append one request line to the serve spool — the client half of the
     file-based front-end (`serve` is the server half).  Geometry falls back
-    to the contract config file exactly like `run` does."""
+    to the contract config file exactly like `run` does; fully flag-
+    specified geometry with no input file queues a seeded random board
+    (the `run --size` shorthand, so demos are self-contained)."""
     import json
     from pathlib import Path
 
     from tpu_life.config import RunConfig
 
-    height, width, steps = RunConfig(
-        height=args.height,
-        width=args.width,
-        steps=args.steps,
-        config_file=args.config_file,
-    ).resolved_geometry()
-    req = {
-        "input_file": args.input_file,
-        "height": height,
-        "width": width,
-        "steps": steps,
-        "rule": args.rule,
-    }
+    height = args.height if args.height is not None else args.size
+    width = args.width if args.width is not None else args.size
+    if (
+        height is not None
+        and width is not None
+        and args.steps is not None
+        and not Path(args.input_file).exists()
+    ):
+        # seeded-random-board shorthand: the request carries no input_file;
+        # `serve` (and the gateway) stage random_board(seed) instead.
+        # Contract mode (geometry from the config file) keeps requiring a
+        # real board file — a typo'd path must fail loudly, not simulate
+        # 50%-density noise.
+        steps = args.steps
+        req = {
+            "height": height,
+            "width": width,
+            "steps": steps,
+            "rule": args.rule,
+            "seed": args.seed,
+        }
+        source = f"seeded random board (seed {args.seed})"
+    else:
+        height, width, steps = RunConfig(
+            height=height,
+            width=width,
+            steps=args.steps,
+            config_file=args.config_file,
+        ).resolved_geometry()
+        req = {
+            "input_file": args.input_file,
+            "height": height,
+            "width": width,
+            "steps": steps,
+            "rule": args.rule,
+        }
+        source = args.input_file
     if args.output_file is not None:
         req["output_file"] = args.output_file
     if args.timeout is not None:
@@ -713,7 +844,7 @@ def _submit(args) -> int:
     with open(p, "a") as f:
         f.write(json.dumps(req) + "\n")
         f.flush()
-    print(f"queued {args.input_file} ({height}x{width}, {steps} steps) -> {p}")
+    print(f"queued {source} ({height}x{width}, {steps} steps) -> {p}")
     return 0
 
 
@@ -767,10 +898,23 @@ def _serve(args) -> int:
     # well-behaved client of its own service
     from tpu_life.serve import QueueFull
 
+    from tpu_life.models.patterns import random_board
+    from tpu_life.models.rules import get_rule
+
     submitted: list[tuple[str, dict]] = []
     try:
         for i, req in enumerate(requests):
-            board = read_board(req["input_file"], req["height"], req["width"])
+            if "input_file" in req:
+                board = read_board(req["input_file"], req["height"], req["width"])
+            else:
+                # a seeded request (`submit --size`): no board file exists,
+                # the spool line fully describes the workload
+                board = random_board(
+                    req["height"],
+                    req["width"],
+                    states=get_rule(req.get("rule", "conway")).states,
+                    seed=int(req.get("seed", 0)),
+                )
             while True:
                 try:
                     sid = svc.submit(
@@ -835,6 +979,212 @@ def _serve(args) -> int:
         )
     )
     return 0 if not failures else 1
+
+
+def _gateway(args) -> int:
+    """The network front door (docs/GATEWAY.md): serve the HTTP API until
+    SIGTERM/SIGINT, then drain gracefully — stop admitting, finish
+    in-flight sessions, flush telemetry — and exit 0.
+
+    Prints one JSON line at startup (bound URL + run_id, so scripts can
+    wait for readiness) and one summary line after the drain.
+    """
+    import json
+
+    from tpu_life.gateway import Gateway, GatewayConfig
+    from tpu_life.gateway.protocol import MAX_BODY
+    from tpu_life.runtime.metrics import configure_logging
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    configure_logging(args.verbose)
+    svc = SimulationService(
+        ServeConfig(
+            capacity=args.capacity,
+            chunk_steps=args.chunk_steps,
+            max_queue=args.max_queue,
+            backend=args.serve_backend,
+            default_timeout_s=args.timeout,
+            metrics=True,
+            metrics_file=args.metrics_file,
+            trace_events=args.trace_events,
+            prom_file=args.prom_file,
+        )
+    )
+    gw = Gateway(
+        svc,
+        GatewayConfig(
+            host=args.host,
+            port=args.port,
+            api_rate=args.api_rate,
+            api_burst=args.api_burst,
+            shed_high_water=args.shed_high_water,
+            max_body=args.max_body if args.max_body is not None else MAX_BODY,
+        ),
+    )
+    gw.install_signal_handlers()
+    gw.start()
+    print(
+        json.dumps(
+            {
+                "mode": "gateway",
+                "url": f"http://{gw.host}:{gw.port}",
+                "run_id": svc.run_id,
+                "backend": args.serve_backend,
+                "capacity": args.capacity,
+                "max_queue": args.max_queue,
+                "api_rate": args.api_rate,
+            }
+        ),
+        flush=True,
+    )
+    try:
+        gw.wait()
+    finally:
+        gw.close()
+    stats = svc.stats()
+    print(
+        json.dumps(
+            {
+                "mode": "gateway",
+                "run_id": stats["run_id"],
+                # a pump crash is a failed serve even though the drain
+                # machinery shut everything down tidily — exit 1 below
+                "pump_error": str(gw.pump_error) if gw.pump_error else None,
+                "sessions": stats["sessions"],
+                "done": stats["done"],
+                "failed": stats["failed"],
+                "cancelled": stats["cancelled"],
+                "rejections": stats["rejections"],
+                "rounds": stats["rounds"],
+                "elapsed_s": stats["elapsed_s"],
+                "sessions_per_sec": stats["sessions_per_sec"],
+                "batch_occupancy_mean": stats["batch_occupancy_mean"],
+                "queue_wait_p50": stats["queue_wait_p50"],
+                "completion_p50": stats["completion_p50"],
+            }
+        ),
+        flush=True,
+    )
+    return 1 if gw.pump_error else 0
+
+
+def _client(parser, args) -> int:
+    """The CLI face of ``tpu_life.gateway.client`` — one JSON line per
+    action (machine-parseable like `bench`/`tune`), boards in contract
+    format or RLE."""
+    import json
+    from pathlib import Path
+
+    from tpu_life.gateway.client import GatewayClient, GatewayError
+
+    client = GatewayClient(
+        args.url, api_key=args.api_key, retries=args.retries
+    )
+
+    def need_session() -> str:
+        if args.session is None:
+            parser.error(f"client {args.action} needs --session SID")
+        return args.session
+
+    try:
+        if args.action == "health":
+            print(json.dumps({"health": client.healthz(), "ready": _ready(client)}))
+            return 0
+        if args.action == "poll":
+            print(json.dumps(client.poll(need_session())))
+            return 0
+        if args.action == "cancel":
+            sid = need_session()
+            print(json.dumps({"session": sid, "cancelled": client.cancel(sid)}))
+            return 0
+        if args.action == "result":
+            return _client_result(args, client, need_session())
+        # submit
+        if args.steps is None:
+            parser.error("client submit needs --steps")
+        kwargs: dict = dict(rule=args.rule, steps=args.steps, timeout_s=args.timeout)
+        if args.input_file is not None:
+            from tpu_life.config import RunConfig
+            from tpu_life.io.codec import read_board
+
+            height, width, _ = RunConfig(
+                height=args.height if args.height is not None else args.size,
+                width=args.width if args.width is not None else args.size,
+                steps=args.steps,
+                config_file=args.config_file,
+            ).resolved_geometry()
+            kwargs["board"] = read_board(args.input_file, height, width)
+        else:
+            if args.size is None and (args.height is None or args.width is None):
+                parser.error(
+                    "client submit needs --input-file, or --size (or "
+                    "--height/--width) for a server-seeded board"
+                )
+            kwargs.update(
+                size=args.size,
+                height=args.height,
+                width=args.width,
+                seed=args.seed,
+                density=args.density,
+            )
+        sid = client.submit(**kwargs)
+        if not args.wait:
+            print(json.dumps(client.poll(sid)))
+            return 0
+        view = client.wait(sid)
+        print(json.dumps(view))
+        if view["state"] != "done":
+            return 1
+        if args.output_file is not None:
+            from tpu_life.io.codec import write_board
+
+            out = Path(args.output_file)
+            out.parent.mkdir(parents=True, exist_ok=True)
+            write_board(out, client.result_board(sid))
+        return 0
+    except GatewayError as e:
+        print(
+            json.dumps(
+                {"error": {"code": e.code, "message": e.message}, "status": e.status}
+            )
+        )
+        return 1
+
+
+def _ready(client) -> bool:
+    from tpu_life.gateway.client import GatewayClient, GatewayError
+
+    # readiness is a yes/no — probe with a zero-retry client so a draining
+    # gateway answers False immediately instead of after the retry budget
+    probe = GatewayClient(client.base_url, api_key=client.api_key, retries=0)
+    try:
+        probe.readyz()
+        return True
+    except GatewayError:
+        return False
+
+
+def _client_result(args, client, sid: str) -> int:
+    from pathlib import Path
+
+    from tpu_life.io.codec import write_board
+
+    if args.output_file is not None:
+        board = client.result_board(sid)
+        out = Path(args.output_file)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        write_board(out, board)
+        h, w = board.shape
+        print(f"wrote {out} ({h}x{w})")
+        return 0
+    payload = client.result(sid, fmt=args.format)
+    if args.format == "rle":
+        print(payload["rle"], end="")
+    else:
+        import json
+
+        print(json.dumps(payload))
+    return 0
 
 
 def _pattern(parser, args) -> int:

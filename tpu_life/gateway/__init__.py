@@ -1,0 +1,62 @@
+"""tpu_life.gateway: the HTTP front door in front of the serving core.
+
+``tpu_life.serve`` made the repo an in-process inference stack; this
+package gives it a network surface with the robustness a front door owes
+the scheduler behind it: typed JSON errors, per-API-key token-bucket rate
+limiting (429 + ``Retry-After``), queue-depth load shedding
+(reject-before-enqueue), bounded request bodies, ``/healthz`` /
+``/readyz`` / live ``/metrics``, and SIGTERM graceful drain — stop
+admitting, finish in-flight sessions, flush telemetry, exit 0.
+
+Dependency-free by design (stdlib ``http.server`` + threads): ONE
+background pump thread owns all device work while handler threads call
+the service's now-locked verbs, so the engine's one-compile-per-
+CompileKey invariant holds under concurrent clients.
+
+Quick start::
+
+    from tpu_life.gateway import Gateway, GatewayConfig
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    svc = SimulationService(ServeConfig(capacity=8, backend="jax"))
+    gw = Gateway(svc, GatewayConfig(port=8000))
+    gw.start()                      # listener + pump threads
+    ...
+    gw.begin_drain(); gw.wait(); gw.close()
+
+    from tpu_life.gateway.client import GatewayClient
+    c = GatewayClient("http://127.0.0.1:8000")
+    sid = c.submit(size=256, steps=64)      # seeded board, no file needed
+    c.wait(sid)
+    board = c.result_board(sid)
+
+See docs/GATEWAY.md for the API reference, and ``tpu-life gateway`` /
+``tpu-life client`` for the CLI front-ends.
+"""
+
+from tpu_life.gateway.errors import ApiError
+from tpu_life.gateway.limits import KeyedBuckets, LoadShedder, TokenBucket
+from tpu_life.gateway.protocol import (
+    MAX_BODY,
+    MAX_CELLS,
+    SubmitSpec,
+    parse_submit,
+    render_result,
+    render_view,
+)
+from tpu_life.gateway.server import Gateway, GatewayConfig
+
+__all__ = [
+    "ApiError",
+    "Gateway",
+    "GatewayConfig",
+    "KeyedBuckets",
+    "LoadShedder",
+    "MAX_BODY",
+    "MAX_CELLS",
+    "SubmitSpec",
+    "TokenBucket",
+    "parse_submit",
+    "render_result",
+    "render_view",
+]

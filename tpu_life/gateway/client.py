@@ -1,0 +1,216 @@
+"""A well-behaved gateway client: urllib + retry/backoff, jax-free.
+
+The reference implementation of the retry contract the gateway publishes:
+429 and 503 responses carry ``Retry-After``; a client that honors it (and
+backs off exponentially when it's absent) rides out rate limiting, load
+shedding, and a draining peer without hammering the front door.  400s are
+client bugs and are never retried.
+
+Everything is stdlib + numpy (board decode) — importable from any
+machine that can reach the gateway, no jax required, same spirit as the
+``stats`` toolchain.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from tpu_life.gateway import protocol
+
+#: Statuses the client retries (with Retry-After / backoff): rate limit,
+#: and the 503 family (queue full / shedding / draining).
+RETRYABLE = frozenset({429, 503})
+
+
+class GatewayError(Exception):
+    """A non-retryable (or retries-exhausted) gateway response."""
+
+    def __init__(
+        self,
+        status: int,
+        code: str,
+        message: str,
+        *,
+        retry_after: float | None = None,
+    ):
+        super().__init__(f"[{status} {code}] {message}")
+        self.status = status
+        self.code = code
+        self.message = message
+        self.retry_after = retry_after
+
+
+class GatewayClient:
+    """Talk to one gateway.  ``retries`` bounds how many times a retryable
+    response (429/503) or a connection refusal is retried; ``backoff`` is
+    the base of the exponential fallback used when the server sent no
+    ``Retry-After``.  ``sleep`` is injectable so tests never wait."""
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        api_key: str | None = None,
+        timeout: float = 30.0,
+        retries: int = 4,
+        backoff: float = 0.2,
+        max_backoff: float = 5.0,
+        sleep=time.sleep,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff = backoff
+        self.max_backoff = max_backoff
+        self.sleep = sleep
+
+    # -- transport ---------------------------------------------------------
+    def _request(self, method: str, path: str, body: dict | None = None) -> dict:
+        url = self.base_url + path
+        data = None if body is None else json.dumps(body).encode()
+        attempt = 0
+        while True:
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Content-Type", "application/json")
+            if self.api_key:
+                req.add_header("X-API-Key", self.api_key)
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                payload = _error_payload(e)
+                err = GatewayError(
+                    e.code,
+                    payload.get("code", "http_error"),
+                    payload.get("message", str(e)),
+                    retry_after=_retry_after(e.headers),
+                )
+                if e.code not in RETRYABLE or attempt >= self.retries:
+                    raise err from None
+                wait = err.retry_after
+            except (urllib.error.URLError, ConnectionError, socket.timeout) as e:
+                # a refused connection means the server never saw the
+                # request — always safe to retry (normal during gateway
+                # startup or a rolling restart).  Anything else (timeout,
+                # reset mid-exchange) may have been PROCESSED: re-POSTing
+                # /v1/sessions would silently create a duplicate session,
+                # so only idempotent methods retry those.
+                reason = getattr(e, "reason", e)
+                refused = isinstance(reason, ConnectionRefusedError)
+                retryable = refused or method in ("GET", "DELETE")
+                if not retryable or attempt >= self.retries:
+                    raise GatewayError(
+                        0, "unreachable", f"{url}: {e}"
+                    ) from None
+                wait = None
+            attempt += 1
+            if wait is None:
+                wait = min(self.max_backoff, self.backoff * (2 ** (attempt - 1)))
+            self.sleep(wait)
+
+    # -- the API -----------------------------------------------------------
+    def submit(
+        self,
+        *,
+        board: np.ndarray | None = None,
+        rule: str = "conway",
+        steps: int,
+        timeout_s: float | None = None,
+        size: int | None = None,
+        height: int | None = None,
+        width: int | None = None,
+        seed: int | None = None,
+        density: float | None = None,
+    ) -> str:
+        """Create a session (inline board, or seeded geometry); returns sid."""
+        req: dict = {"rule": rule, "steps": steps}
+        if timeout_s is not None:
+            req["timeout_s"] = timeout_s
+        if board is not None:
+            req["board"] = board_rows(board)
+        else:
+            for k, v in (
+                ("size", size),
+                ("height", height),
+                ("width", width),
+                ("seed", seed),
+                ("density", density),
+            ):
+                if v is not None:
+                    req[k] = v
+        resp = self._request("POST", "/v1/sessions", req)
+        return resp["session"]
+
+    def poll(self, sid: str) -> dict:
+        return self._request("GET", f"/v1/sessions/{sid}")
+
+    def result(self, sid: str, fmt: str = "raw") -> dict:
+        return self._request("GET", f"/v1/sessions/{sid}/result?format={fmt}")
+
+    def result_board(self, sid: str) -> np.ndarray:
+        """The finished session's board, byte-decoded from the raw payload."""
+        return protocol.decode_result(self.result(sid, fmt="raw"))
+
+    def cancel(self, sid: str) -> bool:
+        return bool(self._request("DELETE", f"/v1/sessions/{sid}")["cancelled"])
+
+    def wait(self, sid: str, *, interval: float = 0.05, timeout: float = 120.0) -> dict:
+        """Poll until the session is terminal; returns the final view."""
+        deadline = time.monotonic() + timeout
+        while True:
+            view = self.poll(sid)
+            if view["finished"]:
+                return view
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"session {sid} still {view['state']} after {timeout}s"
+                )
+            self.sleep(interval)
+
+    def healthz(self) -> dict:
+        return self._request("GET", "/healthz")
+
+    def readyz(self) -> dict:
+        """Raises :class:`GatewayError` (503, retries exhausted) while
+        draining — readiness is a yes/no the LB asks, not a retry loop."""
+        return self._request("GET", "/readyz")
+
+    def metrics(self) -> str:
+        req = urllib.request.Request(self.base_url + "/metrics")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read().decode()
+
+
+def board_rows(board: np.ndarray) -> list[str]:
+    """int8 board -> rows-of-digit-strings (the compact inline encoding)."""
+    board = np.asarray(board)
+    if board.ndim != 2:
+        raise ValueError(f"board must be 2-D, got shape {board.shape}")
+    if board.min(initial=0) < 0 or board.max(initial=0) > 9:
+        raise ValueError("inline boards carry digit states 0..9")
+    return ["".join(str(int(c)) for c in row) for row in board]
+
+
+def _error_payload(e: urllib.error.HTTPError) -> dict:
+    try:
+        doc = json.loads(e.read() or b"{}")
+        return doc.get("error", {}) if isinstance(doc, dict) else {}
+    except (json.JSONDecodeError, OSError):
+        return {}
+
+
+def _retry_after(headers) -> float | None:
+    v = headers.get("Retry-After") if headers is not None else None
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None

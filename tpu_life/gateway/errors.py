@@ -1,0 +1,112 @@
+"""Typed HTTP error mapping — the gateway's contract boundary.
+
+Every failure a client can see is an :class:`ApiError`: an HTTP status, a
+stable machine-readable ``code`` (the thing clients branch on — status
+codes are too coarse: 503 is both "queue full, retry" and "draining, go
+elsewhere"), a human message, and an optional ``Retry-After`` hint.  The
+server serializes it as one JSON envelope::
+
+    {"error": {"code": "rate_limited", "message": "..."}, "run_id": "..."}
+
+``from_serve_error`` is the single place the serving layer's typed
+exceptions (``tpu_life.serve.errors``) become HTTP semantics, so the
+handler code never grows scattered ``except`` clauses with ad-hoc
+status picks.
+"""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    """One client-visible failure: status + stable code + message.
+
+    ``retry_after`` (seconds) becomes a ``Retry-After`` header when set —
+    the backoff contract for 429/503 responses that
+    :mod:`tpu_life.gateway.client` honors.
+    """
+
+    def __init__(
+        self,
+        status: int,
+        code: str,
+        message: str,
+        *,
+        retry_after: float | None = None,
+    ):
+        super().__init__(message)
+        self.status = status
+        self.code = code
+        self.message = message
+        self.retry_after = retry_after
+
+    def body(self) -> dict:
+        return {"error": {"code": self.code, "message": self.message}}
+
+
+def bad_request(code: str, message: str) -> ApiError:
+    return ApiError(400, code, message)
+
+
+def not_found(message: str) -> ApiError:
+    return ApiError(404, "not_found", message)
+
+
+def method_not_allowed(method: str, path: str) -> ApiError:
+    return ApiError(
+        405, "method_not_allowed", f"{method} is not supported on {path}"
+    )
+
+
+def payload_too_large(length: int, limit: int) -> ApiError:
+    return ApiError(
+        413,
+        "payload_too_large",
+        f"request body is {length} bytes; the limit is {limit}",
+    )
+
+
+def rate_limited(retry_after: float) -> ApiError:
+    return ApiError(
+        429,
+        "rate_limited",
+        "request rate exceeds this API key's token bucket; slow down",
+        retry_after=retry_after,
+    )
+
+
+def overloaded(depth: float, high_water: float, retry_after: float) -> ApiError:
+    return ApiError(
+        503,
+        "overloaded",
+        f"queue depth {depth:g} is past the shed threshold {high_water:g}; "
+        f"the service is protecting in-flight sessions",
+        retry_after=retry_after,
+    )
+
+
+def from_serve_error(e: Exception) -> ApiError:
+    """Serving-layer exception -> HTTP semantics (the one mapping table)."""
+    from tpu_life.serve.errors import (
+        Draining,
+        QueueFull,
+        SessionFailed,
+        UnknownSession,
+    )
+
+    if isinstance(e, QueueFull):
+        # backpressure: the bounded admission queue is the hard backstop
+        # behind the shed threshold — same retry contract, same status
+        return ApiError(503, "queue_full", str(e), retry_after=1.0)
+    if isinstance(e, Draining):
+        # a load-balanced client should retry against a peer, not wait here
+        return ApiError(503, "draining", str(e), retry_after=1.0)
+    if isinstance(e, UnknownSession):
+        return ApiError(404, "unknown_session", str(e))
+    if isinstance(e, SessionFailed):
+        # terminal without a board (failed / cancelled): the session is
+        # gone for good — 410, never retried
+        return ApiError(410, "session_failed", str(e))
+    if isinstance(e, ValueError):
+        # the service's board/steps validation speaks ValueError
+        return bad_request("invalid_request", str(e))
+    raise e

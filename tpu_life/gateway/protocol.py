@@ -1,0 +1,242 @@
+"""The gateway's JSON wire vocabulary: requests in, views and boards out.
+
+Kept apart from the HTTP server so the contract is testable without a
+socket and reusable by the client.  Never imports jax — parsing and
+rendering are pure host-side work (numpy + the contract codec + RLE).
+
+Submit request (``POST /v1/sessions``)::
+
+    {"board": ["0110", "1001", ...],        # rows of digit strings, or
+     "board": [[0,1,1,0], ...],             # nested int lists
+     "rule": "conway", "steps": 64,
+     "timeout_s": 5.0}                      # optional deadline
+
+or seeded geometry instead of an inline board (the ``run --size``
+shorthand over the wire — demos need no input file)::
+
+    {"size": 256, "steps": 64}              # or "height" + "width"
+    {"height": 128, "width": 96, "steps": 8, "seed": 7, "density": 0.4}
+
+Result payload (``GET /v1/sessions/{sid}/result?format=rle|raw``):
+``rle`` is the ecosystem interchange text (``io/rle.py``); ``raw`` is
+base64 of the byte-exact contract board format (``io/codec.py``) — the
+format a client decodes back to the identical int8 array, which is what
+the byte-equality acceptance test asserts.
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_life.gateway.errors import ApiError, bad_request
+from tpu_life.io.codec import decode_board, encode_board
+from tpu_life.io.rle import emit_rle
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.serve.sessions import SessionView
+
+#: Hard cap on inline/seeded board cells — a front door must bound the
+#: memory one request can demand before any engine sees it (16 Mcells is
+#: a 4096^2 board: far beyond what an inline JSON board is for).
+MAX_CELLS = 1 << 24
+
+#: Default request-body bound (bytes) — pre-read admission control.
+MAX_BODY = 8 << 20
+
+
+@dataclass(frozen=True)
+class SubmitSpec:
+    """A validated submission, ready for ``SimulationService.submit``."""
+
+    board: np.ndarray
+    rule: str
+    steps: int
+    timeout_s: float | None
+
+
+def _require_int(payload: dict, key: str, *, minimum: int = 0) -> int:
+    v = payload.get(key)
+    # bool is an int subclass; "steps": true must not parse as 1
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise bad_request(
+            "invalid_request", f"{key!r} must be an integer, got {v!r}"
+        )
+    if v < minimum:
+        raise bad_request(
+            "invalid_request", f"{key!r} must be >= {minimum}, got {v}"
+        )
+    return v
+
+
+def parse_board(raw, states: int) -> np.ndarray:
+    """Inline JSON board -> int8 array, with typed 400s for every malformation."""
+    if not isinstance(raw, list) or not raw:
+        raise bad_request(
+            "invalid_board", "'board' must be a non-empty list of rows"
+        )
+    rows: list[list[int]] = []
+    width = None
+    for i, row in enumerate(raw):
+        if isinstance(row, str):
+            # isascii() too: str.isdigit() admits Unicode digits ('¹', '٣')
+            # that int() then rejects — a 500 instead of this typed 400
+            if not (row.isascii() and row.isdigit()):
+                raise bad_request(
+                    "invalid_board",
+                    f"board row {i} contains non-digit characters",
+                )
+            cells = [int(c) for c in row]
+        elif isinstance(row, list):
+            if not all(
+                isinstance(c, int) and not isinstance(c, bool) for c in row
+            ):
+                raise bad_request(
+                    "invalid_board", f"board row {i} must hold only integers"
+                )
+            cells = row
+        else:
+            raise bad_request(
+                "invalid_board",
+                f"board row {i} must be a digit string or an int list",
+            )
+        if not cells:
+            raise bad_request("invalid_board", f"board row {i} is empty")
+        if width is None:
+            width = len(cells)
+        elif len(cells) != width:
+            raise bad_request(
+                "invalid_board",
+                f"board row {i} has {len(cells)} cells; row 0 has {width}",
+            )
+        rows.append(cells)
+    if len(rows) * width > MAX_CELLS:
+        raise bad_request(
+            "board_too_large",
+            f"board has {len(rows) * width} cells; the limit is {MAX_CELLS}",
+        )
+    board = np.array(rows, dtype=np.int64)
+    lo, hi = int(board.min()), int(board.max())
+    if lo < 0 or hi >= states:
+        raise bad_request(
+            "invalid_board",
+            f"board states must be 0..{states - 1} for this rule; "
+            f"found {lo if lo < 0 else hi}",
+        )
+    return board.astype(np.int8)
+
+
+def parse_submit(payload) -> SubmitSpec:
+    """Request JSON -> :class:`SubmitSpec`; raises :class:`ApiError` (400s)."""
+    if not isinstance(payload, dict):
+        raise bad_request("invalid_request", "request body must be a JSON object")
+    rule_name = payload.get("rule", "conway")
+    if not isinstance(rule_name, str):
+        raise bad_request("invalid_request", "'rule' must be a string")
+    try:
+        rule = get_rule(rule_name)
+    except (ValueError, KeyError) as e:
+        raise bad_request("unknown_rule", str(e)) from None
+    steps = _require_int(payload, "steps")
+    timeout_s = payload.get("timeout_s")
+    if timeout_s is not None:
+        if isinstance(timeout_s, bool) or not isinstance(timeout_s, (int, float)):
+            raise bad_request(
+                "invalid_request", f"'timeout_s' must be a number, got {timeout_s!r}"
+            )
+        timeout_s = float(timeout_s)
+
+    if "board" in payload:
+        board = parse_board(payload["board"], rule.states)
+        return SubmitSpec(board=board, rule=rule_name, steps=steps, timeout_s=timeout_s)
+
+    # seeded geometry: the self-contained demo path (run --size over HTTP);
+    # explicit height/width win over the square 'size' shorthand
+    size = _require_int(payload, "size", minimum=1) if "size" in payload else None
+    height = (
+        _require_int(payload, "height", minimum=1) if "height" in payload else size
+    )
+    width = (
+        _require_int(payload, "width", minimum=1) if "width" in payload else size
+    )
+    if height is None or width is None:
+        raise bad_request(
+            "invalid_request",
+            "provide either 'board' (inline) or geometry "
+            "('size', or 'height' + 'width') for a seeded board",
+        )
+    if height * width > MAX_CELLS:
+        raise bad_request(
+            "board_too_large",
+            f"seeded board has {height * width} cells; the limit is {MAX_CELLS}",
+        )
+    seed = _require_int(payload, "seed") if "seed" in payload else 0
+    density = payload.get("density", 0.5)
+    if isinstance(density, bool) or not isinstance(density, (int, float)):
+        raise bad_request("invalid_request", "'density' must be a number")
+    if not 0.0 <= density <= 1.0:
+        raise bad_request(
+            "invalid_request", f"'density' must be in [0, 1], got {density}"
+        )
+    board = random_board(
+        height, width, float(density), states=rule.states, seed=seed
+    )
+    return SubmitSpec(board=board, rule=rule_name, steps=steps, timeout_s=timeout_s)
+
+
+# -- responses -------------------------------------------------------------
+def render_view(view: SessionView) -> dict:
+    """``poll`` response body (no board — results have their own route)."""
+    return {
+        "session": view.sid,
+        "state": view.state.value,
+        "rule": view.rule,
+        "steps": view.steps,
+        "steps_done": view.steps_done,
+        "progress": view.steps_done / view.steps if view.steps else 1.0,
+        "finished": view.finished,
+        "error": view.error,
+    }
+
+
+def render_result(board: np.ndarray, fmt: str, rule: str) -> dict:
+    """Result payload in the requested encoding (``rle`` | ``raw``)."""
+    h, w = board.shape
+    out = {"format": fmt, "height": int(h), "width": int(w), "rule": rule}
+    if fmt == "rle":
+        states = max(2, int(board.max(initial=0)) + 1)
+        try:
+            states = get_rule(rule).states
+        except (ValueError, KeyError):
+            pass  # header follows board content for unregistered specs
+        out["rle"] = emit_rle(board, rule=rule, states=states)
+    elif fmt == "raw":
+        out["b64"] = base64.b64encode(encode_board(board)).decode("ascii")
+    else:
+        raise bad_request(
+            "invalid_format", f"format must be 'rle' or 'raw', got {fmt!r}"
+        )
+    return out
+
+
+def decode_result(payload: dict) -> np.ndarray:
+    """Client-side inverse of :func:`render_result` for ``raw`` payloads."""
+    if payload.get("format") != "raw":
+        raise ValueError(f"cannot decode format {payload.get('format')!r}")
+    buf = base64.b64decode(payload["b64"])
+    return decode_board(buf, int(payload["height"]), int(payload["width"]))
+
+
+__all__ = [
+    "ApiError",
+    "MAX_BODY",
+    "MAX_CELLS",
+    "SubmitSpec",
+    "decode_result",
+    "parse_board",
+    "parse_submit",
+    "render_result",
+    "render_view",
+]

@@ -1,0 +1,489 @@
+"""The HTTP front door: admission control in front of the batch scheduler.
+
+Dependency-free (stdlib ``http.server`` + threads), because the point is
+the *shape*, not the framework: an inference-style serving stack is a
+saturated continuous-batching core behind a traffic layer that admits,
+sheds, and paces outside load (ISSUE: the Ising-on-TPU throughput story
+only survives contact with real clients if overload turns into typed
+429/503s instead of queue collapse).
+
+Threading model — one pump, many handlers::
+
+    handler threads (ThreadingHTTPServer, one per connection)
+        │  submit / poll / result / cancel        (service verbs, locked)
+        ▼
+    SimulationService  ◄── ONE background pump thread (all device work)
+
+The service's internal lock is the seam: handler threads only call the
+verbs, the pump thread owns every scheduling round, so the engine's
+one-compile-per-CompileKey invariant never meets concurrent device work.
+
+Admission pipeline for ``POST /v1/sessions`` (cheapest rejection first)::
+
+    draining? -> 503   rate limit -> 429+Retry-After   shed -> 503
+    body bound -> 413   parse/validate -> typed 400s   QueueFull -> 503
+
+Graceful drain (SIGTERM): admission closes (``/readyz`` flips to 503 so
+load balancers stop routing here), in-flight sessions step to completion,
+telemetry flushes (JSONL snapshot, prom file, trace), the process exits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from tpu_life.gateway import errors as gw_errors
+from tpu_life.gateway import protocol
+from tpu_life.gateway.errors import ApiError
+from tpu_life.gateway.limits import KeyedBuckets, LoadShedder
+from tpu_life.runtime.metrics import log
+from tpu_life.serve.errors import Draining
+from tpu_life.serve.service import SimulationService
+from tpu_life.version import __version__
+
+#: Routes get ONE bounded label each (metrics cardinality): the pattern,
+#: never the concrete path (session ids are unbounded).
+ROUTE_SESSIONS = "/v1/sessions"
+ROUTE_SESSION = "/v1/sessions/{sid}"
+ROUTE_RESULT = "/v1/sessions/{sid}/result"
+
+
+@dataclass
+class GatewayConfig:
+    host: str = "127.0.0.1"
+    port: int = 8000  # 0 = ephemeral (tests); the bound port is Gateway.port
+    api_rate: float = 0.0  # token-bucket refill per API key, tokens/s (0 = off)
+    api_burst: float = 10.0  # bucket capacity (max burst per key)
+    # queue-depth high-water mark for load shedding; None derives 80% of
+    # the service's bounded queue, 0 disables
+    shed_high_water: float | None = None
+    max_body: int = protocol.MAX_BODY  # request-body byte bound (413 past it)
+    pump_idle_s: float = 0.01  # pump-thread nap when no session is live
+
+
+class Gateway:
+    """Owns the HTTP server, the pump thread, and the admission valves.
+
+    The service's registry is shared: gateway families (per-route request
+    counters, latency histograms, shed/rate-limit counters) land next to
+    the serve families, so ``GET /metrics`` — and the service's own
+    ``prom_file`` / JSONL snapshot — expose one coherent instrument set.
+    """
+
+    def __init__(self, service: SimulationService, config: GatewayConfig | None = None):
+        self.service = service
+        self.config = config or GatewayConfig()
+        registry = service.registry
+        self._c_requests = registry.counter(
+            "gateway_requests_total",
+            "HTTP requests by route / method / status",
+            labels=("route", "method", "status"),
+        )
+        self._h_latency = registry.histogram(
+            "gateway_request_seconds",
+            "wall seconds per HTTP request",
+            labels=("route",),
+        )
+        self._c_limited = registry.counter(
+            "gateway_rate_limited_total",
+            "submissions bounced by the per-key token bucket (429)",
+        )
+        self._c_shed = registry.counter(
+            "gateway_shed_total",
+            "submissions shed at the queue-depth high-water mark (503)",
+        )
+        self._c_limited.labels()
+        self._c_shed.labels()
+        self.buckets = KeyedBuckets(self.config.api_rate, self.config.api_burst)
+        high_water = self.config.shed_high_water
+        if high_water is None:
+            high_water = 0.8 * service.config.max_queue
+        # registration is idempotent, so this is the SAME gauge family the
+        # service sets every scheduling round — the obs queue-depth signal
+        # is the shed input, exactly as a Prometheus alert would read it
+        depth_gauge = registry.gauge("serve_queue_depth")
+        self.shedder = LoadShedder(lambda: depth_gauge.value, high_water)
+        self._server = _GatewayHTTPServer(
+            (self.config.host, self.config.port), _Handler
+        )
+        self._server.gateway = self
+        self.host, self.port = self._server.server_address[:2]
+        self._wake = threading.Event()
+        self._drained = threading.Event()
+        self._pump_thread: threading.Thread | None = None
+        self._serve_thread: threading.Thread | None = None
+        self._closed = False
+        self.pump_error: Exception | None = None  # set by a pump crash
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Start the HTTP listener thread and the single pump thread."""
+        self._pump_thread = threading.Thread(
+            target=self._pump_loop, name="gateway-pump", daemon=True
+        )
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="gateway-http",
+            daemon=True,
+        )
+        self._pump_thread.start()
+        self._serve_thread.start()
+        log.info(
+            "gateway listening on http://%s:%d (run_id=%s)",
+            self.host,
+            self.port,
+            self.service.run_id,
+        )
+
+    def begin_drain(self) -> None:
+        """Stop admitting (``/readyz`` -> 503), finish in-flight sessions,
+        then stop the listener.  Idempotent; returns immediately — callers
+        block on :meth:`wait`."""
+        self.service.begin_drain()
+        self._wake.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the drain completed and the listener stopped.
+        Joins in small slices so OS signals still reach the main thread."""
+        threads = [t for t in (self._pump_thread, self._serve_thread) if t]
+        deadline = None if timeout is None else _monotonic() + timeout
+        for t in threads:
+            while t.is_alive():
+                t.join(0.1)
+                if deadline is not None and _monotonic() > deadline:
+                    return False
+        return True
+
+    def close(self) -> None:
+        """Release the socket and flush the service's telemetry."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._serve_thread is not None:
+            # shutdown() blocks on serve_forever's exit handshake, so it is
+            # only safe once the listener thread actually ran
+            self._server.shutdown()
+        self._server.server_close()
+        self.service.close()
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM / SIGINT -> graceful drain (main thread only)."""
+
+        def _drain(signum, frame):
+            log.info("gateway: signal %d — draining", signum)
+            self.begin_drain()
+
+        signal.signal(signal.SIGTERM, _drain)
+        signal.signal(signal.SIGINT, _drain)
+
+    # -- the one pump ------------------------------------------------------
+    def _pump_loop(self) -> None:
+        """All device work lives here.  Runs rounds while sessions are
+        live, naps (wakeable by submits) when idle, and exits — shutting
+        the listener down — once draining AND idle."""
+        svc = self.service
+        while True:
+            # sample draining BEFORE idle: once admission is closed, a
+            # submit can no longer slip in behind an idle() observation —
+            # sampled the other way around, a session admitted between the
+            # two reads would be stranded at shutdown
+            draining = svc.draining
+            if svc.idle():
+                if draining:
+                    break
+                self._wake.wait(self.config.pump_idle_s)
+                self._wake.clear()
+            else:
+                try:
+                    svc.pump()
+                except Exception as e:
+                    # a pump crash must not impersonate a healthy drain:
+                    # log it, remember it (the CLI exits non-zero and the
+                    # summary carries it), and shut down — a stepping-dead
+                    # gateway that kept answering polls would only strand
+                    # its clients more slowly
+                    log.exception("gateway: pump thread crashed")
+                    self.pump_error = e
+                    break
+        self._drained.set()
+        self._server.shutdown()
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    @property
+    def drained(self) -> bool:
+        return self._drained.is_set()
+
+
+class _GatewayHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    gateway: Gateway  # attached right after construction
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = f"tpu-life-gateway/{__version__}"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
+        log.debug("gateway: %s %s", self.address_string(), fmt % args)
+
+    @property
+    def gw(self) -> Gateway:
+        return self.server.gateway  # type: ignore[attr-defined]
+
+    def _send_json(
+        self, status: int, body: dict, *, retry_after: float | None = None
+    ) -> None:
+        body = dict(body)
+        # every response carries the service's correlation id: a client
+        # report ("session X was slow") joins the JSONL sink, the prom
+        # snapshot and the trace file on one key
+        body.setdefault("run_id", self.gw.service.run_id)
+        payload = (json.dumps(body) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        if retry_after is not None:
+            self.send_header("Retry-After", _fmt_retry_after(retry_after))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_text(self, status: int, text: str, content_type: str) -> None:
+        payload = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _read_body(self) -> dict:
+        length = self.headers.get("Content-Length")
+        if length is None:
+            self.close_connection = True
+            raise ApiError(411, "length_required", "Content-Length is required")
+        try:
+            n = int(length)
+        except ValueError:
+            self.close_connection = True
+            raise ApiError(
+                400, "invalid_request", f"bad Content-Length {length!r}"
+            ) from None
+        limit = self.gw.config.max_body
+        if n > limit:
+            # the body is rejected UNREAD, so this keep-alive stream now
+            # holds n bytes the next request parser would misread as a
+            # request line — close instead of desyncing
+            self.close_connection = True
+            raise gw_errors.payload_too_large(n, limit)
+        raw = self.rfile.read(n)
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise gw_errors.bad_request(
+                "invalid_json", f"request body is not valid JSON: {e}"
+            ) from None
+
+    # -- dispatch ----------------------------------------------------------
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._dispatch("DELETE")
+
+    def _dispatch(self, method: str) -> None:
+        parts = urlsplit(self.path)
+        path = parts.path.rstrip("/") or "/"
+        # unrouted paths share ONE label: recording the raw path would let
+        # any scanner mint unbounded series in the shared registry
+        route, status = "unmatched", 500
+        t0 = _monotonic()
+        try:
+            route, handler, kwargs = self._route(method, path, parts.query)
+            status = handler(**kwargs)
+        except ApiError as e:
+            status = e.status
+            try:
+                self._send_json(e.status, e.body(), retry_after=e.retry_after)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        except (BrokenPipeError, ConnectionResetError):
+            status = 499  # client went away mid-response (nginx's code)
+        except Exception:
+            log.exception("gateway: %s %s failed", method, path)
+            status = 500
+            try:
+                self._send_json(
+                    500,
+                    {"error": {"code": "internal", "message": "internal error"}},
+                )
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        finally:
+            gw = self.gw
+            gw._c_requests.labels(
+                route=route, method=method, status=str(status)
+            ).inc()
+            gw._h_latency.labels(route=route).observe(_monotonic() - t0)
+
+    def _route(self, method: str, path: str, query: str):
+        """(route label, bound handler, kwargs) — 404/405 raise here."""
+        if path == "/healthz":
+            if method != "GET":
+                raise gw_errors.method_not_allowed(method, path)
+            return "/healthz", self._healthz, {}
+        if path == "/readyz":
+            if method != "GET":
+                raise gw_errors.method_not_allowed(method, path)
+            return "/readyz", self._readyz, {}
+        if path == "/metrics":
+            if method != "GET":
+                raise gw_errors.method_not_allowed(method, path)
+            return "/metrics", self._metrics, {}
+        if path == ROUTE_SESSIONS:
+            if method != "POST":
+                raise gw_errors.method_not_allowed(method, path)
+            return ROUTE_SESSIONS, self._create, {}
+        if path.startswith(ROUTE_SESSIONS + "/"):
+            rest = path[len(ROUTE_SESSIONS) + 1 :]
+            if "/" not in rest:
+                sid = rest
+                if method == "GET":
+                    return ROUTE_SESSION, self._poll, {"sid": sid}
+                if method == "DELETE":
+                    return ROUTE_SESSION, self._cancel, {"sid": sid}
+                raise gw_errors.method_not_allowed(method, path)
+            sid, _, tail = rest.partition("/")
+            if tail == "result":
+                if method != "GET":
+                    raise gw_errors.method_not_allowed(method, path)
+                fmt = parse_qs(query).get("format", ["rle"])[0]
+                return ROUTE_RESULT, self._result, {"sid": sid, "fmt": fmt}
+        raise gw_errors.not_found(f"no route for {path}")
+
+    # -- handlers (each returns the status it sent) ------------------------
+    def _healthz(self) -> int:
+        # liveness: the process is up and dispatching — true even while
+        # draining (readiness is the signal that flips)
+        self._send_json(200, {"status": "ok"})
+        return 200
+
+    def _readyz(self) -> int:
+        svc = self.gw.service
+        if svc.draining:
+            self._send_json(
+                503,
+                {
+                    "ready": False,
+                    "draining": True,
+                    # the probe's yes/no plus the standard envelope, so a
+                    # client library reports "draining", not a bare 503
+                    "error": {"code": "draining", "message": "service is draining"},
+                },
+                retry_after=1.0,
+            )
+            return 503
+        self._send_json(200, {"ready": True, "draining": False})
+        return 200
+
+    def _metrics(self) -> int:
+        # live Prometheus text straight off the shared registry — the same
+        # renderer --prom-file snapshots, now scrapeable over HTTP
+        text = self.gw.service.registry.prom_text()
+        self._send_text(200, text, "text/plain; version=0.0.4")
+        return 200
+
+    def _create(self) -> int:
+        gw = self.gw
+        svc = gw.service
+        if svc.draining:
+            raise gw_errors.from_serve_error(
+                Draining("service is draining: no new sessions are admitted")
+            )
+        api_key = self.headers.get("X-API-Key", "anonymous")
+        wait = gw.buckets.acquire(api_key)
+        if wait > 0:
+            gw._c_limited.inc()
+            raise gw_errors.rate_limited(wait)
+        shed = gw.shedder.check()
+        if shed is not None:
+            gw._c_shed.inc()
+            raise gw_errors.overloaded(shed[0], gw.shedder.high_water, shed[1])
+        spec = protocol.parse_submit(self._read_body())
+        try:
+            sid = svc.submit(
+                spec.board, spec.rule, spec.steps, timeout_s=spec.timeout_s
+            )
+        except Exception as e:  # typed serve errors -> typed HTTP
+            raise gw_errors.from_serve_error(e) from e
+        gw.wake()  # the pump may be napping — new work just arrived
+        view = svc.poll(sid)
+        body = protocol.render_view(view)
+        self._send_json(201, body)
+        return 201
+
+    def _poll(self, sid: str) -> int:
+        try:
+            view = self.gw.service.poll(sid)
+        except Exception as e:
+            raise gw_errors.from_serve_error(e) from e
+        self._send_json(200, protocol.render_view(view))
+        return 200
+
+    def _result(self, sid: str, fmt: str) -> int:
+        svc = self.gw.service
+        try:
+            view = svc.poll(sid)
+        except Exception as e:
+            raise gw_errors.from_serve_error(e) from e
+        if not view.finished:
+            raise ApiError(
+                409,
+                "not_finished",
+                f"session {sid} is {view.state.value} "
+                f"({view.steps_done}/{view.steps} steps); poll until done",
+                retry_after=0.1,
+            )
+        try:
+            board = svc.result(sid)
+        except Exception as e:
+            raise gw_errors.from_serve_error(e) from e
+        body = protocol.render_result(board, fmt, view.rule)
+        body["session"] = sid
+        self._send_json(200, body)
+        return 200
+
+    def _cancel(self, sid: str) -> int:
+        svc = self.gw.service
+        try:
+            stopped = svc.cancel(sid)
+            view = svc.poll(sid)
+        except Exception as e:
+            raise gw_errors.from_serve_error(e) from e
+        self._send_json(
+            200,
+            {"session": sid, "cancelled": stopped, "state": view.state.value},
+        )
+        return 200
+
+
+def _fmt_retry_after(seconds: float) -> str:
+    # Retry-After is integer seconds; always at least 1 so a client that
+    # honors it literally cannot busy-spin
+    return str(max(1, int(seconds + 0.999)))
+
+
+def _monotonic() -> float:
+    return time.monotonic()

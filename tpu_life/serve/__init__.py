@@ -24,6 +24,7 @@ rules, and ``tpu-life serve`` / ``tpu-life submit`` for the CLI front-end.
 
 from tpu_life.serve.engine import CompileKey, compile_key_for, make_engine
 from tpu_life.serve.errors import (
+    Draining,
     QueueFull,
     ServeError,
     SessionFailed,
@@ -36,6 +37,7 @@ from tpu_life.serve.sessions import Session, SessionState, SessionStore, Session
 
 __all__ = [
     "CompileKey",
+    "Draining",
     "QueueFull",
     "RoundStats",
     "Scheduler",

@@ -24,6 +24,16 @@ class QueueFull(ServeError):
     """
 
 
+class Draining(ServeError):
+    """Admission is closed: the service is draining toward shutdown.
+
+    Raised by ``submit`` after :meth:`SimulationService.begin_drain` —
+    in-flight sessions keep running to completion, but no new work is
+    accepted.  Front-ends map this to 503 + ``Retry-After`` so a
+    load-balanced client retries against a peer that is still admitting.
+    """
+
+
 class SessionTimeout(ServeError):
     """A session exceeded its per-request deadline.
 

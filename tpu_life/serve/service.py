@@ -13,6 +13,15 @@ synchronous loop: every test and every caller sees a deterministic
 interleaving, and the host-sync chunk boundary is already the natural
 scheduling quantum (sessions join and leave the batch only there).
 
+The verbs are thread-safe: one internal lock serializes ``submit`` /
+``poll`` / ``result`` / ``cancel`` / ``stats`` against ``pump``, so a
+network front-end (``tpu_life.gateway``) can run ONE background pump
+thread that owns all device work while handler threads call the verbs
+concurrently — the engine's one-compile-per-CompileKey invariant never
+meets a second pumping thread.  ``begin_drain()`` is the shutdown hook:
+it closes admission (``submit`` raises :class:`Draining`) while in-flight
+sessions keep stepping to completion.
+
 Observability rides the unified obs layer (docs/OBSERVABILITY.md): the
 service generates one ``run_id``, every pump emits a ``MetricsRecorder``
 record (queue depth, batch occupancy, sessions/sec, live queue-wait /
@@ -27,6 +36,7 @@ same tooling as a batch run.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from pathlib import Path
@@ -35,10 +45,11 @@ import numpy as np
 
 from tpu_life import obs
 from tpu_life.models.rules import Rule, get_rule
+from tpu_life.runtime.checkpoint import atomic_publish as ckpt_atomic_publish
 from tpu_life.runtime.metrics import MetricsRecorder, log
 from tpu_life.runtime.profiling import maybe_profile
 from tpu_life.serve.engine import CompileKey, compile_key_for
-from tpu_life.serve.errors import QueueFull
+from tpu_life.serve.errors import Draining, QueueFull
 from tpu_life.serve.scheduler import RoundStats, Scheduler
 from tpu_life.serve.sessions import (
     SessionState,
@@ -116,6 +127,11 @@ class SimulationService:
             "serve_admission_rejections_total",
             "submissions bounced by queue backpressure (QueueFull)",
         )
+        # liveness for file scrapers: a stalled pump shows as a frozen
+        # round counter even while every gauge legitimately sits still
+        self._c_rounds = self.registry.counter(
+            "serve_rounds_total", "scheduling rounds executed"
+        )
         self._c_finished = self.registry.counter(
             "serve_sessions_finished_total",
             "sessions reaching a terminal state, by outcome",
@@ -142,6 +158,7 @@ class SimulationService:
             self._g_occupancy,
             self._c_submitted,
             self._c_rejections,
+            self._c_rounds,
             self._h_queue_wait,
             self._h_latency,
         ):
@@ -163,6 +180,10 @@ class SimulationService:
         self._completed = 0
         self._rounds = 0
         self._occupancy_sum = 0.0  # for mean batch occupancy in stats()
+        # the thread-safe seam: every verb and the pump serialize on this
+        # (reentrant: cancel/pump call observer hooks while holding it)
+        self._lock = threading.RLock()
+        self._draining = False
 
     # -- the four verbs ----------------------------------------------------
     def submit(
@@ -180,7 +201,8 @@ class SimulationService:
         state within the rule's range, non-negative budget) and raises
         :class:`QueueFull` when the bounded queue is at capacity — the
         request is rejected before anything is stored, so backpressure
-        bounds memory, not just slots.
+        bounds memory, not just slots.  After :meth:`begin_drain` every
+        submit raises :class:`Draining` instead (admission is closed).
         """
         if isinstance(rule, str):
             rule = get_rule(rule)
@@ -207,46 +229,57 @@ class SimulationService:
         board = board.astype(np.int8)
         if steps < 0:
             raise ValueError(f"steps must be >= 0, got {steps}")
-        # backpressure check BEFORE the session exists anywhere; a bounce
-        # is an admission outcome worth counting (rejection rate is the
-        # first overload signal), so the counter ticks before the raise
-        try:
-            self.scheduler.ensure_admission()
-        except QueueFull:
-            self._c_rejections.inc()
-            raise
-        now = self.clock()
-        if timeout_s is None:
-            timeout_s = self.config.default_timeout_s
-        s = self.store.create(
-            board=board.copy(),
-            rule=rule,
-            steps=steps,
-            submitted_at=now,
-            deadline=None if timeout_s is None else now + timeout_s,
-            fault_at=fault_at,
-        )
-        self._c_submitted.inc()
-        if steps == 0:
-            # nothing to run: complete at admission, never costs a slot
-            s.finish(board.copy())
-            self._c_finished.labels(state=s.state.value).inc()
-            self._h_latency.observe(0.0)
-            self._completed += 1
-        else:
-            self.scheduler.enqueue(s)
-            # the per-session queue-wait interval: an async (overlapping)
-            # trace span, closed at admission or terminal-in-queue
-            with obs.activate(self._tracer):
-                obs.async_begin("queue-wait", s.sid, steps=steps)
+        # admission is a read-modify-write on the queue: everything from the
+        # backpressure check to the enqueue happens under the lock, so two
+        # racing submits can neither both squeeze past a full queue nor
+        # interleave with a pump's admit scan
+        with self._lock:
+            if self._draining:
+                raise Draining(
+                    "service is draining: no new sessions are admitted"
+                )
+            # backpressure check BEFORE the session exists anywhere; a bounce
+            # is an admission outcome worth counting (rejection rate is the
+            # first overload signal), so the counter ticks before the raise
+            try:
+                self.scheduler.ensure_admission()
+            except QueueFull:
+                self._c_rejections.inc()
+                raise
+            now = self.clock()
+            if timeout_s is None:
+                timeout_s = self.config.default_timeout_s
+            s = self.store.create(
+                board=board.copy(),
+                rule=rule,
+                steps=steps,
+                submitted_at=now,
+                deadline=None if timeout_s is None else now + timeout_s,
+                fault_at=fault_at,
+            )
+            self._c_submitted.inc()
+            if steps == 0:
+                # nothing to run: complete at admission, never costs a slot
+                s.finish(board.copy())
+                self._c_finished.labels(state=s.state.value).inc()
+                self._h_latency.observe(0.0)
+                self._completed += 1
+            else:
+                self.scheduler.enqueue(s)
+                # the per-session queue-wait interval: an async (overlapping)
+                # trace span, closed at admission or terminal-in-queue
+                with obs.activate(self._tracer):
+                    obs.async_begin("queue-wait", s.sid, steps=steps)
         log.debug("serve: submitted %s (%s, %d steps)", s.sid, rule.name, steps)
         return s.sid
 
     def poll(self, sid: str) -> SessionView:
-        return self.store.view(sid)
+        with self._lock:
+            return self.store.view(sid)
 
     def result(self, sid: str) -> np.ndarray:
-        return self.store.result(sid)
+        with self._lock:
+            return self.store.result(sid)
 
     def cancel(self, sid: str) -> bool:
         """Stop a session wherever it is; True if this call stopped it.
@@ -256,17 +289,18 @@ class SimulationService:
         engine's freeze mask stops stepping it, and the partial board is
         discarded (``steps_done`` records how far it got).
         """
-        s = self.store.get(sid)
-        if s.state in TERMINAL:
-            return False
-        if s.state is SessionState.QUEUED:
-            self.scheduler.remove_queued(s)
-        else:
-            self.scheduler.evict_running(s)
-        s.cancel()
-        with obs.activate(self._tracer):
-            self.session_finished(s, max(0.0, self.clock() - s.submitted_at))
-        return True
+        with self._lock:
+            s = self.store.get(sid)
+            if s.state in TERMINAL:
+                return False
+            if s.state is SessionState.QUEUED:
+                self.scheduler.remove_queued(s)
+            else:
+                self.scheduler.evict_running(s)
+            s.cancel()
+            with obs.activate(self._tracer):
+                self.session_finished(s, max(0.0, self.clock() - s.submitted_at))
+            return True
 
     # -- scheduler telemetry observer ---------------------------------------
     def session_admitted(self, session, wait_s: float) -> None:
@@ -289,11 +323,11 @@ class SimulationService:
         drain (it raises rather than spinning forever)."""
         rounds = 0
         with obs.activate(self._tracer), maybe_profile(self.config.profile):
-            while not self.scheduler.idle():
+            while not self.idle():
                 self.pump()
                 rounds += 1
                 if max_rounds is not None and rounds >= max_rounds:
-                    if not self.scheduler.idle():
+                    if not self.idle():
                         raise RuntimeError(
                             f"drain did not converge in {max_rounds} rounds "
                             f"({len(self.scheduler.queue)} queued)"
@@ -301,9 +335,38 @@ class SimulationService:
                     break
         return rounds
 
+    def begin_drain(self) -> None:
+        """Close admission (every later ``submit`` raises :class:`Draining`)
+        while in-flight sessions keep running — the graceful-shutdown hook.
+        The caller still pumps (or ``drain()``s) to completion and then
+        ``close()``s; this only flips the admission valve."""
+        with self._lock:
+            if not self._draining:
+                self._draining = True
+                log.info("serve: draining — admission closed")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def idle(self) -> bool:
+        """True when nothing is queued or resident in any batch slot."""
+        with self._lock:
+            return self.scheduler.idle()
+
     # -- the scheduling quantum -------------------------------------------
     def pump(self) -> RoundStats:
-        """One scheduling round; the only place device work happens."""
+        """One scheduling round; the only place device work happens.
+
+        Holds the service lock for the whole round: verbs block briefly
+        while the batch steps, which is exactly the seam a one-pump-thread
+        front-end needs (handlers never touch engines, the pump never sees
+        a half-enqueued session).
+        """
+        with self._lock:
+            return self._pump_locked()
+
+    def _pump_locked(self) -> RoundStats:
         cfg = self.config
 
         def keyer(s) -> CompileKey:
@@ -313,6 +376,7 @@ class SimulationService:
             stats = self.scheduler.round(keyer)
         self._completed += stats.completed
         self._rounds += 1
+        self._c_rounds.inc()
         occ = stats.occupancy / stats.slots if stats.slots else 0.0
         self._occupancy_sum += occ
         self._g_queue_depth.set(stats.queue_depth)
@@ -345,36 +409,54 @@ class SimulationService:
                 "completion_p95": lat.quantile(0.95),
             }
         )
+        if self.config.prom_file:
+            # live exposition: rewrite the snapshot every round (atomic
+            # rename, so a mid-run scrape never reads a torn file) instead
+            # of only at close — a Prometheus file scraper watching a
+            # long-lived serve sees queue depth move, not a stale zero
+            self._write_prom()
         return stats
+
+    def _write_prom(self) -> None:
+        path = self.config.prom_file
+        obs.ensure_parent(path)
+        with ckpt_atomic_publish(Path(path)) as tmp:
+            tmp.write_text(self.registry.prom_text())
 
     def release_idle_engines(self) -> int:
         """Free engines (device batch + compiled program) whose keys have
         no resident sessions — for quiet periods of a long-lived service;
         returning traffic for a released key costs one recompile."""
-        return self.scheduler.release_idle_engines()
+        with self._lock:
+            return self.scheduler.release_idle_engines()
 
     def close(self) -> None:
         """Flush telemetry and release held resources: the registry
         snapshot lands in the JSONL sink, the Prometheus snapshot in
         ``prom_file``, the trace file is written, idle engines freed."""
-        self.recorder.close()
-        if self.config.prom_file:
-            obs.ensure_parent(self.config.prom_file)
-            Path(self.config.prom_file).write_text(self.registry.prom_text())
-            log.info("prometheus snapshot -> %s", self.config.prom_file)
-        if self._tracer is not None:
-            obs.stop_tracing(self._tracer)
-            log.info(
-                "trace events -> %s (run_id=%s)", self._tracer.path, self.run_id
-            )
-            self._tracer = None
-        self.scheduler.release_idle_engines()
+        with self._lock:
+            self.recorder.close()
+            if self.config.prom_file:
+                self._write_prom()
+                log.info("prometheus snapshot -> %s", self.config.prom_file)
+            if self._tracer is not None:
+                obs.stop_tracing(self._tracer)
+                log.info(
+                    "trace events -> %s (run_id=%s)", self._tracer.path, self.run_id
+                )
+                self._tracer = None
+            self.scheduler.release_idle_engines()
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
         elapsed = self.clock() - self._t0
         return {
             "run_id": self.run_id,
+            "draining": self._draining,
             "queue_wait_p50": self._h_queue_wait.quantile(0.5),
             "queue_wait_p95": self._h_queue_wait.quantile(0.95),
             "queue_wait_p99": self._h_queue_wait.quantile(0.99),

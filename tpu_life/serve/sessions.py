@@ -92,6 +92,9 @@ class SessionView:
     steps_done: int
     result: np.ndarray | None
     error: str | None
+    # the rule the session runs under — front-ends need it to label
+    # results (an RLE export without its rule header is ambiguous)
+    rule: str = ""
 
     @property
     def finished(self) -> bool:
@@ -132,6 +135,7 @@ class SessionStore:
             steps_done=s.steps_done,
             result=s.result,
             error=s.error,
+            rule=s.rule.name,
         )
 
     def result(self, sid: str) -> np.ndarray:
