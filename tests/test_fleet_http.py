@@ -1,0 +1,303 @@
+"""End-to-end fleet: real worker subprocesses behind the real router.
+
+The acceptance spine of the fleet PR: two `tpu-life gateway` worker
+processes (each binding port 0, ports read back from their startup lines)
+behind the in-process router — 20 staggered sessions return boards
+byte-identical to ``driver.run`` with exactly one compile per CompileKey
+per worker; a SIGKILLed worker loses only its own in-flight sessions
+(typed ``worker_lost``) while new submits route around it and the restart
+rejoins the rotation; and the full ``tpu-life fleet`` CLI drains to exit
+0 on SIGTERM.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_life.config import RunConfig
+from tpu_life.fleet import Fleet, FleetConfig, WorkerState
+from tpu_life.gateway.client import GatewayClient, GatewayError
+from tpu_life.models.patterns import random_board
+from tpu_life.runtime import driver
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def make_fleet(tmp_path):
+    """Factory fixture: start an N-worker fleet on ephemeral ports, always
+    drain + close at teardown (worker processes must not leak)."""
+    fleets = []
+
+    def _make(
+        workers=2,
+        worker_args=("--serve-backend", "numpy", "--capacity", "4", "--chunk-steps", "4"),
+        **cfg,
+    ):
+        fleet = Fleet(
+            FleetConfig(
+                workers=workers,
+                port=0,
+                worker_args=tuple(worker_args),
+                log_dir=str(tmp_path / "logs"),
+                probe_interval_s=0.1,
+                backoff_base_s=0.2,
+                healthy_after_s=2.0,
+                **cfg,
+            )
+        )
+        fleet.start()
+        fleets.append(fleet)
+        assert fleet.wait_ready(timeout=90, min_workers=workers), (
+            fleet.supervisor.states()
+        )
+        client = GatewayClient(f"http://127.0.0.1:{fleet.port}", retries=8)
+        return fleet, client
+
+    yield _make
+    for fleet in fleets:
+        fleet.begin_drain()
+        if not fleet.wait(timeout=30):
+            for w in fleet.supervisor.workers:  # aid post-mortems
+                if w.log_path.exists():
+                    print(f"--- {w.name} log tail ---")
+                    print(w.log_path.read_text()[-2000:])
+        fleet.close()
+
+
+def driver_run_board(tmp_path, board, rule, steps, tag):
+    """One independent sequential run through the real driver pipeline."""
+    from tpu_life.io.codec import write_board
+
+    h, w = board.shape
+    inp = tmp_path / f"in_{tag}.txt"
+    write_board(inp, board)
+    res = driver.run(
+        RunConfig(
+            height=h,
+            width=w,
+            steps=steps,
+            input_file=str(inp),
+            output_file=str(tmp_path / f"out_{tag}.txt"),
+            rule=rule,
+            backend="numpy",
+        )
+    )
+    assert res.board is not None
+    return res.board
+
+
+def compile_counts_by_worker(metrics_text: str) -> dict:
+    """worker -> [compile counts] parsed off the merged exposition."""
+    out: dict = {}
+    for line in metrics_text.splitlines():
+        if not line.startswith("serve_engine_compile_count{"):
+            continue
+        labels, _, value = line.rpartition(" ")
+        worker = labels.split('worker="', 1)[1].split('"', 1)[0]
+        out.setdefault(worker, []).append(float(value))
+    return out
+
+
+def test_twenty_staggered_sessions_byte_equal_driver(make_fleet, tmp_path):
+    """THE fleet acceptance test: 20 staggered sessions through a
+    2-worker jax fleet — results byte-equal ``driver.run``, one compile
+    per CompileKey per worker, traffic actually spread across workers."""
+    fleet, client = make_fleet(
+        workers=2,
+        worker_args=(
+            "--serve-backend", "jax", "--capacity", "8", "--chunk-steps", "7",
+        ),
+    )
+    boards = [random_board(24, 19, density=0.4, seed=300 + i) for i in range(20)]
+    budgets = [1 + (7 * i) % 43 for i in range(20)]
+
+    sids = [
+        client.submit(board=b, rule="conway", steps=n)
+        for b, n in zip(boards, budgets)
+    ]
+    for sid in sids:
+        view = client.wait(sid, timeout=180)
+        assert view["state"] == "done", view
+
+    for sid, board, steps in zip(sids, boards, budgets):
+        got = client.result_board(sid)
+        expect = driver_run_board(tmp_path, board, "conway", steps, sid)
+        np.testing.assert_array_equal(got, expect)
+        assert got.tobytes() == expect.tobytes()  # byte-equal, literally
+
+    # the balancer spread the load (equal depths rotate, growing depths
+    # repel) and pinned every sid to the worker that owns it
+    by_worker = {}
+    for sid in sids:
+        by_worker.setdefault(sid.split("g")[0], []).append(sid)
+    assert set(by_worker) == {"w0", "w1"}
+    assert all(len(v) >= 3 for v in by_worker.values()), by_worker
+
+    metrics = client.metrics()
+    counts = compile_counts_by_worker(metrics)
+    assert set(counts) == {"w0", "w1"}
+    for worker, values in counts.items():
+        assert values == [1.0], f"{worker} recompiled: {values}"
+    # fleet-level instruments saw the traffic
+    assert "fleet_workers{" in metrics
+    routed = {
+        w: sum(1 for s in sids if s.startswith(w + "g")) for w in ("w0", "w1")
+    }
+    for w, n in routed.items():
+        assert f'fleet_routed_total{{worker="{w}"}} {n}' in metrics
+
+
+def test_sigkilled_worker_fails_isolated_and_rejoins(make_fleet):
+    """kill -9 one worker mid-session: its sessions fail with typed 410
+    worker_lost, new submits route around it, survivors complete, and the
+    restarted worker rejoins the rotation."""
+    fleet, client = make_fleet(
+        workers=2,
+        worker_args=(
+            "--serve-backend", "numpy", "--capacity", "2", "--chunk-steps", "1",
+        ),
+    )
+    # budgets far past what the pump can finish: observably in flight
+    sids = [client.submit(size=32, steps=500_000) for _ in range(4)]
+    by_worker: dict = {}
+    for sid in sids:
+        by_worker.setdefault(client.poll(sid)["worker"], []).append(sid)
+    victim_name = next(w for w in ("w0", "w1") if by_worker.get(w))
+    victim = fleet.supervisor.get(victim_name)
+    gen0 = victim.generation
+    os.kill(victim.proc.pid, signal.SIGKILL)
+
+    # the victim's sessions fail ISOLATED, with a typed terminal error
+    probe = GatewayClient(f"http://127.0.0.1:{fleet.port}", retries=0)
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            probe.poll(by_worker[victim_name][0])
+        except GatewayError as e:
+            assert e.status == 410 and e.code == "worker_lost", (e.status, e.code)
+            break
+        assert time.monotonic() < deadline, "kill never surfaced as 410"
+        time.sleep(0.1)
+    for sid in by_worker[victim_name][1:]:
+        with pytest.raises(GatewayError) as exc:
+            probe.poll(sid)
+        assert exc.value.status == 410 and exc.value.code == "worker_lost"
+
+    # new submits route around the dead worker and complete
+    sid2 = client.submit(size=8, steps=2)
+    view = client.wait(sid2, timeout=60)
+    assert view["state"] == "done"
+    assert view["worker"] != victim_name
+
+    # the surviving worker's sessions are untouched
+    survivors = [w for w in by_worker if w != victim_name]
+    for w in survivors:
+        for sid in by_worker[w]:
+            assert probe.poll(sid)["state"] in ("running", "queued")
+
+    # the restart (fresh generation, fresh port) rejoins the rotation
+    deadline = time.monotonic() + 60
+    while True:
+        w = fleet.supervisor.get(victim_name)
+        if w.generation > gen0 and w.state is WorkerState.READY:
+            break
+        assert time.monotonic() < deadline, fleet.supervisor.states()
+        time.sleep(0.1)
+    workers_hit = set()
+    for _ in range(6):
+        sid = client.submit(size=8, steps=1)
+        workers_hit.add(client.wait(sid, timeout=60)["worker"])
+    assert victim_name in workers_hit, workers_hit
+    assert fleet.supervisor.restarts() >= 1.0
+    # a pre-kill sid resolved against the NEW generation stays lost — the
+    # successor process must never claim its predecessor's sessions
+    with pytest.raises(GatewayError) as exc:
+        probe.poll(by_worker[victim_name][0])
+    assert exc.value.code == "worker_lost"
+
+    # cancel the survivors' unbounded sessions so teardown's drain converges
+    for w in survivors:
+        for sid in by_worker[w]:
+            client.cancel(sid)
+
+
+def test_fleet_cli_sigterm_drains_to_exit_zero(tmp_path):
+    """The full CLI: `tpu-life fleet --workers 2` serves the unmodified
+    client, then SIGTERM drains the whole tier — router stops admitting,
+    every worker finishes and exits 0, the supervisor reaps, exit 0 —
+    and the per-worker metrics sinks read back as ONE merged report."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = str(REPO_ROOT) + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    metrics_dir = tmp_path / "metrics"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_life", "fleet",
+            "--workers", "2", "--port", "0", "--serve-backend", "numpy",
+            "--metrics-dir", str(metrics_dir),
+            "--log-dir", str(tmp_path / "logs"),
+            "--probe-interval", "0.1",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    try:
+        start = json.loads(proc.stdout.readline())
+        assert start["mode"] == "fleet" and start["workers"] == 2
+        url = start["url"]
+
+        deadline = time.monotonic() + 90
+        while True:
+            try:
+                with urllib.request.urlopen(url + "/readyz", timeout=1) as r:
+                    if json.load(r)["workers_ready"] == 2:
+                        break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, "fleet never became ready"
+            time.sleep(0.2)
+
+        client = GatewayClient(url, retries=6)
+        sids = [client.submit(size=16, steps=8, seed=i) for i in range(4)]
+        for sid in sids:
+            assert client.wait(sid, timeout=60)["state"] == "done"
+        assert "fleet_workers" in client.metrics()
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=90)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, (out, err)
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["mode"] == "fleet"
+    assert summary["failed_workers"] == []
+    assert sum(summary["routed"].values()) == 4.0
+
+    # the per-worker sinks merge keyed by run_id into one report
+    sinks = sorted(metrics_dir.glob("*.jsonl"))
+    assert len(sinks) == 2
+    from tpu_life.obs import stats as obs_stats
+
+    records = []
+    for sink in sinks:
+        records.extend(obs_stats.load_records(str(sink)))
+    merged = obs_stats.summarize(records)
+    assert len(merged["run_ids"]) == 2
+    assert merged["serve"]["runs_merged"] == 2
+    assert merged["serve"]["sessions_done"] == 4
+    assert set(merged["runs"]) == set(merged["run_ids"])

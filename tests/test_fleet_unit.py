@@ -1,0 +1,384 @@
+"""Fleet units: balancer choice, registry pinning, breaker/backoff, merge.
+
+No subprocesses and no sockets here — the supervisor runs on injected
+``spawn`` / ``probe`` / ``clock`` fakes so the restart scheduling and the
+circuit breaker are tested deterministically at unit speed;
+tests/test_fleet_http.py covers the real-process path.
+"""
+
+import pytest
+
+from tpu_life import obs
+from tpu_life.fleet.balancer import UNKNOWN_DEPTH, LeastDepthBalancer, prom_value
+from tpu_life.fleet.registry import SessionRegistry, parse_fleet_sid
+from tpu_life.fleet.router import merge_prom_texts
+from tpu_life.fleet.supervisor import FleetConfig, Supervisor, WorkerState
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- prometheus helpers ------------------------------------------------------
+def test_prom_value_finds_unlabeled_sample():
+    text = (
+        "# HELP serve_queue_depth sessions waiting\n"
+        "# TYPE serve_queue_depth gauge\n"
+        "serve_queue_depth_other 9\n"
+        "serve_queue_depth 3\n"
+    )
+    assert prom_value(text, "serve_queue_depth") == 3.0
+    assert prom_value(text, "missing_metric") is None
+
+
+def test_merge_prom_texts_labels_and_groups_families():
+    def registry_text(depth, requests):
+        reg = obs.MetricsRegistry()
+        reg.gauge("serve_queue_depth", "queue").set(depth)
+        c = reg.counter("gw_requests_total", "reqs", labels=("route",))
+        c.labels(route="/v1/sessions").inc(requests)
+        reg.histogram("lat_seconds", "latency").observe(0.002)
+        return reg.prom_text()
+
+    fleet_reg = obs.MetricsRegistry()
+    fleet_reg.counter("fleet_retry_total", "retries").inc(2)
+    merged = merge_prom_texts(
+        [
+            (None, fleet_reg.prom_text()),
+            ("w0", registry_text(1, 5)),
+            ("w1", registry_text(4, 7)),
+        ]
+    )
+    # fleet-level series pass through unlabeled
+    assert "fleet_retry_total 2" in merged
+    # worker series gain the worker label, prepended to existing labels
+    assert 'serve_queue_depth{worker="w0"} 1' in merged
+    assert 'serve_queue_depth{worker="w1"} 4' in merged
+    assert 'gw_requests_total{worker="w1",route="/v1/sessions"} 7' in merged
+    # each family appears under exactly ONE TYPE line, series contiguous
+    assert merged.count("# TYPE serve_queue_depth gauge") == 1
+    assert merged.count("# TYPE lat_seconds histogram") == 1
+    lines = merged.splitlines()
+    depth_idx = [i for i, l in enumerate(lines) if l.startswith("serve_queue_depth{")]
+    assert depth_idx[1] - depth_idx[0] == 1  # contiguous block
+    # histogram child samples (_bucket/_sum/_count) stay under the family
+    w0_buckets = [
+        l for l in lines if l.startswith("lat_seconds_bucket") and 'worker="w0"' in l
+    ]
+    assert w0_buckets and 'le="0.001"' not in w0_buckets[0].split("worker")[0]
+    assert 'lat_seconds_count{worker="w0"} 1' in merged
+
+
+# -- session registry --------------------------------------------------------
+def test_registry_pin_resolve_round_trip():
+    reg = SessionRegistry()
+    fsid = reg.pin("w1", 3, "s000042")
+    # the generation is baked into the id: a restarted worker reuses the
+    # same sid NUMBERS, so the name alone would collide across restarts
+    assert fsid == "w1g3-s000042"
+    pin = reg.resolve(fsid)
+    assert (pin.worker, pin.generation, pin.sid) == ("w1", 3, "s000042")
+    reg.forget(fsid)
+    # evicted/forgotten pins degrade to parsing the sid, losing nothing
+    pin = reg.resolve(fsid)
+    assert (pin.worker, pin.generation, pin.sid) == ("w1", 3, "s000042")
+
+
+def test_registry_generations_never_collide():
+    """THE restart-confusion guard: gen 1's s000000 and gen 2's s000000
+    are different fleet sids — the successor process must never claim its
+    predecessor's sessions."""
+    reg = SessionRegistry()
+    old = reg.pin("w0", 1, "s000000")
+    new = reg.pin("w0", 2, "s000000")
+    assert old != new
+    assert reg.resolve(old).generation == 1
+    assert reg.resolve(new).generation == 2
+
+
+def test_registry_lru_cap_and_bad_sids():
+    reg = SessionRegistry(max_pins=2)
+    a = reg.pin("w0", 1, "s000000")
+    b = reg.pin("w0", 1, "s000001")
+    c = reg.pin("w1", 1, "s000002")  # evicts a
+    assert len(reg) == 2
+    assert reg.resolve(b).generation == 1
+    assert reg.resolve(c).generation == 1
+    assert reg.resolve(a).sid == "s000000"  # fallback parse, full fidelity
+    # not a fleet sid at all -> None (the router 404s)
+    assert reg.resolve("s000000") is None
+    assert parse_fleet_sid("bogus") is None
+    assert parse_fleet_sid("w12g4-s000009").worker == "w12"
+    assert parse_fleet_sid("w12g4-s000009").generation == 4
+
+
+# -- balancer ----------------------------------------------------------------
+class FakeWorker:
+    def __init__(self, name, generation=1):
+        self.name = name
+        self.generation = generation
+
+
+def test_balancer_prefers_least_depth_and_caches_with_ttl():
+    clock = FakeClock()
+    depths = {"w0": 5.0, "w1": 1.0}
+    calls = []
+
+    def fetch(w):
+        calls.append(w.name)
+        return depths[w.name]
+
+    bal = LeastDepthBalancer(fetch, ttl_s=0.5, clock=clock)
+    w0, w1 = FakeWorker("w0"), FakeWorker("w1")
+    assert [w.name for w in bal.candidates([w0, w1])] == ["w1", "w0"]
+    # within the TTL: cached, no new fetches
+    n = len(calls)
+    assert [w.name for w in bal.candidates([w0, w1])] == ["w1", "w0"]
+    assert len(calls) == n
+    # past the TTL: re-scraped, new ordering observed
+    clock.t += 1.0
+    depths["w1"] = 9.0
+    assert [w.name for w in bal.candidates([w0, w1])] == ["w0", "w1"]
+    assert len(calls) > n
+
+
+def test_balancer_fetch_failure_sorts_last_but_stays_candidate():
+    def fetch(w):
+        if w.name == "w0":
+            raise ConnectionRefusedError("dead")
+        return 2.0
+
+    bal = LeastDepthBalancer(fetch, ttl_s=10.0, clock=FakeClock())
+    w0, w1 = FakeWorker("w0"), FakeWorker("w1")
+    assert [w.name for w in bal.candidates([w0, w1])] == ["w1", "w0"]
+    assert bal.depth(w0) == UNKNOWN_DEPTH
+
+
+def test_balancer_ties_rotate_round_robin():
+    bal = LeastDepthBalancer(lambda w: 0.0, ttl_s=10.0, clock=FakeClock())
+    workers = [FakeWorker("w0"), FakeWorker("w1")]
+    first = [bal.candidates(workers)[0].name for _ in range(4)]
+    assert set(first) == {"w0", "w1"}, "equal depths must spread, not pile up"
+
+
+def test_balancer_cache_is_generation_keyed():
+    clock = FakeClock()
+    calls = []
+
+    def fetch(w):
+        calls.append((w.name, w.generation))
+        return 0.0
+
+    bal = LeastDepthBalancer(fetch, ttl_s=100.0, clock=clock)
+    w = FakeWorker("w0", generation=1)
+    bal.depth(w)
+    w.generation = 2  # restarted: the old reading must not be inherited
+    bal.depth(w)
+    assert calls == [("w0", 1), ("w0", 2)]
+    # dead generations' readings are purged (restarts are unbounded over a
+    # router's lifetime — the cache must not leak one entry per restart)
+    assert list(bal._cache) == [("w0", 2)]
+
+
+# -- supervisor: restart scheduling and the circuit breaker ------------------
+class FakeProc:
+    def __init__(self, pid=1000):
+        self.pid = pid
+        self.rc = None
+        self.killed = False
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        self.rc = 0
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+    def die(self, rc=1):
+        self.rc = rc
+
+
+@pytest.fixture
+def sup(tmp_path):
+    """A 2-worker supervisor on fakes: spawn assigns a FakeProc + URL,
+    probe answers from a mutable dict, the clock is manual."""
+    clock = FakeClock()
+    procs: dict[str, FakeProc] = {}
+    probe_answers: dict[str, str] = {}
+
+    def spawn(w):
+        procs[w.name] = w.proc = FakeProc(pid=1000 + w.generation)
+        w.url = f"http://fake/{w.name}/g{w.generation}"
+        probe_answers.setdefault(w.name, "ready")
+
+    def probe(w):
+        return probe_answers.get(w.name, "unreachable")
+
+    cfg = FleetConfig(
+        workers=2,
+        log_dir=str(tmp_path / "logs"),
+        backoff_base_s=1.0,
+        backoff_max_s=8.0,
+        breaker_threshold=3,
+        healthy_after_s=10.0,
+        unready_threshold=3,
+    )
+    s = Supervisor(cfg, obs.MetricsRegistry(), spawn=spawn, probe=probe, clock=clock)
+    # start() would launch the monitor thread; drive ticks by hand instead
+    with s._lock:
+        for w in s.workers:
+            s._spawn_worker(w, first=True)
+    s.tick()
+    return s, clock, procs, probe_answers
+
+
+def test_supervisor_ready_and_gauges(sup):
+    s, clock, procs, answers = sup
+    assert [w.state for w in s.workers] == [WorkerState.READY] * 2
+    assert len(s.ready_workers()) == 2
+    g = s._g_workers
+    assert g.labels(state="ready").value == 2.0
+    assert g.labels(state="down").value == 0.0
+
+
+def test_supervisor_restart_backoff_doubles(sup):
+    s, clock, procs, answers = sup
+    w = s.workers[0]
+    procs["w0"].die(rc=1)
+    clock.t = 100.0
+    s.tick()
+    assert w.state is WorkerState.DOWN and w.failures == 1
+    assert w.restart_at == pytest.approx(101.0)  # base backoff
+    s.tick()  # before the backoff elapses: no respawn
+    assert w.generation == 1
+    clock.t = 101.5
+    s.tick()
+    assert w.generation == 2 and w.state is WorkerState.STARTING
+    assert s.restarts() == 1.0
+    s.tick()  # probe says ready again
+    assert w.state is WorkerState.READY
+    # a second fast crash doubles the delay (uptime < healthy_after_s)
+    procs["w0"].die(rc=1)
+    clock.t = 102.0
+    s.tick()
+    assert w.failures == 2
+    assert w.restart_at == pytest.approx(104.0)  # 2 * base
+
+
+def test_supervisor_circuit_breaker_opens_and_stays_open(sup):
+    s, clock, procs, answers = sup
+    w = s.workers[0]
+    for _ in range(20):  # crash loop: die as soon as respawned
+        if w.proc is not None and w.proc.poll() is None:
+            procs["w0"].die(rc=1)
+        # past the max backoff (so respawns happen) but short of
+        # healthy_after_s (so every crash counts as a FAST failure)
+        clock.t += 9.0
+        s.tick()
+        if w.state is WorkerState.FAILED:
+            break
+    assert w.state is WorkerState.FAILED
+    assert w.failures == s.config.breaker_threshold
+    spawned = w.generation
+    clock.t += 1000.0
+    s.tick()
+    assert w.generation == spawned, "a FAILED worker must never respawn"
+    # the healthy worker is unaffected and the gauges say so
+    assert s.workers[1].state is WorkerState.READY
+    assert s._g_workers.labels(state="failed").value == 1.0
+
+
+def test_supervisor_healthy_uptime_resets_breaker_count(sup):
+    s, clock, procs, answers = sup
+    w = s.workers[0]
+    procs["w0"].die(rc=1)
+    clock.t = 50.0
+    s.tick()  # failure 1
+    clock.t = 60.0
+    s.tick()  # respawn
+    s.tick()  # ready
+    assert w.failures == 1
+    clock.t = 60.0 + s.config.healthy_after_s + 1
+    s.tick()  # survived long enough: count resets
+    assert w.failures == 0
+
+
+def test_supervisor_unresponsive_worker_is_killed_for_restart(sup):
+    s, clock, procs, answers = sup
+    answers["w0"] = "unreachable"
+    for _ in range(s.config.unready_threshold):
+        s.tick()
+    assert procs["w0"].killed, "a wedged-but-alive worker must be recycled"
+    clock.t += 100.0
+    s.tick()  # reap the kill -> DOWN -> restart scheduling
+    assert s.workers[0].failures == 1
+
+
+def test_supervisor_drain_terminates_and_never_restarts(sup):
+    s, clock, procs, answers = sup
+    s.begin_drain()
+    assert procs["w0"].terminated and procs["w1"].terminated
+    clock.t += 1000.0
+    s.tick()
+    assert all(w.state is WorkerState.DOWN for w in s.workers)
+    assert s.drained()
+    assert all(w.generation == 1 for w in s.workers), "no respawns while draining"
+
+
+def test_supervisor_all_breakers_open_counts_as_finished(sup):
+    """A fleet that crash-loops every worker to FAILED must FINISH (the
+    CLI exits 1 with failed_workers) — not hang serving 503s until an
+    operator signals it."""
+    s, clock, procs, answers = sup
+    assert not s.finished()
+    for w in s.workers:
+        for _ in range(20):
+            if w.proc is not None and w.proc.poll() is None:
+                procs[w.name].die(rc=1)
+            clock.t += 9.0
+            s.tick()
+            if w.state is WorkerState.FAILED:
+                break
+    assert all(w.state is WorkerState.FAILED for w in s.workers)
+    assert s.finished()
+    assert s.wait(timeout=0.2)
+
+
+def test_supervisor_drain_raced_by_spawn_still_finishes(sup):
+    """A SIGTERM landing before (or between) spawns must not strand a
+    worker the drain can never reach: spawns after begin_drain are
+    no-ops, and a repeat begin_drain re-TERMs anything alive."""
+    s, clock, procs, answers = sup
+    s.begin_drain()
+    w = s.workers[0]
+    w.proc = None  # as if this worker had not been spawned yet
+    with s._lock:
+        s._spawn_worker(w)  # the racing spawn: must refuse
+    assert w.proc is None and w.state is WorkerState.DOWN
+    # the other worker was TERMed by begin_drain; a second call re-TERMs
+    # (idempotent but never silently dropped)
+    s.begin_drain()
+    assert procs["w1"].terminated
+    clock.t += 1.0
+    s.tick()
+    assert s.finished() and s.wait(timeout=0.2)
+
+
+def test_supervisor_worker_draining_state_from_probe(sup):
+    s, clock, procs, answers = sup
+    answers["w0"] = "draining"
+    s.tick()
+    assert s.workers[0].state is WorkerState.DRAINING
+    assert [w.name for w in s.ready_workers()] == ["w1"]

@@ -276,10 +276,14 @@ def test_queue_full_maps_to_503_not_hang(make_gateway):
         # shedding off: this test targets the QueueFull backstop itself
         GatewayConfig(port=0, shed_high_water=0.0),
     )
+    # budgets the pump cannot finish during the hammer loop: on a fast
+    # machine, 300-step sessions retire between submits and the queue
+    # never fills — the push-back assertion below was timing-flaky
     outcomes = {"ok": 0, "queue_full": 0}
+    admitted = []
     for _ in range(30):
         try:
-            client.submit(size=16, steps=300)
+            admitted.append(client.submit(size=16, steps=300_000))
             outcomes["ok"] += 1
         except GatewayError as e:
             assert e.status == 503 and e.code == "queue_full"
@@ -287,3 +291,5 @@ def test_queue_full_maps_to_503_not_hang(make_gateway):
             outcomes["queue_full"] += 1
     assert outcomes["queue_full"] > 0, "the bounded queue must push back"
     assert outcomes["ok"] >= 2  # slots + queue admitted some
+    for sid in admitted:  # unbounded budgets: cancel so teardown's drain converges
+        client.cancel(sid)

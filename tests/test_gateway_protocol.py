@@ -198,3 +198,100 @@ def test_serve_errors_map_to_http(exc, status, code):
 def test_unmapped_exceptions_propagate():
     with pytest.raises(KeyError):
         from_serve_error(KeyError("not a serve error"))
+
+
+# -- client backoff jitter --------------------------------------------------
+def _flaky_urlopen(responses):
+    """Fake urlopen: pops (status, headers) tuples, raising HTTPError for
+    each; a None entry means success with an empty JSON body."""
+    import io
+    import json as _json
+    import urllib.error
+    from email.message import Message
+
+    def fake(req, timeout=None):
+        item = responses.pop(0)
+        if item is None:
+            class _Resp:
+                status = 200
+
+                def read(self):
+                    return _json.dumps({"ok": True}).encode()
+
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *a):
+                    return False
+
+            return _Resp()
+        status, retry_after = item
+        hdrs = Message()
+        if retry_after is not None:
+            hdrs["Retry-After"] = str(retry_after)
+        raise urllib.error.HTTPError(
+            "http://x", status, "busy", hdrs,
+            io.BytesIO(b'{"error": {"code": "overloaded", "message": "x"}}'),
+        )
+
+    return fake
+
+
+def test_client_backoff_jitter_is_bounded_and_desynchronized(monkeypatch):
+    """No Retry-After -> exponential backoff spread by bounded jitter, so
+    N identical clients bounced together don't re-arrive in lockstep."""
+    import random
+
+    from tpu_life.gateway.client import GatewayClient
+
+    def sleeps_for(seed):
+        slept = []
+        monkeypatch.setattr(
+            "urllib.request.urlopen",
+            _flaky_urlopen([(503, None), (503, None), (503, None), None]),
+        )
+        client = GatewayClient(
+            "http://x",
+            retries=3,
+            backoff=0.2,
+            jitter=0.25,
+            sleep=slept.append,
+            rng=random.Random(seed),
+        )
+        assert client.poll("s000000") == {"ok": True}
+        return slept
+
+    a = sleeps_for(1)
+    b = sleeps_for(2)
+    for slept in (a, b):
+        assert len(slept) == 3
+        for k, s in enumerate(slept):
+            base = 0.2 * 2**k
+            assert base * 0.75 <= s <= base * 1.25, (k, s)  # bounded
+    assert a != b, "two clients must not back off in lockstep"
+
+
+def test_client_retry_after_wins_unjittered(monkeypatch):
+    """An explicit Retry-After is the server asking for exact pacing —
+    honored verbatim, never jittered."""
+    import random
+
+    from tpu_life.gateway.client import GatewayClient
+
+    slept = []
+    monkeypatch.setattr(
+        "urllib.request.urlopen", _flaky_urlopen([(429, 7), None])
+    )
+    client = GatewayClient(
+        "http://x", retries=1, jitter=0.25, sleep=slept.append,
+        rng=random.Random(0),
+    )
+    client.poll("s000000")
+    assert slept == [7.0]
+
+
+def test_client_rejects_bad_jitter():
+    from tpu_life.gateway.client import GatewayClient
+
+    with pytest.raises(ValueError, match="jitter"):
+        GatewayClient("http://x", jitter=1.5)

@@ -493,3 +493,54 @@ def test_stats_tolerates_torn_final_line(tmp_path):
     sink.write_text('{"bad\n' + json.dumps({"step": 2}) + "\n")
     with pytest.raises(ValueError, match="bad metrics line"):
         obs_stats.load_records(str(sink))
+
+
+def test_stats_merges_multiple_sinks_keyed_by_run_id(tmp_path, capsys):
+    """The fleet read-back path: per-worker JSONL sinks (distinct run_ids)
+    merge into one report — counts sum, occupancy is round-weighted,
+    elapsed is the longest worker's wall clock — with a per-run breakdown
+    under ``runs``.  Same-run records keep the classic single-run shape."""
+    import json as _json
+
+    def worker_sink(path, rid, done, sps, occ, rejected):
+        rows = [
+            {"kind": "serve", "run_id": rid, "elapsed_s": 1.0,
+             "queue_depth": 1, "batch_occupancy": occ, "admitted": done,
+             "completed": done, "failed": 0, "steps_advanced": 8 * done,
+             "sessions_done": done, "sessions_per_sec": sps},
+            {"kind": "metric", "run_id": rid, "labels": {},
+             "metric": "serve_sessions_submitted_total", "type": "counter",
+             "value": float(done)},
+            {"kind": "metric", "run_id": rid, "labels": {},
+             "metric": "serve_admission_rejections_total", "type": "counter",
+             "value": float(rejected)},
+        ]
+        path.write_text("".join(_json.dumps(r) + "\n" for r in rows))
+
+    a, b = tmp_path / "w0.jsonl", tmp_path / "w1.jsonl"
+    worker_sink(a, "runA", done=4, sps=4.0, occ=0.5, rejected=1)
+    worker_sink(b, "runB", done=2, sps=2.0, occ=1.0, rejected=1)
+
+    records = obs_stats.load_records(str(a)) + obs_stats.load_records(str(b))
+    s = obs_stats.summarize(records)
+    assert s["run_ids"] == ["runA", "runB"]
+    assert s["serve"]["runs_merged"] == 2
+    assert s["serve"]["sessions_done"] == 6
+    assert s["serve"]["sessions_per_sec"] == pytest.approx(6.0)  # concurrent
+    assert s["serve"]["batch_occupancy_mean"] == pytest.approx(0.75)
+    assert s["runs"]["runA"]["serve"]["sessions_done"] == 4
+    assert s["runs"]["runB"]["serve"]["sessions_done"] == 2
+    # identical counters from two workers SUM (not overwrite) in the rate
+    assert s["serve"]["rejection_rate"] == pytest.approx(2 / 8)
+    # metric entries stay distinguishable by run_id in the merged report
+    mets = [m for m in s["metrics"] if m["metric"] == "serve_sessions_submitted_total"]
+    assert {m["run_id"] for m in mets} == {"runA", "runB"}
+
+    # the CLI face: multiple positional sinks, one merged JSON report
+    assert main(["stats", str(a), str(b), "--json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["serve"]["sessions_done"] == 6 and len(doc["run_ids"]) == 2
+    # and the human table renders the per-run breakdown
+    assert main(["stats", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "per run:" in out and "runA" in out and "runB" in out

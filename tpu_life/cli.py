@@ -209,6 +209,60 @@ def build_parser() -> argparse.ArgumentParser:
                     help="force a JAX platform (cpu/tpu), like `run --platform`")
     gw.add_argument("--verbose", "-v", action="store_true")
 
+    fl = sub.add_parser(
+        "fleet",
+        help="multi-worker front tier (docs/FLEET.md): supervise N gateway "
+        "worker subprocesses and route session traffic across them by "
+        "least queue depth, with health-checked failover",
+    )
+    fl.add_argument("--workers", type=int, default=2,
+                    help="gateway worker subprocesses to supervise")
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, default=8000,
+                    help="router listen port (0 = ephemeral; the bound "
+                    "port is printed in the startup JSON line; workers "
+                    "always bind port 0 and are read back)")
+    fl.add_argument("--capacity", type=int, default=8,
+                    help="batch slots per compile key, per worker (fleet "
+                    "capacity = workers x this)")
+    fl.add_argument("--chunk-steps", type=int, default=16)
+    fl.add_argument("--max-queue", type=int, default=64,
+                    help="bounded admission queue per worker")
+    fl.add_argument(
+        "--serve-backend",
+        default="jax",
+        choices=["jax", "tuned", "numpy", "sharded", "stripes", "pallas", "native"],
+        help="engine executor for every worker (same semantics as "
+        "`gateway --serve-backend`)",
+    )
+    fl.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
+                    help="default per-request deadline (per worker)")
+    fl.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
+                    help="per-API-key token bucket, enforced per worker "
+                    "(the router forwards X-API-Key)")
+    fl.add_argument("--api-burst", type=float, default=10.0)
+    fl.add_argument("--metrics-dir", default=None, metavar="DIR",
+                    help="per-worker JSONL sinks at DIR/wN.jsonl — read "
+                    "them back merged with `tpu-life stats DIR/*.jsonl`")
+    fl.add_argument("--log-dir", default=None, metavar="DIR",
+                    help="per-worker stdout+stderr logs at DIR/wN.log "
+                    "(default: a fresh temp dir)")
+    fl.add_argument("--restart-backoff", type=float, default=0.5,
+                    metavar="SECONDS",
+                    help="base of the exponential restart backoff after "
+                    "a worker crash")
+    fl.add_argument("--max-restarts", type=int, default=5, metavar="N",
+                    help="restart a crash-looping worker at most this many "
+                    "times (one more consecutive fast failure opens its "
+                    "circuit breaker and leaves it down; 0 = fail fast, "
+                    "matching `run --max-restarts`)")
+    fl.add_argument("--probe-interval", type=float, default=0.25,
+                    metavar="SECONDS",
+                    help="health-check cadence (liveness + /readyz)")
+    fl.add_argument("--platform", default=None,
+                    help="force a JAX platform in every worker (cpu/tpu)")
+    fl.add_argument("--verbose", "-v", action="store_true")
+
     cl = sub.add_parser(
         "client",
         help="talk to a running gateway: submit boards, poll, fetch "
@@ -258,9 +312,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="summarize a metrics JSONL file (run or serve): throughput "
         "aggregates, histogram quantiles, occupancy, rejection rate",
     )
-    st.add_argument("metrics_file", metavar="JSONL",
-                    help="sink written by `run --metrics-file` or "
-                    "`serve --metrics-file`")
+    st.add_argument("metrics_file", metavar="JSONL", nargs="+",
+                    help="sink(s) written by `run --metrics-file`, `serve "
+                    "--metrics-file`, or a fleet's per-worker sinks — "
+                    "multiple files merge keyed by run_id into one report")
     st.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead of "
                     "the human table")
@@ -488,6 +543,10 @@ def main(argv: list[str] | None = None) -> int:
         # pure HTTP: the gateway owns the devices, the client only needs
         # numpy + urllib — runs anywhere, no watchdog, no jax
         return _client(parser, args)
+    if args.command == "fleet":
+        # the front tier is stdlib plumbing: only the worker SUBPROCESSES
+        # touch jax, so the supervisor/router process needs no watchdog
+        return _fleet(args)
 
     from tpu_life.utils.platform import devices_with_watchdog, ensure_platform
 
@@ -775,7 +834,9 @@ def _stats(args) -> int:
 
     from tpu_life.obs import stats as obs_stats
 
-    records = obs_stats.load_records(args.metrics_file)
+    records = []
+    for path in args.metrics_file:
+        records.extend(obs_stats.load_records(path))
     summary = obs_stats.summarize(records)
     if args.json:
         print(json.dumps(summary))
@@ -1066,6 +1127,101 @@ def _gateway(args) -> int:
         flush=True,
     )
     return 1 if gw.pump_error else 0
+
+
+def _fleet(args) -> int:
+    """The horizontally scaled front tier (docs/FLEET.md): supervise N
+    gateway workers, route sessions across them, and drain the whole
+    fleet gracefully on SIGTERM/SIGINT.
+
+    Prints one JSON line at startup (router URL + fleet run_id, so
+    scripts can wait for readiness via ``/readyz``) and one summary line
+    after the drain.  Exit 0 on a clean drain; 1 if any worker ended with
+    its circuit breaker open (a crash-looping worker is a failure even
+    when the drain itself was tidy).
+    """
+    import json
+
+    from tpu_life.fleet import Fleet, FleetConfig, WorkerState
+    from tpu_life.runtime.metrics import configure_logging
+
+    configure_logging(args.verbose)
+    worker_args = [
+        "--capacity", str(args.capacity),
+        "--chunk-steps", str(args.chunk_steps),
+        "--max-queue", str(args.max_queue),
+        "--serve-backend", args.serve_backend,
+        "--api-rate", str(args.api_rate),
+        "--api-burst", str(args.api_burst),
+    ]
+    if args.timeout is not None:
+        worker_args += ["--timeout", str(args.timeout)]
+    if args.platform is not None:
+        worker_args += ["--platform", args.platform]
+    if args.verbose:
+        worker_args += ["--verbose"]
+    fleet = Fleet(
+        FleetConfig(
+            workers=args.workers,
+            host=args.host,
+            port=args.port,
+            worker_args=tuple(worker_args),
+            metrics_dir=args.metrics_dir,
+            log_dir=args.log_dir,
+            probe_interval_s=args.probe_interval,
+            backoff_base_s=args.restart_backoff,
+            # the flag counts RESTARTS; the breaker counts consecutive
+            # failures, of which the initial crash is the first — so N
+            # permitted restarts means the breaker opens on failure N+1
+            breaker_threshold=args.max_restarts + 1,
+        )
+    )
+    fleet.install_signal_handlers()
+    fleet.start()
+    print(
+        json.dumps(
+            {
+                "mode": "fleet",
+                "url": f"http://{fleet.host}:{fleet.port}",
+                "run_id": fleet.run_id,
+                "workers": args.workers,
+                "backend": args.serve_backend,
+                "capacity": args.capacity,
+                "max_queue": args.max_queue,
+                "log_dir": str(fleet.supervisor.log_dir),
+            }
+        ),
+        flush=True,
+    )
+    try:
+        fleet.wait()
+    finally:
+        fleet.close()
+    stats = fleet.stats()
+    failed = [
+        name
+        for name, state in stats["workers"].items()
+        if state == WorkerState.FAILED.value
+    ]
+    print(
+        json.dumps(
+            {
+                "mode": "fleet",
+                "run_id": stats["run_id"],
+                "workers": stats["workers"],
+                "generations": stats["generations"],
+                "restarts": stats["restarts"],
+                "routed": stats["routed"],
+                "retries": stats["retries"],
+                "sessions_pinned": stats["sessions_pinned"],
+                # a breaker-open worker is a real failure even though the
+                # drain machinery shut everything down tidily — exit 1
+                "failed_workers": failed,
+            }
+        ),
+        flush=True,
+    )
+    return 1 if failed else 0
 
 
 def _client(parser, args) -> int:

@@ -1,0 +1,125 @@
+"""tpu_life.fleet — multi-worker router with supervision and failover.
+
+The horizontal-scale tier (docs/FLEET.md): a :class:`Supervisor` keeps N
+``tpu-life gateway`` worker subprocesses alive (readyz health checks,
+exponential-backoff restarts, a circuit breaker for crash loops) while a
+:class:`Router` speaks the exact gateway HTTP protocol to clients and
+routes each session to the least-loaded worker, pinning sid -> worker in
+a :class:`SessionRegistry` so polls and results land on the right
+backend.  One worker dying takes out only its own in-flight sessions
+(typed ``worker_lost`` errors); everything else keeps completing, and the
+restarted worker rejoins the rotation.
+
+:class:`Fleet` wires the pieces together and owns the drain choreography:
+SIGTERM -> the router stops admitting, every worker drains gracefully,
+processes are reaped, and the CLI exits 0.
+
+Total capacity is ``workers x per-worker batch capacity``; the ROADMAP's
+"heavy traffic" story is this tier stamped out behind a real load
+balancer.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpu_life import obs
+from tpu_life.fleet.balancer import LeastDepthBalancer
+from tpu_life.fleet.registry import SessionRegistry
+from tpu_life.fleet.router import Router, merge_prom_texts
+from tpu_life.fleet.supervisor import (
+    FleetConfig,
+    Supervisor,
+    Worker,
+    WorkerState,
+    propagate_signals,
+)
+from tpu_life.runtime.metrics import log
+
+
+class Fleet:
+    """The assembled tier: supervisor + router + session registry, on one
+    shared metrics registry (``fleet_workers`` / ``fleet_restarts_total``
+    / ``fleet_routed_total`` / ``fleet_retry_total``)."""
+
+    def __init__(self, config: FleetConfig | None = None):
+        self.config = config or FleetConfig()
+        self.run_id = obs.new_run_id()
+        self.registry = obs.MetricsRegistry()
+        self.supervisor = Supervisor(self.config, self.registry)
+        self.sessions = SessionRegistry(self.config.max_pins)
+        self.router = Router(
+            self.config, self.supervisor, self.sessions, self.registry
+        )
+        self.host, self.port = self.router.host, self.router.port
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.supervisor.start()
+        self.router.start()
+        log.info(
+            "fleet: %d workers behind http://%s:%d (run_id=%s)",
+            self.config.workers,
+            self.host,
+            self.port,
+            self.run_id,
+        )
+
+    def wait_ready(self, timeout: float = 60.0, min_workers: int = 1) -> bool:
+        """Block until at least ``min_workers`` workers answer ready."""
+        deadline = time.monotonic() + timeout
+        while len(self.supervisor.ready_workers()) < min_workers:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.05)
+        return True
+
+    def begin_drain(self) -> None:
+        """Fleet-wide graceful drain: stop admitting at the router, then
+        SIGTERM every worker (each finishes in-flight sessions, exits 0).
+        Idempotent; block on :meth:`wait`."""
+        self.router.begin_drain()
+        self.supervisor.begin_drain()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the drain completed and every worker is reaped.
+        The router keeps forwarding polls/results while workers finish."""
+        return self.supervisor.wait(timeout)
+
+    def close(self) -> None:
+        self.router.close()
+        self.supervisor.close()
+
+    def install_signal_handlers(self) -> None:
+        propagate_signals(self.begin_drain)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        routed = {
+            labels["worker"]: inst.value
+            for labels, inst in self.registry.counter(
+                "fleet_routed_total", labels=("worker",)
+            ).series()
+        }
+        return {
+            "run_id": self.run_id,
+            "workers": self.supervisor.states(),
+            "generations": {w.name: w.generation for w in self.supervisor.workers},
+            "restarts": self.supervisor.restarts(),
+            "routed": routed,
+            "retries": self.registry.counter("fleet_retry_total").value,
+            "sessions_pinned": len(self.sessions),
+        }
+
+
+__all__ = [
+    "Fleet",
+    "FleetConfig",
+    "LeastDepthBalancer",
+    "Router",
+    "SessionRegistry",
+    "Supervisor",
+    "Worker",
+    "WorkerState",
+    "merge_prom_texts",
+]

@@ -1,0 +1,130 @@
+"""Worker choice: least queue depth, scraped from each worker's /metrics.
+
+The routing signal is the same one the gateway's own load shedder uses —
+the ``serve_queue_depth`` gauge the service updates every scheduling
+round — read over HTTP from the worker's live ``/metrics`` endpoint.
+Depth readings are cached with a short TTL: one scrape per worker per TTL
+window bounds the metrics traffic no matter the submit rate, at the cost
+of routing on a slightly stale signal (the router's refusal-retry and the
+worker's own shed valve catch what staleness misses).
+
+Equal depths tie-break by rotation so an idle fleet spreads sessions
+round-robin instead of piling onto the first worker until the cache
+expires.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+#: Depth assigned to a worker whose metrics could not be scraped — sorts
+#: last, but stays a candidate (the submit-path retry skips it if dead).
+UNKNOWN_DEPTH = float("inf")
+
+
+def prom_value(text: str, name: str) -> float | None:
+    """First sample value of an (unlabeled) metric in Prometheus text."""
+    for line in text.splitlines():
+        if line.startswith(name):
+            rest = line[len(name) :]
+            if rest.startswith(" "):
+                try:
+                    return float(rest.strip())
+                except ValueError:
+                    return None
+    return None
+
+
+class LeastDepthBalancer:
+    """Order candidate workers by cached queue depth, ties rotated.
+
+    ``fetch`` takes a worker and returns its current queue depth (raising
+    on failure); the router wires it to a ``/metrics`` scrape.  The cache
+    is keyed by (worker name, generation) so a restarted worker never
+    inherits its predecessor's reading.
+    """
+
+    def __init__(self, fetch, ttl_s: float = 0.5, *, clock=time.monotonic):
+        self.fetch = fetch
+        self.ttl_s = ttl_s
+        self.clock = clock
+        self._cache: dict[tuple[str, int], tuple[float, float]] = {}
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def depth(self, worker) -> float:
+        """The worker's queue depth (cached within the TTL)."""
+        return self.depths([worker])[worker.name]
+
+    def depths(self, workers: list) -> dict:
+        """name -> depth for all ``workers``, scraping STALE entries
+        concurrently: a submit that lands on a cold cache must pay the
+        slowest single scrape, not the sum of them (one wedged worker
+        burning its timeout would otherwise stall every admission for a
+        whole TTL window)."""
+        now = self.clock()
+        out: dict = {}
+        stale: list = []
+        with self._lock:
+            for w in workers:
+                hit = self._cache.get((w.name, w.generation))
+                if hit is not None and now - hit[0] < self.ttl_s:
+                    out[w.name] = hit[1]
+                else:
+                    stale.append(w)
+        if stale:
+            values: list = [None] * len(stale)
+
+            def one(i: int, w) -> None:
+                try:
+                    values[i] = float(self.fetch(w))
+                except Exception:
+                    values[i] = UNKNOWN_DEPTH
+
+            if len(stale) == 1:
+                one(0, stale[0])
+            else:
+                threads = [
+                    threading.Thread(target=one, args=(i, w), daemon=True)
+                    for i, w in enumerate(stale)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()  # bounded: fetch carries its own HTTP timeout
+            with self._lock:
+                for w, d in zip(stale, values):
+                    key = (w.name, w.generation)
+                    # drop readings from this worker's dead generations:
+                    # restarts are unbounded over a router's lifetime, and
+                    # a per-restart orphan entry would be a slow leak
+                    for k in [
+                        k for k in self._cache if k[0] == w.name and k != key
+                    ]:
+                        del self._cache[k]
+                    self._cache[key] = (now, d)
+                    out[w.name] = d
+        return out
+
+    def candidates(self, workers: list) -> list:
+        """Workers ordered least-depth-first; equal depths rotate so an
+        idle fleet round-robins instead of always hitting index 0."""
+        if not workers:
+            return []
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        n = len(workers)
+        depths = self.depths(workers)
+        keyed = [
+            (depths[w.name], (i - rr) % n, w) for i, w in enumerate(workers)
+        ]
+        keyed.sort(key=lambda t: (t[0], t[1]))
+        return [w for _, _, w in keyed]
+
+    def invalidate(self, worker) -> None:
+        """Drop a worker's cached reading (e.g. right after routing to it,
+        or after it refused — the next choice should re-scrape)."""
+        with self._lock:
+            self._cache.pop((worker.name, worker.generation), None)

@@ -1,0 +1,65 @@
+"""Fleet-level typed errors — the router's additions to the gateway contract.
+
+The router speaks the exact gateway error envelope
+(:class:`tpu_life.gateway.errors.ApiError`), so an unmodified
+``GatewayClient`` sees fleet failures as the same typed JSON it already
+handles.  The fleet adds three failure modes a single gateway cannot have:
+
+- ``worker_lost`` (410): the worker holding a pinned session died (crash,
+  SIGKILL, restart).  Terminal and never retried — the session's state is
+  gone with the process, exactly like a single gateway's
+  ``session_failed``.
+- ``fleet_unavailable`` (503): every worker refused the submission
+  (shedding, queue-full, or draining).  Retryable with ``Retry-After`` —
+  the fleet-wide twin of a single gateway's ``overloaded``.
+- ``upstream_error`` (502): a worker failed *mid-exchange* (timeout,
+  reset) so the request may have been processed.  NOT retried by the
+  router — re-forwarding a submit that may already have created a session
+  would silently duplicate it (the same no-duplicate rule the PR 4 client
+  applies to its own retries).
+"""
+
+from __future__ import annotations
+
+from tpu_life.gateway.errors import ApiError
+
+
+def worker_lost(worker: str, sid: str) -> ApiError:
+    return ApiError(
+        410,
+        "worker_lost",
+        f"session {sid} was pinned to worker {worker}, which is gone; "
+        f"its in-flight state is lost — resubmit to start over",
+    )
+
+
+def fleet_unavailable(tried: int, retry_after: float = 1.0) -> ApiError:
+    return ApiError(
+        503,
+        "fleet_unavailable",
+        f"all {tried} ready workers refused the submission (shedding or "
+        f"draining); the fleet is protecting in-flight sessions",
+        retry_after=retry_after,
+    )
+
+
+def no_ready_workers(total: int) -> ApiError:
+    return ApiError(
+        503,
+        "fleet_unavailable",
+        f"no ready workers ({total} supervised); retry shortly",
+        retry_after=1.0,
+    )
+
+
+def upstream_error(worker: str, detail: str) -> ApiError:
+    return ApiError(
+        502,
+        "upstream_error",
+        f"worker {worker} failed mid-request ({detail}); the request may "
+        f"or may not have been processed — not retried to avoid duplicates",
+    )
+
+
+def unknown_session(sid: str) -> ApiError:
+    return ApiError(404, "unknown_session", f"no session {sid!r} in this fleet")

@@ -1,0 +1,89 @@
+"""Session pinning: which worker (and which incarnation of it) owns a sid.
+
+Worker gateways mint session ids independently (every worker starts at
+``s000000``, and a RESTARTED worker starts at ``s000000`` again), so the
+fleet id namespaces them by worker *and generation*: ``w1g2-s000042`` is
+session ``s000042`` on the second incarnation of worker ``w1``.  Baking
+the generation into the id is load-bearing: a pin into a dead generation
+must resolve to a typed ``worker_lost``, never to the (identically
+numbered) session the successor process mints — and a sid that merely
+namespaced the worker name would be silently re-pinned onto the new
+generation's session the moment the restarted worker reused it.
+
+Pins are LRU-capped so a long-lived router cannot grow memory without
+bound; an evicted pin degrades gracefully — the fleet sid encodes the
+full pin, so resolution falls back to parsing it.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+#: Default cap on live pins (sessions the router can route back to).
+MAX_PINS = 100_000
+
+_FLEET_SID = re.compile(r"(?P<worker>w\d+)g(?P<gen>\d+)-(?P<sid>.+)")
+
+
+@dataclass(frozen=True)
+class Pin:
+    worker: str  # worker name, e.g. "w0"
+    generation: int  # worker incarnation at submit time
+    sid: str  # the worker's own session id
+
+
+def fleet_sid(worker: str, generation: int, sid: str) -> str:
+    return f"{worker}g{generation}-{sid}"
+
+
+def parse_fleet_sid(fsid: str) -> Pin | None:
+    """Recover the pin from the sid itself — the fallback when an LRU-
+    evicted pin comes back (the encoding carries the whole pin)."""
+    m = _FLEET_SID.fullmatch(fsid)
+    if m is None:
+        return None
+    return Pin(
+        worker=m.group("worker"),
+        generation=int(m.group("gen")),
+        sid=m.group("sid"),
+    )
+
+
+class SessionRegistry:
+    """Thread-safe fleet-sid -> :class:`Pin` map with LRU eviction."""
+
+    def __init__(self, max_pins: int = MAX_PINS):
+        self.max_pins = max_pins
+        self._pins: OrderedDict[str, Pin] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def pin(self, worker: str, generation: int, sid: str) -> str:
+        """Record the mapping; returns the fleet sid clients will use."""
+        fsid = fleet_sid(worker, generation, sid)
+        with self._lock:
+            self._pins[fsid] = Pin(worker=worker, generation=generation, sid=sid)
+            self._pins.move_to_end(fsid)
+            while len(self._pins) > self.max_pins:
+                self._pins.popitem(last=False)
+        return fsid
+
+    def resolve(self, fsid: str) -> Pin | None:
+        """The pin for a fleet sid; falls back to prefix parsing when the
+        pin was LRU-evicted.  None = not a fleet sid at all (404)."""
+        with self._lock:
+            pin = self._pins.get(fsid)
+            if pin is not None:
+                self._pins.move_to_end(fsid)
+                return pin
+        return parse_fleet_sid(fsid)
+
+    def forget(self, fsid: str) -> None:
+        with self._lock:
+            self._pins.pop(fsid, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pins)

@@ -1,0 +1,525 @@
+"""The fleet router: one HTTP front speaking the gateway protocol for N
+workers.
+
+Clients talk to the router exactly as they would to a single gateway —
+the unmodified ``GatewayClient`` works against it — and the router
+forwards:
+
+- ``POST /v1/sessions``: pick a worker (least queue depth, TTL-cached
+  ``/metrics`` scrape, ties rotated), forward the body verbatim, pin the
+  returned sid in the session registry, and answer with the namespaced
+  fleet sid (``w1g2-s000042`` — worker, generation, worker's own sid).  A worker that *refuses* — connection
+  refused (the request was never seen) or a typed 503 (shedding /
+  queue-full / draining: the session was definitively not created) — is
+  retried on the next candidate.  A worker that fails *mid-exchange*
+  (timeout, reset) is NOT retried: the session may exist, and
+  re-forwarding would silently duplicate it (the PR 4 client's own
+  no-duplicate-session rule, applied server-side).  503
+  ``fleet_unavailable`` only when every candidate refused.
+- ``GET/DELETE /v1/sessions/{fleet-sid}[...]``: resolve the pin and hit
+  the exact worker generation that owns the session; a pin into a dead
+  worker or a stale generation is a typed 410 ``worker_lost``.
+
+Fleet endpoints aggregate the tier: ``/healthz`` (router liveness +
+worker states), ``/readyz`` (503 unless ≥1 worker is ready), and
+``/metrics`` (the fleet's own families plus every live worker's registry,
+merged with a ``worker`` label so per-worker series never collide).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+from urllib.parse import urlsplit
+
+from tpu_life.fleet import errors as fl_errors
+from tpu_life.fleet.balancer import LeastDepthBalancer, prom_value
+from tpu_life.fleet.registry import SessionRegistry
+from tpu_life.fleet.supervisor import FleetConfig, Supervisor, Worker, WorkerState
+from tpu_life.gateway import errors as gw_errors
+from tpu_life.gateway.errors import ApiError, parse_retry_after
+from tpu_life.gateway.server import ROUTE_SESSIONS, JsonHandler
+from tpu_life.runtime.metrics import log
+from tpu_life.version import __version__
+
+#: Worker 503 codes that mean "definitively not admitted" — safe to retry
+#: the submission on the next candidate without risking a duplicate.
+REFUSAL_CODES = frozenset({"overloaded", "queue_full", "draining"})
+
+
+class WorkerUnreachable(Exception):
+    """Transport-level forward failure; ``refused`` means the connection
+    was refused outright (the worker never saw the request)."""
+
+    def __init__(self, worker: Worker, refused: bool, cause: Exception):
+        super().__init__(f"{worker.name}: {cause}")
+        self.worker = worker
+        self.refused = refused
+        self.cause = cause
+
+
+class Router:
+    """Owns the HTTP listener, the balancer, and the session pins."""
+
+    def __init__(
+        self,
+        config: FleetConfig,
+        supervisor: Supervisor,
+        sessions: SessionRegistry,
+        registry,
+    ):
+        self.config = config
+        self.supervisor = supervisor
+        self.sessions = sessions
+        self.balancer = LeastDepthBalancer(
+            self._fetch_depth, ttl_s=config.depth_ttl_s
+        )
+        self._c_routed = registry.counter(
+            "fleet_routed_total", "sessions routed, by worker", labels=("worker",)
+        )
+        self._c_retry = registry.counter(
+            "fleet_retry_total",
+            "submissions retried on another worker after a refusal",
+        )
+        self._c_retry.labels()
+        self.registry = registry
+        self._server = _RouterHTTPServer((config.host, config.port), _Handler)
+        self._server.router = self
+        self.host, self.port = self._server.server_address[:2]
+        self._serve_thread: threading.Thread | None = None
+        self._draining = False
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="fleet-router",
+            daemon=True,
+        )
+        self._serve_thread.start()
+        log.info("fleet: router listening on http://%s:%d", self.host, self.port)
+
+    def begin_drain(self) -> None:
+        """Stop admitting (``/readyz`` -> 503, submits -> 503 draining);
+        poll/result/cancel keep forwarding while workers finish."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._serve_thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+
+    # -- worker I/O --------------------------------------------------------
+    def _fetch_depth(self, worker: Worker) -> float:
+        text = self._fetch_text(worker, "/metrics", timeout=2.0)
+        v = prom_value(text, "serve_queue_depth")
+        if v is None:
+            raise ValueError(f"{worker.name}: no serve_queue_depth sample")
+        return v
+
+    def _fetch_text(self, worker: Worker, path: str, timeout: float) -> str:
+        req = urllib.request.Request(worker.url + path)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode()
+
+    def forward(
+        self,
+        worker: Worker,
+        method: str,
+        path: str,
+        *,
+        body: bytes | None = None,
+        api_key: str | None = None,
+    ) -> tuple[int, float | None, dict]:
+        """One proxied request; returns (status, retry_after, json body).
+        HTTP error statuses return normally (they are protocol answers to
+        relay); transport failures raise :class:`WorkerUnreachable`."""
+        if worker.url is None:
+            raise WorkerUnreachable(
+                worker, True, ConnectionRefusedError("worker has no bound URL")
+            )
+        req = urllib.request.Request(worker.url + path, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if api_key is not None:
+            req.add_header("X-API-Key", api_key)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.config.forward_timeout_s
+            ) as resp:
+                return resp.status, None, _json_body(resp)
+        except urllib.error.HTTPError as e:
+            return e.code, parse_retry_after(e.headers), _json_body(e)
+        except (urllib.error.URLError, ConnectionError, socket.timeout, TimeoutError) as e:
+            reason = getattr(e, "reason", e)
+            refused = isinstance(reason, ConnectionRefusedError) or isinstance(
+                e, ConnectionRefusedError
+            )
+            raise WorkerUnreachable(worker, refused, e) from None
+
+    # -- routing -----------------------------------------------------------
+    def route_submit(
+        self, body: bytes, api_key: str | None
+    ) -> tuple[int, float | None, dict]:
+        """The submit pipeline: candidates by least depth, refusal-only
+        retry, pin on 201.  Returns (status, retry_after, response doc)."""
+        if self._draining:
+            raise ApiError(
+                503,
+                "draining",
+                "the fleet is draining: no new sessions are admitted",
+                retry_after=1.0,
+            )
+        ready = self.supervisor.ready_workers()
+        if not ready:
+            raise fl_errors.no_ready_workers(len(self.supervisor.workers))
+        hint = 1.0
+        for i, worker in enumerate(self.balancer.candidates(ready)):
+            if i > 0:
+                self._c_retry.inc()
+            # capture the generation BEFORE the round-trip: if the worker
+            # crashes and respawns mid-forward, pinning the (dead) session
+            # under the successor's generation would hand its sid numbers
+            # to the wrong tenant — the exact confusion the generation
+            # namespace exists to prevent
+            generation = worker.generation
+            try:
+                status, retry_after, doc = self.forward(
+                    worker, "POST", ROUTE_SESSIONS, body=body, api_key=api_key
+                )
+            except WorkerUnreachable as e:
+                if e.refused or not worker.alive:
+                    # refused = the worker never saw the request; dead = even
+                    # if it did, the session died with the process and can
+                    # never be observed — either way the next candidate
+                    # cannot produce a duplicate.  Only a mid-exchange
+                    # failure on a LIVE worker is ambiguous (502 below).
+                    log.warning(
+                        "fleet: %s unreachable on submit; trying next", worker.name
+                    )
+                    self.balancer.invalidate(worker)
+                    continue
+                raise fl_errors.upstream_error(worker.name, str(e.cause)) from None
+            if status == 201:
+                sid = doc.get("session")
+                if isinstance(sid, str):
+                    doc["session"] = self.sessions.pin(
+                        worker.name, generation, sid
+                    )
+                doc["worker"] = worker.name
+                self._c_routed.labels(worker=worker.name).inc()
+                # this worker's queue just grew: re-scrape before routing
+                # the next submit rather than trusting the stale reading
+                self.balancer.invalidate(worker)
+                return status, None, doc
+            if status == 503 and _error_code(doc) in REFUSAL_CODES:
+                # a definitive refusal — the session was not created
+                log.info(
+                    "fleet: %s refused submit (%s); trying next",
+                    worker.name,
+                    _error_code(doc),
+                )
+                self.balancer.invalidate(worker)
+                if retry_after:
+                    hint = max(hint, retry_after)
+                continue
+            # any other answer (400/413/429/...) is the worker speaking the
+            # protocol: relay it verbatim — retrying a deterministic 400 on
+            # another worker would just fail N times instead of once
+            doc.setdefault("worker", worker.name)
+            return status, retry_after, doc
+        raise fl_errors.fleet_unavailable(len(ready), retry_after=hint)
+
+    def resolve(self, fsid: str) -> tuple[Worker, str]:
+        """Fleet sid -> (live worker of the pinned generation, worker sid);
+        typed 404/410 otherwise."""
+        pin = self.sessions.resolve(fsid)
+        if pin is None:
+            raise fl_errors.unknown_session(fsid)
+        worker = self.supervisor.get(pin.worker)
+        if worker is None:
+            raise fl_errors.unknown_session(fsid)
+        if worker.generation != pin.generation:
+            # the owning process died and was replaced; its sessions died
+            # with it (the successor mints the same sid NUMBERS for new
+            # tenants — the generation in the pin is what keeps them apart)
+            raise fl_errors.worker_lost(worker.name, fsid)
+        if not worker.alive or worker.state in (WorkerState.DOWN, WorkerState.FAILED):
+            raise fl_errors.worker_lost(worker.name, fsid)
+        return worker, pin.sid
+
+    def route_pinned(
+        self, method: str, fsid: str, tail: str, api_key: str | None
+    ) -> tuple[int, float | None, dict]:
+        worker, sid = self.resolve(fsid)
+        try:
+            status, retry_after, doc = self.forward(
+                worker, method, f"{ROUTE_SESSIONS}/{sid}{tail}", api_key=api_key
+            )
+        except WorkerUnreachable as e:
+            if e.refused or not worker.alive:
+                # no listener on the pinned port, or the process itself is
+                # dead (a freshly SIGKILLed worker answers with a reset
+                # before the supervisor reaps it): either way the session's
+                # state died with the process — typed-terminal, not a 502.
+                # A restart binds a fresh ephemeral port, so this can never
+                # reach the successor generation by accident.
+                raise fl_errors.worker_lost(worker.name, fsid) from None
+            raise fl_errors.upstream_error(worker.name, str(e.cause)) from None
+        if isinstance(doc.get("session"), str):
+            doc["session"] = fsid
+        doc["worker"] = worker.name
+        return status, retry_after, doc
+
+    # -- fleet endpoints ---------------------------------------------------
+    def merged_metrics(self) -> str:
+        """The fleet registry plus every reachable worker's registry, each
+        worker's samples tagged ``worker="<name>"``.  Workers are scraped
+        CONCURRENTLY: the endpoint's latency is the slowest single scrape,
+        so one wedged worker burning its timeout cannot push the whole
+        fleet's exposition past a scraper's deadline."""
+        workers = [
+            w for w in self.supervisor.workers if w.url is not None and w.alive
+        ]
+        texts: list[str | None] = [None] * len(workers)
+
+        def scrape(i: int, w: Worker) -> None:
+            try:
+                texts[i] = self._fetch_text(w, "/metrics", timeout=2.0)
+            except Exception:
+                log.debug("fleet: metrics scrape of %s failed", w.name)
+
+        threads = [
+            threading.Thread(target=scrape, args=(i, w), daemon=True)
+            for i, w in enumerate(workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sources: list[tuple[str | None, str]] = [
+            (None, self.registry.prom_text())
+        ]
+        sources += [
+            (w.name, text) for w, text in zip(workers, texts) if text is not None
+        ]
+        return merge_prom_texts(sources)
+
+
+class _RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    router: Router  # attached right after construction
+
+
+class _Handler(JsonHandler):
+    server_version = f"tpu-life-fleet/{__version__}"
+    log_tag = "fleet"
+
+    @property
+    def rt(self) -> Router:
+        return self.server.router  # type: ignore[attr-defined]
+
+    def _read_body(self) -> bytes:
+        """The raw request body, bounded — the router forwards it verbatim
+        (workers own the JSON validation), but the byte bound is admission
+        control and belongs at the front."""
+        return self._read_sized_body(self.rt.config.max_body)
+
+    # -- dispatch ----------------------------------------------------------
+    def do_GET(self):  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def do_DELETE(self):  # noqa: N802
+        self._dispatch("DELETE")
+
+    def _dispatch(self, method: str) -> None:
+        parts = urlsplit(self.path)
+        path = parts.path.rstrip("/") or "/"
+        try:
+            self._route(method, path, parts.query)
+        except ApiError as e:
+            try:
+                body = e.body()
+                body["fleet"] = True  # who answered: the router, not a worker
+                self._send_json(e.status, body, retry_after=e.retry_after)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("fleet: %s %s failed", method, path)
+            try:
+                self._send_json(
+                    500,
+                    {"error": {"code": "internal", "message": "internal error"}},
+                )
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+    def _route(self, method: str, path: str, query: str) -> None:
+        rt = self.rt
+        api_key = self.headers.get("X-API-Key")
+        if path == "/healthz":
+            self._require(method, "GET", path)
+            self._send_json(
+                200, {"status": "ok", "workers": rt.supervisor.states()}
+            )
+            return
+        if path == "/readyz":
+            self._require(method, "GET", path)
+            ready = rt.supervisor.ready_workers()
+            if rt.draining or not ready:
+                code = "draining" if rt.draining else "no_ready_workers"
+                self._send_json(
+                    503,
+                    {
+                        "ready": False,
+                        "draining": rt.draining,
+                        "workers_ready": len(ready),
+                        "error": {"code": code, "message": f"fleet is {code}"},
+                    },
+                    retry_after=1.0,
+                )
+            else:
+                self._send_json(
+                    200,
+                    {
+                        "ready": True,
+                        "draining": False,
+                        "workers_ready": len(ready),
+                    },
+                )
+            return
+        if path == "/metrics":
+            self._require(method, "GET", path)
+            self._send_text(200, rt.merged_metrics(), "text/plain; version=0.0.4")
+            return
+        if path == ROUTE_SESSIONS:
+            self._require(method, "POST", path)
+            body = self._read_body()
+            status, retry_after, doc = rt.route_submit(body, api_key)
+            self._send_json(status, doc, retry_after=retry_after)
+            return
+        if path.startswith(ROUTE_SESSIONS + "/"):
+            rest = path[len(ROUTE_SESSIONS) + 1 :]
+            if "/" not in rest:
+                if method not in ("GET", "DELETE"):
+                    raise gw_errors.method_not_allowed(method, path)
+                status, retry_after, doc = rt.route_pinned(method, rest, "", api_key)
+                self._send_json(status, doc, retry_after=retry_after)
+                return
+            fsid, _, tail = rest.partition("/")
+            if tail == "result":
+                self._require(method, "GET", path)
+                suffix = "/result" + (f"?{query}" if query else "")
+                status, retry_after, doc = rt.route_pinned(
+                    method, fsid, suffix, api_key
+                )
+                self._send_json(status, doc, retry_after=retry_after)
+                return
+        raise gw_errors.not_found(f"no route for {path}")
+
+    def _require(self, method: str, expected: str, path: str) -> None:
+        if method != expected:
+            raise gw_errors.method_not_allowed(method, path)
+
+
+# -- prometheus merging ------------------------------------------------------
+def merge_prom_texts(sources: list[tuple[str | None, str]]) -> str:
+    """Merge Prometheus text expositions into one valid document.
+
+    ``sources`` is ``[(worker_label, text), ...]``; every sample from a
+    labeled source gains ``worker="<label>"`` (a ``None`` label — the
+    fleet's own registry — passes through untouched).  Samples are
+    regrouped by family so each family appears once, under one ``# TYPE``
+    line, with all workers' series contiguous — the exposition-format
+    contract a real scraper enforces.
+    """
+    fams: dict[str, dict] = {}
+
+    def fam_entry(name: str) -> dict:
+        return fams.setdefault(
+            name, {"help": None, "type": None, "samples": []}
+        )
+
+    def family_of(sample: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample.endswith(suffix) and sample[: -len(suffix)] in fams:
+                return sample[: -len(suffix)]
+        return sample
+
+    for label, text in sources:
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP "):
+                parts = line.split(" ", 3)
+                entry = fam_entry(parts[2])
+                if entry["help"] is None and len(parts) > 3:
+                    entry["help"] = parts[3]
+            elif line.startswith("# TYPE "):
+                parts = line.split(" ", 3)
+                entry = fam_entry(parts[2])
+                if entry["type"] is None and len(parts) > 3:
+                    entry["type"] = parts[3]
+            elif line.startswith("#"):
+                continue
+            else:
+                head, _, value = line.rpartition(" ")
+                if not head:
+                    continue
+                brace = head.find("{")
+                if brace >= 0:
+                    name, labelpart = head[:brace], head[brace + 1 : -1]
+                else:
+                    name, labelpart = head, ""
+                if label is not None:
+                    worker_label = f'worker="{label}"'
+                    labelpart = (
+                        f"{worker_label},{labelpart}" if labelpart else worker_label
+                    )
+                fam_entry(family_of(name))["samples"].append(
+                    (name, labelpart, value)
+                )
+    lines: list[str] = []
+    for fam, entry in fams.items():
+        if not entry["samples"]:
+            continue
+        if entry["help"] is not None:
+            lines.append(f"# HELP {fam} {entry['help']}")
+        if entry["type"] is not None:
+            lines.append(f"# TYPE {fam} {entry['type']}")
+        for name, labelpart, value in entry["samples"]:
+            series = f"{name}{{{labelpart}}}" if labelpart else name
+            lines.append(f"{series} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _json_body(resp) -> dict:
+    try:
+        doc = json.loads(resp.read() or b"{}")
+        return doc if isinstance(doc, dict) else {"value": doc}
+    except (json.JSONDecodeError, OSError):
+        return {}
+
+
+def _error_code(doc: dict) -> str | None:
+    err = doc.get("error")
+    return err.get("code") if isinstance(err, dict) else None

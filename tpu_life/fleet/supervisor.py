@@ -1,0 +1,512 @@
+"""Worker supervision: spawn, health-check, restart, breaker, drain.
+
+The supervisor owns N gateway worker *subprocesses* (``tpu-life gateway``
+on distinct ephemeral ports — every worker binds port 0 and the bound
+port is read back from its startup JSON line, so no port can collide
+under parallel CI).  A monitor thread ticks every ``probe_interval_s``:
+
+- **liveness**: ``proc.poll()`` — a dead process is a crash (unless the
+  fleet is draining, when exits are the goal);
+- **readiness**: ``GET /readyz`` — 200 is READY, 503 is DRAINING, and a
+  process that stays unreachable while alive past a threshold is wedged
+  and gets killed into the restart path;
+- **restart**: crashed workers respawn (a fresh generation, a fresh
+  port) after exponential backoff; a worker that keeps dying young —
+  ``breaker_threshold`` consecutive failures, each before
+  ``healthy_after_s`` of uptime — opens its circuit breaker and is marked
+  FAILED, never respawned (a config that crashes on boot must not turn
+  the supervisor into a fork bomb).  Surviving ``healthy_after_s`` resets
+  the count;
+- **drain**: ``begin_drain()`` forwards SIGTERM to every live worker —
+  each gateway finishes its in-flight sessions and exits 0 — and stops
+  restarting; ``drained()`` turns true once every process is reaped.
+
+Everything is injectable (``spawn``, ``probe``, ``clock``) so the restart
+and breaker logic unit-test with fake processes and a fake clock; the
+default implementations spawn real ``sys.executable -m tpu_life gateway``
+subprocesses and probe over real HTTP.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tpu_life.gateway import protocol
+from tpu_life.runtime.metrics import log
+
+
+class WorkerState(enum.Enum):
+    STARTING = "starting"  # spawned, startup line / first readyz pending
+    READY = "ready"  # /readyz answered 200 — in the routing rotation
+    DRAINING = "draining"  # /readyz answered 503 (worker-side drain)
+    DOWN = "down"  # process exited; restart scheduled (or drain done)
+    FAILED = "failed"  # circuit breaker open — never respawned
+
+
+@dataclass
+class FleetConfig:
+    workers: int = 2
+    host: str = "127.0.0.1"
+    port: int = 0  # router port; 0 = ephemeral (read Fleet.port back)
+    #: extra argv appended to ``gateway --host H --port 0`` for every worker
+    worker_args: tuple[str, ...] = ()
+    #: per-worker JSONL metrics sinks land at <metrics_dir>/<name>.jsonl
+    metrics_dir: str | None = None
+    #: per-worker stdout+stderr logs (default: a fresh temp dir)
+    log_dir: str | None = None
+    probe_interval_s: float = 0.25
+    startup_timeout_s: float = 30.0  # spawn -> startup line + first readyz
+    backoff_base_s: float = 0.5  # restart delay doubles from here
+    backoff_max_s: float = 10.0
+    breaker_threshold: int = 5  # consecutive fast failures -> FAILED
+    healthy_after_s: float = 5.0  # uptime that resets the failure count
+    unready_threshold: int = 20  # failed probes while alive -> kill+restart
+    depth_ttl_s: float = 0.5  # balancer metrics-scrape cache TTL
+    forward_timeout_s: float = 30.0  # router -> worker per-request bound
+    max_body: int = protocol.MAX_BODY  # router request-body bound (413)
+    max_pins: int = 100_000  # session-registry LRU cap
+
+
+@dataclass
+class Worker:
+    """One supervised gateway: process + bound URL + health state."""
+
+    name: str
+    log_path: Path
+    generation: int = 0
+    proc: subprocess.Popen | None = None
+    url: str | None = None
+    run_id: str | None = None
+    state: WorkerState = WorkerState.DOWN
+    started_at: float = 0.0
+    restart_at: float = 0.0
+    failures: int = 0  # consecutive fast failures (breaker input)
+    unready: int = 0  # consecutive failed probes while alive
+    log_offset: int = 0  # startup line scan starts here (per generation)
+    exit_codes: list[int] = field(default_factory=list)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Supervisor:
+    """Owns the workers and the monitor thread; exposes the routing view
+    (:meth:`ready_workers`) and the drain choreography."""
+
+    def __init__(
+        self,
+        config: FleetConfig,
+        registry,
+        *,
+        spawn=None,
+        probe=None,
+        clock=time.monotonic,
+    ):
+        self.config = config
+        self.clock = clock
+        self.spawn = spawn or self._default_spawn
+        self.probe = probe or self._default_probe
+        self._lock = threading.RLock()
+        self._draining = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        log_dir = Path(config.log_dir or tempfile.mkdtemp(prefix="tpu-life-fleet-"))
+        log_dir.mkdir(parents=True, exist_ok=True)
+        self.log_dir = log_dir
+        self.workers = [
+            Worker(name=f"w{i}", log_path=log_dir / f"w{i}.log")
+            for i in range(config.workers)
+        ]
+        self._g_workers = registry.gauge(
+            "fleet_workers", "supervised workers by state", labels=("state",)
+        )
+        self._c_restarts = registry.counter(
+            "fleet_restarts_total", "worker respawns after a crash"
+        )
+        self._c_restarts.labels()
+        for st in WorkerState:
+            self._g_workers.labels(state=st.value).set(0.0)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            for w in self.workers:
+                self._spawn_worker(w, first=True)
+            self._update_gauges()
+        self._thread = threading.Thread(
+            target=self._monitor, name="fleet-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def begin_drain(self) -> None:
+        """Fleet-wide graceful drain: SIGTERM every live worker (each
+        gateway finishes in-flight sessions and exits 0) and stop
+        restarting.  Idempotent — but a repeat call re-TERMs anything
+        still alive, so a signal that raced a worker spawn (or a second
+        SIGTERM from an impatient operator) is never silently dropped.
+        Callers block on :meth:`wait`."""
+        with self._lock:
+            first = not self._draining
+            self._draining = True
+            for w in self.workers:
+                if w.alive:
+                    if first:
+                        log.info("fleet: draining %s (pid %d)", w.name, w.proc.pid)
+                    w.proc.terminate()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drained(self) -> bool:
+        """True once every worker process is gone (reaped or never up)."""
+        with self._lock:
+            return all(not w.alive for w in self.workers)
+
+    def finished(self) -> bool:
+        """True when this supervisor will never run another worker: a
+        requested drain completed, OR every worker opened its circuit
+        breaker (a fleet that crash-loops to all-FAILED must surface as
+        exit 1, not hang serving 503s until someone signals it)."""
+        with self._lock:
+            if self.workers and all(
+                w.state is WorkerState.FAILED for w in self.workers
+            ):
+                return True
+            return self._draining and all(not w.alive for w in self.workers)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block (in signal-friendly slices) until :meth:`finished`."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self.finished():
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.05)
+        return True
+
+    def close(self) -> None:
+        """Stop the monitor and hard-kill anything still alive."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        with self._lock:
+            for w in self.workers:
+                if w.alive:
+                    w.proc.kill()
+            for w in self.workers:
+                if w.proc is not None:
+                    try:
+                        w.proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:  # pragma: no cover
+                        log.warning("fleet: %s did not die on SIGKILL", w.name)
+
+    # -- the routing view --------------------------------------------------
+    def ready_workers(self) -> list[Worker]:
+        # liveness-checked on read: a freshly dead worker leaves the
+        # rotation immediately, not at the monitor's next tick
+        with self._lock:
+            return [
+                w
+                for w in self.workers
+                if w.state is WorkerState.READY and w.alive
+            ]
+
+    def get(self, name: str) -> Worker | None:
+        for w in self.workers:
+            if w.name == name:
+                return w
+        return None
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            out = {}
+            for w in self.workers:
+                st = w.state
+                if st not in (WorkerState.DOWN, WorkerState.FAILED) and not w.alive:
+                    st = WorkerState.DOWN  # dead but not yet reaped by a tick
+                out[w.name] = st.value
+            return out
+
+    def restarts(self) -> float:
+        return self._c_restarts.value
+
+    # -- the monitor -------------------------------------------------------
+    def _monitor(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - monitor must not die
+                log.exception("fleet: monitor tick failed")
+            if self.finished():
+                # reaping is done (or every breaker opened); keep gauges
+                # truthful and stop ticking
+                with self._lock:
+                    self._update_gauges()
+                return
+            self._stop.wait(self.config.probe_interval_s)
+
+    def tick(self) -> None:
+        """One monitor pass (public so unit tests drive it directly).
+
+        Two phases around the lock: process lifecycle (exits, respawns,
+        startup-line reads — fast and local) runs under it; the ``/readyz``
+        HTTP probes (up to 1 s each against a wedged-but-alive worker) run
+        OUTSIDE it, so a slow worker can never stall the router's
+        ``ready_workers()`` / ``states()`` hot path for the probe's
+        duration.  Probe answers are re-validated against the generation
+        before applying — the world may have moved while we waited.
+        """
+        now = self.clock()
+        to_probe: list[tuple[Worker, int]] = []
+        with self._lock:
+            for w in self.workers:
+                if self._tick_liveness(w, now):
+                    to_probe.append((w, w.generation))
+            self._update_gauges()
+        if not to_probe:
+            return
+        results = self._probe_all(to_probe)
+        with self._lock:
+            for w, gen, status in results:
+                if (
+                    w.generation != gen
+                    or w.proc is None
+                    or w.proc.poll() is not None
+                    or w.state in (WorkerState.DOWN, WorkerState.FAILED)
+                ):
+                    continue  # stale answer: the next tick sees the truth
+                self._apply_probe(w, status, now)
+            self._update_gauges()
+
+    def _probe_all(self, targets: list[tuple[Worker, int]]) -> list[tuple]:
+        """Probe workers CONCURRENTLY: tick latency must be max(probe),
+        not sum(probe) — with several wedged workers each burning their
+        full HTTP timeout, sequential probes would stretch every tick by
+        the sum and lag healthy workers' state transitions behind it."""
+        if len(targets) == 1:
+            w, gen = targets[0]
+            return [(w, gen, self.probe(w))]
+        results: list = [None] * len(targets)
+
+        def one(i: int, w: Worker, gen: int) -> None:
+            results[i] = (w, gen, self.probe(w))
+
+        threads = [
+            threading.Thread(target=one, args=(i, w, gen), daemon=True)
+            for i, (w, gen) in enumerate(targets)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()  # bounded: the probe itself carries an HTTP timeout
+        return [r for r in results if r is not None]
+
+    def _tick_liveness(self, w: Worker, now: float) -> bool:
+        """Lifecycle transitions under the lock; True = probe this worker
+        over HTTP (it is alive with a bound URL)."""
+        if w.state is WorkerState.FAILED:
+            return False
+        if w.proc is not None and w.proc.poll() is not None:
+            self._on_exit(w, now)
+            return False
+        if w.state is WorkerState.DOWN:
+            if not self._draining and now >= w.restart_at:
+                self._spawn_worker(w)
+            return False  # freshly spawned: startup line read next tick
+        if w.state is WorkerState.STARTING and w.url is None:
+            w.url, w.run_id = self._read_startup(w)
+            if w.url is None:
+                if now - w.started_at > self.config.startup_timeout_s:
+                    log.warning(
+                        "fleet: %s produced no startup line in %.0fs; killing",
+                        w.name,
+                        self.config.startup_timeout_s,
+                    )
+                    w.proc.kill()
+                return False
+            log.info("fleet: %s gen %d at %s", w.name, w.generation, w.url)
+        return True
+
+    def _apply_probe(self, w: Worker, status: str, now: float) -> None:
+        if status == "ready":
+            w.state = WorkerState.READY
+            w.unready = 0
+            if w.failures and now - w.started_at >= self.config.healthy_after_s:
+                w.failures = 0  # survived long enough: breaker resets
+        elif status == "draining":
+            w.state = WorkerState.DRAINING
+            w.unready = 0
+        else:  # unreachable
+            if w.state is WorkerState.STARTING:
+                if now - w.started_at > self.config.startup_timeout_s:
+                    log.warning("fleet: %s never became ready; killing", w.name)
+                    w.proc.kill()
+                return
+            w.unready += 1
+            if w.unready >= self.config.unready_threshold:
+                log.warning(
+                    "fleet: %s unresponsive for %d probes; killing for restart",
+                    w.name,
+                    w.unready,
+                )
+                w.proc.kill()
+
+    def _on_exit(self, w: Worker, now: float) -> None:
+        rc = w.proc.poll()
+        w.exit_codes.append(rc)
+        w.proc = None
+        w.url = None
+        w.unready = 0
+        if self._draining:
+            w.state = WorkerState.DOWN
+            log.info("fleet: %s exited rc=%s (drain)", w.name, rc)
+            return
+        uptime = now - w.started_at
+        w.failures = w.failures + 1 if uptime < self.config.healthy_after_s else 1
+        if w.failures >= self.config.breaker_threshold:
+            w.state = WorkerState.FAILED
+            log.error(
+                "fleet: %s circuit breaker OPEN after %d consecutive fast "
+                "failures (last rc=%s) — not restarting",
+                w.name,
+                w.failures,
+                rc,
+            )
+            return
+        delay = min(
+            self.config.backoff_max_s,
+            self.config.backoff_base_s * 2 ** (w.failures - 1),
+        )
+        w.restart_at = now + delay
+        w.state = WorkerState.DOWN
+        log.warning(
+            "fleet: %s exited rc=%s after %.1fs; restart %d in %.1fs",
+            w.name,
+            rc,
+            uptime,
+            w.failures,
+            delay,
+        )
+
+    def _spawn_worker(self, w: Worker, *, first: bool = False) -> None:
+        if self._draining:
+            # a SIGTERM can land between installing handlers and start()'s
+            # spawn loop (the handler interleaves — the lock is reentrant
+            # on this thread): a worker spawned AFTER the drain began would
+            # never receive its SIGTERM and the drain would hang forever
+            w.state = WorkerState.DOWN
+            return
+        w.generation += 1
+        w.started_at = self.clock()
+        w.url = None
+        w.run_id = None
+        w.unready = 0
+        w.state = WorkerState.STARTING
+        if not first:
+            self._c_restarts.inc()
+        self.spawn(w)
+
+    def _update_gauges(self) -> None:
+        counts = {st: 0 for st in WorkerState}
+        for w in self.workers:
+            counts[w.state] += 1
+        for st, n in counts.items():
+            self._g_workers.labels(state=st.value).set(float(n))
+
+    # -- default process plumbing -----------------------------------------
+    def worker_argv(self, w: Worker) -> list[str]:
+        argv = [
+            sys.executable,
+            "-m",
+            "tpu_life",
+            "gateway",
+            "--host",
+            self.config.host,
+            "--port",
+            "0",
+            *self.config.worker_args,
+        ]
+        if self.config.metrics_dir is not None:
+            sink = Path(self.config.metrics_dir) / f"{w.name}.jsonl"
+            argv += ["--metrics-file", str(sink)]
+        return argv
+
+    def _default_spawn(self, w: Worker) -> None:
+        # the package may be import-from-checkout rather than installed:
+        # make sure the child can `python -m tpu_life` regardless of cwd
+        env = dict(os.environ)
+        pkg_root = str(Path(__file__).resolve().parents[2])
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_root
+        )
+        w.log_offset = w.log_path.stat().st_size if w.log_path.exists() else 0
+        with open(w.log_path, "ab") as logf:
+            w.proc = subprocess.Popen(
+                self.worker_argv(w),
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                env=env,
+                # detached session: a ^C at the fleet CLI must reach the
+                # workers as a supervised drain, not a raw group SIGINT
+                start_new_session=True,
+            )
+        log.debug("fleet: spawned %s gen %d pid %d", w.name, w.generation, w.proc.pid)
+
+    def _read_startup(self, w: Worker) -> tuple[str | None, str | None]:
+        """Scan the worker's log (from this generation's offset) for the
+        gateway startup JSON line; returns (url, run_id) or (None, None)."""
+        try:
+            with open(w.log_path, "rb") as f:
+                f.seek(w.log_offset)
+                data = f.read()
+        except OSError:
+            return None, None
+        for raw in data.split(b"\n")[:-1]:  # complete lines only
+            raw = raw.strip()
+            if not raw.startswith(b"{"):
+                continue
+            try:
+                doc = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if doc.get("mode") == "gateway" and "url" in doc:
+                return doc["url"], doc.get("run_id")
+        return None, None
+
+    def _default_probe(self, w: Worker) -> str:
+        if w.url is None:
+            return "unreachable"
+        try:
+            req = urllib.request.Request(w.url + "/readyz")
+            with urllib.request.urlopen(req, timeout=1.0):
+                return "ready"
+        except urllib.error.HTTPError as e:
+            return "draining" if e.code == 503 else "unreachable"
+        except Exception:
+            return "unreachable"
+
+
+def propagate_signals(on_signal) -> None:
+    """SIGTERM / SIGINT -> the fleet-wide drain (main thread only)."""
+
+    def _handler(signum, frame):
+        log.info("fleet: signal %d — draining the fleet", signum)
+        on_signal()
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)

@@ -14,6 +14,7 @@ machine that can reach the gateway, no jax required, same spirit as the
 from __future__ import annotations
 
 import json
+import random
 import socket
 import time
 import urllib.error
@@ -22,6 +23,7 @@ import urllib.request
 import numpy as np
 
 from tpu_life.gateway import protocol
+from tpu_life.gateway.errors import parse_retry_after
 
 #: Statuses the client retries (with Retry-After / backoff): rate limit,
 #: and the 503 family (queue full / shedding / draining).
@@ -47,10 +49,15 @@ class GatewayError(Exception):
 
 
 class GatewayClient:
-    """Talk to one gateway.  ``retries`` bounds how many times a retryable
-    response (429/503) or a connection refusal is retried; ``backoff`` is
-    the base of the exponential fallback used when the server sent no
-    ``Retry-After``.  ``sleep`` is injectable so tests never wait."""
+    """Talk to one gateway (or a fleet router — same protocol).
+    ``retries`` bounds how many times a retryable response (429/503) or a
+    connection refusal is retried; ``backoff`` is the base of the
+    exponential fallback used when the server sent no ``Retry-After``,
+    spread by bounded ``jitter`` so N clients bounced by the same
+    shedding fleet don't synchronize into retry storms (an explicit
+    ``Retry-After`` always wins, un-jittered — the server asked for that
+    exact pacing).  ``sleep`` and ``rng`` are injectable so tests never
+    wait and never flake."""
 
     def __init__(
         self,
@@ -61,7 +68,9 @@ class GatewayClient:
         retries: int = 4,
         backoff: float = 0.2,
         max_backoff: float = 5.0,
+        jitter: float = 0.25,
         sleep=time.sleep,
+        rng: random.Random | None = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.api_key = api_key
@@ -69,7 +78,11 @@ class GatewayClient:
         self.retries = retries
         self.backoff = backoff
         self.max_backoff = max_backoff
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+        self.jitter = jitter
         self.sleep = sleep
+        self.rng = rng or random.Random()
 
     # -- transport ---------------------------------------------------------
     def _request(self, method: str, path: str, body: dict | None = None) -> dict:
@@ -90,7 +103,7 @@ class GatewayClient:
                     e.code,
                     payload.get("code", "http_error"),
                     payload.get("message", str(e)),
-                    retry_after=_retry_after(e.headers),
+                    retry_after=parse_retry_after(e.headers),
                 )
                 if e.code not in RETRYABLE or attempt >= self.retries:
                     raise err from None
@@ -112,7 +125,15 @@ class GatewayClient:
                 wait = None
             attempt += 1
             if wait is None:
-                wait = min(self.max_backoff, self.backoff * (2 ** (attempt - 1)))
+                # no Retry-After: exponential backoff with bounded jitter —
+                # the multiplicative spread keeps a thundering herd of
+                # identical clients from re-arriving in lockstep.  Clamp
+                # AFTER jittering: max_backoff is a hard bound callers size
+                # against deadlines (downward jitter still spreads the cap)
+                wait = self.backoff * (2 ** (attempt - 1))
+                if self.jitter:
+                    wait *= 1.0 + self.rng.uniform(-self.jitter, self.jitter)
+                wait = min(self.max_backoff, wait)
             self.sleep(wait)
 
     # -- the API -----------------------------------------------------------
@@ -204,13 +225,3 @@ def _error_payload(e: urllib.error.HTTPError) -> dict:
         return doc.get("error", {}) if isinstance(doc, dict) else {}
     except (json.JSONDecodeError, OSError):
         return {}
-
-
-def _retry_after(headers) -> float | None:
-    v = headers.get("Retry-After") if headers is not None else None
-    if v is None:
-        return None
-    try:
-        return float(v)
-    except ValueError:
-        return None

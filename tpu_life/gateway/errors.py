@@ -43,6 +43,25 @@ class ApiError(Exception):
         return {"error": {"code": self.code, "message": self.message}}
 
 
+def fmt_retry_after(seconds: float) -> str:
+    """``Retry-After`` is integer seconds; always at least 1 so a client
+    that honors it literally cannot busy-spin."""
+    return str(max(1, int(seconds + 0.999)))
+
+
+def parse_retry_after(headers) -> float | None:
+    """Inverse of :func:`fmt_retry_after` — the one place the header is
+    read, shared by the client and the fleet router so the semantics
+    (numeric seconds only, None on anything else) cannot drift."""
+    v = headers.get("Retry-After") if headers is not None else None
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
 def bad_request(code: str, message: str) -> ApiError:
     return ApiError(400, code, message)
 

@@ -40,7 +40,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from tpu_life.gateway import errors as gw_errors
 from tpu_life.gateway import protocol
-from tpu_life.gateway.errors import ApiError
+from tpu_life.gateway.errors import ApiError, fmt_retry_after
 from tpu_life.gateway.limits import KeyedBuckets, LoadShedder
 from tpu_life.runtime.metrics import log
 from tpu_life.serve.errors import Draining
@@ -229,13 +229,62 @@ class _GatewayHTTPServer(ThreadingHTTPServer):
     gateway: Gateway  # attached right after construction
 
 
-class _Handler(BaseHTTPRequestHandler):
-    server_version = f"tpu-life-gateway/{__version__}"
-    protocol_version = "HTTP/1.1"
+class JsonHandler(BaseHTTPRequestHandler):
+    """Shared envelope plumbing for the repo's JSON HTTP fronts — the
+    gateway and the fleet router speak the same wire envelope, and the
+    Content-Length / 411 / 413 hygiene must not diverge between them."""
 
-    # -- plumbing ----------------------------------------------------------
+    protocol_version = "HTTP/1.1"
+    log_tag = "http"
+
     def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
-        log.debug("gateway: %s %s", self.address_string(), fmt % args)
+        log.debug("%s: %s %s", self.log_tag, self.address_string(), fmt % args)
+
+    def _send_json(
+        self, status: int, body: dict, *, retry_after: float | None = None
+    ) -> None:
+        payload = (json.dumps(body) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        if retry_after is not None:
+            self.send_header("Retry-After", fmt_retry_after(retry_after))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_text(self, status: int, text: str, content_type: str) -> None:
+        payload = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _read_sized_body(self, limit: int) -> bytes:
+        """The raw request body, bounded BEFORE it is read (411/400/413)."""
+        length = self.headers.get("Content-Length")
+        if length is None:
+            self.close_connection = True
+            raise ApiError(411, "length_required", "Content-Length is required")
+        try:
+            n = int(length)
+        except ValueError:
+            self.close_connection = True
+            raise ApiError(
+                400, "invalid_request", f"bad Content-Length {length!r}"
+            ) from None
+        if n > limit:
+            # the body is rejected UNREAD, so this keep-alive stream now
+            # holds n bytes the next request parser would misread as a
+            # request line — close instead of desyncing
+            self.close_connection = True
+            raise gw_errors.payload_too_large(n, limit)
+        return self.rfile.read(n)
+
+
+class _Handler(JsonHandler):
+    server_version = f"tpu-life-gateway/{__version__}"
+    log_tag = "gateway"
 
     @property
     def gw(self) -> Gateway:
@@ -249,43 +298,10 @@ class _Handler(BaseHTTPRequestHandler):
         # report ("session X was slow") joins the JSONL sink, the prom
         # snapshot and the trace file on one key
         body.setdefault("run_id", self.gw.service.run_id)
-        payload = (json.dumps(body) + "\n").encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        if retry_after is not None:
-            self.send_header("Retry-After", _fmt_retry_after(retry_after))
-        self.end_headers()
-        self.wfile.write(payload)
-
-    def _send_text(self, status: int, text: str, content_type: str) -> None:
-        payload = text.encode()
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
+        super()._send_json(status, body, retry_after=retry_after)
 
     def _read_body(self) -> dict:
-        length = self.headers.get("Content-Length")
-        if length is None:
-            self.close_connection = True
-            raise ApiError(411, "length_required", "Content-Length is required")
-        try:
-            n = int(length)
-        except ValueError:
-            self.close_connection = True
-            raise ApiError(
-                400, "invalid_request", f"bad Content-Length {length!r}"
-            ) from None
-        limit = self.gw.config.max_body
-        if n > limit:
-            # the body is rejected UNREAD, so this keep-alive stream now
-            # holds n bytes the next request parser would misread as a
-            # request line — close instead of desyncing
-            self.close_connection = True
-            raise gw_errors.payload_too_large(n, limit)
-        raw = self.rfile.read(n)
+        raw = self._read_sized_body(self.gw.config.max_body)
         try:
             return json.loads(raw)
         except json.JSONDecodeError as e:
@@ -477,12 +493,6 @@ class _Handler(BaseHTTPRequestHandler):
             {"session": sid, "cancelled": stopped, "state": view.state.value},
         )
         return 200
-
-
-def _fmt_retry_after(seconds: float) -> str:
-    # Retry-After is integer seconds; always at least 1 so a client that
-    # honors it literally cannot busy-spin
-    return str(max(1, int(seconds + 0.999)))
 
 
 def _monotonic() -> float:

@@ -79,8 +79,91 @@ def _labels_id(labels: dict) -> str:
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
 
 
+def _group_by_run(records: list[dict]) -> dict:
+    """Records bucketed by ``run_id`` (insertion-ordered; None = unstamped)."""
+    groups: dict = {}
+    for r in records:
+        groups.setdefault(r.get("run_id"), []).append(r)
+    return groups
+
+
+def _run_summary(chunks: list[dict]) -> dict:
+    last = chunks[-1]
+    rates = [r["steps_per_sec"] for r in chunks if r.get("steps_per_sec")]
+    cell_rates = [
+        r["cell_updates_per_sec"]
+        for r in chunks
+        if r.get("cell_updates_per_sec")
+    ]
+    return {
+        "chunks": len(chunks),
+        "final_step": last["step"],
+        "elapsed_s": last.get("elapsed_s"),
+        # the per-chunk steps_per_sec is cumulative (done / elapsed), so
+        # the final record IS the whole-run average; max is the best
+        # window the run ever sustained
+        "steps_per_sec": last.get("steps_per_sec"),
+        "steps_per_sec_max": max(rates) if rates else 0.0,
+        "cell_updates_per_sec": last.get("cell_updates_per_sec"),
+        "cell_updates_per_sec_max": max(cell_rates) if cell_rates else 0.0,
+        "live_cells_final": last.get("live_cells"),
+    }
+
+
+def _serve_summary(rounds: list[dict]) -> dict:
+    last = rounds[-1]
+    occ = [r.get("batch_occupancy", 0.0) for r in rounds]
+    return {
+        "rounds": len(rounds),
+        "elapsed_s": last.get("elapsed_s"),
+        "sessions_done": last.get("sessions_done"),
+        "sessions_per_sec": last.get("sessions_per_sec"),
+        "steps_advanced": sum(r.get("steps_advanced", 0) for r in rounds),
+        "admitted": sum(r.get("admitted", 0) for r in rounds),
+        "completed": sum(r.get("completed", 0) for r in rounds),
+        "failed": sum(r.get("failed", 0) for r in rounds),
+        "batch_occupancy_mean": sum(occ) / len(occ),
+        "queue_depth_max": max(r.get("queue_depth", 0) for r in rounds),
+    }
+
+
+def _merge_serve(per_run: dict) -> dict:
+    """Combine per-run serve summaries into one fleet-level view: counts
+    and rates sum (the workers ran concurrently), elapsed is the longest
+    worker's wall clock, occupancy is the round-weighted mean."""
+    summaries = list(per_run.values())
+    total_rounds = sum(s["rounds"] for s in summaries)
+    return {
+        "rounds": total_rounds,
+        "elapsed_s": max((s.get("elapsed_s") or 0.0) for s in summaries),
+        "sessions_done": sum(s.get("sessions_done") or 0 for s in summaries),
+        "sessions_per_sec": sum(
+            s.get("sessions_per_sec") or 0.0 for s in summaries
+        ),
+        "steps_advanced": sum(s["steps_advanced"] for s in summaries),
+        "admitted": sum(s["admitted"] for s in summaries),
+        "completed": sum(s["completed"] for s in summaries),
+        "failed": sum(s["failed"] for s in summaries),
+        "batch_occupancy_mean": (
+            sum(s["batch_occupancy_mean"] * s["rounds"] for s in summaries)
+            / total_rounds
+            if total_rounds
+            else 0.0
+        ),
+        "queue_depth_max": max(s["queue_depth_max"] for s in summaries),
+        "runs_merged": len(summaries),
+    }
+
+
 def summarize(records: list[dict]) -> dict:
-    """The summary dict behind both output modes of ``tpu-life stats``."""
+    """The summary dict behind both output modes of ``tpu-life stats``.
+
+    Records from a single run keep the classic shape.  Records carrying
+    *multiple* run_ids — a fleet's per-worker sinks read back together —
+    are grouped by run_id: the ``serve`` section becomes the fleet-level
+    merge (counts sum, occupancy is round-weighted) and ``runs`` carries
+    each worker's own summary keyed by its run_id.
+    """
     chunks = [r for r in records if "step" in r and "kind" not in r]
     rounds = [r for r in records if r.get("kind") == "serve"]
     metrics = [r for r in records if r.get("kind") == "metric"]
@@ -91,42 +174,24 @@ def summarize(records: list[dict]) -> dict:
     }
 
     if chunks:
-        last = chunks[-1]
-        rates = [r["steps_per_sec"] for r in chunks if r.get("steps_per_sec")]
-        cell_rates = [
-            r["cell_updates_per_sec"]
-            for r in chunks
-            if r.get("cell_updates_per_sec")
-        ]
-        summary["run"] = {
-            "chunks": len(chunks),
-            "final_step": last["step"],
-            "elapsed_s": last.get("elapsed_s"),
-            # the per-chunk steps_per_sec is cumulative (done / elapsed), so
-            # the final record IS the whole-run average; max is the best
-            # window the run ever sustained
-            "steps_per_sec": last.get("steps_per_sec"),
-            "steps_per_sec_max": max(rates) if rates else 0.0,
-            "cell_updates_per_sec": last.get("cell_updates_per_sec"),
-            "cell_updates_per_sec_max": max(cell_rates) if cell_rates else 0.0,
-            "live_cells_final": last.get("live_cells"),
-        }
+        groups = _group_by_run(chunks)
+        if len(groups) == 1:
+            summary["run"] = _run_summary(chunks)
+        else:
+            for rid, g in groups.items():
+                summary.setdefault("runs", {}).setdefault(rid or "<none>", {})[
+                    "run"
+                ] = _run_summary(g)
 
     if rounds:
-        last = rounds[-1]
-        occ = [r.get("batch_occupancy", 0.0) for r in rounds]
-        summary["serve"] = {
-            "rounds": len(rounds),
-            "elapsed_s": last.get("elapsed_s"),
-            "sessions_done": last.get("sessions_done"),
-            "sessions_per_sec": last.get("sessions_per_sec"),
-            "steps_advanced": sum(r.get("steps_advanced", 0) for r in rounds),
-            "admitted": sum(r.get("admitted", 0) for r in rounds),
-            "completed": sum(r.get("completed", 0) for r in rounds),
-            "failed": sum(r.get("failed", 0) for r in rounds),
-            "batch_occupancy_mean": sum(occ) / len(occ),
-            "queue_depth_max": max(r.get("queue_depth", 0) for r in rounds),
-        }
+        groups = _group_by_run(rounds)
+        per_run = {rid or "<none>": _serve_summary(g) for rid, g in groups.items()}
+        if len(per_run) == 1:
+            summary["serve"] = next(iter(per_run.values()))
+        else:
+            summary["serve"] = _merge_serve(per_run)
+            for rid, s in per_run.items():
+                summary.setdefault("runs", {}).setdefault(rid, {})["serve"] = s
 
     if metrics:
         summary["metrics"] = []
@@ -137,6 +202,10 @@ def summarize(records: list[dict]) -> dict:
                 "type": rec["type"],
                 "labels": rec.get("labels", {}),
             }
+            if len(summary["run_ids"]) > 1 and rec.get("run_id"):
+                # merged sinks: the same metric arrives once per worker —
+                # keep them distinguishable in the report
+                entry["run_id"] = rec["run_id"]
             if rec["type"] == "histogram":
                 entry.update(
                     count=rec.get("count"),
@@ -147,18 +216,24 @@ def summarize(records: list[dict]) -> dict:
                 )
             else:
                 entry["value"] = rec.get("value")
-                counters[(rec["metric"], _labels_id(rec.get("labels", {})))] = (
-                    rec.get("value") or 0.0
-                )
+                # keyed per run_id too: two workers' identical counters
+                # must SUM below, not overwrite each other
+                counters[
+                    (
+                        rec["metric"],
+                        _labels_id(rec.get("labels", {})),
+                        rec.get("run_id"),
+                    )
+                ] = rec.get("value") or 0.0
             summary["metrics"].append(entry)
         # admission rejection rate: rejected / offered, when both counters
         # are present in the snapshot
         rejected = sum(
-            v for (name, _), v in counters.items()
+            v for (name, _, _), v in counters.items()
             if name == "serve_admission_rejections_total"
         )
         submitted = sum(
-            v for (name, _), v in counters.items()
+            v for (name, _, _), v in counters.items()
             if name == "serve_sessions_submitted_total"
         )
         if submitted or rejected:
@@ -214,12 +289,33 @@ def render(summary: dict) -> str:
             )
         if "rejection_rate" in serve:
             lines.append(f"  rejection_rate={_fmt(serve['rejection_rate'])}")
+    runs = summary.get("runs")
+    if runs:
+        lines.append("per run:")
+        for rid, r in runs.items():
+            s = r.get("serve")
+            if s:
+                lines.append(
+                    f"  {rid}  rounds={s['rounds']}  "
+                    f"done={_fmt(s.get('sessions_done'))}  "
+                    f"sessions/s={_fmt(s.get('sessions_per_sec'))}  "
+                    f"occupancy={_fmt(s.get('batch_occupancy_mean'))}"
+                )
+            rn = r.get("run")
+            if rn:
+                lines.append(
+                    f"  {rid}  chunks={rn['chunks']}  "
+                    f"final_step={rn['final_step']}  "
+                    f"steps/s={_fmt(rn.get('steps_per_sec'))}"
+                )
     mets = summary.get("metrics")
     if mets:
         lines.append("metrics:")
         name_w = max(len(m["metric"]) for m in mets)
         for m in mets:
             label = _labels_id(m["labels"])
+            if m.get("run_id"):
+                label = f"run_id={m['run_id']}" + (f",{label}" if label else "")
             tag = f"{m['metric']:<{name_w}}" + (f"  [{label}]" if label else "")
             if m["type"] == "histogram":
                 lines.append(
