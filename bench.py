@@ -468,6 +468,61 @@ def run_serve_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def run_mc_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_mc capture: Metropolis checkerboard sweep throughput
+    (sweeps/s and spin-updates/s) through the stochastic tier
+    (tpu_life.mc, docs/STOCHASTIC.md).  Same delta-timing methodology as
+    the kernel bench — two fused runs of different sweep counts,
+    differenced to cancel dispatch + readback latency — and every record
+    carries the (run_id, seed, temperature) triple that fully replays the
+    measured trajectory."""
+    actual, pinned = _pin_and_verify(args, platform)
+
+    from tpu_life import mc
+    from tpu_life.backends.base import get_backend, make_runner
+    from tpu_life.models.rules import IsingRule, get_rule
+    from tpu_life.utils.timing import delta_seconds_per_step
+
+    rule = get_rule(args.mc_rule)
+    if not rule.stochastic:
+        raise ValueError(f"--mc needs a stochastic rule, got {args.mc_rule!r}")
+    temperature = args.mc_temperature if isinstance(rule, IsingRule) else None
+    n = args.mc_size
+    board = mc.seeded_board(n, n, seed=args.mc_seed)
+    backend = get_backend(args.backend)
+    runner = make_runner(
+        backend,
+        board,
+        rule,
+        seed=args.mc_seed,
+        temperature=temperature,
+    )
+    per_sweep = delta_seconds_per_step(
+        runner, args.mc_steps, args.mc_base_steps, repeats=args.repeats
+    )
+    return {
+        "metric": "mc_sweeps_per_sec",
+        "value": 1.0 / per_sweep,
+        "unit": "sweeps/s",
+        # one sweep proposes a flip at every site (two half-lattice
+        # checkerboard updates), so spin-updates/s = cells * sweeps/s —
+        # the unit the TPU-cluster Ising paper reports
+        "spin_updates_per_sec": n * n / per_sweep,
+        "rule": args.mc_rule,
+        "temperature": temperature,
+        "seed": args.mc_seed,
+        "platform": platform,
+        "platform_actual": actual,
+        "platform_pinned": pinned,
+        "backend": getattr(backend, "name", args.backend),
+        "size": n,
+        "steps": args.mc_steps,
+        "base_steps": args.mc_base_steps,
+        "repeats": args.repeats,
+        "degraded": degraded,
+    }
+
+
 def run_bench(args, platform: str, degraded: bool) -> dict:
     actual, pinned = _pin_and_verify(args, platform)
 
@@ -648,16 +703,58 @@ def main() -> None:
     p.add_argument("--serve-capacity", type=int, default=8,
                    help="batch slots (the acceptance-config default)")
     p.add_argument("--serve-chunk-steps", type=int, default=16)
+    # the BENCH_mc capture: Metropolis sweep throughput through the
+    # stochastic tier (sweeps/s, spin-updates/s; docs/STOCHASTIC.md)
+    p.add_argument("--mc", action="store_true",
+                   help="stochastic-tier bench: checkerboard Metropolis "
+                   "sweeps (emits mc_sweeps_per_sec + spin_updates_per_sec)")
+    p.add_argument("--mc-size", type=int, default=None,
+                   help="square lattice edge (default 4096, 256 degraded)")
+    p.add_argument("--mc-steps", type=int, default=None,
+                   help="sweeps per timed run (default 400, 48 degraded)")
+    p.add_argument("--mc-base-steps", type=int, default=None,
+                   help="sweeps in the baseline run of the delta pair "
+                   "(default 40, 8 degraded)")
+    p.add_argument("--mc-temperature", type=float, default=2.27,
+                   help="Metropolis temperature (default ~ the Onsager "
+                   "critical point, the hardest-mixing regime)")
+    p.add_argument("--mc-seed", type=int, default=0)
+    p.add_argument("--mc-rule", default="ising",
+                   help="stochastic rule to measure (ising / noisy:<p>/<base>)")
     args = p.parse_args()
 
     # fail fast on pure config errors — they must never trigger the
     # accelerator-failure fallback below
     from tpu_life.models.rules import get_rule
 
+    mc_is_ising = False
+    mc_rule = None
     try:
         get_rule(args.rule)
+        if args.mc:
+            mc_rule = get_rule(args.mc_rule)
     except Exception as e:  # noqa: BLE001
-        p.error(f"unknown rule {args.rule!r}: {e}")
+        p.error(f"unknown rule: {e}")
+    if args.mc:
+        from tpu_life import mc as mc_mod
+        from tpu_life.models.rules import IsingRule
+
+        if not mc_rule.stochastic:
+            p.error(f"--mc needs a stochastic rule, got {args.mc_rule!r}")
+        mc_is_ising = isinstance(mc_rule, IsingRule)
+        # pure config errors fail fast, like the rule check — they must
+        # never ride the accelerator-failure fallback below (a bogus
+        # degraded record + a CPU retry cannot fix an odd lattice)
+        try:
+            mc_mod.validate_params(
+                mc_rule, args.mc_temperature if mc_is_ising else None
+            )
+            if args.mc_size is not None:
+                mc_mod.validate_board_shape(
+                    mc_rule, (args.mc_size, args.mc_size)
+                )
+        except ValueError as e:
+            p.error(str(e))
 
     platform = args.platform or os.environ.get("TPU_LIFE_PLATFORM")
     probe_failed = False
@@ -691,6 +788,9 @@ def main() -> None:
         "--serve-sessions": args.serve_sessions,
         "--serve-size": args.serve_size,
         "--serve-steps": args.serve_steps,
+        "--mc-size": args.mc_size,
+        "--mc-steps": args.mc_steps,
+        "--mc-base-steps": args.mc_base_steps,
     }
     if args.size is None:
         args.size = 16384 if on_accel else DEGRADED_SIZE
@@ -708,13 +808,25 @@ def main() -> None:
         args.serve_size = 512 if on_accel else 128
     if args.serve_steps is None:
         args.serve_steps = 128 if on_accel else 32
+    # mc workload knobs: same accel/degraded split (a sweep is ~2 stencil
+    # passes + a hash per cell, so the degraded lattice stays small)
+    if args.mc_size is None:
+        args.mc_size = 4096 if on_accel else 256
+    if args.mc_steps is None:
+        args.mc_steps = 400 if on_accel else 48
+    if args.mc_base_steps is None:
+        args.mc_base_steps = 40 if on_accel else 8
+    if args.mc and args.mc_steps <= args.mc_base_steps:
+        p.error("--mc-steps must be greater than --mc-base-steps (delta timing)")
     # resolve the backend up front (after snapshotting what the user pinned)
     # so every emitted record — success or failure — names what actually ran
     # (ADVICE r2 item 3): the composed flagship path on TPU, jax elsewhere.
     # The serve bench defaults to the vmapped jax engine on every platform
     # (the batched path is the thing being measured).
     if args.backend is None:
-        if args.serve:
+        if args.serve or args.mc:
+            # the vmapped/fused single-device XLA path is the thing being
+            # measured on both service-shaped benches
             args.backend = "jax"
         else:
             args.backend = "sharded" if platform == "tpu" else "jax"
@@ -738,6 +850,8 @@ def main() -> None:
     try:
         if args.serve:
             result = run_serve_bench(args, platform, degraded)
+        elif args.mc:
+            result = run_mc_bench(args, platform, degraded)
         else:
             result = run_bench(args, platform, degraded)
     except Exception as e:  # noqa: BLE001 — the JSON line must always appear
@@ -771,6 +885,11 @@ def main() -> None:
                 cmd.append("--serve")
                 cmd += ["--serve-capacity", str(args.serve_capacity)]
                 cmd += ["--serve-chunk-steps", str(args.serve_chunk_steps)]
+            if args.mc:
+                cmd.append("--mc")
+                cmd += ["--mc-temperature", str(args.mc_temperature)]
+                cmd += ["--mc-seed", str(args.mc_seed)]
+                cmd += ["--mc-rule", args.mc_rule]
             try:
                 r = subprocess.run(
                     cmd, capture_output=True, text=True, timeout=1800, env=env
@@ -783,22 +902,35 @@ def main() -> None:
                 return
             except Exception as e2:  # noqa: BLE001
                 e = RuntimeError(f"{e!r}; cpu retry failed: {e2!r}")
+        if args.serve:
+            metric, unit = "serve_sessions_per_sec", "sessions/s"
+            size, steps = args.serve_size, args.serve_steps
+        elif args.mc:
+            metric, unit = "mc_sweeps_per_sec", "sweeps/s"
+            size, steps = args.mc_size, args.mc_steps
+        else:
+            metric, unit = "cell_updates_per_sec_per_chip", "cells/s/chip"
+            size, steps = args.size, args.steps
         failure = {
-            "metric": "serve_sessions_per_sec"
-            if args.serve
-            else "cell_updates_per_sec_per_chip",
+            "metric": metric,
             "value": 0.0,
-            "unit": "sessions/s" if args.serve else "cells/s/chip",
+            "unit": unit,
             "platform": platform,
             "backend": args.backend,
-            "size": args.serve_size if args.serve else args.size,
-            "steps": args.serve_steps if args.serve else args.steps,
+            "size": size,
+            "steps": steps,
             "degraded": True,
             "error": repr(e)[:500],
         }
         if args.serve:
             failure["sessions"] = args.serve_sessions
             failure["batch_capacity"] = args.serve_capacity
+        elif args.mc:
+            # the replay record must name what the run actually used:
+            # the measured rule, and None temperature for non-ising rules
+            failure["rule"] = args.mc_rule
+            failure["seed"] = args.mc_seed
+            failure["temperature"] = args.mc_temperature if mc_is_ising else None
         else:
             failure["vs_baseline"] = 0.0
             failure["n_chips"] = 0

@@ -104,6 +104,40 @@ def test_twenty_staggered_sessions_byte_equal_driver(make_gateway, tmp_path):
     assert "gateway_request_seconds_bucket" in metrics
 
 
+def test_ising_session_over_http_replays_exactly(make_gateway):
+    """The stochastic tier over the wire (docs/STOCHASTIC.md): a seeded
+    ising session submitted twice returns byte-identical boards equal to
+    the numpy ground truth, the poll view echoes the replay record
+    (seed + temperature), and bad pairings are typed 400s."""
+    from tpu_life.mc import run_np, seeded_board
+    from tpu_life.models.rules import get_rule
+
+    gw, client = make_gateway(
+        ServeConfig(capacity=4, chunk_steps=3, max_queue=16, backend="jax")
+    )
+    retrying = GatewayClient(f"http://127.0.0.1:{gw.port}", retries=8)
+    kw = dict(rule="ising", steps=7, size=12, seed=9, temperature=2.27)
+    sids = [retrying.submit(**kw), retrying.submit(**kw)]
+    views = [retrying.wait(s, timeout=120) for s in sids]
+    for view in views:
+        assert view["state"] == "done"
+        assert view["seed"] == 9 and view["temperature"] == 2.27
+    a, b = (retrying.result_board(s) for s in sids)
+    assert a.tobytes() == b.tobytes()
+    oracle = run_np(
+        get_rule("ising"), seeded_board(12, 12, seed=9), 9, 7, temperature=2.27
+    )
+    np.testing.assert_array_equal(a, oracle)
+    # typed 400: ising without a temperature / temperature elsewhere
+    for bad in (
+        dict(rule="ising", steps=2, size=8),
+        dict(rule="conway", steps=2, size=8, temperature=2.0),
+    ):
+        with pytest.raises(GatewayError) as e:
+            client.submit(**bad)
+        assert e.value.status == 400
+
+
 def test_rate_limit_is_429_with_retry_after(make_gateway):
     """A 1-token bucket: first submit admitted, second bounced with 429 +
     Retry-After — and the client's retry loop rides it out."""

@@ -52,12 +52,19 @@ def test_inline_board_nested_lists_and_timeout():
     assert spec.timeout_s == 2.0
 
 
-def test_seeded_geometry_matches_random_board():
+def test_seeded_geometry_matches_seeded_board():
+    # staging uses the counter-based stream (tpu_life.mc.prng), so the
+    # seed names the identical board on every host — and is echoed in
+    # the spec as the replay record
+    from tpu_life.mc import seeded_board
+
     spec = protocol.parse_submit({"size": 16, "steps": 3, "seed": 9})
-    np.testing.assert_array_equal(spec.board, random_board(16, 16, seed=9))
+    np.testing.assert_array_equal(spec.board, seeded_board(16, 16, seed=9))
+    assert spec.seed == 9
     # explicit height wins over the square shorthand
     spec = protocol.parse_submit({"size": 16, "height": 4, "steps": 3})
     assert spec.board.shape == (4, 16)
+    assert spec.seed == 0  # default seed is part of the record too
 
 
 def test_seeded_geometry_respects_rule_states():

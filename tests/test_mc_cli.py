@@ -1,0 +1,171 @@
+"""CLI fronts of the stochastic tier: `run --rule ising`, `sweep`, the
+spool's temperature field, and the RunResult seed stamp."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_life.cli import main
+from tpu_life.config import RunConfig
+from tpu_life.io.codec import read_board
+from tpu_life.mc import run_np, seeded_board
+from tpu_life.models.rules import get_rule
+from tpu_life.runtime.driver import run
+
+ISING = get_rule("ising")
+
+
+def summary_line(capsys) -> dict:
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_run_ising_replay_byte_identical(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = [
+        "run", "--size", "24", "--steps", "6", "--rule", "ising",
+        "--temperature", "2.3", "--seed", "5", "--backend", "numpy",
+    ]
+    assert main([*args, "--output-file", "a.txt"]) == 0
+    assert main([*args, "--output-file", "b.txt"]) == 0
+    assert (tmp_path / "a.txt").read_bytes() == (tmp_path / "b.txt").read_bytes()
+    np.testing.assert_array_equal(
+        read_board(tmp_path / "a.txt", 24, 24),
+        run_np(ISING, seeded_board(24, 24, seed=5), 5, 6, temperature=2.3),
+    )
+
+
+def test_run_result_stamps_seed(tmp_path):
+    base = dict(
+        height=10,
+        width=10,
+        steps=3,
+        backend="numpy",
+        input_file=str(tmp_path / "absent.txt"),
+        config_file=str(tmp_path / "absent_cfg.txt"),
+        output_file=str(tmp_path / "out.txt"),
+    )
+    # seeded-deterministic exploratory run: the seed named the board
+    res = run(RunConfig(rule="conway", seed=13, **base))
+    assert res.seed == 13 and res.temperature is None
+    # stochastic run: the seed names the trajectory
+    res2 = run(RunConfig(rule="ising", temperature=2.0, seed=8, **base))
+    assert res2.seed == 8 and res2.temperature == 2.0
+    # file-board deterministic run: no seed consumed -> not stamped
+    from tpu_life.io.codec import write_board, write_config
+
+    write_board(tmp_path / "data.txt", seeded_board(10, 10, seed=0))
+    write_config(tmp_path / "cfg.txt", 10, 10, 3)
+    res3 = run(
+        RunConfig(
+            rule="conway",
+            input_file=str(tmp_path / "data.txt"),
+            config_file=str(tmp_path / "cfg.txt"),
+            output_file=str(tmp_path / "out3.txt"),
+            backend="numpy",
+        )
+    )
+    assert res3.seed is None
+
+
+def test_run_ising_without_temperature_fails(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(ValueError, match="temperature"):
+        main(["run", "--size", "8", "--steps", "2", "--rule", "ising",
+              "--backend", "numpy"])
+
+
+def test_sweep_cli_summary(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = main([
+        "sweep", "--size", "16", "--steps", "5",
+        "--temps", "1.5,2.0,2.5,3.0", "--seed", "3",
+        "--serve-backend", "numpy", "--output-dir", "boards",
+    ])
+    assert rc == 0
+    s = summary_line(capsys)
+    assert s["mode"] == "sweep" and s["seed"] == 3
+    assert s["done"] == 4 and s["failed"] == 0
+    assert len(s["sessions"]) == 4
+    assert [e["temperature"] for e in s["sessions"]] == [1.5, 2.0, 2.5, 3.0]
+    # one CompileKey for the whole grid — the continuous-batching claim
+    assert len(s["compile_counts"]) == 1
+    board = seeded_board(16, 16, seed=3)
+    for entry in s["sessions"]:
+        oracle = run_np(
+            ISING, board, 3, 5, temperature=entry["temperature"]
+        )
+        assert entry["magnetization"] == pytest.approx(
+            abs(float((oracle.astype(np.int64) * 2 - 1).mean()))
+        )
+        np.testing.assert_array_equal(
+            read_board(tmp_path / "boards" / f"{entry['session']}.txt", 16, 16),
+            oracle,
+        )
+
+
+def test_sweep_cli_range_spec_and_errors(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = main([
+        "sweep", "--size", "8", "--steps", "2", "--temps", "1.0:2.0:3",
+        "--serve-backend", "numpy",
+    ])
+    assert rc == 0
+    s = summary_line(capsys)
+    assert [e["temperature"] for e in s["sessions"]] == [1.0, 1.5, 2.0]
+    with pytest.raises(SystemExit):
+        main(["sweep", "--size", "8", "--steps", "2", "--temps", "bogus"])
+    with pytest.raises(SystemExit):
+        main(["sweep", "--steps", "2"])  # geometry required
+
+
+def test_spool_temperature_field_end_to_end(tmp_path, monkeypatch, capsys):
+    # `submit --rule ising --temperature` rides the spool line; `serve`
+    # honors it and the result equals the ground-truth trajectory
+    monkeypatch.chdir(tmp_path)
+    assert main([
+        "submit", "--size", "12", "--steps", "4", "--rule", "ising",
+        "--temperature", "2.1", "--seed", "6",
+        "--output-file", "ising_out.txt",
+    ]) == 0
+    capsys.readouterr()
+    assert main(["serve", "--serve-backend", "numpy", "--capacity", "2"]) == 0
+    s = summary_line(capsys)
+    assert s["done"] == 1 and s["failed"] == 0
+    np.testing.assert_array_equal(
+        read_board(tmp_path / "ising_out.txt", 12, 12),
+        run_np(ISING, seeded_board(12, 12, seed=6), 6, 4, temperature=2.1),
+    )
+
+
+def test_bench_mc_record_shape(tmp_path, monkeypatch, capsys):
+    # the BENCH_mc leg emits one JSON record with the replay triple
+    # (run_id stamped by the emitter) and both throughput units
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    r = subprocess.run(
+        [
+            sys.executable, str(repo / "bench.py"), "--mc",
+            "--mc-size", "32", "--mc-steps", "6", "--mc-base-steps", "2",
+            "--repeats", "1", "--platform", "cpu",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+            "TPU_LIFE_BENCH_NO_RETRY": "1",
+        },
+        cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "mc_sweeps_per_sec"
+    assert rec["value"] > 0 and rec["spin_updates_per_sec"] > 0
+    assert rec["seed"] == 0 and rec["temperature"] == 2.27
+    assert rec["run_id"] and rec["rule"] == "ising"

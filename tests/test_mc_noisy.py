@@ -1,0 +1,113 @@
+"""Noisy-Life: spec parsing (typed errors) + composed dynamics.
+
+``noisy:<p>/<base>`` applies the base rule deterministically, then flips
+each cell with probability p from the ``SUB_NOISE`` substream — the
+noise is as reproducible as the rule, the endpoints are exact, and the
+jax/numpy executors are bit-identical.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.backends.base import get_backend, make_runner
+from tpu_life.mc import run_np, seeded_board
+from tpu_life.models.rules import NoisyRule, get_rule, parse_rule
+from tpu_life.ops.reference import run_np as det_run_np
+
+
+def test_parse_noisy_spec():
+    r = parse_rule("noisy:0.01/conway")
+    assert isinstance(r, NoisyRule) and r.stochastic
+    assert r.flip_p == 0.01
+    assert r.base.name == "B3/S23"
+    # structural fields copied so the deterministic machinery applies
+    assert r.birth == r.base.birth and r.survive == r.base.survive
+    assert hash(r) == hash(parse_rule("noisy:0.01/conway"))
+    # distinct p -> distinct rule (p is part of the CompileKey)
+    assert parse_rule("noisy:0.02/conway") != r
+
+
+def test_parse_noisy_with_torus_base():
+    r = parse_rule("noisy:0.05/B36/S23:T")
+    assert r.boundary == "torus" and r.flip_p == 0.05
+    assert r.name == "noisy:0.05/B36/S23:T"
+
+
+@pytest.mark.parametrize(
+    "spec,match",
+    [
+        ("noisy:0.1", "expected 'noisy:<p>/<base>'"),
+        ("noisy:zzz/conway", "not a number"),
+        ("noisy:1.5/conway", "must be in"),
+        ("noisy:-0.1/conway", "must be in"),
+        ("noisy:nan/conway", "must be in"),
+        ("noisy:0.1/", "empty base"),
+        ("noisy:0.1/no_such_rule", "unrecognized rule"),
+        ("noisy:0.1/brians_brain", "2-state base"),
+        ("noisy:0.1/ising", "deterministic"),
+        ("noisy:0.1/noisy:0.1/conway", "deterministic"),
+    ],
+)
+def test_parse_noisy_typed_errors(spec, match):
+    with pytest.raises(ValueError, match=match):
+        parse_rule(spec)
+
+
+def test_p_zero_equals_base_rule():
+    b0 = seeded_board(20, 17, seed=6)
+    out = run_np(get_rule("noisy:0.0/conway"), b0, 6, 8)
+    np.testing.assert_array_equal(out, det_run_np(b0, get_rule("conway"), 8))
+
+
+def test_p_one_is_exact_inversion():
+    # p = 1 specializes to an unconditional flip of the base step's
+    # output — exact, no 2^-32 threshold residue
+    b0 = seeded_board(12, 12, seed=1)
+    base_rule = get_rule("conway")
+    cur = b0
+    for step in range(3):
+        expected = 1 - det_run_np(cur, base_rule, 1)
+        cur = run_np(get_rule("noisy:1.0/conway"), cur, 1, 1, start_step=step)
+        np.testing.assert_array_equal(cur, expected)
+
+
+def test_jax_numpy_bit_identity_and_chunk_invariance():
+    rule = get_rule("noisy:0.1/conway")
+    b0 = seeded_board(16, 19, seed=15)
+    oracle = run_np(rule, b0, 15, 7)
+    jb = get_backend("jax")
+    for chunks in ([7], [3, 4], [1] * 7):
+        r = make_runner(jb, b0, rule, seed=15)
+        for n in chunks:
+            r.advance(n)
+        r.sync()
+        np.testing.assert_array_equal(r.fetch(), oracle)
+
+
+def test_noise_actually_flips():
+    # p = 0.25 over life-without-death from a dead board: without noise
+    # the board stays dead forever; with it, roughly a quarter lights up
+    rule = get_rule("noisy:0.25/life_without_death")
+    out = run_np(rule, np.zeros((40, 40), np.int8), 3, 1)
+    frac = out.mean()
+    assert 0.15 < frac < 0.35
+
+
+def test_noisy_rejects_temperature(tmp_path):
+    from tpu_life.config import RunConfig
+    from tpu_life.runtime.driver import run
+
+    with pytest.raises(ValueError, match="temperature"):
+        run(
+            RunConfig(
+                height=8,
+                width=8,
+                steps=1,
+                rule="noisy:0.1/conway",
+                temperature=2.0,
+                backend="numpy",
+                input_file=str(tmp_path / "absent.txt"),
+                config_file=str(tmp_path / "absent_cfg.txt"),
+                output_file=str(tmp_path / "out.txt"),
+            )
+        )

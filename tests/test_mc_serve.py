@@ -1,0 +1,243 @@
+"""Stochastic sessions through the serving tier (ISSUE 6 acceptance).
+
+The headline invariant: a mixed-temperature sweep batch compiles ONCE
+per CompileKey (temperature and seed ride per-slot, not in the key) and
+every session's result equals its single-session run with the same seed
+— asserted against both the vmapped jax engine and the numpy ground
+truth engine.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.mc import run_np, seeded_board
+from tpu_life.models.rules import get_rule
+from tpu_life.serve import (
+    ServeConfig,
+    SessionState,
+    SimulationService,
+)
+
+ISING = get_rule("ising")
+TEMPS = [1.5, 1.8, 2.0, 2.2, 2.4, 2.6, 2.8, 3.0]
+
+
+def _svc(backend="jax", **kw):
+    kw.setdefault("capacity", 8)
+    kw.setdefault("chunk_steps", 4)
+    return SimulationService(ServeConfig(backend=backend, **kw))
+
+
+@pytest.mark.parametrize("backend", ["jax", "numpy"])
+def test_temperature_sweep_one_compile_key(backend):
+    # >= 8 temperatures, one board+seed, ONE CompileKey, compile_count 1,
+    # every session bit-identical to its own single-session oracle
+    board = seeded_board(16, 12, seed=11)
+    svc = _svc(backend)
+    sids = svc.sweep(board, "ising", 10, TEMPS, seed=11)
+    svc.drain()
+    counts = svc.scheduler.compile_counts()
+    assert len(counts) == 1, "a temperature sweep must share one CompileKey"
+    if backend == "jax":
+        assert list(counts.values()) == [1]
+    for sid, t in zip(sids, TEMPS):
+        view = svc.poll(sid)
+        assert view.state is SessionState.DONE
+        assert view.seed == 11 and view.temperature == t
+        oracle = run_np(ISING, board, 11, 10, temperature=t)
+        np.testing.assert_array_equal(svc.result(sid), oracle)
+    svc.close()
+
+
+def test_staggered_joins_keep_bit_identity_and_one_compile():
+    # sessions joining a RUNNING stochastic batch mid-flight get their own
+    # stream position (per-slot step counters), with zero recompilation
+    board = seeded_board(14, 14, seed=2)
+    svc = _svc("jax", capacity=4, chunk_steps=3)
+    first = [svc.submit(board, ISING, 11, seed=s, temperature=2.2) for s in (1, 2)]
+    svc.pump()
+    svc.pump()
+    later = [svc.submit(board, ISING, 5, seed=s, temperature=2.6) for s in (3, 4)]
+    svc.drain()
+    assert list(svc.scheduler.compile_counts().values()) == [1]
+    for sid, seed, steps, t in [
+        (first[0], 1, 11, 2.2),
+        (first[1], 2, 11, 2.2),
+        (later[0], 3, 5, 2.6),
+        (later[1], 4, 5, 2.6),
+    ]:
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(ISING, board, seed, steps, temperature=t)
+        )
+    svc.close()
+
+
+def test_serve_equals_driver_run_same_seed(tmp_path):
+    # end-to-end: a serve session equals the driver's single run of the
+    # same (board, seed, temperature) — the two public fronts agree
+    from tpu_life.config import RunConfig
+    from tpu_life.runtime.driver import run
+
+    res = run(
+        RunConfig(
+            height=12,
+            width=12,
+            steps=8,
+            rule="ising",
+            temperature=2.4,
+            seed=9,
+            backend="jax",
+            input_file=str(tmp_path / "absent.txt"),
+            config_file=str(tmp_path / "absent_cfg.txt"),
+            output_file=str(tmp_path / "out.txt"),
+        )
+    )
+    svc = _svc("jax")
+    sid = svc.submit(seeded_board(12, 12, seed=9), "ising", 8, seed=9, temperature=2.4)
+    svc.drain()
+    np.testing.assert_array_equal(svc.result(sid), res.board)
+    svc.close()
+
+
+def test_noisy_rule_through_serve():
+    rule = get_rule("noisy:0.1/conway")
+    board = seeded_board(13, 17, seed=4)
+    for backend in ("jax", "numpy"):
+        svc = _svc(backend)
+        sids = [svc.submit(board, rule, 6, seed=s) for s in (4, 5)]
+        svc.drain()
+        for sid, s in zip(sids, (4, 5)):
+            np.testing.assert_array_equal(
+                svc.result(sid), run_np(rule, board, s, 6)
+            )
+        svc.close()
+
+
+def test_mixed_deterministic_and_stochastic_batch():
+    # a det rule and a stochastic rule coexist: two CompileKeys, each
+    # executor correct
+    from tpu_life.ops.reference import run_np as det_run
+
+    board = seeded_board(10, 10, seed=0)
+    svc = _svc("jax")
+    det_sid = svc.submit(board, "conway", 7)
+    mc_sid = svc.submit(board, ISING, 7, seed=1, temperature=2.0)
+    svc.drain()
+    np.testing.assert_array_equal(
+        svc.result(det_sid), det_run(board, get_rule("conway"), 7)
+    )
+    np.testing.assert_array_equal(
+        svc.result(mc_sid), run_np(ISING, board, 1, 7, temperature=2.0)
+    )
+    assert len(svc.scheduler.compile_counts()) == 2
+    svc.close()
+
+
+def test_submit_validation_typed_errors():
+    board = seeded_board(8, 8, seed=0)
+    svc = _svc("jax")
+    with pytest.raises(ValueError, match="temperature"):
+        svc.submit(board, ISING, 4)  # ising needs a temperature
+    with pytest.raises(ValueError, match="temperature"):
+        svc.submit(board, "conway", 4, temperature=2.0)
+    with pytest.raises(ValueError, match="finite"):
+        svc.submit(board, ISING, 4, temperature=float("nan"))
+    svc.close()
+    # stochastic rules on a slot-loop executor: typed rejection at submit
+    # (before anything is stored), not a pump-time crash
+    svc = _svc("stripes")
+    with pytest.raises(ValueError, match="key schedule"):
+        svc.submit(board, ISING, 4, temperature=2.0)
+    assert len(svc.store) == 0
+    svc.close()
+
+
+def test_per_slot_failure_isolation_keeps_streams_exact():
+    # one faulty stochastic tenant dies alone; survivors' trajectories
+    # stay bit-identical to their solo runs
+    board = seeded_board(10, 10, seed=7)
+    svc = _svc("jax", capacity=3, chunk_steps=2)
+    ok1 = svc.submit(board, ISING, 8, seed=1, temperature=2.1)
+    bad = svc.submit(board, ISING, 8, seed=2, temperature=2.1, fault_at=3)
+    ok2 = svc.submit(board, ISING, 8, seed=3, temperature=2.1)
+    svc.drain()
+    assert svc.poll(bad).state is SessionState.FAILED
+    for sid, seed in ((ok1, 1), (ok2, 3)):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(ISING, board, seed, 8, temperature=2.1)
+        )
+    svc.close()
+
+
+def test_slot_reuse_resets_stream_state():
+    # a slot freed by a finished session and reused by a new one must
+    # start the new stream at step 0 with the new seed/temperature
+    board = seeded_board(10, 10, seed=1)
+    svc = _svc("jax", capacity=1, chunk_steps=4)
+    a = svc.submit(board, ISING, 4, seed=10, temperature=1.7)
+    b = svc.submit(board, ISING, 6, seed=20, temperature=2.9)
+    svc.drain()
+    np.testing.assert_array_equal(
+        svc.result(a), run_np(ISING, board, 10, 4, temperature=1.7)
+    )
+    np.testing.assert_array_equal(
+        svc.result(b), run_np(ISING, board, 20, 6, temperature=2.9)
+    )
+    svc.close()
+
+
+def test_seed_stamped_on_seeded_deterministic_sessions():
+    # the replay-record satellite: a seed passed with a deterministic rule
+    # is stamped into the session view (the gateway's seeded staging path)
+    svc = _svc("numpy")
+    sid = svc.submit(seeded_board(8, 8, seed=5), "conway", 2, seed=5)
+    svc.drain()
+    view = svc.poll(sid)
+    assert view.seed == 5 and view.temperature is None
+    svc.close()
+
+
+def test_render_view_carries_replay_fields():
+    from tpu_life.gateway import protocol
+
+    svc = _svc("jax")
+    sid = svc.submit(seeded_board(8, 8, seed=3), ISING, 2, seed=3, temperature=2.0)
+    svc.drain()
+    body = protocol.render_view(svc.poll(sid))
+    assert body["seed"] == 3 and body["temperature"] == 2.0
+    det = svc.submit(seeded_board(8, 8, seed=0), "conway", 1)
+    svc.drain()
+    det_body = protocol.render_view(svc.poll(det))
+    assert "seed" not in det_body and "temperature" not in det_body
+    svc.close()
+
+
+def test_gateway_protocol_stochastic_parse_and_errors():
+    from tpu_life.gateway import protocol
+    from tpu_life.gateway.errors import ApiError
+
+    spec = protocol.parse_submit(
+        {"size": 8, "steps": 4, "rule": "ising", "temperature": 2.27, "seed": 6}
+    )
+    assert spec.temperature == 2.27 and spec.seed == 6
+    np.testing.assert_array_equal(spec.board, seeded_board(8, 8, seed=6))
+    # typed 400s: missing/invalid temperature pairings
+    with pytest.raises(ApiError) as e:
+        protocol.parse_submit({"size": 8, "steps": 4, "rule": "ising"})
+    assert e.value.status == 400
+    with pytest.raises(ApiError) as e:
+        protocol.parse_submit(
+            {"size": 8, "steps": 4, "rule": "conway", "temperature": 2.0}
+        )
+    assert e.value.status == 400
+    with pytest.raises(ApiError) as e:
+        protocol.parse_submit(
+            {"size": 8, "steps": 4, "rule": "ising", "temperature": "hot"}
+        )
+    assert e.value.status == 400
+    with pytest.raises(ApiError) as e:
+        protocol.parse_submit(
+            {"size": 8, "steps": 4, "rule": "ising", "temperature": 2.0,
+             "seed": "abc"}
+        )
+    assert e.value.status == 400

@@ -224,11 +224,11 @@ def test_failure_during_initial_staging_is_retried(tmp_path, monkeypatch):
     calls = {"n": 0}
     real = drv.make_runner
 
-    def flaky(backend, board, rule):
+    def flaky(backend, board, rule, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
             raise RuntimeError("device detaching during staging")
-        return real(backend, board, rule)
+        return real(backend, board, rule, **kw)
 
     monkeypatch.setattr(drv, "make_runner", flaky)
     board, base = _setup(tmp_path)

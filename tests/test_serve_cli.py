@@ -110,15 +110,17 @@ def test_submit_size_seeded_board_is_self_contained(tmp_path, monkeypatch, capsy
     assert main(["serve", "--serve-backend", "numpy", "--capacity", "2"]) == 0
     summary = summary_line(capsys)
     assert summary["done"] == 2 and summary["failed"] == 0
-    from tpu_life.models.patterns import random_board
+    # staging is counter-based (tpu_life.mc.seeded_board): the seed names
+    # the identical board on every host, so spool lines replay anywhere
+    from tpu_life.mc import seeded_board
 
     np.testing.assert_array_equal(
         read_board(tmp_path / "serve_out" / "s000000.txt", 18, 18),
-        run_np(random_board(18, 18, seed=0), get_rule("conway"), 7),
+        run_np(seeded_board(18, 18, seed=0), get_rule("conway"), 7),
     )
     np.testing.assert_array_equal(
         read_board(tmp_path / "seeded_out.txt", 18, 18),
-        run_np(random_board(18, 18, seed=9), get_rule("highlife"), 4),
+        run_np(seeded_board(18, 18, seed=9), get_rule("highlife"), 4),
     )
 
 

@@ -92,12 +92,36 @@ class Backend(Protocol):
         callback: ChunkCallback | None = None,
     ) -> np.ndarray: ...
 
-def make_runner(backend: "Backend", board: np.ndarray, rule: Rule) -> Runner:
+def make_runner(
+    backend: "Backend",
+    board: np.ndarray,
+    rule: Rule,
+    *,
+    seed: int = 0,
+    temperature: float | None = None,
+    start_step: int = 0,
+) -> Runner:
     """Stage ``board`` on the backend's devices and return a Runner.
 
     Backends with device-resident state implement ``prepare``; host
-    backends fall back to ``HostRunner``.
+    backends fall back to ``HostRunner``.  Stochastic rules
+    (``tpu_life.mc``) dispatch to the MC runners, which also consume the
+    counter-based PRNG state: ``seed`` names the stream, ``start_step``
+    is the absolute resume point (so checkpoint/resume re-enters the
+    stream exactly), ``temperature`` is the ising scalar.  Backends
+    without the key schedule are a typed rejection.
     """
+    if getattr(rule, "stochastic", False):
+        from tpu_life.mc.engine import mc_runner_for
+
+        return mc_runner_for(
+            backend,
+            board,
+            rule,
+            seed=seed,
+            temperature=temperature,
+            start_step=start_step,
+        )
     prep = getattr(backend, "prepare", None)
     if prep is not None:
         return prep(board, rule)
@@ -236,39 +260,45 @@ def get_backend(name: str, *, rule: Rule | None = None, **kwargs) -> Backend:
     from tpu_life.backends import numpy_backend, jax_backend, sharded_backend  # noqa: F401
 
     if name == "auto":
-        import jax
-
-        devices = jax.devices()
-        torus = rule is not None and rule.boundary == "torus"
-        if len(devices) > 1 and not torus:
-            name = "sharded"
-        elif (
-            torus
-            and len(devices) == 1
-            and devices[0].platform == "tpu"
-            and kwargs.get("partition_mode") in (None, "shard_map")
-            and kwargs.get("local_kernel") != "pallas"
-        ):
-            # n=1 mesh: the MESH torus constraints are vacuous and the
-            # sharded backend carries the Pallas torus kernel (tiling
-            # permitting; it degrades to the packed XLA torus scan
-            # itself).  User-pinned kwargs that can make _prepare_torus
-            # raise (gspmd, an explicit pallas pin on an infeasible
-            # board) keep the old single-device routing instead — auto
-            # must never raise.
-            name = "sharded"
-        elif devices[0].platform == "tpu":
-            # the Pallas deep-halo kernels are the fastest single-chip path
-            # (and fall back to the fused XLA scan on small boards); keep
-            # "auto" infallible if pallas itself cannot import
-            try:
-                from tpu_life.backends import pallas_backend  # noqa: F401
-
-                name = "pallas"
-            except ImportError:
-                name = "jax"
-        else:
+        if rule is not None and getattr(rule, "stochastic", False):
+            # stochastic rules run on the executors that implement the
+            # counter-based key schedule; the single-device XLA path is
+            # the accelerated one (numpy stays the explicit ground truth)
             name = "jax"
+        else:
+            import jax
+
+            devices = jax.devices()
+            torus = rule is not None and rule.boundary == "torus"
+            if len(devices) > 1 and not torus:
+                name = "sharded"
+            elif (
+                torus
+                and len(devices) == 1
+                and devices[0].platform == "tpu"
+                and kwargs.get("partition_mode") in (None, "shard_map")
+                and kwargs.get("local_kernel") != "pallas"
+            ):
+                # n=1 mesh: the MESH torus constraints are vacuous and the
+                # sharded backend carries the Pallas torus kernel (tiling
+                # permitting; it degrades to the packed XLA torus scan
+                # itself).  User-pinned kwargs that can make _prepare_torus
+                # raise (gspmd, an explicit pallas pin on an infeasible
+                # board) keep the old single-device routing instead — auto
+                # must never raise.
+                name = "sharded"
+            elif devices[0].platform == "tpu":
+                # the Pallas deep-halo kernels are the fastest single-chip
+                # path (and fall back to the fused XLA scan on small
+                # boards); keep "auto" infallible if pallas cannot import
+                try:
+                    from tpu_life.backends import pallas_backend  # noqa: F401
+
+                    name = "pallas"
+                except ImportError:
+                    name = "jax"
+            else:
+                name = "jax"
     if name not in BACKENDS:
         try:
             if name == "pallas":

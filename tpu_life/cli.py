@@ -161,6 +161,54 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--profile", default=None, metavar="TRACE_DIR")
     srv.add_argument("--verbose", "-v", action="store_true")
 
+    sw = sub.add_parser(
+        "sweep",
+        help="temperature sweep (docs/STOCHASTIC.md): fan a temperature "
+        "grid into one ising session per temperature through the "
+        "continuous-batching service — mixed temperatures share ONE "
+        "compiled vmapped step",
+    )
+    sw.add_argument("--size", type=int, default=None,
+                    help="square lattice edge (or --height/--width)")
+    sw.add_argument("--height", type=int, default=None)
+    sw.add_argument("--width", type=int, default=None)
+    sw.add_argument("--steps", type=int, required=True,
+                    help="Metropolis sweeps per session")
+    sw.add_argument("--rule", default="ising",
+                    help="stochastic rule to sweep (ising)")
+    sw.add_argument(
+        "--temps",
+        default="1.5:3.0:8",
+        metavar="SPEC",
+        help="temperature grid: comma list 'T1,T2,...' or range 'lo:hi:n' "
+        "(n points, endpoints included; default 1.5:3.0:8 brackets the "
+        "Onsager critical point T~2.269)",
+    )
+    sw.add_argument("--seed", type=int, default=0,
+                    help="counter-based PRNG seed shared by every session "
+                    "(the temperature is the only thing that varies)")
+    sw.add_argument("--density", type=float, default=0.5,
+                    help="seeded initial-board density")
+    sw.add_argument(
+        "--serve-backend",
+        default="jax",
+        choices=["jax", "numpy"],
+        help="engine executor (stochastic rules run on the executors "
+        "implementing the counter-based key schedule)",
+    )
+    sw.add_argument("--capacity", type=int, default=None,
+                    help="batch slots (default: one per temperature, so "
+                    "the whole grid runs as one batch)")
+    sw.add_argument("--chunk-steps", type=int, default=16)
+    sw.add_argument("--output-dir", default=None, metavar="DIR",
+                    help="also write each final lattice to "
+                    "DIR/<session-id>.txt (contract board format)")
+    sw.add_argument("--metrics-file", default=None, metavar="JSONL",
+                    help="append per-round serve metrics as JSON lines")
+    sw.add_argument("--platform", default=None,
+                    help="force a JAX platform (cpu/tpu), like `run --platform`")
+    sw.add_argument("--verbose", "-v", action="store_true")
+
     gw = sub.add_parser(
         "gateway",
         help="HTTP front door over the batched simulation service: JSON "
@@ -294,6 +342,8 @@ def build_parser() -> argparse.ArgumentParser:
     cl.add_argument("--seed", type=int, default=None,
                     help="seed for a server-seeded board")
     cl.add_argument("--density", type=float, default=None)
+    cl.add_argument("--temperature", type=float, default=None, metavar="T",
+                    help="Metropolis temperature for --rule ising")
     cl.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="per-request deadline submitted with the session")
     cl.add_argument("--wait", action="store_true",
@@ -340,6 +390,9 @@ def build_parser() -> argparse.ArgumentParser:
     sm.add_argument("--seed", type=int, default=0,
                     help="seed for the no-input-file random board")
     sm.add_argument("--rule", default="conway")
+    sm.add_argument("--temperature", type=float, default=None, metavar="T",
+                    help="Metropolis temperature for --rule ising "
+                    "(per-session; rides the spool line)")
     sm.add_argument("--output-file", default=None,
                     help="where `serve` writes this request's result "
                     "(default: <output-dir>/<session-id>.txt)")
@@ -371,6 +424,23 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument("--width", type=int, default=None)
     r.add_argument("--steps", type=int, default=None)
     r.add_argument("--rule", default="conway", help="name or B/S / LtL spec")
+    r.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="counter-based PRNG seed (docs/STOCHASTIC.md): names the "
+        "whole trajectory for stochastic rules (ising / noisy:*) and the "
+        "staged board for seeded exploratory runs; stamped into the run "
+        "record so any run is replayable",
+    )
+    r.add_argument(
+        "--temperature",
+        type=float,
+        default=None,
+        metavar="T",
+        help="Metropolis temperature for --rule ising (required there, "
+        "invalid elsewhere); the Onsager critical point is T~2.269",
+    )
     r.add_argument(
         "--bug-compat",
         action="store_true",
@@ -568,6 +638,8 @@ def main(argv: list[str] | None = None) -> int:
         return _tune(args)
     if args.command == "serve":
         return _serve(args)
+    if args.command == "sweep":
+        return _sweep(parser, args)
     if args.command == "gateway":
         return _gateway(args)
     cfg = RunConfig(
@@ -578,6 +650,8 @@ def main(argv: list[str] | None = None) -> int:
         input_file=args.input_file,
         output_file=args.output_file,
         rule=args.rule,
+        seed=args.seed,
+        temperature=args.temperature,
         bug_compat=args.bug_compat,
         backend=args.backend,
         num_devices=args.num_devices,
@@ -667,7 +741,9 @@ def _info() -> int:
     print(
         "rule axes: B/S + Generations /C + Larger-than-Life R,C,M,S,B specs; "
         "neighborhoods NM (Moore) / NN (von Neumann); topology clamped "
-        "(default) / board-sized torus via the ':T' suffix"
+        "(default) / board-sized torus via the ':T' suffix; stochastic "
+        "rules ising (needs --temperature) and noisy:<p>/<base> "
+        "(docs/STOCHASTIC.md)"
     )
     return 0
 
@@ -891,8 +967,13 @@ def _submit(args) -> int:
             "width": width,
             "steps": steps,
             "rule": args.rule,
+            # stochastic rules consume the stream even with a file board;
+            # stamping the seed keeps the spool line a full replay record
+            "seed": args.seed,
         }
         source = args.input_file
+    if args.temperature is not None:
+        req["temperature"] = args.temperature
     if args.output_file is not None:
         req["output_file"] = args.output_file
     if args.timeout is not None:
@@ -959,7 +1040,7 @@ def _serve(args) -> int:
     # well-behaved client of its own service
     from tpu_life.serve import QueueFull
 
-    from tpu_life.models.patterns import random_board
+    from tpu_life import mc
     from tpu_life.models.rules import get_rule
 
     submitted: list[tuple[str, dict]] = []
@@ -969,8 +1050,10 @@ def _serve(args) -> int:
                 board = read_board(req["input_file"], req["height"], req["width"])
             else:
                 # a seeded request (`submit --size`): no board file exists,
-                # the spool line fully describes the workload
-                board = random_board(
+                # the spool line fully describes the workload — staged from
+                # the counter-based stream so the seed names the same board
+                # on every host (docs/STOCHASTIC.md)
+                board = mc.seeded_board(
                     req["height"],
                     req["width"],
                     states=get_rule(req.get("rule", "conway")).states,
@@ -983,6 +1066,8 @@ def _serve(args) -> int:
                         req.get("rule", "conway"),
                         int(req["steps"]),
                         timeout_s=req.get("timeout_s"),
+                        seed=req.get("seed"),
+                        temperature=req.get("temperature"),
                     )
                     break
                 except QueueFull:
@@ -1036,6 +1121,135 @@ def _serve(args) -> int:
                 "completion_p50": stats["completion_p50"],
                 "rejections": stats["rejections"],
                 "failures": failures,
+            }
+        )
+    )
+    return 0 if not failures else 1
+
+
+def _parse_temps(parser, spec: str) -> list[float]:
+    """'T1,T2,...' or 'lo:hi:n' -> temperature grid, loudly on malformation."""
+    spec = spec.strip()
+    try:
+        if ":" in spec:
+            lo_s, hi_s, n_s = spec.split(":")
+            lo, hi, n = float(lo_s), float(hi_s), int(n_s)
+            if n < 1:
+                raise ValueError
+            if n == 1:
+                return [lo]
+            return [lo + (hi - lo) * i / (n - 1) for i in range(n)]
+        temps = [float(t) for t in spec.split(",") if t.strip()]
+        if not temps:
+            raise ValueError
+        return temps
+    except ValueError:
+        parser.error(
+            f"--temps must be 'T1,T2,...' or 'lo:hi:n', got {spec!r}"
+        )
+
+
+def _sweep(parser, args) -> int:
+    """The temperature-sweep front (docs/STOCHASTIC.md): N ising sessions
+    — same seed, same board, one temperature each — through the
+    continuous-batching service, magnetization per temperature out as one
+    JSON line.  The MPMD parameter-sweep shape: the whole grid shares ONE
+    CompileKey (temperature rides per-slot), which the summary's
+    ``compile_counts`` lets scripts assert."""
+    import json
+    from pathlib import Path
+
+    from tpu_life import mc
+    from tpu_life.models.rules import get_rule
+    from tpu_life.runtime.metrics import configure_logging
+    from tpu_life.serve import QueueFull, ServeConfig, SessionState, SimulationService
+
+    configure_logging(args.verbose)
+    height = args.height if args.height is not None else args.size
+    width = args.width if args.width is not None else args.size
+    if height is None or width is None:
+        parser.error("sweep needs --size (or --height/--width)")
+    temps = _parse_temps(parser, args.temps)
+    rule = get_rule(args.rule)
+    board = mc.seeded_board(
+        height, width, args.density, states=rule.states, seed=args.seed
+    )
+    capacity = args.capacity if args.capacity is not None else len(temps)
+    svc = SimulationService(
+        ServeConfig(
+            capacity=capacity,
+            chunk_steps=args.chunk_steps,
+            max_queue=max(64, len(temps)),
+            backend=args.serve_backend,
+            metrics=bool(args.metrics_file),
+            metrics_file=args.metrics_file,
+        )
+    )
+    try:
+        sids: list[str] = []
+        for t in temps:
+            while True:
+                try:
+                    sids.append(
+                        svc.submit(
+                            board,
+                            rule,
+                            args.steps,
+                            seed=args.seed,
+                            temperature=t,
+                        )
+                    )
+                    break
+                except QueueFull:
+                    svc.pump()
+        svc.drain()
+        # snapshot BEFORE close: close() releases idle engines, and the
+        # summary's compile_counts (the one-compile sweep invariant CI
+        # asserts) lives on them
+        stats = svc.stats()
+    finally:
+        svc.close()
+
+    out_dir = Path(args.output_dir) if args.output_dir else None
+    sessions = []
+    failures = 0
+    for sid, t in zip(sids, temps):
+        view = svc.poll(sid)
+        entry = {
+            "session": sid,
+            "temperature": t,
+            "state": view.state.value,
+            "steps": view.steps_done,
+        }
+        if view.state is SessionState.DONE:
+            entry["magnetization"] = mc.ising.magnetization(view.result)
+            if out_dir is not None:
+                from tpu_life.io.codec import write_board
+
+                out_dir.mkdir(parents=True, exist_ok=True)
+                write_board(out_dir / f"{sid}.txt", view.result)
+        else:
+            entry["error"] = view.error
+            failures += 1
+        sessions.append(entry)
+    print(
+        json.dumps(
+            {
+                "mode": "sweep",
+                "run_id": stats["run_id"],
+                "rule": rule.name,
+                "seed": args.seed,
+                "height": height,
+                "width": width,
+                "steps": args.steps,
+                "backend": args.serve_backend,
+                "capacity": capacity,
+                "sessions": sessions,
+                "done": stats["done"],
+                "failed": stats["failed"],
+                "rounds": stats["rounds"],
+                "elapsed_s": stats["elapsed_s"],
+                "compile_counts": stats["compile_counts"],
             }
         )
     )
@@ -1259,6 +1473,12 @@ def _client(parser, args) -> int:
         if args.steps is None:
             parser.error("client submit needs --steps")
         kwargs: dict = dict(rule=args.rule, steps=args.steps, timeout_s=args.timeout)
+        if args.temperature is not None:
+            kwargs["temperature"] = args.temperature
+        if args.seed is not None:
+            # meaningful for inline boards too: a stochastic rule's
+            # trajectory is named by (board, seed, temperature)
+            kwargs["seed"] = args.seed
         if args.input_file is not None:
             from tpu_life.config import RunConfig
             from tpu_life.io.codec import read_board

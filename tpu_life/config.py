@@ -30,6 +30,14 @@ class RunConfig:
     # rule + semantics
     rule: str = "conway"
     bug_compat: bool = False  # replicate the shipped binary's effective B/S2 rule
+    # stochastic tier (tpu_life.mc, docs/STOCHASTIC.md): the counter-based
+    # PRNG seed — names the whole trajectory for stochastic rules AND the
+    # staged board for seeded exploratory runs (stamped into RunResult so
+    # every run is replayable from its telemetry record)
+    seed: int = 0
+    # per-run Metropolis temperature; required by (and only valid for) the
+    # ising rule
+    temperature: float | None = None
 
     # execution
     # "tuned" resolves backend + perf knobs through tpu_life.autotune

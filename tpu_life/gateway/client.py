@@ -149,11 +149,22 @@ class GatewayClient:
         width: int | None = None,
         seed: int | None = None,
         density: float | None = None,
+        temperature: float | None = None,
     ) -> str:
-        """Create a session (inline board, or seeded geometry); returns sid."""
+        """Create a session (inline board, or seeded geometry); returns sid.
+
+        ``seed`` and ``temperature`` are the stochastic-tier fields
+        (docs/STOCHASTIC.md): seed names the counter-based PRNG stream
+        (and, for seeded geometry, the staged board); temperature is the
+        per-session ising scalar.
+        """
         req: dict = {"rule": rule, "steps": steps}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
+        if temperature is not None:
+            req["temperature"] = temperature
+        if seed is not None:
+            req["seed"] = seed
         if board is not None:
             req["board"] = board_rows(board)
         else:
@@ -161,7 +172,6 @@ class GatewayClient:
                 ("size", size),
                 ("height", height),
                 ("width", width),
-                ("seed", seed),
                 ("density", density),
             ):
                 if v is not None:

@@ -17,6 +17,13 @@ shorthand over the wire — demos need no input file)::
     {"size": 256, "steps": 64}              # or "height" + "width"
     {"height": 128, "width": 96, "steps": 8, "seed": 7, "density": 0.4}
 
+Stochastic sessions (docs/STOCHASTIC.md) add the per-session Monte-Carlo
+fields — ``seed`` names the counter-based PRNG stream, ``temperature``
+is the ising Metropolis scalar (required there, a typed 400 elsewhere)::
+
+    {"size": 128, "steps": 200, "rule": "ising",
+     "temperature": 2.27, "seed": 42}
+
 Result payload (``GET /v1/sessions/{sid}/result?format=rle|raw``):
 ``rle`` is the ecosystem interchange text (``io/rle.py``); ``raw`` is
 base64 of the byte-exact contract board format (``io/codec.py``) — the
@@ -34,7 +41,8 @@ import numpy as np
 from tpu_life.gateway.errors import ApiError, bad_request
 from tpu_life.io.codec import decode_board, encode_board
 from tpu_life.io.rle import emit_rle
-from tpu_life.models.patterns import random_board
+from tpu_life.mc import validate_params as mc_validate_params
+from tpu_life.mc.prng import seeded_board
 from tpu_life.models.rules import get_rule
 from tpu_life.serve.sessions import SessionView
 
@@ -49,12 +57,20 @@ MAX_BODY = 8 << 20
 
 @dataclass(frozen=True)
 class SubmitSpec:
-    """A validated submission, ready for ``SimulationService.submit``."""
+    """A validated submission, ready for ``SimulationService.submit``.
+
+    ``seed``/``temperature`` are the stochastic-tier fields
+    (docs/STOCHASTIC.md): the counter-based PRNG stream id and the
+    per-session ising scalar.  ``seed`` is also set for seeded-geometry
+    deterministic requests (it named the staged board).
+    """
 
     board: np.ndarray
     rule: str
     steps: int
     timeout_s: float | None
+    seed: int | None = None
+    temperature: float | None = None
 
 
 def _require_int(payload: dict, key: str, *, minimum: int = 0) -> int:
@@ -147,10 +163,38 @@ def parse_submit(payload) -> SubmitSpec:
                 "invalid_request", f"'timeout_s' must be a number, got {timeout_s!r}"
             )
         timeout_s = float(timeout_s)
+    temperature = payload.get("temperature")
+    if temperature is not None:
+        if isinstance(temperature, bool) or not isinstance(
+            temperature, (int, float)
+        ):
+            raise bad_request(
+                "invalid_request",
+                f"'temperature' must be a number, got {temperature!r}",
+            )
+        temperature = float(temperature)
+    try:
+        # the (rule, temperature) pairing contract (tpu_life.mc): ising
+        # needs one, nothing else takes one — typed 400, not a late 500
+        mc_validate_params(rule, temperature)
+    except ValueError as e:
+        raise bad_request("invalid_request", str(e)) from None
+    seed = (
+        _require_int(payload, "seed", minimum=-(1 << 63))
+        if "seed" in payload
+        else None
+    )
 
     if "board" in payload:
         board = parse_board(payload["board"], rule.states)
-        return SubmitSpec(board=board, rule=rule_name, steps=steps, timeout_s=timeout_s)
+        return SubmitSpec(
+            board=board,
+            rule=rule_name,
+            steps=steps,
+            timeout_s=timeout_s,
+            seed=seed,
+            temperature=temperature,
+        )
 
     # seeded geometry: the self-contained demo path (run --size over HTTP);
     # explicit height/width win over the square 'size' shorthand
@@ -172,7 +216,6 @@ def parse_submit(payload) -> SubmitSpec:
             "board_too_large",
             f"seeded board has {height * width} cells; the limit is {MAX_CELLS}",
         )
-    seed = _require_int(payload, "seed") if "seed" in payload else 0
     density = payload.get("density", 0.5)
     if isinstance(density, bool) or not isinstance(density, (int, float)):
         raise bad_request("invalid_request", "'density' must be a number")
@@ -180,16 +223,26 @@ def parse_submit(payload) -> SubmitSpec:
         raise bad_request(
             "invalid_request", f"'density' must be in [0, 1], got {density}"
         )
-    board = random_board(
-        height, width, float(density), states=rule.states, seed=seed
+    # counter-based staging (tpu_life.mc.prng): the board a seed names is
+    # identical on every host, so the echoed seed fully replays the run
+    staged_seed = 0 if seed is None else seed
+    board = seeded_board(
+        height, width, float(density), states=rule.states, seed=staged_seed
     )
-    return SubmitSpec(board=board, rule=rule_name, steps=steps, timeout_s=timeout_s)
+    return SubmitSpec(
+        board=board,
+        rule=rule_name,
+        steps=steps,
+        timeout_s=timeout_s,
+        seed=staged_seed,
+        temperature=temperature,
+    )
 
 
 # -- responses -------------------------------------------------------------
 def render_view(view: SessionView) -> dict:
     """``poll`` response body (no board — results have their own route)."""
-    return {
+    out = {
         "session": view.sid,
         "state": view.state.value,
         "rule": view.rule,
@@ -199,6 +252,14 @@ def render_view(view: SessionView) -> dict:
         "finished": view.finished,
         "error": view.error,
     }
+    # the replay record (docs/STOCHASTIC.md) — present only when the
+    # session consumed the stochastic tier, so deterministic responses
+    # keep their exact prior shape
+    if view.seed is not None:
+        out["seed"] = view.seed
+    if view.temperature is not None:
+        out["temperature"] = view.temperature
+    return out
 
 
 def render_result(board: np.ndarray, fmt: str, rule: str) -> dict:

@@ -440,7 +440,12 @@ class _Handler(JsonHandler):
         spec = protocol.parse_submit(self._read_body())
         try:
             sid = svc.submit(
-                spec.board, spec.rule, spec.steps, timeout_s=spec.timeout_s
+                spec.board,
+                spec.rule,
+                spec.steps,
+                timeout_s=spec.timeout_s,
+                seed=spec.seed,
+                temperature=spec.temperature,
             )
         except Exception as e:  # typed serve errors -> typed HTTP
             raise gw_errors.from_serve_error(e) from e

@@ -1,0 +1,189 @@
+"""Stochastic CA & Monte-Carlo tier (docs/STOCHASTIC.md).
+
+The TPU-cluster Ising paper (PAPERS.md, arXiv:1903.11714) runs the exact
+stencil + halo skeleton this repo already has — what it adds is *noise*:
+Metropolis sweeps whose accept/reject draws come from an on-device
+counter-based PRNG.  This package is that tier:
+
+- :mod:`tpu_life.mc.prng` — portable Threefry-2x32 keyed by
+  ``(seed, step, cell, substream)``: any trajectory is bit-reproducible
+  from its seed regardless of chunking, backend (numpy vs XLA), or
+  checkpoint/resume point, because the stream is a pure function of the
+  counter, never of execution order.
+- :mod:`tpu_life.mc.ising` — Metropolis–Hastings via the checkerboard
+  decomposition (two half-lattice updates per sweep), temperature as a
+  per-session scalar folded into a 5-entry uint32 acceptance table.
+- :mod:`tpu_life.mc.noisy` — noisy-Life: any registered 2-state rule
+  composed with a per-cell flip probability.
+- :mod:`tpu_life.mc.engine` — the serve executors (vmapped device batch
+  + numpy ground truth, mixed temperatures in ONE CompileKey) and the
+  single-run Runners behind ``run --rule ising``.
+
+The dispatchers below (``step_np`` / ``run_np`` / ``make_step_fn``) are
+the single seam the backends, engines and tests share, so the jax and
+numpy paths cannot drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_life.models.rules import IsingRule, NoisyRule, Rule
+from tpu_life.mc import prng
+from tpu_life.mc.prng import key_halves, seeded_board
+from tpu_life.mc import ising, noisy
+
+
+def validate_params(rule: Rule, temperature: float | None) -> None:
+    """Typed errors for the (rule, temperature) pairing — shared by the
+    driver, the serve submit path and the gateway protocol so every
+    front speaks the same contract."""
+    if isinstance(rule, IsingRule):
+        if temperature is None:
+            raise ValueError(
+                f"rule {rule.name!r} is a Metropolis sampler and needs a "
+                f"temperature (e.g. --temperature 2.27)"
+            )
+        t = float(temperature)
+        if not np.isfinite(t) or t < 0.0:
+            raise ValueError(
+                f"temperature must be a finite number >= 0, got {temperature!r}"
+            )
+    elif temperature is not None:
+        raise ValueError(
+            f"temperature only applies to the 'ising' rule; rule "
+            f"{rule.name!r} does not take one"
+        )
+
+
+#: Executors implementing the counter-based key schedule.  THE single
+#: allow-list — the driver pre-check, the runner factory and the serve
+#: engine factory all consult it, so adding a stochastic-capable backend
+#: (e.g. a future sharded path) is a one-line change.
+SUPPORTED_BACKENDS = ("jax", "numpy")
+
+
+def require_key_schedule(rule: Rule, backend_name: str) -> None:
+    """The hard gate: ``backend_name`` must implement the key schedule.
+    A silent fallback would produce a different (and irreproducible)
+    trajectory, which is worse than an error."""
+    if backend_name not in SUPPORTED_BACKENDS:
+        raise ValueError(
+            f"stochastic rule {rule.name!r} needs the jax or numpy backend "
+            f"(the counter-based per-cell key schedule is not implemented "
+            f"for {backend_name!r}); a silent deterministic fallback would "
+            f"not be the rule you asked for"
+        )
+
+
+def ensure_backend_supported(rule: Rule, backend_name: str) -> None:
+    """Driver-facing form of :func:`require_key_schedule`: ``auto`` is
+    allowed through (get_backend resolves it to a supported executor)."""
+    if getattr(rule, "stochastic", False) and backend_name != "auto":
+        require_key_schedule(rule, backend_name)
+
+
+def validate_board_shape(rule: Rule, shape: tuple[int, int]) -> None:
+    """Typed rejection for lattices the rule cannot run correctly.
+
+    The ising checkerboard 2-coloring is only a valid independent-set
+    decomposition on the torus when BOTH dimensions are even: with an
+    odd dimension, wrap-seam neighbors share a parity, so the two
+    half-updates would step coupled spins simultaneously — no longer
+    Metropolis.  Rejected loudly at every front rather than sampling
+    the wrong distribution.
+    """
+    if isinstance(rule, IsingRule):
+        h, w = int(shape[0]), int(shape[1])
+        if h % 2 or w % 2:
+            raise ValueError(
+                f"rule {rule.name!r} needs even lattice dimensions (the "
+                f"torus checkerboard 2-coloring breaks across the wrap "
+                f"seam on odd sizes), got {h}x{w}"
+            )
+
+
+def make_step_fn(xp, rule: Rule):
+    """One stochastic step as ``fn(board, k0, k1, step, thresholds)``.
+
+    ``xp`` is ``numpy`` or ``jax.numpy``; the returned callable is pure
+    and traceable (usable under jit/vmap/scan when ``xp`` is jnp).
+    ``thresholds`` is the ising uint32[5] acceptance table (per-slot in
+    the batched engine); noisy rules ignore it (their flip probability is
+    frozen in the rule itself).
+    """
+    if isinstance(rule, IsingRule):
+        def step(board, k0, k1, step_idx, thresholds):
+            return ising.sweep(xp, board, k0, k1, step_idx, thresholds)
+
+        return step
+    if isinstance(rule, NoisyRule):
+        base = noisy.make_noisy_step(xp, rule)
+
+        def step(board, k0, k1, step_idx, thresholds=None):  # noqa: ARG001
+            return base(board, k0, k1, step_idx)
+
+        return step
+    raise ValueError(f"rule {rule.name!r} is not stochastic")
+
+
+def step_np(
+    rule: Rule,
+    board: np.ndarray,
+    seed: int,
+    step: int,
+    *,
+    temperature: float | None = None,
+) -> np.ndarray:
+    """One ground-truth NumPy step at absolute step index ``step``."""
+    k0, k1 = key_halves(seed)
+    thr = (
+        ising.acceptance_thresholds(temperature)
+        if isinstance(rule, IsingRule)
+        else None
+    )
+    return make_step_fn(np, rule)(board, k0, k1, np.uint32(step), thr)
+
+
+def run_np(
+    rule: Rule,
+    board: np.ndarray,
+    seed: int,
+    steps: int,
+    *,
+    temperature: float | None = None,
+    start_step: int = 0,
+) -> np.ndarray:
+    """``steps`` ground-truth NumPy steps from absolute ``start_step`` —
+    the oracle every other executor is pinned bit-identical against."""
+    validate_params(rule, temperature)
+    k0, k1 = key_halves(seed)
+    thr = (
+        ising.acceptance_thresholds(temperature)
+        if isinstance(rule, IsingRule)
+        else None
+    )
+    fn = make_step_fn(np, rule)
+    board = np.asarray(board, np.int8)
+    for i in range(steps):
+        board = fn(board, k0, k1, np.uint32(start_step + i), thr)
+    return board
+
+
+__all__ = [
+    "SUPPORTED_BACKENDS",
+    "IsingRule",
+    "NoisyRule",
+    "ensure_backend_supported",
+    "require_key_schedule",
+    "validate_board_shape",
+    "ising",
+    "key_halves",
+    "make_step_fn",
+    "noisy",
+    "prng",
+    "run_np",
+    "seeded_board",
+    "step_np",
+    "validate_params",
+]

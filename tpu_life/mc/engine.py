@@ -1,0 +1,370 @@
+"""Stochastic executors: single-run Runners and batched serve engines.
+
+Mirrors the deterministic split (``backends.jax_backend.DeviceRunner`` /
+``serve.engine.VmapEngine`` / ``HostBatchEngine``) with one extra piece
+of state everywhere: the **absolute step counter** feeding the
+counter-based key schedule (``tpu_life.mc.prng``).  The counter advances
+with the trajectory, never with the host loop, so chunking, batching and
+checkpoint/resume all read the same stream:
+
+- :class:`MCHostRunner` / :class:`MCDeviceRunner` — the ``run --rule
+  ising`` path (numpy ground truth / single-device XLA).  Both accept a
+  ``start_step`` so a resumed run re-enters the stream exactly where the
+  snapshot left it.
+- :class:`MCVmapEngine` / :class:`MCHostEngine` — the serve path.  Seed,
+  temperature (as a uint32[5] acceptance table) and per-slot step
+  counters ride in the batch alongside the boards, so a **mixed batch of
+  temperatures runs under ONE compiled vmapped step** (one CompileKey,
+  ``compile_count == 1``) and a frozen slot's counter freezes with its
+  board — each session's trajectory is bit-identical to its own
+  single-session run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_life.mc import (
+    ising,
+    make_step_fn,
+    require_key_schedule,
+    validate_board_shape,
+    validate_params,
+)
+from tpu_life.mc.prng import key_halves
+from tpu_life.models.rules import IsingRule, Rule
+from tpu_life.serve.engine import CompileKey, EngineBase
+
+
+def _thresholds_for(rule: Rule, temperature: float | None) -> np.ndarray:
+    """uint32[5] acceptance table; zeros for rules that ignore it (the
+    noisy flip probability is frozen in the rule, not per-session)."""
+    if isinstance(rule, IsingRule) and temperature is not None:
+        return ising.acceptance_thresholds(temperature)
+    return np.zeros(5, dtype=np.uint32)
+
+
+# -- single-run runners (the driver path) ----------------------------------
+class MCHostRunner:
+    """NumPy ground-truth Runner for stochastic rules."""
+
+    def __init__(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        *,
+        seed: int = 0,
+        temperature: float | None = None,
+        start_step: int = 0,
+    ):
+        validate_params(rule, temperature)
+        self.board = np.asarray(board, np.int8)
+        validate_board_shape(rule, self.board.shape)
+        self.step = int(start_step)
+        self._k0, self._k1 = key_halves(seed)
+        self._thr = _thresholds_for(rule, temperature)
+        self._fn = make_step_fn(np, rule)
+
+    def advance(self, steps: int) -> None:
+        for _ in range(steps):
+            self.board = self._fn(
+                self.board, self._k0, self._k1, np.uint32(self.step), self._thr
+            )
+            self.step += 1
+
+    def sync(self) -> None:
+        pass
+
+    def fetch(self) -> np.ndarray:
+        return self.board
+
+    def snapshot(self):
+        return lambda board=self.board: board
+
+    def live_count(self) -> int:
+        return int(np.count_nonzero(self.board == 1))
+
+
+class MCDeviceRunner:
+    """Single-device XLA Runner: fused scan with the step counter in the
+    carry, donated buffers, no host round-trip per advance."""
+
+    def __init__(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        *,
+        seed: int = 0,
+        temperature: float | None = None,
+        start_step: int = 0,
+        device=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        validate_params(rule, temperature)
+        board = np.asarray(board, np.int8)
+        validate_board_shape(rule, board.shape)
+        self._jnp = jnp
+        k0, k1 = key_halves(seed)
+        self._k0 = jnp.uint32(k0)
+        self._k1 = jnp.uint32(k1)
+        self._thr = jax.device_put(
+            jnp.asarray(_thresholds_for(rule, temperature)), device
+        )
+        self.x = jax.device_put(jnp.asarray(board, jnp.int8), device)
+        self._step = jnp.uint32(int(start_step))
+        step_fn = make_step_fn(jnp, rule)
+
+        def advance(x, st, k0, k1, thr, *, steps):
+            def body(carry, _):
+                b, s = carry
+                b = step_fn(b, k0, k1, s, thr)
+                return (b, s + jnp.uint32(1)), None
+
+            (x, st), _ = jax.lax.scan(body, (x, st), None, length=steps)
+            return x, st
+
+        self._advance = jax.jit(
+            advance, static_argnames=("steps",), donate_argnums=(0, 1)
+        )
+
+    def advance(self, steps: int) -> None:
+        if steps > 0:
+            self.x, self._step = self._advance(
+                self.x, self._step, self._k0, self._k1, self._thr, steps=steps
+            )
+
+    def sync(self) -> None:
+        import jax
+
+        jax.block_until_ready(self.x)
+        np.asarray(self.x[:1, :1])
+
+    def fetch(self) -> np.ndarray:
+        return np.asarray(self.x)
+
+    def snapshot(self):
+        # valid until the next advance donates the buffer — materialize
+        # within the chunk callback, matching DeviceRunner's contract
+        return lambda x=self.x: np.asarray(x)
+
+    def live_count(self) -> int:
+        return int(np.count_nonzero(self.fetch() == 1))
+
+
+def mc_runner_for(
+    backend,
+    board: np.ndarray,
+    rule: Rule,
+    *,
+    seed: int = 0,
+    temperature: float | None = None,
+    start_step: int = 0,
+):
+    """Runner factory for stochastic rules, dispatched on the backend.
+
+    Only the ``mc.SUPPORTED_BACKENDS`` executors implement the
+    counter-based key schedule; anything else is a typed rejection
+    (never a silent deterministic fallback).
+    """
+    name = getattr(backend, "name", "") or type(backend).__name__
+    require_key_schedule(rule, name)
+    if name == "jax":
+        return MCDeviceRunner(
+            board,
+            rule,
+            seed=seed,
+            temperature=temperature,
+            start_step=start_step,
+            device=getattr(backend, "device", None),
+        )
+    return MCHostRunner(
+        board, rule, seed=seed, temperature=temperature, start_step=start_step
+    )
+
+
+# -- batched serve engines -------------------------------------------------
+class MCVmapEngine(EngineBase):
+    """The stochastic device path: one jitted scan over the whole batch,
+    with per-slot (key, step-counter, acceptance-table) state vmapped
+    alongside the boards.  Temperature and seed are NOT in the
+    CompileKey, so a temperature sweep's N sessions pack into one
+    compiled program — the MPMD parameter-sweep shape of the ISSUE."""
+
+    def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
+        super().__init__(key, capacity, chunk_steps)
+        import jax
+        import jax.numpy as jnp
+
+        h, w = key.shape
+        self._jnp = jnp
+        self._boards = jax.device_put(jnp.zeros((capacity, h, w), jnp.int8))
+        self._rem_dev = jax.device_put(jnp.zeros(capacity, jnp.int32))
+        self._k0 = jax.device_put(jnp.zeros(capacity, jnp.uint32))
+        self._k1 = jax.device_put(jnp.zeros(capacity, jnp.uint32))
+        self._steps_abs = jax.device_put(jnp.zeros(capacity, jnp.uint32))
+        self._thr = jax.device_put(jnp.zeros((capacity, 5), jnp.uint32))
+        self._staged = (0, None, 0)  # (seed, temperature, start_step)
+
+        def set_slot(boards, rem, k0, k1, st, thr, slot, board, steps, kv0, kv1, stv, thrv):
+            return (
+                boards.at[slot].set(board),
+                rem.at[slot].set(steps),
+                k0.at[slot].set(kv0),
+                k1.at[slot].set(kv1),
+                st.at[slot].set(stv),
+                thr.at[slot].set(thrv),
+            )
+
+        self._set_slot = jax.jit(set_slot, donate_argnums=(0, 1, 2, 3, 4, 5))
+        self._chunk = None  # built lazily on first advance
+
+    def load(self, slot, board, steps, *, seed=None, temperature=None, start_step=0):
+        validate_params(self.key.rule, temperature)
+        self._staged = (int(seed or 0), temperature, int(start_step))
+        super().load(slot, board, steps)
+
+    def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
+        jnp = self._jnp
+        seed, temperature, start_step = self._staged
+        k0, k1 = key_halves(seed)
+        thr = _thresholds_for(self.key.rule, temperature)
+        (
+            self._boards,
+            self._rem_dev,
+            self._k0,
+            self._k1,
+            self._steps_abs,
+            self._thr,
+        ) = self._set_slot(
+            self._boards,
+            self._rem_dev,
+            self._k0,
+            self._k1,
+            self._steps_abs,
+            self._thr,
+            jnp.int32(slot),
+            jnp.asarray(board, jnp.int8),
+            jnp.int32(steps),
+            jnp.uint32(k0),
+            jnp.uint32(k1),
+            jnp.uint32(start_step),
+            jnp.asarray(thr),
+        )
+
+    def _clear_slot(self, slot: int) -> None:
+        h, w = self.key.shape
+        self._staged = (0, None, 0)
+        self._load_slot(slot, np.zeros((h, w), np.int8), 0)
+
+    def _build_chunk(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_life import obs
+
+        obs.instant(
+            "serve.compile",
+            rule=self.key.rule.name,
+            shape=f"{self.key.shape[0]}x{self.key.shape[1]}",
+            backend=self.key.backend,
+        )
+        vstep = jax.vmap(make_step_fn(jnp, self.key.rule))
+        length = self.chunk_steps
+
+        def chunk(boards, rem, st, k0, k1, thr):
+            def body(carry, _):
+                bs, r, s = carry
+                stepped = vstep(bs, k0, k1, s, thr)
+                live = r > 0
+                bs = jnp.where(live[:, None, None], stepped, bs)
+                # a frozen slot's counter freezes with its board: the
+                # stream position is a function of trajectory progress,
+                # not of how many rounds the slot sat in the batch
+                s = s + live.astype(jnp.uint32)
+                return (bs, jnp.maximum(r - 1, 0), s), None
+
+            (boards, rem, st), _ = jax.lax.scan(
+                body, (boards, rem, st), None, length=length
+            )
+            return boards, rem, st
+
+        self.compile_count += 1
+        return jax.jit(chunk, donate_argnums=(0, 1, 2))
+
+    def _advance_impl(self) -> None:
+        if self._chunk is None:
+            self._chunk = self._build_chunk()
+        self._boards, self._rem_dev, self._steps_abs = self._chunk(
+            self._boards,
+            self._rem_dev,
+            self._steps_abs,
+            self._k0,
+            self._k1,
+            self._thr,
+        )
+
+    def fetch(self, slot: int) -> np.ndarray:
+        return np.asarray(self._boards[slot])
+
+
+class MCHostEngine(EngineBase):
+    """NumPy executor on the batch layout — the ground truth the device
+    engine's equivalence tests pin against (same role as
+    ``HostBatchEngine`` for deterministic rules)."""
+
+    def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
+        super().__init__(key, capacity, chunk_steps)
+        h, w = key.shape
+        self._boards = np.zeros((capacity, h, w), dtype=np.int8)
+        self._keys = [(0, 0)] * capacity
+        self._steps_abs = np.zeros(capacity, dtype=np.int64)
+        self._thrs: list[np.ndarray] = [
+            np.zeros(5, np.uint32) for _ in range(capacity)
+        ]
+        self._fn = make_step_fn(np, key.rule)
+        self._staged = (0, None, 0)
+
+    def load(self, slot, board, steps, *, seed=None, temperature=None, start_step=0):
+        validate_params(self.key.rule, temperature)
+        self._staged = (int(seed or 0), temperature, int(start_step))
+        super().load(slot, board, steps)
+
+    def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
+        seed, temperature, start_step = self._staged
+        self._boards[slot] = board
+        self._keys[slot] = key_halves(seed)
+        self._steps_abs[slot] = start_step
+        self._thrs[slot] = _thresholds_for(self.key.rule, temperature)
+
+    def _clear_slot(self, slot: int) -> None:
+        self._boards[slot] = 0
+        self._staged = (0, None, 0)
+
+    def _advance_impl(self) -> None:
+        for slot, rem in enumerate(self._remaining):
+            n = min(self.chunk_steps, int(rem))
+            if n <= 0:
+                continue
+            k0, k1 = self._keys[slot]
+            b = self._boards[slot]
+            base = int(self._steps_abs[slot])
+            for i in range(n):
+                b = self._fn(b, k0, k1, np.uint32(base + i), self._thrs[slot])
+            self._boards[slot] = b
+            self._steps_abs[slot] = base + n
+
+    def fetch(self, slot: int) -> np.ndarray:
+        return self._boards[slot].copy()
+
+
+def make_mc_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
+    """Engine factory for stochastic CompileKeys (typed rejection for
+    executors without the key schedule — slot-loop backends would run a
+    different, irreproducible trajectory)."""
+    require_key_schedule(key.rule, key.backend)
+    validate_board_shape(key.rule, key.shape)
+    if key.backend == "jax":
+        return MCVmapEngine(key, capacity, chunk_steps)
+    return MCHostEngine(key, capacity, chunk_steps)

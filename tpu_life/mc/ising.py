@@ -1,0 +1,103 @@
+"""Checkerboard Metropolis–Hastings for the 2-D Ising model.
+
+The TPU-cluster Ising paper (PAPERS.md, arXiv:1903.11714) scales exactly
+this update to pods: color the lattice like a checkerboard, and within
+one color no two cells are coupled (the radius-1 von Neumann neighbors of
+any cell all have the other parity), so updating a whole color at once is
+*exactly* sequential single-site Metropolis restricted to that color —
+the vectorized sweep is not an approximation.  One "step" of the rule is
+one full sweep: the parity-0 half-update, then (reading the just-updated
+opposite color) the parity-1 half-update.
+
+Acceptance without floats on device: with J = 1 and 4 neighbors,
+dE = 2 * s * sum(neighbor spins) takes only values {-8, -4, 0, 4, 8}, so
+``min(1, exp(-dE/T))`` becomes a host-computed **uint32[5] threshold
+table** indexed by ``(s * nsum + 4) >> 1``; the device compares the
+cell's counter-based draw against its entry (dE <= 0 force-accepts
+exactly).  Temperature therefore rides alongside the batch as one tiny
+table per session — mixed temperatures share one compiled program — and
+the on-device step is pure integer work, bit-identical between numpy
+and XLA.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from tpu_life.mc import prng
+
+#: Onsager's exact critical temperature, 2 / ln(1 + sqrt(2)) — the point
+#: the slow statistical test brackets (ordered below, disordered above).
+T_CRITICAL = 2.0 / math.log(1.0 + math.sqrt(2.0))
+
+#: dE values by table index i = (s * nsum + 4) >> 1.
+_DELTA_E = (-8, -4, 0, 4, 8)
+
+
+def acceptance_thresholds(temperature: float) -> np.ndarray:
+    """uint32[5] Metropolis acceptance table for one temperature.
+
+    Entry i covers dE = _DELTA_E[i]; accept iff dE <= 0 (forced on
+    device) or u32 < entry.  T = 0 is exact: only dE <= 0 moves accept.
+    Host-side float math happens once per session here, so every
+    executor shares the identical integer table.
+    """
+    t = float(temperature)
+    if not np.isfinite(t) or t < 0.0:
+        raise ValueError(f"temperature must be finite and >= 0, got {temperature!r}")
+    out = np.zeros(5, dtype=np.uint32)
+    for i, de in enumerate(_DELTA_E):
+        if de <= 0:
+            out[i] = 0xFFFFFFFF  # informational; device force-accepts
+        elif t > 0.0:
+            out[i] = prng.threshold_u32(math.exp(-de / t))
+    return out
+
+
+def _neighbor_spin_sum(xp, spins):
+    """int32 sum of the 4 torus neighbors (roll = periodic wraparound)."""
+    return (
+        xp.roll(spins, 1, 0)
+        + xp.roll(spins, -1, 0)
+        + xp.roll(spins, 1, 1)
+        + xp.roll(spins, -1, 1)
+    )
+
+
+def _half_update(xp, board, k0, k1, step, parity, substream, thresholds):
+    h, w = board.shape[-2], board.shape[-1]
+    s = board.astype(xp.int32) * 2 - 1  # {0,1} -> {-1,+1}
+    nsum = _neighbor_spin_sum(xp, s)
+    # dE = 2*s*nsum in {-8,-4,0,4,8}; index i = (s*nsum + 4) >> 1 in 0..4
+    idx = (s * nsum + 4) >> 1
+    u = prng.cell_uniforms(xp, (h, w), k0, k1, step, substream)
+    accept = (idx <= 2) | (u < thresholds[idx])
+    rows = xp.arange(h, dtype=xp.int32)[:, None]
+    cols = xp.arange(w, dtype=xp.int32)[None, :]
+    on_color = ((rows + cols) & 1) == parity
+    flip = accept & on_color
+    return xp.where(flip, (1 - board).astype(board.dtype), board)
+
+
+def sweep(xp, board, k0, k1, step, thresholds):
+    """One full Metropolis sweep (both checkerboard half-updates).
+
+    ``board`` int8 {0,1}; ``k0``/``k1``/``step`` uint32 scalars (traced
+    under vmap in the batched engine); ``thresholds`` uint32[5] from
+    :func:`acceptance_thresholds`.  Pure and traceable for ``xp = jnp``.
+    """
+    board = _half_update(
+        xp, board, k0, k1, step, 0, prng.SUB_EVEN, thresholds
+    )
+    board = _half_update(
+        xp, board, k0, k1, step, 1, prng.SUB_ODD, thresholds
+    )
+    return board
+
+
+def magnetization(board: np.ndarray) -> float:
+    """|mean spin| in [0, 1] — ~1 ordered (low T), ~0 disordered (high T)."""
+    spins = np.asarray(board, np.int64) * 2 - 1
+    return abs(float(spins.mean()))

@@ -1,0 +1,54 @@
+"""Noisy-Life: a deterministic 2-state rule composed with per-cell flips.
+
+The step is ``flip(base_step(board))`` where ``flip`` inverts each cell
+independently with probability ``rule.flip_p``, drawn from the counter
+stream's ``SUB_NOISE`` substream at the cell's absolute step — so the
+noise is as reproducible as the rule, and the deterministic half reuses
+the existing stencil executors untouched (a :class:`NoisyRule` carries
+its base rule's structural fields, so ``ops.stencil.make_step`` /
+``ops.reference.step_np`` apply verbatim).
+
+``flip_p`` is frozen in the rule spec (``noisy:<p>/<base>``), so the
+endpoint probabilities specialize at build time: p = 0 compiles to the
+bare base step, p = 1 to an exact unconditional inversion — no 2^-32
+edge-of-threshold residue at either end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_life.mc import prng
+from tpu_life.models.rules import NoisyRule
+
+
+def make_noisy_step(xp, rule: NoisyRule):
+    """``fn(board, k0, k1, step) -> board`` for numpy or jax.numpy.
+
+    The base step comes from the module-appropriate deterministic
+    executor — the two are bit-identical by the repo's core invariant,
+    so the composed stochastic step is too.
+    """
+    if xp is np:
+        from tpu_life.ops.reference import step_np
+
+        base = lambda b: step_np(b, rule)
+    else:
+        from tpu_life.ops.stencil import make_step
+
+        base = make_step(rule)
+    p = float(rule.flip_p)
+    if p <= 0.0:
+        return lambda board, k0, k1, step: base(board)
+    h_thr = prng.threshold_u32(p)
+
+    def step(board, k0, k1, step_idx):
+        nxt = base(board)
+        if p >= 1.0:
+            return (1 - nxt).astype(nxt.dtype)
+        shape = (nxt.shape[-2], nxt.shape[-1])
+        u = prng.cell_uniforms(xp, shape, k0, k1, step_idx, prng.SUB_NOISE)
+        flip = u < xp.uint32(h_thr)
+        return xp.where(flip, (1 - nxt).astype(nxt.dtype), nxt)
+
+    return step

@@ -1,0 +1,159 @@
+"""Counter-based PRNG: Threefry-2x32 keyed by (seed, step, cell, substream).
+
+The reproducibility contract of the stochastic tier rests on one idea:
+every random draw is a **pure function of its coordinates**, never of
+execution order.  The draw for cell ``(r, c)`` at absolute step ``s`` in
+substream ``m`` of a run seeded ``S`` is::
+
+    u32 = threefry2x32(key=(S_lo, S_hi), counter=(r*w + c, s*NSUB + m))[0]
+
+so the same (seed, rule, temperature, board) produces the byte-identical
+trajectory
+
+- across host-sync chunk sizes (the counter is the *absolute* step, not
+  the chunk-local one),
+- across checkpoint/resume (the resume step re-enters the stream at the
+  right counter),
+- across executors (the hash is ~20 uint32 add/rotl/xor rounds, which
+  NumPy and XLA implement with identical wrapping semantics — asserted
+  against the Random123 known-answer vectors in tests/test_mc_prng.py),
+- across batch slots (vmap maps the same pure function over per-slot
+  keys).
+
+This is deliberately NOT ``jax.random``: serving needs the numpy ground
+truth to produce bit-identical streams, so the hash is implemented once
+here against an array-module parameter ``xp`` (numpy or jax.numpy) and
+shared by both.  It is the same Threefry-2x32/20 JAX itself uses, and
+matches ``jax._src.prng.threefry_2x32`` bit-for-bit.
+
+Substreams keep logically distinct draw families from colliding at the
+same (cell, step): the two checkerboard half-sweeps, the noisy-Life flip
+mask, and board seeding each own one.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext as _nullcontext
+
+import numpy as np
+
+#: Substream ids — one per independent draw family at the same (cell, step).
+SUB_EVEN = 0  # checkerboard half-sweep, parity 0
+SUB_ODD = 1  # checkerboard half-sweep, parity 1
+SUB_NOISE = 2  # noisy-Life flip mask
+SUB_BOARD = 3  # seeded initial-board staging
+NSUB = 4
+
+_ROT_A = (13, 15, 26, 6)
+_ROT_B = (17, 29, 16, 24)
+
+
+def _rotl(xp, x, r: int):
+    r = xp.uint32(r)
+    return (x << r) | (x >> (xp.uint32(32) - r))
+
+
+def threefry2x32(xp, k0, k1, c0, c1):
+    """Threefry-2x32, 20 rounds: counter ``(c0, c1)`` under key ``(k0, k1)``.
+
+    All inputs are uint32 (scalars or arrays; ``c0``/``c1`` broadcast);
+    returns the two uint32 output words.  ``xp`` is numpy or jax.numpy —
+    uint32 arithmetic wraps identically in both, which is the whole
+    portability story.
+    """
+    # wraparound is the algorithm; numpy warns on *scalar* uint32 overflow
+    # (0-d counters), so the intent is declared explicitly for that path
+    guard = np.errstate(over="ignore") if xp is np else _nullcontext()
+    with guard:
+        k0 = xp.uint32(k0)
+        k1 = xp.uint32(k1)
+        ks2 = k0 ^ k1 ^ xp.uint32(0x1BD11BDA)
+        x0 = xp.asarray(c0, dtype=xp.uint32) + k0
+        x1 = xp.asarray(c1, dtype=xp.uint32) + k1
+        keys = (k0, k1, ks2)
+        for group in range(5):
+            for r in _ROT_A if group % 2 == 0 else _ROT_B:
+                x0 = x0 + x1
+                x1 = _rotl(xp, x1, r)
+                x1 = x1 ^ x0
+            x0 = x0 + keys[(group + 1) % 3]
+            x1 = x1 + keys[(group + 2) % 3] + xp.uint32(group + 1)
+        return x0, x1
+
+
+def key_halves(seed: int) -> tuple[int, int]:
+    """Split a Python-int seed into the (lo, hi) uint32 key words.
+
+    Negative seeds are well-defined (two's complement of the low 64
+    bits), so ``seed=-1`` is a valid, distinct stream.
+    """
+    seed = int(seed)
+    return seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF
+
+
+def cell_uniforms(xp, shape: tuple[int, int], k0, k1, step, substream: int):
+    """uint32[h, w] of i.i.d. draws for every cell at ``step``/``substream``.
+
+    ``k0``/``k1``/``step`` may be traced scalars (per-slot under vmap);
+    ``shape`` and ``substream`` are static.  Cell index wraps mod 2^32 —
+    boards at or beyond 65536^2 cells would reuse counters and must move
+    to a 2-word cell index first.
+    """
+    h, w = shape
+    c0 = xp.arange(h * w, dtype=xp.uint32).reshape(h, w)
+    c1 = xp.uint32(step) * xp.uint32(NSUB) + xp.uint32(substream)
+    u, _ = threefry2x32(xp, k0, k1, c0, c1)
+    return u
+
+
+def threshold_u32(p: float) -> int:
+    """``p`` in [0, 1] -> the uint32 threshold t with P(u < t) ~= p.
+
+    Exact at the ends in the strict-less-than convention: p<=0 -> 0
+    (never), p>=1 -> callers must branch (no uint32 t makes ``u < t``
+    always true); interior p is within 2^-32 of exact.
+    """
+    if p <= 0.0:
+        return 0
+    return min(0xFFFFFFFF, int(float(p) * 4294967296.0))
+
+
+def seeded_board(
+    height: int,
+    width: int,
+    density: float = 0.5,
+    *,
+    states: int = 2,
+    seed: int = 0,
+) -> np.ndarray:
+    """A seeded random board from the counter-based stream (int8).
+
+    Replaces the numpy-Generator staging for seeded runs so the board a
+    seed names is identical everywhere a seed can be replayed — CLI,
+    serve spool, gateway, any host — and is stamped into telemetry as
+    the full replay record.  Uses ``SUB_BOARD`` at step 0, so it never
+    collides with any simulation draw of the same seed.
+    """
+    if not 0.0 <= density <= 1.0:
+        raise ValueError(f"density must be in [0, 1], got {density}")
+    if states < 2:
+        raise ValueError(f"states must be >= 2, got {states}")
+    k0, k1 = key_halves(seed)
+    u = cell_uniforms(np, (height, width), k0, k1, np.uint32(0), SUB_BOARD)
+    if density >= 1.0:
+        alive = np.ones((height, width), dtype=bool)
+    else:
+        alive = u < np.uint32(threshold_u32(density))
+    if states == 2:
+        return alive.astype(np.int8)
+    # multi-state: reuse the high-quality word 1 for the state choice so
+    # the alive mask and the state draw stay independent
+    _, u2 = threefry2x32(
+        np,
+        k0,
+        k1,
+        np.arange(height * width, dtype=np.uint32).reshape(height, width),
+        np.uint32(1) * np.uint32(NSUB) + np.uint32(SUB_BOARD),
+    )
+    state = (u2 % np.uint32(states - 1)).astype(np.int8) + np.int8(1)
+    return np.where(alive, state, np.int8(0)).astype(np.int8)

@@ -113,8 +113,127 @@ class Rule:
                 t[s] = (s + 1) % self.states
         return t
 
+    @property
+    def stochastic(self) -> bool:
+        """True for Monte-Carlo rules whose step consumes counter-based
+        PRNG draws (see ``tpu_life.mc``); they carry a per-run seed and
+        only run on executors that honor the key schedule."""
+        return False
+
     def __str__(self) -> str:
         return self.name
+
+
+@dataclass(frozen=True)
+class IsingRule(Rule):
+    """The 2-D Ising model under Metropolis–Hastings (J = 1, H = 0).
+
+    Spins live on the board as int8 {0, 1} <-> {-1, +1}; one CA "step" is
+    one full Metropolis **sweep** via the checkerboard decomposition (two
+    half-lattice updates — cells of one (row+col) parity see only
+    frozen cells of the other, so the vectorized update is exactly
+    sequential single-site Metropolis within a parity).  Temperature is
+    NOT part of the rule: it is a per-session scalar (serve packs mixed
+    temperatures into one CompileKey); the rule itself stays a frozen
+    hashable value like every other ``Rule``.
+
+    The inherited fields pin the neighborhood structure: radius-1 von
+    Neumann (the 4-neighbor coupling), 2 states, torus topology (the
+    periodic lattice Onsager's solution assumes).  ``birth``/``survive``
+    are unused — the transition is the Metropolis acceptance rule in
+    ``tpu_life.mc.ising``, not a count LUT.
+    """
+
+    name: str = "ising"
+    radius: int = 1
+    states: int = 2
+    neighborhood: str = "von_neumann"
+    boundary: str = "torus"
+
+    @property
+    def stochastic(self) -> bool:
+        return True
+
+
+@dataclass(frozen=True)
+class NoisyRule(Rule):
+    """A registered 2-state rule composed with per-cell flip noise.
+
+    Spec ``noisy:<p>/<base>``: apply ``base`` deterministically, then
+    flip each cell 0<->1 with probability ``flip_p`` from the counter
+    stream's ``SUB_NOISE`` substream.  The base rule's structural fields
+    (birth/survive/radius/neighborhood/boundary) are copied onto this
+    rule, so the deterministic half reuses the exact stencil machinery
+    (``ops.stencil.make_step`` / ``ops.reference.step_np``) unchanged;
+    ``base`` is kept for provenance.  ``flip_p`` is frozen in the rule
+    (it is part of the spec string and hence the CompileKey), unlike the
+    ising temperature which rides per-session.
+    """
+
+    flip_p: float = 0.0
+    base: Rule | None = None
+
+    def __post_init__(self):
+        super().__post_init__()
+        if not (0.0 <= self.flip_p <= 1.0):
+            raise ValueError(
+                f"noise probability must be in [0, 1], got {self.flip_p}"
+            )
+        if self.states != 2:
+            raise ValueError(
+                f"noisy rules need a 2-state base (flip is 0<->1); "
+                f"{self.base.name if self.base else self.name!r} has "
+                f"{self.states} states"
+            )
+
+    @property
+    def stochastic(self) -> bool:
+        return True
+
+
+def _parse_noisy(spec: str) -> NoisyRule:
+    """``noisy:<p>/<base>`` -> :class:`NoisyRule`, with typed errors for
+    every malformation (mirroring :func:`parse_rule`'s loud failures)."""
+    body = spec[len("noisy:"):]
+    if "/" not in body:
+        raise ValueError(
+            f"bad noisy spec {spec!r}: expected 'noisy:<p>/<base>' "
+            f"(e.g. 'noisy:0.01/conway')"
+        )
+    p_str, base_spec = body.split("/", 1)
+    try:
+        p = float(p_str)
+    except ValueError:
+        raise ValueError(
+            f"bad noise probability {p_str!r} in {spec!r}: not a number"
+        ) from None
+    if not np.isfinite(p) or not (0.0 <= p <= 1.0):
+        raise ValueError(
+            f"noise probability must be in [0, 1], got {p_str!r} in {spec!r}"
+        )
+    if not base_spec.strip():
+        raise ValueError(f"bad noisy spec {spec!r}: empty base rule")
+    base = parse_rule(base_spec)
+    if base.stochastic:
+        raise ValueError(
+            f"noisy base must be deterministic, got stochastic rule "
+            f"{base.name!r} in {spec!r} (substream composition of two "
+            f"stochastic rules is not defined)"
+        )
+    # a multi-state base is rejected by NoisyRule.__post_init__ (the one
+    # check that also guards direct construction)
+    return NoisyRule(
+        name=f"noisy:{p_str}/{base.name}",
+        birth=base.birth,
+        survive=base.survive,
+        radius=base.radius,
+        states=base.states,
+        include_center=base.include_center,
+        neighborhood=base.neighborhood,
+        boundary=base.boundary,
+        flip_p=p,
+        base=base,
+    )
 
 
 def _expand_ranges(spec: str) -> frozenset:
@@ -147,8 +266,17 @@ def parse_rule(spec: str) -> Rule:
       NN von Neumann diamond; C, M and N optional)
     - any of the above + Golly's bounded-grid suffix ``:T`` for a
       board-sized torus (periodic wraparound): ``conway:T``, ``B3/S23:T``
+    - stochastic rules (``tpu_life.mc``): ``ising`` (Metropolis,
+      per-session temperature) and ``noisy:<p>/<base>`` (per-cell flip
+      probability ``p`` over any registered 2-state rule):
+      ``noisy:0.01/conway``, ``noisy:0.05/B36/S23:T``
     """
     spec = spec.strip()
+    if spec.lower().startswith("noisy:"):
+        # before the ':T' scan: the noisy prefix's own colon must not be
+        # mistaken for a bounded-grid suffix; the base spec inside may
+        # still carry ':T' (parsed recursively)
+        return _parse_noisy(spec)
     m_t = re.search(r":\s*[tT](.*)$", spec)
     if m_t is not None:
         dims = m_t.group(1).strip()
@@ -285,6 +413,10 @@ register_rule(
         states=3,
     ),
 )
+# Stochastic tier (tpu_life.mc, docs/STOCHASTIC.md): Metropolis Ising on
+# the periodic lattice.  Temperature is per-session, not part of the rule;
+# `noisy:<p>/<base>` specs are parsed, not registered (p-parameterized).
+register_rule("ising", IsingRule())
 # The reference binary's *effective* rule as shipped: its unconditional rule-overwrite makes
 # the B3 branch dead code, so live' = (count == 2 and live), i.e. B/S2
 # (Parallel_Life_MPI.cpp:44-50; SURVEY.md §2.2).  Offered as an explicit

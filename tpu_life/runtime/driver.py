@@ -29,7 +29,6 @@ from tpu_life import obs
 from tpu_life.backends.base import drive_runner, get_backend, make_runner
 from tpu_life.config import RunConfig
 from tpu_life.io.codec import read_board, write_board
-from tpu_life.models.patterns import random_board
 from tpu_life.models.rules import get_rule
 from tpu_life.parallel.mesh import init_distributed
 from tpu_life.runtime import checkpoint as ckpt
@@ -54,6 +53,11 @@ class RunResult:
     metrics: list[dict] = field(default_factory=list)
     restarts: int = 0  # recoveries taken by the elastic-recovery loop
     run_id: str = ""  # correlation id shared by metrics/trace artifacts
+    # the counter-based PRNG seed (tpu_life.mc) — stamped for stochastic
+    # rules and for seeded-random-board staging, so the telemetry record
+    # is a full replay recipe; None when the run consumed no seed
+    seed: int | None = None
+    temperature: float | None = None  # ising per-run scalar (None elsewhere)
 
 
 def _single_process() -> bool:
@@ -101,6 +105,16 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
     with obs.span("config-resolve"):
         height, width, steps = cfg.resolved_geometry()
         rule = get_rule(cfg.effective_rule())
+        # stochastic-tier gating (tpu_life.mc) happens before any backend
+        # resolution: a stochastic rule on an executor without the
+        # counter-based key schedule — including "tuned", whose resolver
+        # could pick one — is a typed rejection, and the (rule,
+        # temperature) pairing is validated once for every front
+        from tpu_life import mc
+
+        mc.ensure_backend_supported(rule, cfg.backend)
+        mc.validate_params(rule, cfg.temperature)
+        mc.validate_board_shape(rule, (height, width))
 
     timer = Timer()  # spans I/O too, like the reference's Wtime bracket
 
@@ -192,10 +206,11 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
         # data file.
         log.info(
             "input file %r absent; using a seeded random board (%dx%d, "
-            "density 0.5, seed 0)",
+            "density 0.5, seed %d)",
             input_path,
             height,
             width,
+            cfg.seed,
         )
         input_path = None
 
@@ -252,7 +267,12 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
                 b = None
             else:
                 if source is None:
-                    b = random_board(height, width, states=rule.states, seed=0)
+                    # counter-based staging (tpu_life.mc.prng): the board
+                    # a seed names is identical on every host/backend, so
+                    # the stamped seed fully replays the run
+                    b = mc.seeded_board(
+                        height, width, states=rule.states, seed=cfg.seed
+                    )
                 else:
                     b = read_board(source, height, width)
                     max_state = int(b.max(initial=0))
@@ -262,7 +282,14 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
                             f"{rule.name!r} has only {rule.states} states "
                             f"(0..{rule.states - 1})"
                         )
-                r = make_runner(backend, b, rule)
+                r = make_runner(
+                    backend,
+                    b,
+                    rule,
+                    seed=cfg.seed,
+                    temperature=cfg.temperature,
+                    start_step=start,
+                )
             if cfg.fault_at > 0:
                 r = recovery.FaultingRunner(
                     r, start, cfg.fault_at, fault_fired, cfg.fault_count
@@ -551,4 +578,8 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
         metrics=recorder.records,
         restarts=restarts,
         run_id=run_id,
+        # replay record: stamped whenever the run consumed the seed —
+        # stochastic dynamics, or counter-seeded board staging
+        seed=cfg.seed if (rule.stochastic or origin[0] is None) else None,
+        temperature=cfg.temperature,
     )

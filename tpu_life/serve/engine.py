@@ -96,8 +96,23 @@ class EngineBase:
     def occupancy(self) -> int:
         return self.capacity - len(self._free)
 
-    def load(self, slot: int, board: np.ndarray, steps: int) -> None:
-        """Stage a session's lattice into ``slot`` with ``steps`` budget."""
+    def load(
+        self,
+        slot: int,
+        board: np.ndarray,
+        steps: int,
+        *,
+        seed: int | None = None,
+        temperature: float | None = None,
+        start_step: int = 0,
+    ) -> None:
+        """Stage a session's lattice into ``slot`` with ``steps`` budget.
+
+        ``seed``/``temperature``/``start_step`` are the stochastic-tier
+        per-slot state (``tpu_life.mc.engine``); deterministic engines
+        ignore them — submit-time validation already rejected any
+        meaningless combination.
+        """
         h, w = self.key.shape
         if board.shape != (h, w):
             raise ValueError(
@@ -296,6 +311,13 @@ def make_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
     untuned key degrades to the cost-model pick, it does not trigger a
     trial sweep.  Run ``tpu-life tune`` offline to populate the cache.
     """
+    if getattr(key.rule, "stochastic", False):
+        # stochastic keys dispatch to the MC executors (per-slot seed /
+        # temperature / step-counter state); backends without the key
+        # schedule are a typed rejection, never a silent fallback
+        from tpu_life.mc.engine import make_mc_engine
+
+        return make_mc_engine(key, capacity, chunk_steps)
     backend_name = key.backend
     backend_kwargs: dict = {}
     if backend_name == "tuned":

@@ -167,7 +167,15 @@ class Scheduler:
                 deferred.append(s)
                 continue
             try:
-                engine.load(slot, s.board, s.steps_remaining)
+                # seed/temperature are the stochastic per-slot state
+                # (validated at submit); deterministic engines ignore them
+                engine.load(
+                    slot,
+                    s.board,
+                    s.steps_remaining,
+                    seed=s.seed,
+                    temperature=s.temperature,
+                )
             except recovery.RECOVERABLE as e:
                 engine.release(slot)
                 s.fail(f"load failed: {e}")

@@ -194,6 +194,8 @@ class SimulationService:
         *,
         timeout_s: float | None = None,
         fault_at: int = 0,
+        seed: int | None = None,
+        temperature: float | None = None,
     ) -> str:
         """Admit one simulation request; returns its session id.
 
@@ -203,9 +205,28 @@ class SimulationService:
         request is rejected before anything is stored, so backpressure
         bounds memory, not just slots.  After :meth:`begin_drain` every
         submit raises :class:`Draining` instead (admission is closed).
+
+        Stochastic rules (``tpu_life.mc``): ``seed`` names the
+        counter-based PRNG stream (default 0) and ``temperature`` is the
+        per-session ising scalar — both ride in the batch slot, not the
+        CompileKey, so a mixed-temperature sweep shares one compiled
+        program.  A temperature on a non-ising rule, or a stochastic rule
+        on an executor without the key schedule, is a typed rejection
+        here — before anything is stored.
         """
         if isinstance(rule, str):
             rule = get_rule(rule)
+        from tpu_life import mc
+
+        mc.validate_params(rule, temperature)
+        if rule.stochastic:
+            # serve backends are always explicit (no "auto"), so the hard
+            # gate applies directly — rejected before anything is stored
+            mc.require_key_schedule(rule, self.config.backend)
+            if seed is None:
+                seed = 0
+        if seed is not None:
+            seed = int(seed)
         # validate BEFORE the int8 cast: a wider-dtype caller array with
         # state 256 would wrap to 0 and sail through a post-cast check —
         # simulated junk, not a rejection
@@ -227,6 +248,7 @@ class SimulationService:
                 f"0..{rule.states - 1}"
             )
         board = board.astype(np.int8)
+        mc.validate_board_shape(rule, board.shape)
         if steps < 0:
             raise ValueError(f"steps must be >= 0, got {steps}")
         # admission is a read-modify-write on the queue: everything from the
@@ -256,6 +278,8 @@ class SimulationService:
                 submitted_at=now,
                 deadline=None if timeout_s is None else now + timeout_s,
                 fault_at=fault_at,
+                seed=seed,
+                temperature=None if temperature is None else float(temperature),
             )
             self._c_submitted.inc()
             if steps == 0:
@@ -272,6 +296,42 @@ class SimulationService:
                     obs.async_begin("queue-wait", s.sid, steps=steps)
         log.debug("serve: submitted %s (%s, %d steps)", s.sid, rule.name, steps)
         return s.sid
+
+    def sweep(
+        self,
+        board: np.ndarray,
+        rule: Rule | str,
+        steps: int,
+        temperatures,
+        *,
+        seed: int = 0,
+        timeout_s: float | None = None,
+    ) -> list[str]:
+        """Fan a temperature grid into one session per temperature.
+
+        The continuous-batching shape of a Monte-Carlo parameter sweep
+        (ISSUE; arXiv:2412.14374's MPMD load): every session shares the
+        same board, seed and rule, so they all land in ONE CompileKey and
+        one compiled vmapped step — the per-slot acceptance tables are
+        the only thing that differs.  Returns the session ids in
+        temperature order.  Admission semantics are exactly N ``submit``
+        calls: a full queue raises :class:`QueueFull` on the session that
+        did not fit (earlier ones stay admitted — pump and resubmit).
+        """
+        temps = [float(t) for t in temperatures]
+        if not temps:
+            raise ValueError("sweep needs at least one temperature")
+        return [
+            self.submit(
+                board,
+                rule,
+                steps,
+                timeout_s=timeout_s,
+                seed=seed,
+                temperature=t,
+            )
+            for t in temps
+        ]
 
     def poll(self, sid: str) -> SessionView:
         with self._lock:

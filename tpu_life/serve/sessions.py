@@ -60,6 +60,12 @@ class Session:
     # fixture behind the "one bad tenant must not kill the batch" tests
     fault_at: int = 0
     slot: int | None = None  # batch slot while RUNNING
+    # stochastic-tier state (tpu_life.mc): the PRNG seed the trajectory is
+    # replayable from, and the ising temperature (None elsewhere).  Seed is
+    # also stamped for seeded-board deterministic sessions so the summary
+    # is a full replay record.
+    seed: int | None = None
+    temperature: float | None = None
 
     @property
     def steps_remaining(self) -> int:
@@ -95,6 +101,10 @@ class SessionView:
     # the rule the session runs under — front-ends need it to label
     # results (an RLE export without its rule header is ambiguous)
     rule: str = ""
+    # replay record: the PRNG seed (stochastic or seeded-board sessions)
+    # and the ising temperature; None where not applicable
+    seed: int | None = None
+    temperature: float | None = None
 
     @property
     def finished(self) -> bool:
@@ -136,6 +146,8 @@ class SessionStore:
             result=s.result,
             error=s.error,
             rule=s.rule.name,
+            seed=s.seed,
+            temperature=s.temperature,
         )
 
     def result(self, sid: str) -> np.ndarray:
