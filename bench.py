@@ -25,7 +25,13 @@ still emits its JSON line — the capture can never again be empty.
 The emit guarantee survives signals too (the round-4 lesson,
 BENCH_r04.json rc=124, parsed: null): the probe retry schedule slept past
 the driver's capture window and ``timeout``'s SIGTERM killed the process
-mid-sleep with nothing on stdout.  Two defenses now hold the line:
+mid-sleep with nothing on stdout.  Rounds 4/5 additionally showed two
+840 s+ fixed retry sleeps burning the deadline before the third probe
+could even run — the gaps are now exponential per failure mode (short
+crash base, sparser hang base, both doubling under a cap) and every
+degraded record carries ``degraded_reason`` (probe_hang / probe_crash /
+cpu_platform / accelerator_error / error / signal) so a capture
+self-explains.  Two defenses hold the emit line:
  * total probe time (probes + quiet gaps) is bounded by an overall
    deadline (``TPU_LIFE_BENCH_DEADLINE_S``, default 20 min — comfortably
    inside any sane capture window), so the retry loop can never outlast
@@ -64,25 +70,32 @@ DEGRADED_SIZE = 2048
 DEGRADED_STEPS = 110
 DEGRADED_BASE_STEPS = 10
 
-PROBE_TIMEOUT_S = 180.0  # first TPU attach can be slow; hang is minutes
+# dedicated, SHORT probe timeout: a healthy attach answers in well under
+# two minutes, and a hang past this is a wedged grant the retry schedule
+# handles — the r4/r5 captures burned their whole deadline because each
+# hung probe held 180 s AND the gap after it was a fixed 840 s+ sleep
+PROBE_TIMEOUT_S = float(os.environ.get("TPU_LIFE_PROBE_TIMEOUT_S", "120"))
 
-# a wedged chip grant usually clears in ~10 min but multi-hour outages
-# were observed (round 4); the retry loop rides out a transient wedge
-# instead of instantly degrading to CPU (VERDICT r2 item 1b).  The long
-# wait applies only to HANGS (stale grant) and is deliberately SPARSE:
-# each probe itself claims the chip at interpreter start (the plugin's
-# sitecustomize registers before user code), so frequent probing can
-# RENEW the very grant it is waiting out — observed 2026-07-30, when
-# ~7-min probe cadence kept a wedge alive for hours.  The nominal
-# schedule (900 s gaps) is clamped by PROBE_DEADLINE_S below: at the
-# defaults that means roughly two 180 s probes around one ~14-min gap,
-# all inside the 20-min budget — never again the r4 57-min schedule that
-# outslept the capture window.  Fast CRASHES (plugin raises in seconds —
-# the BENCH_r01 mode) get a short wait so a deterministically broken
-# plugin cannot burn an hour of sleeps before the guaranteed JSON line.
+# retry gaps are EXPONENTIAL, not fixed-huge (the r4/r5 lesson: two
+# ~840 s sleeps ate the capture window before the third probe could run):
+# each failure mode starts from a base and doubles per attempt up to a
+# cap.  Hang gaps MUST stay sparse — each probe itself claims the chip
+# at interpreter start (the plugin's sitecustomize registers before user
+# code), so dense probing can RENEW the very grant it is waiting out
+# (observed 2026-07-30, when a ~7-min cadence kept a wedge alive for
+# hours) — hence the hang base sits at 420 s (just past that hazard
+# cadence) and doubles from there; with the shorter 120 s probe timeout
+# the whole schedule still fits the 20-min budget with probes to spare,
+# unlike the old fixed-840 s gaps.  Fast CRASHES (plugin raises in
+# seconds — the BENCH_r01 mode) start near-immediate so a
+# deterministically broken plugin cannot burn an hour of sleeps before
+# the guaranteed JSON line.  Every gap is additionally clamped by
+# PROBE_DEADLINE_S below.
 PROBE_RETRIES = int(os.environ.get("TPU_LIFE_PROBE_RETRIES", "4"))
-PROBE_RETRY_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_WAIT_S", "900"))
-PROBE_CRASH_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_CRASH_WAIT_S", "30"))
+PROBE_HANG_BASE_S = float(os.environ.get("TPU_LIFE_PROBE_HANG_BASE_S", "420"))
+PROBE_RETRY_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_WAIT_S", "900"))  # hang-gap cap
+PROBE_CRASH_WAIT_S = float(os.environ.get("TPU_LIFE_PROBE_CRASH_WAIT_S", "15"))
+PROBE_CRASH_CAP_S = float(os.environ.get("TPU_LIFE_PROBE_CRASH_CAP_S", "240"))
 
 # overall ceiling on the probe phase (probes + quiet gaps together): the r4
 # schedule's 57 min of coverage outlasted the driver's capture window and
@@ -141,6 +154,11 @@ def _die_emitting(signame: str) -> None:
             }
             if _SIGNAL_STATE.get("probe_failed"):
                 record["probe_failed"] = True
+            # why this record is degraded (ISSUE 7 satellite): the probe's
+            # failure mode when one was observed, else the signal itself
+            record["degraded_reason"] = (
+                _SIGNAL_STATE.get("degraded_reason") or "signal"
+            )
             # one os.write straight to fd 1: reentrancy-safe against an
             # in-progress main-thread print and unbuffered, so the line
             # lands even though we _exit without interpreter teardown
@@ -267,21 +285,32 @@ def _probe_default_platform() -> tuple[str | None, str]:
 def _probe_with_retries() -> str | None:
     """Probe the default platform, waiting out a transiently wedged grant.
 
-    Total probe-phase time (probes and quiet gaps together) is bounded by
-    ``PROBE_DEADLINE_S``: a gap is clamped so the probe after it still fits
-    the budget, and when the clamped gap drops below ``MIN_RETRY_GAP_S``
-    (dense re-probing only renews the wedge) the loop gives up instead —
+    Gaps grow exponentially per failure mode (hang: ``PROBE_HANG_BASE_S``
+    doubling up to ``PROBE_RETRY_WAIT_S``; crash: ``PROBE_CRASH_WAIT_S``
+    doubling up to ``PROBE_CRASH_CAP_S``) and total probe-phase time
+    (probes and quiet gaps together) is bounded by ``PROBE_DEADLINE_S``:
+    a gap is clamped so the probe after it still fits the budget, and
+    when the clamped gap drops below ``MIN_RETRY_GAP_S`` (dense
+    re-probing only renews the wedge) the loop gives up instead —
     sleeping past the harness's capture window is how round 4 lost its
-    JSON line.
+    JSON line, and rounds 4/5 burned two 840 s+ fixed sleeps this
+    schedule replaces.  The last failure mode is recorded in
+    ``_SIGNAL_STATE['degraded_reason']`` so the emitted record explains
+    WHY the capture degraded.
     """
     deadline = time.monotonic() + PROBE_DEADLINE_S
+    mode = "crash"
     for attempt in range(PROBE_RETRIES):
         platform, mode = _probe_default_platform()
         if platform is not None:
             return platform
+        _SIGNAL_STATE["degraded_reason"] = f"probe_{mode}"
         if attempt + 1 >= PROBE_RETRIES:
             break
-        wait = PROBE_RETRY_WAIT_S if mode == "hang" else PROBE_CRASH_WAIT_S
+        if mode == "hang":
+            wait = min(PROBE_RETRY_WAIT_S, PROBE_HANG_BASE_S * (2.0 ** attempt))
+        else:
+            wait = min(PROBE_CRASH_CAP_S, PROBE_CRASH_WAIT_S * (2.0 ** attempt))
         # reserve room for the probe after the gap: a hang burns the full
         # probe timeout, a crash returns in seconds — reserving 180 s for
         # a crash-mode retry would cut the fast-retry schedule on small
@@ -391,6 +420,22 @@ def _pin_and_verify(args, platform: str) -> tuple[str, bool]:
     return actual, bool(pinned)
 
 
+def _drive_serve_mix(svc, boards, rule, budgets) -> tuple[float, dict]:
+    """The staggered-admission harness shared by both serve benches: half
+    the sessions up front, the rest trickling in while the batch runs —
+    the continuous-batching shape, not a static batch.  Returns
+    (elapsed_seconds, final service stats)."""
+    sessions = len(budgets)
+    for i in range(sessions // 2):
+        svc.submit(boards[i % len(boards)], rule, budgets[i])
+    t0 = time.monotonic()
+    for i in range(sessions // 2, sessions):
+        svc.pump()
+        svc.submit(boards[i % len(boards)], rule, budgets[i])
+    svc.drain()
+    return time.monotonic() - t0, svc.stats()
+
+
 def run_serve_bench(args, platform: str, degraded: bool) -> dict:
     """The BENCH_serve capture: staggered sessions through the
     continuous-batching service — sessions/sec and batch occupancy, so the
@@ -427,19 +472,9 @@ def run_serve_bench(args, platform: str, degraded: bool) -> dict:
     boards = [
         random_board(n, n, seed=i) for i in range(min(sessions, 8))
     ]  # a few distinct boards reused: board gen must not dominate the bench
-    # staggered admission: half up front, the rest trickling in while the
-    # batch runs — the continuous-batching shape, not a static batch
-    sids = [
-        svc.submit(boards[i % len(boards)], args.rule, steps)
-        for i in range(sessions // 2)
-    ]
-    t0 = time.monotonic()
-    for i in range(sessions // 2, sessions):
-        svc.pump()
-        sids.append(svc.submit(boards[i % len(boards)], args.rule, steps))
-    svc.drain()
-    elapsed = time.monotonic() - t0
-    stats = svc.stats()
+    elapsed, stats = _drive_serve_mix(
+        svc, boards, args.rule, [steps] * sessions
+    )
     done = stats["done"]
     return {
         "metric": "serve_sessions_per_sec",
@@ -465,6 +500,79 @@ def run_serve_bench(args, platform: str, degraded: bool) -> dict:
         "degraded": degraded,
         "tuned": tuned_dict,
         "tuned_source": tuned_source,
+    }
+
+
+def run_serve_pipeline_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_serve_pipeline capture (ISSUE 7): the same staggered,
+    uneven-budget session mix through the host-synchronous pump and then
+    the pipelined (double-buffered) pump, reporting rounds/s, sessions/s
+    and the device-idle fraction for each — the overlap win as one JSON
+    record.  Headline value = the pipelined pump's rounds/s."""
+    actual, pinned = _pin_and_verify(args, platform)
+
+    from tpu_life.models.patterns import random_board
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    n = args.serve_size
+    sessions = args.serve_sessions
+    steps = args.serve_steps
+    boards = [random_board(n, n, seed=i) for i in range(min(sessions, 8))]
+    # uneven budgets (full down to half): completions trickle every round,
+    # the continuous-batching shape where retire/admit overlap pays
+    budgets = [
+        max(1, steps - (steps * i) // (2 * max(sessions - 1, 1)))
+        for i in range(sessions)
+    ]
+    legs = {}
+    for mode, pipelined in (("sync", False), ("pipelined", True)):
+        svc = SimulationService(
+            ServeConfig(
+                capacity=args.serve_capacity,
+                chunk_steps=args.serve_chunk_steps,
+                max_queue=max(sessions, 1),
+                backend=args.backend,
+                pipeline=pipelined,
+            )
+        )
+        elapsed, stats = _drive_serve_mix(svc, boards, args.rule, budgets)
+        svc.close()
+        legs[mode] = {
+            "rounds": stats["rounds"],
+            "rounds_per_sec": stats["rounds"] / elapsed if elapsed > 0 else 0.0,
+            "sessions_per_sec": stats["done"] / elapsed if elapsed > 0 else 0.0,
+            "done": stats["done"],
+            "failed": stats["failed"],
+            "elapsed_s": elapsed,
+            "device_idle_seconds": stats["device_idle_seconds"],
+            "device_idle_fraction": stats["device_idle_seconds"] / elapsed
+            if elapsed > 0
+            else 0.0,
+            "batch_occupancy_mean": stats["batch_occupancy_mean"],
+        }
+    sync, pipe = legs["sync"], legs["pipelined"]
+    return {
+        "metric": "serve_pipeline_rounds_per_sec",
+        "value": pipe["rounds_per_sec"],
+        "unit": "rounds/s",
+        "rule": args.rule,
+        "platform": platform,
+        "platform_actual": actual,
+        "platform_pinned": pinned,
+        "backend": args.backend,
+        "size": n,
+        "steps": steps,
+        "sessions": sessions,
+        "batch_capacity": args.serve_capacity,
+        "chunk_steps": args.serve_chunk_steps,
+        "sync": sync,
+        "pipelined": pipe,
+        "speedup_sessions_per_sec": (
+            pipe["sessions_per_sec"] / sync["sessions_per_sec"]
+            if sync["sessions_per_sec"] > 0
+            else 0.0
+        ),
+        "degraded": degraded,
     }
 
 
@@ -703,6 +811,14 @@ def main() -> None:
     p.add_argument("--serve-capacity", type=int, default=8,
                    help="batch slots (the acceptance-config default)")
     p.add_argument("--serve-chunk-steps", type=int, default=16)
+    # the BENCH_serve_pipeline capture (ISSUE 7): the same session mix
+    # through the sync and pipelined pumps — rounds/s + device-idle
+    # fraction per pump, the overlap win in one record
+    p.add_argument("--serve-pipeline", action="store_true",
+                   help="pump-overlap bench: run the serve session mix "
+                   "under both the host-synchronous and the pipelined "
+                   "pump (emits serve_pipeline_rounds_per_sec with "
+                   "sync/pipelined legs and device-idle fractions)")
     # the BENCH_mc capture: Metropolis sweep throughput through the
     # stochastic tier (sweeps/s, spin-updates/s; docs/STOCHASTIC.md)
     p.add_argument("--mc", action="store_true",
@@ -798,7 +914,7 @@ def main() -> None:
         args.steps = 1000 if on_accel else DEGRADED_STEPS
     if args.base_steps is None:
         args.base_steps = 100 if on_accel else DEGRADED_BASE_STEPS
-    if not args.serve and args.steps <= args.base_steps:
+    if not (args.serve or args.serve_pipeline) and args.steps <= args.base_steps:
         p.error("--steps must be greater than --base-steps (delta timing)")
     # serve workload knobs follow the same accel/degraded split: the CPU
     # fallback must finish in seconds while still filling the batch
@@ -824,7 +940,7 @@ def main() -> None:
     # The serve bench defaults to the vmapped jax engine on every platform
     # (the batched path is the thing being measured).
     if args.backend is None:
-        if args.serve or args.mc:
+        if args.serve or args.serve_pipeline or args.mc:
             # the vmapped/fused single-device XLA path is the thing being
             # measured on both service-shaped benches
             args.backend = "jax"
@@ -835,20 +951,30 @@ def main() -> None:
                     args.rule, args.no_bitpack
                 )
 
+    # why this capture is degraded, for every emit path: the probe's
+    # observed failure mode (probe_hang / probe_crash), or an explicit /
+    # probed CPU platform — a degraded record must self-explain instead
+    # of looking like a silent choice (ISSUE 7 satellite)
+    degraded_reason = None
+    if probe_failed:
+        degraded_reason = _SIGNAL_STATE.get("degraded_reason", "probe_failed")
+    elif degraded:
+        degraded_reason = "cpu_platform"
+
     def annotate(record: dict) -> dict:
         if probe_failed:
-            # why this capture is CPU: every accelerator probe crashed or
-            # hung (wedged chip grant / broken plugin) — record it so a
-            # degraded capture self-explains instead of looking like a
-            # silent choice.  Applied to every emit path, error included.
             record["probe_failed"] = True
+        if record.get("degraded") and degraded_reason:
+            record.setdefault("degraded_reason", degraded_reason)
         return record
 
     _SIGNAL_STATE.update(
         backend=args.backend, size=args.size, steps=args.steps, phase="measure"
     )
     try:
-        if args.serve:
+        if args.serve_pipeline:
+            result = run_serve_pipeline_bench(args, platform, degraded)
+        elif args.serve:
             result = run_serve_bench(args, platform, degraded)
         elif args.mc:
             result = run_mc_bench(args, platform, degraded)
@@ -879,10 +1005,10 @@ def main() -> None:
                     cmd += [flag, str(value)]
             if args.no_bitpack:
                 cmd.append("--no-bitpack")
-            if args.serve:
+            if args.serve or args.serve_pipeline:
                 # the retry must measure the same MODE, not fall back to
                 # the kernel bench and mislabel the record
-                cmd.append("--serve")
+                cmd.append("--serve-pipeline" if args.serve_pipeline else "--serve")
                 cmd += ["--serve-capacity", str(args.serve_capacity)]
                 cmd += ["--serve-chunk-steps", str(args.serve_chunk_steps)]
             if args.mc:
@@ -897,12 +1023,16 @@ def main() -> None:
                 line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
                 retried = json.loads(line)
                 retried["degraded"] = True
+                retried["degraded_reason"] = "accelerator_error"
                 retried["fallback_from"] = f"{platform}: {e!r}"
                 _emit(annotate(retried))
                 return
             except Exception as e2:  # noqa: BLE001
                 e = RuntimeError(f"{e!r}; cpu retry failed: {e2!r}")
-        if args.serve:
+        if args.serve_pipeline:
+            metric, unit = "serve_pipeline_rounds_per_sec", "rounds/s"
+            size, steps = args.serve_size, args.serve_steps
+        elif args.serve:
             metric, unit = "serve_sessions_per_sec", "sessions/s"
             size, steps = args.serve_size, args.serve_steps
         elif args.mc:
@@ -920,9 +1050,10 @@ def main() -> None:
             "size": size,
             "steps": steps,
             "degraded": True,
+            "degraded_reason": "error",
             "error": repr(e)[:500],
         }
-        if args.serve:
+        if args.serve or args.serve_pipeline:
             failure["sessions"] = args.serve_sessions
             failure["batch_capacity"] = args.serve_capacity
         elif args.mc:

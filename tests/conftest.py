@@ -63,6 +63,12 @@ def pytest_configure(config):
         "(jax with top-level shard_map — 0.6+ — for interpret mode on "
         "CPU, or a real TPU); skipped when the capability probe fails",
     )
+    config.addinivalue_line(
+        "markers",
+        "pipeline: pipelined-pump overlap tests (docs/SERVING.md) — run "
+        "them in isolation with `pytest -m pipeline`; all are tier-1 "
+        "safe (not slow)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -69,6 +69,8 @@ def test_bench_emits_contract_record_on_cpu():
     # on run_id, versioned by the shared schema stamp
     assert isinstance(rec["run_id"], str) and len(rec["run_id"]) == 12
     assert rec["telemetry_schema"] == 1
+    # a degraded record self-explains: explicit CPU is a named reason
+    assert rec["degraded_reason"] == "cpu_platform"
 
 
 @pytest.mark.slow
@@ -114,6 +116,31 @@ def test_bench_serve_emits_serving_record_on_cpu():
     assert rec["backend"] == "jax"  # the vmapped serve engine
     assert isinstance(rec["run_id"], str) and len(rec["run_id"]) == 12
     assert rec["telemetry_schema"] == 1
+
+
+@pytest.mark.slow
+@pytest.mark.pipeline
+def test_bench_serve_pipeline_emits_overlap_record_on_cpu():
+    """The BENCH_serve_pipeline hook: `--serve-pipeline` runs the same
+    session mix under both pumps and the record carries rounds/s and the
+    device-idle fraction per leg — the overlap win, machine-readable."""
+    rec = run_bench(
+        "--serve-pipeline", "--platform", "cpu",
+        "--serve-sessions", "12", "--serve-size", "48", "--serve-steps", "24",
+        "--serve-chunk-steps", "4",
+    )
+    assert rec["metric"] == "serve_pipeline_rounds_per_sec"
+    assert rec["unit"] == "rounds/s"
+    assert rec["value"] > 0
+    assert rec["platform"] == "cpu" and rec["degraded"] is True
+    assert rec["backend"] == "jax"
+    for leg in ("sync", "pipelined"):
+        assert rec[leg]["done"] == 12 and rec[leg]["failed"] == 0, rec[leg]
+        assert rec[leg]["rounds_per_sec"] > 0
+        assert 0.0 <= rec[leg]["device_idle_fraction"] <= 1.0
+    assert rec["value"] == pytest.approx(rec["pipelined"]["rounds_per_sec"])
+    assert rec["speedup_sessions_per_sec"] > 0
+    assert len(rec["run_id"]) == 12 and rec["telemetry_schema"] == 1
 
 
 def bench_popen(*args, env_extra=None, stderr_path=None):
@@ -269,9 +296,14 @@ def test_bench_crash_mode_retries_survive_budget_guard(tmp_path):
     assert proc.returncode == 0
     rec = json.loads(out.strip().splitlines()[-1])
     assert rec["probe_failed"] is True and rec["degraded"] is True
+    # the record names the observed probe failure mode
+    assert rec["degraded_reason"] == "probe_crash"
     retries = [l for l in open(stderr_path).read().splitlines() if "retrying in" in l]
     assert len(retries) == 3  # attempts 2..4 all ran
     assert not any("budget exhausted" in l for l in retries)
+    # the backoff is EXPONENTIAL, not fixed: 1s base doubling per attempt
+    waits = [int(l.split("retrying in ")[1].split("s")[0]) for l in retries]
+    assert waits == [1, 2, 4], waits
 
 
 @pytest.mark.slow
@@ -293,6 +325,7 @@ def test_bench_probe_budget_bounds_total_sleep():
     )
     assert time.monotonic() - t0 < 240
     assert rec["probe_failed"] is True
+    assert rec["degraded_reason"] == "probe_hang"
     assert rec["platform"] == "cpu" and rec["degraded"] is True
     assert rec["value"] > 0  # a real (if degraded) measurement, not a stub
 

@@ -337,8 +337,11 @@ def test_serve_rejection_counter_and_trace(tmp_path):
     assert doc["otherData"]["run_id"] == svc.run_id
     assert_nested(doc["traceEvents"])
     names = {e["name"] for e in doc["traceEvents"]}
-    assert {"serve.round", "serve.admit", "serve.step-chunk", "serve.retire",
-            "queue-wait"} <= names
+    # the pipelined pump's span vocabulary: dispatch (async chunk launch),
+    # collect (the unlocked settle window), retire — replacing the sync
+    # round's single step-chunk span (still emitted under pipeline=False)
+    assert {"serve.round", "serve.admit", "serve.dispatch", "serve.collect",
+            "serve.retire", "queue-wait"} <= names
     # every async queue-wait interval that opened was closed
     opens = [e for e in doc["traceEvents"] if e["ph"] == "b"]
     closes = [e for e in doc["traceEvents"] if e["ph"] == "e"]

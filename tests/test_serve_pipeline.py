@@ -1,0 +1,398 @@
+"""The pipelined pump (docs/SERVING.md, ISSUE 7): overlap without drift.
+
+Everything here runs under the DEFAULT pump (``ServeConfig.pipeline``)
+and asserts the two properties the async rebuild must hold at once:
+
+- **bit-identity** — every session equals its solo-driver / ground-truth
+  run bit-for-bit, across mixed CompileKeys (det + stochastic MC),
+  faults, cancels, and a gateway drain issued mid-pipeline;
+- **the overlap is real and observable** — verbs are never blocked
+  behind device compute (proven with a gated engine, not a stopwatch),
+  the pipeline-depth gauge and device-idle counter move, and the stamps
+  land in the per-round records and the ``tpu-life stats`` summaries.
+
+All tests carry the ``pipeline`` marker so the overlap tier runs in
+isolation with ``pytest -m pipeline``; none are slow.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.serve import ServeConfig, SessionState, SimulationService
+from tpu_life.serve.engine import HostBatchEngine
+
+pytestmark = pytest.mark.pipeline
+
+
+def make_service(**cfg):
+    defaults = dict(capacity=4, chunk_steps=4, max_queue=64, backend="numpy")
+    defaults.update(cfg)
+    return SimulationService(ServeConfig(**defaults))
+
+
+# -- bit-identity across mixed CompileKeys ----------------------------------
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_mixed_two_keys_det_plus_mc_match_solo_oracles(backend):
+    """The acceptance test: a mixed batch — deterministic conway sessions
+    AND stochastic ising sessions (two CompileKeys, one of them the MC
+    engine) — under the async pump equals the solo oracles bit-for-bit,
+    with exactly one compile per key."""
+    from tpu_life.mc.engine import MCHostRunner
+
+    svc = make_service(capacity=4, chunk_steps=5, backend=backend)
+    rule_ising = get_rule("ising")
+
+    det_boards = [random_board(18, 14, seed=10 + i) for i in range(5)]
+    det_steps = [3, 11, 7, 16, 1]
+    det_sids = [
+        svc.submit(b, "conway", n) for b, n in zip(det_boards, det_steps)
+    ]
+    mc_board = random_board(16, 16, seed=99)
+    mc_params = [(0, 1.8, 9), (1, 2.27, 14), (2, 3.0, 6)]  # (seed, T, steps)
+    mc_sids = [
+        svc.submit(mc_board, "ising", n, seed=s, temperature=t)
+        for s, t, n in mc_params
+    ]
+    svc.drain()
+
+    for sid, b, n in zip(det_sids, det_boards, det_steps):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(b, get_rule("conway"), n)
+        )
+    for sid, (seed, t, n) in zip(mc_sids, mc_params):
+        solo = MCHostRunner(mc_board, rule_ising, seed=seed, temperature=t)
+        solo.advance(n)
+        np.testing.assert_array_equal(svc.result(sid), solo.fetch())
+
+    counts = svc.scheduler.compile_counts()
+    assert len(counts) == 2
+    if backend == "jax":
+        assert all(v == 1 for v in counts.values())
+    svc.close()
+
+
+def test_async_pump_equals_sync_pump_bit_for_bit():
+    """The same staggered workload through both pump shapes: identical
+    results, session by session (the sync round is the oracle)."""
+    results = {}
+    for pipeline in (False, True):
+        svc = make_service(
+            capacity=3, chunk_steps=6, backend="jax", pipeline=pipeline
+        )
+        sids = []
+        for i in range(5):
+            sids.append(svc.submit(random_board(12, 17, seed=i), "highlife", 4 + 5 * i))
+        svc.pump()
+        for i in range(5, 10):
+            sids.append(svc.submit(random_board(12, 17, seed=i), "highlife", 4 + 5 * i))
+            svc.pump()
+        svc.drain()
+        results[pipeline] = [svc.result(s) for s in sids]
+        svc.close()
+    for a, b in zip(results[False], results[True]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_faulted_slot_in_one_key_never_stalls_the_other_key():
+    """Per-key in-flight isolation: a fault-drilled session in key A
+    fails alone; key B's sessions (and A's survivors) finish exact."""
+    svc = make_service(capacity=2, chunk_steps=4, backend="jax")
+    a_boards = [random_board(10, 10, seed=i) for i in range(2)]
+    b_boards = [random_board(12, 8, seed=50 + i) for i in range(2)]
+    bad = svc.submit(a_boards[0], "conway", 20, fault_at=6)
+    good_a = svc.submit(a_boards[1], "conway", 20)
+    good_b = [svc.submit(b, "brians_brain", 13) for b in b_boards]
+    svc.drain()
+    assert svc.poll(bad).state is SessionState.FAILED
+    assert "InjectedFault" in svc.poll(bad).error
+    np.testing.assert_array_equal(
+        svc.result(good_a), run_np(a_boards[1], get_rule("conway"), 20)
+    )
+    for sid, b in zip(good_b, b_boards):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(b, get_rule("brians_brain"), 13)
+        )
+    svc.close()
+
+
+def test_deadline_cannot_fail_a_fully_computed_session():
+    """Retirement lags dispatch by one round under the pipelined pump; a
+    deadline landing inside that lag must NOT fail a session whose steps
+    are already fully computed — the sync pump would have retired it
+    DONE, and the overlap may never change an outcome."""
+    clk = {"t": 0.0}
+    svc = SimulationService(
+        ServeConfig(capacity=1, chunk_steps=8, backend="numpy"),
+        clock=lambda: clk["t"],
+    )
+    board = random_board(8, 8, seed=7)
+    sid = svc.submit(board, "conway", 5, timeout_s=10.0)
+    svc.pump()  # dispatches the session's only chunk: fully computed
+    assert svc.poll(sid).steps_done == 5
+    clk["t"] = 11.0  # deadline passes during the retire lag
+    svc.drain()
+    view = svc.poll(sid)
+    assert view.state is SessionState.DONE, view.error
+    np.testing.assert_array_equal(
+        svc.result(sid), run_np(board, get_rule("conway"), 5)
+    )
+    svc.close()
+
+
+# -- the narrowed critical section ------------------------------------------
+
+
+def test_submit_and_poll_not_blocked_while_round_in_flight():
+    """Satellite 2's proof, gate-based (no stopwatch flakiness): park the
+    engine's chunk compute mid-settle — the window where the sync pump
+    would hold the lock — and show submit/poll/cancel complete while it
+    is parked.  Then release the gate and verify everything is exact."""
+    svc = make_service(capacity=2, chunk_steps=4, backend="numpy")
+    entered = threading.Event()
+    gate = threading.Event()
+    orig = HostBatchEngine._collect_impl
+
+    def gated_collect(self, advanced):
+        entered.set()
+        assert gate.wait(10), "test gate never released"
+        orig(self, advanced)
+
+    board1 = random_board(10, 10, seed=1)
+    board2 = random_board(10, 10, seed=2)
+    sid1 = svc.submit(board1, "conway", 12)
+    HostBatchEngine._collect_impl = gated_collect
+    try:
+        pump_exc = []
+
+        def pump_once():
+            try:
+                svc.pump()
+            except BaseException as e:  # surfaced after join
+                pump_exc.append(e)
+
+        t = threading.Thread(target=pump_once)
+        t.start()
+        assert entered.wait(10), "round never reached its settle phase"
+        # the round is mid-flight (engine computing, lock released):
+        # every verb must complete NOW, not after the chunk
+        sid2 = svc.submit(board2, "conway", 7)
+        view = svc.poll(sid1)
+        assert view.state is SessionState.RUNNING
+        victim = svc.submit(board1, "conway", 50)
+        assert svc.cancel(victim) is True  # parks its (queued) removal
+        gate.set()
+        t.join(timeout=30)
+        assert not t.is_alive() and not pump_exc, pump_exc
+    finally:
+        HostBatchEngine._collect_impl = orig
+        gate.set()
+    svc.drain()
+    np.testing.assert_array_equal(
+        svc.result(sid1), run_np(board1, get_rule("conway"), 12)
+    )
+    np.testing.assert_array_equal(
+        svc.result(sid2), run_np(board2, get_rule("conway"), 7)
+    )
+    assert svc.poll(victim).state is SessionState.CANCELLED
+    svc.close()
+
+
+def test_cancel_of_running_session_mid_settle_defers_and_slot_is_reused():
+    """A cancel landing while the engine settles outside the lock parks
+    the slot release (never mutating the engine mid-compute); the next
+    round applies it and the slot serves a new session exactly."""
+    svc = make_service(capacity=1, chunk_steps=3, backend="numpy")
+    entered = threading.Event()
+    gate = threading.Event()
+    orig = HostBatchEngine._collect_impl
+
+    def gated_collect(self, advanced):
+        entered.set()
+        assert gate.wait(10), "test gate never released"
+        orig(self, advanced)
+
+    board = random_board(9, 9, seed=3)
+    victim = svc.submit(board, "conway", 1000)
+    HostBatchEngine._collect_impl = gated_collect
+    try:
+        t = threading.Thread(target=svc.pump)
+        t.start()
+        assert entered.wait(10)
+        assert svc.cancel(victim) is True  # RUNNING, engine busy -> deferred
+        assert svc.scheduler.deferred, "release must be parked, not applied"
+        gate.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+    finally:
+        HostBatchEngine._collect_impl = orig
+        gate.set()
+    assert svc.poll(victim).state is SessionState.CANCELLED
+    reuse = svc.submit(board, "conway", 5)
+    svc.drain()
+    assert not svc.scheduler.deferred  # the parked release was applied
+    np.testing.assert_array_equal(
+        svc.result(reuse), run_np(board, get_rule("conway"), 5)
+    )
+    svc.close()
+
+
+# -- drain under load through the gateway -----------------------------------
+
+
+def test_gateway_drain_mid_pipeline_flushes_and_matches_oracle(tmp_path):
+    """Satellite 3: a graceful drain issued while rounds are in flight
+    must flush the pipeline — zero lost sessions, every board equal to
+    the sync-pump oracle (run_np), a clean (non-crashed) pump exit."""
+    from tpu_life.gateway import Gateway, GatewayConfig
+    from tpu_life.gateway.client import GatewayClient
+
+    svc = make_service(capacity=4, chunk_steps=2, backend="numpy", max_queue=64)
+    gw = Gateway(svc, GatewayConfig(port=0))
+    gw.start()
+    try:
+        client = GatewayClient(f"http://127.0.0.1:{gw.port}", retries=0)
+        boards = [random_board(12, 12, seed=40 + i) for i in range(10)]
+        budgets = [6 + 3 * i for i in range(10)]  # up to 33 steps: many rounds
+        sids = [
+            client.submit(board=b, rule="conway", steps=n)
+            for b, n in zip(boards, budgets)
+        ]
+        # rounds are now in flight (chunk 2 vs budgets up to 33); drain
+        # mid-pipeline and require the flush to finish every session
+        gw.begin_drain()
+        assert gw.wait(timeout=60), "drain never completed"
+        assert gw.pump_error is None
+    finally:
+        gw.close()
+    assert svc.store.count(SessionState.DONE) == 10  # zero sessions lost
+    for sid, b, n in zip(sids, boards, budgets):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(b, get_rule("conway"), n)
+        )
+
+
+# -- observability stamps ----------------------------------------------------
+
+
+def test_pipeline_metrics_and_stats_stamps(tmp_path):
+    """The overlap is visible end-to-end: depth gauge >= 1 mid-run,
+    device-idle counter present, per-round records stamped, and
+    `tpu-life stats` (summarize + --json path) reports the new fields
+    for both a single sink and a two-run merge."""
+    import json
+
+    from tpu_life.obs import stats as obs_stats
+
+    sink = tmp_path / "pipe.jsonl"
+    svc = SimulationService(
+        ServeConfig(
+            capacity=2, chunk_steps=3, backend="jax", max_queue=32,
+            metrics=True, metrics_file=str(sink),
+        )
+    )
+    for i in range(4):
+        svc.submit(random_board(10, 10, seed=i), "conway", 9)
+    svc.drain()
+    stats = svc.stats()
+    assert stats["pump"] == "pipelined"
+    assert stats["device_idle_seconds"] >= 0.0
+    svc.close()
+
+    recs = [json.loads(l) for l in sink.read_text().splitlines()]
+    rounds = [r for r in recs if r.get("kind") == "serve"]
+    assert rounds and all(r["pump"] == "pipelined" for r in rounds)
+    assert max(r["pipeline_depth"] for r in rounds) >= 1  # overlap happened
+    assert all("device_idle_s" in r for r in rounds)
+    # the registry snapshot carries both instruments
+    metrics = {r["metric"] for r in recs if r.get("kind") == "metric"}
+    assert {"serve_pipeline_depth", "serve_device_idle_seconds_total"} <= metrics
+
+    summary = obs_stats.summarize(recs)
+    serve = summary["serve"]
+    assert serve["pump"] == "pipelined"
+    assert serve["pipeline_depth_max"] >= 1
+    assert serve["device_idle_seconds"] >= 0.0
+    assert 0.0 <= serve["device_idle_fraction"] <= 1.0
+
+    # merge path: a second run_id in the same record stream merges with
+    # idle seconds summed and depth max'd (the fleet read-back shape)
+    other = [dict(r, run_id="feedbeefcafe") for r in rounds]
+    merged = obs_stats.summarize(recs + other)["serve"]
+    assert merged["runs_merged"] == 2
+    assert merged["pipeline_depth_max"] == serve["pipeline_depth_max"]
+    assert merged["device_idle_seconds"] == pytest.approx(
+        2 * serve["device_idle_seconds"]
+    )
+
+
+def test_sync_pump_still_emits_legacy_spans_and_counts_idle(tmp_path):
+    """`--sync-pump` keeps the classic round: step-chunk spans, depth 0,
+    and a device-idle counter that actually accumulates (the seconds the
+    pipelined pump exists to reclaim)."""
+    import json
+
+    svc = SimulationService(
+        ServeConfig(
+            capacity=2, chunk_steps=4, backend="jax", pipeline=False,
+            metrics=True, trace_events=str(tmp_path / "sync.json"),
+        )
+    )
+    boards = [random_board(10, 10, seed=i) for i in range(4)]
+    sids = [svc.submit(b, "conway", 12) for b in boards]
+    svc.drain()
+    for sid, b in zip(sids, boards):
+        np.testing.assert_array_equal(
+            svc.result(sid), run_np(b, get_rule("conway"), 12)
+        )
+    stats = svc.stats()
+    assert stats["pump"] == "sync"
+    assert stats["pipeline_depth"] == 0.0
+    assert stats["device_idle_seconds"] > 0.0  # retire/admit gaps counted
+    assert all(r["pump"] == "sync" for r in svc.recorder.records)
+    svc.close()
+    doc = json.loads(open(tmp_path / "sync.json").read())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "serve.step-chunk" in names
+    assert "serve.dispatch" not in names
+
+
+def test_serve_cli_summary_carries_pump_stamp(tmp_path, capsys):
+    """The `tpu-life serve` summary line names the pump and its idle
+    seconds — the win is observable without reading raw traces."""
+    import json
+
+    from tpu_life import cli
+    from tpu_life.io.codec import write_board
+
+    board = random_board(8, 8, seed=5)
+    inp = tmp_path / "in.txt"
+    write_board(inp, board)
+    spool = tmp_path / "requests.jsonl"
+    spool.write_text(
+        json.dumps(
+            {"input_file": str(inp), "height": 8, "width": 8,
+             "steps": 6, "rule": "conway"}
+        )
+        + "\n"
+    )
+    rc = cli.main(
+        [
+            "serve",
+            "--requests", str(spool),
+            "--output-dir", str(tmp_path / "out"),
+            "--capacity", "2",
+            "--serve-backend", "numpy",
+        ]
+    )
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["pump"] == "pipelined"
+    assert summary["device_idle_s"] >= 0.0
+    assert summary["done"] == 1

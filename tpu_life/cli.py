@@ -144,15 +144,20 @@ def build_parser() -> argparse.ArgumentParser:
         "CompileKey through the autotune cache (read path only — an "
         "untuned key takes the cost-model pick, never a measurement)",
     )
+    srv.add_argument("--sync-pump", action="store_true",
+                     help="run the host-synchronous scheduling round "
+                     "instead of the default pipelined (double-buffered) "
+                     "pump — the bit-identical oracle shape, for "
+                     "debugging and baseline timing (docs/SERVING.md)")
     srv.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                      help="default per-request deadline")
     srv.add_argument("--metrics-file", default=None, metavar="JSONL",
                      help="append per-round serve metrics as JSON lines")
     srv.add_argument("--trace-events", default=None, metavar="FILE",
                      help="write Chrome trace-event JSON (Perfetto): round "
-                     "spans (admit/step-chunk/retire) + per-session "
-                     "queue-wait intervals, run_id-correlated with the "
-                     "metrics sink")
+                     "spans (admit/dispatch/collect/retire; step-chunk "
+                     "under --sync-pump) + per-session queue-wait "
+                     "intervals, run_id-correlated with the metrics sink")
     srv.add_argument("--prom-file", default=None, metavar="FILE",
                      help="write a Prometheus text-exposition snapshot of "
                      "the serve metrics registry at shutdown")
@@ -200,6 +205,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="batch slots (default: one per temperature, so "
                     "the whole grid runs as one batch)")
     sw.add_argument("--chunk-steps", type=int, default=16)
+    sw.add_argument("--sync-pump", action="store_true",
+                    help="host-synchronous rounds instead of the pipelined "
+                    "pump (same semantics as `serve --sync-pump`)")
     sw.add_argument("--output-dir", default=None, metavar="DIR",
                     help="also write each final lattice to "
                     "DIR/<session-id>.txt (contract board format)")
@@ -231,6 +239,9 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["jax", "tuned", "numpy", "sharded", "stripes", "pallas", "native"],
         help="engine executor (same semantics as `serve --serve-backend`)",
     )
+    gw.add_argument("--sync-pump", action="store_true",
+                    help="host-synchronous rounds instead of the pipelined "
+                    "pump (same semantics as `serve --sync-pump`)")
     gw.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline")
     gw.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
@@ -283,6 +294,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="engine executor for every worker (same semantics as "
         "`gateway --serve-backend`)",
     )
+    fl.add_argument("--sync-pump", action="store_true",
+                    help="workers run host-synchronous rounds instead of "
+                    "the pipelined pump (forwarded to every gateway)")
     fl.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline (per worker)")
     fl.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
@@ -1027,6 +1041,7 @@ def _serve(args) -> int:
             chunk_steps=args.chunk_steps,
             max_queue=args.max_queue,
             backend=args.serve_backend,
+            pipeline=not args.sync_pump,
             default_timeout_s=args.timeout,
             metrics=True,
             metrics_file=args.metrics_file,
@@ -1106,6 +1121,8 @@ def _serve(args) -> int:
                 "mode": "serve",
                 "run_id": stats["run_id"],
                 "backend": args.serve_backend,
+                "pump": stats["pump"],
+                "device_idle_s": stats["device_idle_seconds"],
                 "capacity": args.capacity,
                 "chunk_steps": args.chunk_steps,
                 "sessions": len(submitted),
@@ -1181,6 +1198,7 @@ def _sweep(parser, args) -> int:
             chunk_steps=args.chunk_steps,
             max_queue=max(64, len(temps)),
             backend=args.serve_backend,
+            pipeline=not args.sync_pump,
             metrics=bool(args.metrics_file),
             metrics_file=args.metrics_file,
         )
@@ -1278,6 +1296,7 @@ def _gateway(args) -> int:
             chunk_steps=args.chunk_steps,
             max_queue=args.max_queue,
             backend=args.serve_backend,
+            pipeline=not args.sync_pump,
             default_timeout_s=args.timeout,
             metrics=True,
             metrics_file=args.metrics_file,
@@ -1322,6 +1341,8 @@ def _gateway(args) -> int:
             {
                 "mode": "gateway",
                 "run_id": stats["run_id"],
+                "pump": stats["pump"],
+                "device_idle_s": stats["device_idle_seconds"],
                 # a pump crash is a failed serve even though the drain
                 # machinery shut everything down tidily — exit 1 below
                 "pump_error": str(gw.pump_error) if gw.pump_error else None,
@@ -1368,6 +1389,8 @@ def _fleet(args) -> int:
         "--api-rate", str(args.api_rate),
         "--api-burst", str(args.api_burst),
     ]
+    if args.sync_pump:
+        worker_args += ["--sync-pump"]
     if args.timeout is not None:
         worker_args += ["--timeout", str(args.timeout)]
     if args.platform is not None:

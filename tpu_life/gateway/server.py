@@ -197,6 +197,11 @@ class Gateway:
             draining = svc.draining
             if svc.idle():
                 if draining:
+                    # flush before reporting empty: a chunk whose sessions
+                    # were all cancelled mid-pipeline is still executing,
+                    # and a drain that abandons it would race device work
+                    # against interpreter teardown
+                    svc.flush()
                     break
                 self._wake.wait(self.config.pump_idle_s)
                 self._wake.clear()

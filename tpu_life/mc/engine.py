@@ -18,6 +18,15 @@ checkpoint/resume all read the same stream:
   ``compile_count == 1``) and a frozen slot's counter freezes with its
   board — each session's trajectory is bit-identical to its own
   single-session run.
+
+Both serve engines implement the split dispatch/collect contract
+(``serve.engine.EngineBase``): the device engine double-buffers the
+in-flight chunk's input batch so frozen slots retire while the chunk
+runs (the per-slot step counters freeze with the boards, so the stream
+position a retired board implies is exact — bit-identity survives the
+pipelined pump, including counter state across checkpoint/resume), and
+the host engine defers its chunk compute to ``settle()`` so the
+pipelined pump can run it outside the service lock.
 """
 
 from __future__ import annotations
@@ -192,6 +201,8 @@ class MCVmapEngine(EngineBase):
     CompileKey, so a temperature sweep's N sessions pack into one
     compiled program — the MPMD parameter-sweep shape of the ISSUE."""
 
+    ASYNC_ROLL = True
+
     def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
         super().__init__(key, capacity, chunk_steps)
         import jax
@@ -199,6 +210,7 @@ class MCVmapEngine(EngineBase):
 
         h, w = key.shape
         self._jnp = jnp
+        self._prev = None  # the in-flight chunk's input batch (double buffer)
         self._boards = jax.device_put(jnp.zeros((capacity, h, w), jnp.int8))
         self._rem_dev = jax.device_put(jnp.zeros(capacity, jnp.int32))
         self._k0 = jax.device_put(jnp.zeros(capacity, jnp.uint32))
@@ -291,11 +303,15 @@ class MCVmapEngine(EngineBase):
             return boards, rem, st
 
         self.compile_count += 1
-        return jax.jit(chunk, donate_argnums=(0, 1, 2))
+        # donate the remaining/step-counter carries, NOT the boards: the
+        # chunk input is the double buffer late retirement reads while the
+        # next chunk is still in flight (serve.engine module docstring)
+        return jax.jit(chunk, donate_argnums=(1, 2))
 
-    def _advance_impl(self) -> None:
+    def _dispatch_impl(self) -> None:
         if self._chunk is None:
             self._chunk = self._build_chunk()
+        self._prev = self._boards
         self._boards, self._rem_dev, self._steps_abs = self._chunk(
             self._boards,
             self._rem_dev,
@@ -305,7 +321,25 @@ class MCVmapEngine(EngineBase):
             self._thr,
         )
 
+    def _collect_impl(self, advanced: dict[int, int]) -> None:
+        import jax
+
+        jax.block_until_ready(self._boards)
+        self._prev = None
+
+    def settle(self) -> None:
+        # wait for everything but the newest chunk (see VmapEngine.settle)
+        if self._prev is not None:
+            import jax
+
+            jax.block_until_ready(self._prev)
+
     def fetch(self, slot: int) -> np.ndarray:
+        self._fetch_guard(slot)
+        if self._inflight and self._prev is not None:
+            # frozen slot: board AND step counter are provably unchanged
+            # by the in-flight chunk, so the chunk input is its final state
+            return np.asarray(self._prev[slot])
         return np.asarray(self._boards[slot])
 
 
@@ -342,11 +376,11 @@ class MCHostEngine(EngineBase):
         self._boards[slot] = 0
         self._staged = (0, None, 0)
 
-    def _advance_impl(self) -> None:
-        for slot, rem in enumerate(self._remaining):
-            n = min(self.chunk_steps, int(rem))
-            if n <= 0:
-                continue
+    def _dispatch_impl(self) -> None:
+        pass  # deferred: the chunk runs at collect time (outside the lock)
+
+    def _collect_impl(self, advanced: dict[int, int]) -> None:
+        for slot, n in advanced.items():
             k0, k1 = self._keys[slot]
             b = self._boards[slot]
             base = int(self._steps_abs[slot])
@@ -356,6 +390,7 @@ class MCHostEngine(EngineBase):
             self._steps_abs[slot] = base + n
 
     def fetch(self, slot: int) -> np.ndarray:
+        self._fetch_guard(slot)
         return self._boards[slot].copy()
 
 

@@ -113,7 +113,7 @@ def _run_summary(chunks: list[dict]) -> dict:
 def _serve_summary(rounds: list[dict]) -> dict:
     last = rounds[-1]
     occ = [r.get("batch_occupancy", 0.0) for r in rounds]
-    return {
+    out = {
         "rounds": len(rounds),
         "elapsed_s": last.get("elapsed_s"),
         "sessions_done": last.get("sessions_done"),
@@ -125,6 +125,20 @@ def _serve_summary(rounds: list[dict]) -> dict:
         "batch_occupancy_mean": sum(occ) / len(occ),
         "queue_depth_max": max(r.get("queue_depth", 0) for r in rounds),
     }
+    # the pipelined-pump stamps (ISSUE 7) — only when the sink carries
+    # them, so summaries of pre-pipeline sinks are byte-stable
+    if "pump" in last:
+        out["pump"] = last["pump"]
+    if any("device_idle_s" in r for r in rounds):
+        idle = last.get("device_idle_s") or 0.0
+        elapsed = last.get("elapsed_s") or 0.0
+        out["device_idle_seconds"] = idle
+        out["device_idle_fraction"] = idle / elapsed if elapsed > 0 else 0.0
+    if any("pipeline_depth" in r for r in rounds):
+        out["pipeline_depth_max"] = max(
+            r.get("pipeline_depth", 0) for r in rounds
+        )
+    return out
 
 
 def _merge_serve(per_run: dict) -> dict:
@@ -133,7 +147,7 @@ def _merge_serve(per_run: dict) -> dict:
     worker's wall clock, occupancy is the round-weighted mean."""
     summaries = list(per_run.values())
     total_rounds = sum(s["rounds"] for s in summaries)
-    return {
+    merged = {
         "rounds": total_rounds,
         "elapsed_s": max((s.get("elapsed_s") or 0.0) for s in summaries),
         "sessions_done": sum(s.get("sessions_done") or 0 for s in summaries),
@@ -153,6 +167,30 @@ def _merge_serve(per_run: dict) -> dict:
         "queue_depth_max": max(s["queue_depth_max"] for s in summaries),
         "runs_merged": len(summaries),
     }
+    # device-idle merges like the counts: seconds sum across workers, the
+    # fraction renormalizes over their combined wall time (workers ran
+    # concurrently, so per-worker fractions are what each chip wasted)
+    idles = [
+        s["device_idle_seconds"] for s in summaries
+        if "device_idle_seconds" in s
+    ]
+    if idles:
+        merged["device_idle_seconds"] = sum(idles)
+        total_elapsed = sum(
+            s.get("elapsed_s") or 0.0
+            for s in summaries
+            if "device_idle_seconds" in s
+        )
+        merged["device_idle_fraction"] = (
+            sum(idles) / total_elapsed if total_elapsed > 0 else 0.0
+        )
+    depths = [
+        s["pipeline_depth_max"] for s in summaries
+        if "pipeline_depth_max" in s
+    ]
+    if depths:
+        merged["pipeline_depth_max"] = max(depths)
+    return merged
 
 
 def summarize(records: list[dict]) -> dict:
@@ -286,6 +324,14 @@ def render(summary: dict) -> str:
                 f"sessions/s={_fmt(serve.get('sessions_per_sec'))}  "
                 f"occupancy={_fmt(serve.get('batch_occupancy_mean'))}  "
                 f"queue_depth_max={_fmt(serve.get('queue_depth_max'))}"
+            )
+        if "device_idle_seconds" in serve:
+            pump = serve.get("pump")
+            lines.append(
+                f"  device_idle_s={_fmt(serve['device_idle_seconds'])}  "
+                f"idle_fraction={_fmt(serve.get('device_idle_fraction'))}  "
+                f"pipeline_depth_max={_fmt(serve.get('pipeline_depth_max'))}"
+                + (f"  pump={pump}" if pump else "")
             )
         if "rejection_rate" in serve:
             lines.append(f"  rejection_rate={_fmt(serve['rejection_rate'])}")

@@ -26,10 +26,31 @@ Three executors behind one interface, mirroring the Backend split:
   stripes): one ``Runner`` per slot via the existing ``make_runner`` seam,
   advanced slot by slot.  Slower, but keeps the whole backend matrix
   servable without new kernels.
+
+The chunk API is split into a **dispatch / collect contract** so the
+pipelined pump (docs/SERVING.md) can overlap device compute with host
+work: ``dispatch_chunk()`` *launches* one chunk and returns immediately
+with the per-slot step accounting; ``collect_chunk()`` blocks until that
+chunk is materialized; ``settle()`` blocks only far enough that
+``fetch()`` of *frozen* slots cannot stall (the device executor keeps its
+newest chunk in flight; host executors run their deferred compute here —
+outside the service lock).  ``advance_chunk()`` = dispatch + collect is
+the host-synchronous composition the classic scheduler round still uses.
+
+Double buffering and donation rules: the device executors keep a
+reference to the in-flight chunk's *input* batch (``_prev``), so a slot
+frozen during the chunk (``remaining == 0`` — its value is provably
+unchanged by the freeze mask) can be fetched from ``_prev`` while the
+chunk is still executing.  That reference is why the chunk function
+donates only its auxiliary carry (``remaining``, and the MC step
+counters) and **not** the board batch — donating boards would invalidate
+the very buffer late retirement reads.  The slot-writer programs still
+donate everything (nothing holds their inputs).
 """
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -69,6 +90,13 @@ class EngineBase:
     stays at 1 per engine no matter how many sessions churn through.
     """
 
+    #: True for executors whose ``dispatch_chunk`` may be called while a
+    #: previous chunk is still in flight (the device path: XLA chains the
+    #: chunks on data dependencies, so rolling never blocks the host).
+    #: Host executors auto-collect first — their "in-flight" chunk is
+    #: deferred *host* compute that would otherwise be silently dropped.
+    ASYNC_ROLL = False
+
     def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -80,6 +108,18 @@ class EngineBase:
         self.compile_count = 0
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self._remaining = np.zeros(capacity, dtype=np.int64)
+        # the in-flight chunk's {slot: steps} accounting (empty = none)
+        self._inflight: dict[int, int] = {}
+        # set by the service while this engine settles OUTSIDE the lock:
+        # verb-triggered slot releases must defer to the pump meanwhile
+        self.busy = False
+        # device-idle bookkeeping: wall time this engine sat with no chunk
+        # in flight between a collect and the next dispatch.  Always real
+        # time (time.monotonic), independent of any injected test clock —
+        # it measures the machine, not the simulated schedule.
+        self.idle_seconds = 0.0
+        self._idle_reported = 0.0
+        self._idle_since: float | None = None
 
     # -- slot lifecycle ----------------------------------------------------
     def acquire(self) -> int | None:
@@ -88,8 +128,12 @@ class EngineBase:
 
     def release(self, slot: int) -> None:
         """Return a slot to the pool; its lattice is dead weight until the
-        next load (the freeze mask already ignores it: remaining == 0)."""
+        next load (the freeze mask already ignores it: remaining == 0).
+        The slot also leaves any uncollected chunk's accounting: a host
+        executor's deferred compute must not step a board that a new
+        session is about to be (or already was) loaded into."""
         self._remaining[slot] = 0
+        self._inflight.pop(slot, None)
         self._clear_slot(slot)
         self._free.append(slot)
 
@@ -124,19 +168,82 @@ class EngineBase:
     def remaining(self, slot: int) -> int:
         return int(self._remaining[slot])
 
-    # -- the batched chunk -------------------------------------------------
-    def advance_chunk(self) -> dict[int, int]:
-        """Advance every occupied slot by ``min(chunk_steps, remaining)``
-        steps in one batched dispatch; returns {slot: steps_advanced}."""
+    # -- the batched chunk: dispatch / collect ------------------------------
+    @property
+    def inflight(self) -> bool:
+        """True while a dispatched chunk has not been collected."""
+        return bool(self._inflight)
+
+    def dispatch_chunk(self) -> dict[int, int]:
+        """Launch one chunk that advances every occupied slot by
+        ``min(chunk_steps, remaining)`` steps; returns that per-slot
+        accounting immediately, without waiting for the result.
+
+        The device executors may be re-dispatched while a previous chunk
+        is still in flight (``ASYNC_ROLL``) — XLA executes the chunks
+        back-to-back with no host in the loop, which is the whole point
+        of the pipelined pump.  Host executors collect first.
+        """
+        if self._inflight and not self.ASYNC_ROLL:
+            self.collect_chunk()
         advanced = {
             s: min(self.chunk_steps, int(r))
             for s, r in enumerate(self._remaining)
             if r > 0
         }
         if advanced:
-            self._advance_impl()
+            now = time.monotonic()
+            if self._idle_since is not None:
+                self.idle_seconds += now - self._idle_since
+                self._idle_since = None
+            self._dispatch_impl()
             self._remaining = np.maximum(self._remaining - self.chunk_steps, 0)
+            self._inflight = advanced
         return advanced
+
+    def collect_chunk(self) -> dict[int, int]:
+        """Block until the in-flight chunk (if any) is fully materialized;
+        returns its {slot: steps} accounting.  After this, ``fetch`` of
+        any slot reflects the chunk."""
+        adv, self._inflight = self._inflight, {}
+        if adv:
+            self._collect_impl(adv)
+            self._idle_since = time.monotonic()
+        return adv
+
+    def settle(self) -> None:
+        """Finish enough in-flight work that ``fetch()`` of *frozen*
+        slots cannot stall.  Host executors run their deferred chunk
+        compute here (the pipelined pump calls this outside the service
+        lock, so submit/poll stay serviceable meanwhile); the device
+        executor overrides to wait for everything but its newest chunk.
+        """
+        self.collect_chunk()
+
+    def advance_chunk(self) -> dict[int, int]:
+        """The host-synchronous composition: dispatch one chunk and wait
+        for it — the classic scheduler round's quantum."""
+        advanced = self.dispatch_chunk()
+        self.collect_chunk()
+        return advanced
+
+    def idle_seconds_delta(self) -> float:
+        """Idle seconds accumulated since this was last called — the
+        service drains these into its ``serve_device_idle_seconds_total``
+        counter every round."""
+        delta = self.idle_seconds - self._idle_reported
+        self._idle_reported = self.idle_seconds
+        return delta
+
+    def _fetch_guard(self, slot: int) -> None:
+        # fetching a slot the in-flight chunk is still STEPPING would
+        # return pre-chunk data on the host executors; the scheduler only
+        # ever fetches frozen slots, so tripping this is a pump bug
+        if slot in self._inflight:
+            raise RuntimeError(
+                f"slot {slot} is being stepped by an in-flight chunk; "
+                f"collect_chunk() before fetch"
+            )
 
     # -- executor hooks ----------------------------------------------------
     def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
@@ -145,7 +252,14 @@ class EngineBase:
     def _clear_slot(self, slot: int) -> None:
         raise NotImplementedError
 
-    def _advance_impl(self) -> None:
+    def _dispatch_impl(self) -> None:
+        """Launch (device) or stage (host) one chunk of work."""
+        raise NotImplementedError
+
+    def _collect_impl(self, advanced: dict[int, int]) -> None:
+        """Materialize the chunk ``_dispatch_impl`` launched; ``advanced``
+        is its {slot: steps} accounting (host executors compute from it —
+        ``_remaining`` has already been decremented)."""
         raise NotImplementedError
 
     def fetch(self, slot: int) -> np.ndarray:
@@ -161,7 +275,15 @@ class VmapEngine(EngineBase):
     inherited, not re-proven.  Boards stay device-resident between chunks;
     slot loads go through one jitted dynamic-update program (slot index
     traced, so joining a running batch never triggers a retrace).
+
+    Pipelining: dispatch is an async XLA launch, and the pre-chunk board
+    batch is retained in ``_prev`` (double buffer) so frozen slots retire
+    without waiting for the newest chunk.  ``settle`` waits only for
+    ``_prev`` to materialize — i.e. for every chunk but the newest —
+    which also bounds the device queue at double-buffer depth.
     """
+
+    ASYNC_ROLL = True
 
     def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
         super().__init__(key, capacity, chunk_steps)
@@ -174,6 +296,7 @@ class VmapEngine(EngineBase):
             jnp.zeros((capacity, h, w), dtype=jnp.int8)
         )
         self._rem_dev = jax.device_put(jnp.zeros(capacity, dtype=jnp.int32))
+        self._prev = None  # the in-flight chunk's input batch (double buffer)
 
         # slot writer: slot index and budget are traced scalars, so every
         # load/evict reuses one compiled program regardless of which slot
@@ -216,7 +339,9 @@ class VmapEngine(EngineBase):
             return boards, rem
 
         self.compile_count += 1
-        return jax.jit(chunk, donate_argnums=(0, 1))
+        # donate only the remaining-steps carry: the board input is the
+        # double buffer late retirement reads (see the module docstring)
+        return jax.jit(chunk, donate_argnums=(1,))
 
     def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
         jnp = self._jnp
@@ -232,19 +357,46 @@ class VmapEngine(EngineBase):
         h, w = self.key.shape
         self._load_slot(slot, np.zeros((h, w), np.int8), 0)
 
-    def _advance_impl(self) -> None:
+    def _dispatch_impl(self) -> None:
         if self._chunk is None:
             self._chunk = self._build_chunk()
+        self._prev = self._boards
         self._boards, self._rem_dev = self._chunk(self._boards, self._rem_dev)
 
+    def _collect_impl(self, advanced: dict[int, int]) -> None:
+        import jax
+
+        jax.block_until_ready(self._boards)
+        self._prev = None
+
+    def settle(self) -> None:
+        # wait for every chunk but the newest: _prev is the newest chunk's
+        # input, i.e. the previous chunk's output — once it is ready, every
+        # frozen slot fetches without blocking, and the host can never run
+        # more than one chunk ahead of the device
+        if self._prev is not None:
+            import jax
+
+            jax.block_until_ready(self._prev)
+
     def fetch(self, slot: int) -> np.ndarray:
+        self._fetch_guard(slot)
+        if self._inflight and self._prev is not None:
+            # the slot is frozen in the in-flight chunk (remaining == 0 ->
+            # the freeze mask provably leaves it untouched), so its value
+            # in the chunk INPUT equals its value in the output — read the
+            # materialized buffer instead of blocking on the newest chunk
+            return np.asarray(self._prev[slot])
         return np.asarray(self._boards[slot])
 
 
 class HostBatchEngine(EngineBase):
     """The numpy executor on the same batch layout — the serving twin of
     ``NumpyBackend``, and the truth executor the equivalence tests pin
-    the device engine against."""
+    the device engine against.  Its chunk "dispatch" only stages the
+    work; the compute runs in ``_collect_impl`` — which the pipelined
+    pump calls from ``settle()`` *outside* the service lock, so host
+    stepping never blocks submit/poll."""
 
     def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
         super().__init__(key, capacity, chunk_steps)
@@ -257,18 +409,21 @@ class HostBatchEngine(EngineBase):
     def _clear_slot(self, slot: int) -> None:
         self._boards[slot] = 0
 
-    def _advance_impl(self) -> None:
+    def _dispatch_impl(self) -> None:
+        pass  # deferred: the chunk runs at collect time (see class doc)
+
+    def _collect_impl(self, advanced: dict[int, int]) -> None:
         from tpu_life.ops.reference import step_np
 
         rule = self.key.rule
-        for slot, rem in enumerate(self._remaining):
-            n = min(self.chunk_steps, int(rem))
+        for slot, n in advanced.items():
             b = self._boards[slot]
             for _ in range(n):
                 b = step_np(b, rule)
             self._boards[slot] = b
 
     def fetch(self, slot: int) -> np.ndarray:
+        self._fetch_guard(slot)
         return self._boards[slot].copy()
 
 
@@ -292,13 +447,17 @@ class SlotLoopEngine(EngineBase):
     def _clear_slot(self, slot: int) -> None:
         self._runners.pop(slot, None)
 
-    def _advance_impl(self) -> None:
-        for slot, rem in enumerate(self._remaining):
-            n = min(self.chunk_steps, int(rem))
-            if n > 0:
-                self._runners[slot].advance(n)
+    def _dispatch_impl(self) -> None:
+        pass  # deferred: runners advance at collect time, like the host engine
+
+    def _collect_impl(self, advanced: dict[int, int]) -> None:
+        for slot, n in advanced.items():
+            runner = self._runners.get(slot)
+            if runner is not None:  # slot released since dispatch: work is moot
+                runner.advance(n)
 
     def fetch(self, slot: int) -> np.ndarray:
+        self._fetch_guard(slot)
         return self._runners[slot].fetch()
 
 

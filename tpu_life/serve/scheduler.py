@@ -21,6 +21,27 @@ slots, and the engines advance whatever is resident.  Policies:
   drill, or any RECOVERABLE error surfacing during its slot operations —
   ``runtime.recovery`` semantics) marks only that session FAILED and
   frees its slot; the rest of the batch keeps stepping.
+
+Two round shapes share these policies:
+
+- :meth:`Scheduler.round` — the classic host-synchronous quantum
+  (admit -> advance -> retire, each engine's chunk awaited in place).
+  This is the oracle the pipelined pump is bit-compared against.
+- :meth:`Scheduler.round_begin` / :meth:`Scheduler.round_end` — the
+  pipelined round the service drives in three phases (docs/SERVING.md):
+  *begin* (locked) expires, admits, and async-dispatches one chunk per
+  engine in rotated key order — so a mixed-rule population round-robins
+  its compiled steps and a slow or faulted key never head-of-line-blocks
+  another key's pipeline; *settle* (run by the service OUTSIDE its lock)
+  lets device chunks and host-engine compute finish; *end* (locked)
+  retires the PREVIOUS dispatch's finishers from the engines' double
+  buffers, re-admits into the freed slots, and late-dispatches engines
+  that were empty at begin.  Retirement lags dispatch by one round by
+  construction — that lag is the overlap.  Per-key in-flight tracking
+  (``pending`` / each engine's own in-flight chunk) keeps the keys'
+  pipelines independent.  Verb-triggered slot releases that land while
+  an engine is settling outside the lock are parked in ``deferred`` and
+  applied at the next begin — verbs never mutate an engine mid-compute.
 """
 
 from __future__ import annotations
@@ -65,6 +86,17 @@ class Scheduler:
     # on admission (with the measured queue wait) and on every terminal
     # transition the scheduler performs (with the submit-to-finish latency)
     observer: object | None = None
+    # pipelined-round state: sessions that finished inside an already-
+    # dispatched chunk, awaiting retirement once that chunk settles
+    # (CompileKey -> [(slot, Session)]) ...
+    pending: dict = field(default_factory=dict)
+    # ... the finishers of the round currently being built ...
+    _fresh: dict = field(default_factory=dict)
+    # ... slot releases parked while their engine settles outside the
+    # service lock (a cancel must not race an engine mid-compute), and
+    # the key-rotation cursor for round-robin dispatch order
+    deferred: list = field(default_factory=list)
+    _rotation: int = 0
 
     # -- ingestion ---------------------------------------------------------
     def ensure_admission(self) -> None:
@@ -93,14 +125,28 @@ class Scheduler:
 
     def evict_running(self, session: Session) -> bool:
         """Free a RUNNING session's slot (cancel / deadline); the caller
-        sets the session's terminal state."""
+        sets the session's terminal state.  While the slot's engine is
+        settling outside the service lock the release is parked in
+        ``deferred`` (applied at the next round's begin) — touching an
+        engine mid-compute from a verb thread would race the pump."""
         for key, slots in self.running.items():
             for slot, s in list(slots.items()):
                 if s is session:
                     del slots[slot]
-                    self.engines[key].release(slot)
+                    engine = self.engines[key]
+                    if engine.busy:
+                        self.deferred.append((key, slot))
+                    else:
+                        engine.release(slot)
                     return True
         return False
+
+    def _process_deferred(self) -> None:
+        for key, slot in self.deferred:
+            engine = self.engines.get(key)
+            if engine is not None:
+                engine.release(slot)
+        self.deferred.clear()
 
     # -- one scheduling round ---------------------------------------------
     def round(self, keyer) -> RoundStats:
@@ -136,6 +182,13 @@ class Scheduler:
         # tenants that can still meet their deadlines
         for key, slots in self.running.items():
             for slot, s in list(slots.items()):
+                if s.steps_remaining == 0:
+                    # fully computed, awaiting retirement (the pipelined
+                    # pump retires one round after dispatch): under the
+                    # sync pump this session retired DONE in its final
+                    # round, so failing it here would make the overlap
+                    # change an outcome — the one thing it must never do
+                    continue
                 if s.deadline is not None and now >= s.deadline:
                     del slots[slot]
                     self.engines[key].release(slot)
@@ -193,28 +246,49 @@ class Scheduler:
             stats.admitted += 1
         self.queue.extend(deferred)
 
+    def _fault_drill(self, engine: EngineBase, slots: dict, stats: RoundStats) -> None:
+        # the fault-injection drill fires where a real per-slot device
+        # failure would: before the chunk that crosses fault_at.  Only
+        # the faulty tenant dies; its slot frees, the batch continues.
+        for slot, s in list(slots.items()):
+            to_run = min(engine.chunk_steps, s.steps_remaining)
+            if not (s.fault_at and s.steps_done < s.fault_at <= s.steps_done + to_run):
+                continue
+            e = recovery.InjectedFault(
+                f"injected per-slot device failure crossing step {s.fault_at}"
+            )
+            assert isinstance(e, recovery.RECOVERABLE)
+            del slots[slot]
+            engine.release(slot)
+            s.fail(f"{type(e).__name__}: {e}")
+            self._notify_finished(s)
+            stats.failed += 1
+            log.info("serve: session %s failed in slot %d: %s", s.sid, slot, e)
+
+    def _retire_slot(
+        self, engine: EngineBase, slots: dict, slot: int, s: Session,
+        stats: RoundStats,
+    ) -> None:
+        del slots[slot]
+        try:
+            board = engine.fetch(slot)
+        except recovery.RECOVERABLE as e:
+            engine.release(slot)
+            s.fail(f"fetch failed: {e}")
+            self._notify_finished(s)
+            stats.failed += 1
+            return
+        engine.release(slot)
+        s.finish(board)
+        self._notify_finished(s)
+        stats.completed += 1
+
     def _advance(self, stats: RoundStats) -> None:
         for key, engine in self.engines.items():
             slots = self.running[key]
             if not slots:
                 continue
-            # the fault-injection drill fires where a real per-slot device
-            # failure would: before the chunk that crosses fault_at.  Only
-            # the faulty tenant dies; its slot frees, the batch continues.
-            for slot, s in list(slots.items()):
-                to_run = min(engine.chunk_steps, s.steps_remaining)
-                if not (s.fault_at and s.steps_done < s.fault_at <= s.steps_done + to_run):
-                    continue
-                e = recovery.InjectedFault(
-                    f"injected per-slot device failure crossing step {s.fault_at}"
-                )
-                assert isinstance(e, recovery.RECOVERABLE)
-                del slots[slot]
-                engine.release(slot)
-                s.fail(f"{type(e).__name__}: {e}")
-                self._notify_finished(s)
-                stats.failed += 1
-                log.info("serve: session %s failed in slot %d: %s", s.sid, slot, e)
+            self._fault_drill(engine, slots, stats)
             if not slots:
                 continue
             with obs.span(
@@ -229,19 +303,125 @@ class Scheduler:
                     s.steps_done += n
                     stats.steps_advanced += n
                     if s.steps_remaining == 0:
-                        del slots[slot]
-                        try:
-                            board = engine.fetch(slot)
-                        except recovery.RECOVERABLE as e:
-                            engine.release(slot)
-                            s.fail(f"fetch failed: {e}")
-                            self._notify_finished(s)
-                            stats.failed += 1
-                            continue
-                        engine.release(slot)
-                        s.finish(board)
-                        self._notify_finished(s)
-                        stats.completed += 1
+                        self._retire_slot(engine, slots, slot, s, stats)
+
+    # -- the pipelined round (three phases; see the module docstring) -------
+    def round_begin(self, keyer, stats: RoundStats) -> list:
+        """Locked phase 1: apply parked releases, expire deadlines, admit,
+        then async-dispatch one chunk per engine in rotated key order.
+        Returns the settle plan — ``(key, engine, rolled)`` per engine that
+        has in-flight or pending work — for the service to run outside its
+        lock.  Sessions finishing inside a dispatched chunk are recorded
+        in ``_fresh``; they retire at the NEXT round's end, once their
+        chunk has settled behind its successor."""
+        self._process_deferred()
+        now = self.clock()
+        with obs.span("serve.admit"):
+            self._expire(now, stats)
+            self._admit(keyer, stats)
+        stats.occupancy = sum(e.occupancy() for e in self.engines.values())
+        stats.slots = sum(e.capacity for e in self.engines.values())
+        plan = []
+        keys = list(self.engines)
+        if keys:
+            # rotate the dispatch order so no key's chunk is always the
+            # last launched — with several compiled programs sharing one
+            # device queue, the tail position is the one that waits
+            off = self._rotation % len(keys)
+            self._rotation += 1
+            keys = keys[off:] + keys[:off]
+        for key in keys:
+            engine = self.engines[key]
+            slots = self.running[key]
+            if not slots and not engine.inflight and not self.pending.get(key):
+                continue
+            self._fault_drill(engine, slots, stats)
+            if engine.inflight and not engine.ASYNC_ROLL:
+                # a host executor still carrying a late-dispatched chunk:
+                # dispatching now would run that compute HERE, under the
+                # lock — let settle collect it outside, and the end phase
+                # launch the next one
+                rolled = False
+            else:
+                rolled = self._dispatch_engine(
+                    key, engine, slots, stats, self._fresh
+                )
+            plan.append((key, engine, rolled))
+        stats.queue_depth = len(self.queue)
+        return plan
+
+    def _dispatch_engine(
+        self, key, engine: EngineBase, slots: dict, stats: RoundStats,
+        sink: dict,
+    ) -> bool:
+        """Launch one async chunk on ``engine`` and account its steps to
+        the resident sessions; True if a chunk was actually dispatched.
+        Sessions the chunk finishes are recorded in ``sink`` — ``_fresh``
+        for begin-phase dispatches (their chunk is this round's newest),
+        ``pending`` for end-phase ones (the next settle materializes
+        them, so they retire at the very next end)."""
+        if not any(s.steps_remaining > 0 for s in slots.values()):
+            return False
+        with obs.span(
+            "serve.dispatch", occupied=len(slots), steps=engine.chunk_steps
+        ):
+            advanced = engine.dispatch_chunk()
+        if not advanced:
+            return False
+        fresh = []
+        for slot, n in advanced.items():
+            s = slots.get(slot)
+            if s is None:
+                continue  # slot freed above; the chunk steps dead weight
+            s.steps_done += n
+            stats.steps_advanced += n
+            if s.steps_remaining == 0:
+                fresh.append((slot, s))
+        if fresh:
+            sink.setdefault(key, []).extend(fresh)
+        return True
+
+    def round_end(self, keyer, stats: RoundStats, rolled: set) -> None:
+        """Locked phase 3: retire the previous dispatches' finishers
+        (their chunks settled in phase 2, so every fetch reads a
+        materialized buffer), refill the freed slots from the queue, and
+        late-dispatch engines that sat out phase 1 (``rolled`` names the
+        keys that already launched a chunk this round — dispatching those
+        again would double-step their sessions) — so the drain tail never
+        costs a device-idle round per batch generation."""
+        with obs.span("serve.retire"):
+            for key, entries in list(self.pending.items()):
+                engine = self.engines.get(key)
+                if engine is None:
+                    continue  # key released while its finishers waited
+                slots = self.running[key]
+                for slot, s in entries:
+                    if slots.get(slot) is not s:
+                        continue  # cancelled/expired meanwhile; handled there
+                    self._retire_slot(engine, slots, slot, s, stats)
+            self.pending = self._fresh
+            self._fresh = {}
+        with obs.span("serve.admit", phase="post-retire"):
+            self._admit(keyer, stats)
+        for key, engine in self.engines.items():
+            slots = self.running[key]
+            if slots and not engine.inflight and key not in rolled:
+                self._dispatch_engine(key, engine, slots, stats, self.pending)
+        stats.queue_depth = len(self.queue)
+
+    def flush_inflight(self) -> None:
+        """Collect every engine's in-flight chunk without running a new
+        round — the drain tail's last act before close, so no device work
+        is abandoned mid-air (e.g. when every session of a chunk was
+        cancelled while it flew)."""
+        for engine in self.engines.values():
+            if engine.inflight:
+                engine.collect_chunk()
+
+    def idle_seconds_delta(self) -> float:
+        """Device-idle seconds accumulated across engines since last asked
+        (the service drains this into its counter every round)."""
+        return sum(e.idle_seconds_delta() for e in self.engines.values())
 
     def _notify_finished(self, session: Session) -> None:
         """Tell the observer a session the scheduler drove reached a
@@ -262,16 +442,35 @@ class Scheduler:
         API for quiet periods, never called automatically mid-burst.
         """
         # a queued session for a released key just rebuilds the engine next
-        # round (one recompile) — no need to scan the queue here
-        idle_keys = [k for k, slots in self.running.items() if not slots]
+        # round (one recompile) — no need to scan the queue here.  Engines
+        # mid-settle (the pump's unlocked window holds no service lock, so
+        # this call CAN overlap it) are skipped: collecting or deleting one
+        # under the pump would race its compute — next quiet call gets it.
+        idle_keys = [
+            k for k, slots in self.running.items()
+            if not slots and not self.engines[k].busy
+        ]
         for k in idle_keys:
+            engine = self.engines[k]
+            if engine.inflight:
+                # don't strand a dispatched chunk mid-air (every session of
+                # it was cancelled): wait it out before dropping the engine
+                engine.collect_chunk()
             del self.engines[k]
             del self.running[k]
+            self.pending.pop(k, None)
+            self._fresh.pop(k, None)
         return len(idle_keys)
 
     # -- introspection -----------------------------------------------------
     def idle(self) -> bool:
-        return not self.queue and all(not s for s in self.running.values())
+        # parked releases count as live work: one more begin phase applies
+        # them, so a drain loop cannot exit with slots still held
+        return (
+            not self.queue
+            and not self.deferred
+            and all(not s for s in self.running.values())
+        )
 
     def compile_counts(self) -> dict[CompileKey, int]:
         return {k: e.compile_count for k, e in self.engines.items()}

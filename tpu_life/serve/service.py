@@ -5,22 +5,43 @@ the repo whose shape is an inference stack rather than a batch job.  A
 network front-end would be a thin shell over exactly these four verbs;
 the CLI's ``serve`` / ``submit`` modes are the first such shell.
 
-Execution is cooperative: ``pump()`` runs one scheduling round (expire
-deadlines -> admit from the queue -> one batched device chunk per engine
--> retire finished sessions), ``drain()`` pumps until idle.  Cooperative
-beats background threads here for the same reason the driver is a
-synchronous loop: every test and every caller sees a deterministic
-interleaving, and the host-sync chunk boundary is already the natural
-scheduling quantum (sessions join and leave the batch only there).
+Execution is cooperative: ``pump()`` runs one scheduling round,
+``drain()`` pumps until idle.  Cooperative beats background threads here
+for the same reason the driver is a synchronous loop: every test and
+every caller sees a deterministic interleaving, and the host-sync chunk
+boundary is already the natural scheduling quantum (sessions join and
+leave the batch only there).
+
+``pump()`` comes in two shapes (``ServeConfig.pipeline``):
+
+- **pipelined** (the default): a double-buffered round in three phases —
+  a locked *begin* (deadline expiry, admission, one async chunk dispatch
+  per engine in rotated key order), an **unlocked** *settle* (device
+  chunks and host-engine compute finish while submit/poll/cancel stay
+  serviceable), and a locked *end* (retire the previous dispatches'
+  finishers from the engines' double buffers, refill the freed slots,
+  late-dispatch engines that sat out the begin).  The device rounds
+  back-to-back: retirement and admission overlap the in-flight chunk
+  instead of idling it.  Bit-identity with the synchronous pump (and
+  with solo ``driver.run``) is structural — a finished slot is frozen by
+  the in-scan mask, so *when* the host reads it cannot change *what* it
+  reads — and the equivalence suites assert it.
+- **sync** (``pipeline=False``): the classic host-synchronous round
+  (admit -> step -> retire under one lock hold) — the oracle shape, and
+  the baseline leg of ``bench.py --serve-pipeline``.
 
 The verbs are thread-safe: one internal lock serializes ``submit`` /
-``poll`` / ``result`` / ``cancel`` / ``stats`` against ``pump``, so a
-network front-end (``tpu_life.gateway``) can run ONE background pump
-thread that owns all device work while handler threads call the verbs
-concurrently — the engine's one-compile-per-CompileKey invariant never
-meets a second pumping thread.  ``begin_drain()`` is the shutdown hook:
-it closes admission (``submit`` raises :class:`Draining`) while in-flight
-sessions keep stepping to completion.
+``poll`` / ``result`` / ``cancel`` / ``stats`` against the pump's locked
+phases, so a network front-end (``tpu_life.gateway``) can run ONE
+background pump thread that owns all device work while handler threads
+call the verbs concurrently — the engine's one-compile-per-CompileKey
+invariant never meets a second pumping thread, and under the pipelined
+pump a verb is never blocked behind device compute (a separate pump
+mutex keeps a second pumping thread out of the phase machine without
+making it wait on device work either).  ``begin_drain()`` is the
+shutdown hook: it closes admission (``submit`` raises :class:`Draining`)
+while in-flight sessions keep stepping to completion; the pipelined
+drain retires every in-flight chunk before ``idle()`` reports true.
 
 Observability rides the unified obs layer (docs/OBSERVABILITY.md): the
 service generates one ``run_id``, every pump emits a ``MetricsRecorder``
@@ -65,6 +86,9 @@ class ServeConfig:
     chunk_steps: int = 16  # device steps per scheduling round
     max_queue: int = 64  # bounded admission queue (backpressure)
     backend: str = "jax"  # engine executor: jax | numpy | sharded | pallas | ...
+    # the pipelined (double-buffered) pump; False = the host-synchronous
+    # round, kept as the bit-identity oracle and the bench baseline
+    pipeline: bool = True
     default_timeout_s: float | None = None  # per-request deadline default
     metrics: bool = False  # record per-pump serve metrics
     metrics_file: str | None = None  # JSONL sink (implies metrics)
@@ -143,6 +167,18 @@ class SimulationService:
         self._h_latency = self.registry.histogram(
             "serve_completion_seconds", "submit-to-terminal-state latency"
         )
+        # the overlap instruments (ISSUE 7): how many chunks are in flight
+        # after dispatch (0 = host-synchronous), and how long engines sat
+        # with nothing in flight between a collect and the next dispatch —
+        # the seconds the pipelined pump exists to reclaim
+        self._g_pipeline_depth = self.registry.gauge(
+            "serve_pipeline_depth",
+            "device chunks in flight after the round's dispatch phase",
+        )
+        self._c_device_idle = self.registry.counter(
+            "serve_device_idle_seconds_total",
+            "wall seconds engines had no chunk in flight between dispatches",
+        )
         # engine compile counts by CompileKey bucket (rule:HxW:backend —
         # a closed set in any sane deployment; the cap bounds the rest)
         self._g_compiles = self.registry.gauge(
@@ -161,6 +197,8 @@ class SimulationService:
             self._c_rounds,
             self._h_queue_wait,
             self._h_latency,
+            self._g_pipeline_depth,
+            self._c_device_idle,
         ):
             fam.labels()
         # the service OWNS its tracer rather than claiming the process-
@@ -183,6 +221,10 @@ class SimulationService:
         # the thread-safe seam: every verb and the pump serialize on this
         # (reentrant: cancel/pump call observer hooks while holding it)
         self._lock = threading.RLock()
+        # pump exclusivity for the pipelined path: the round spans an
+        # unlocked settle window, so a second pumping thread must queue at
+        # the round boundary, never interleave phases
+        self._pump_mutex = threading.Lock()
         self._draining = False
 
     # -- the four verbs ----------------------------------------------------
@@ -418,22 +460,71 @@ class SimulationService:
     def pump(self) -> RoundStats:
         """One scheduling round; the only place device work happens.
 
-        Holds the service lock for the whole round: verbs block briefly
-        while the batch steps, which is exactly the seam a one-pump-thread
-        front-end needs (handlers never touch engines, the pump never sees
-        a half-enqueued session).
+        The pipelined pump (default) holds the service lock only for its
+        begin/end phases — the settle window, where device chunks and
+        host-engine compute actually finish, runs unlocked so ``submit``
+        and ``poll`` are never blocked behind device work.  The sync pump
+        holds the lock for the whole round (the classic seam: handlers
+        never touch engines, the pump never sees a half-enqueued session).
         """
-        with self._lock:
-            return self._pump_locked()
+        if not self.config.pipeline:
+            with self._lock:
+                return self._pump_locked()
+        with self._pump_mutex:
+            return self._pump_pipelined()
 
-    def _pump_locked(self) -> RoundStats:
+    def _keyer(self):
         cfg = self.config
 
         def keyer(s) -> CompileKey:
             return compile_key_for(s.rule, s.board, cfg.backend)
 
-        with obs.activate(self._tracer), obs.span("serve.round", round=self._rounds):
-            stats = self.scheduler.round(keyer)
+        return keyer
+
+    def _pump_locked(self) -> RoundStats:
+        with obs.activate(self._tracer), obs.span(
+            "serve.round", round=self._rounds, pump="sync"
+        ):
+            stats = self.scheduler.round(self._keyer())
+        self._finish_round(stats)
+        return stats
+
+    def _pump_pipelined(self) -> RoundStats:
+        keyer = self._keyer()
+        stats = RoundStats()
+        with self._lock:
+            with obs.activate(self._tracer), obs.span(
+                "serve.round", round=self._rounds, pump="pipelined"
+            ):
+                plan = self.scheduler.round_begin(keyer, stats)
+                rolled = {key for key, _, r in plan if r}
+                for _, engine, _ in plan:
+                    engine.busy = True
+        # -- the overlap window: no service lock held.  Device chunks (and
+        # host-engine compute) complete here while submit/poll/cancel stay
+        # serviceable; verb-triggered slot releases defer to the next begin.
+        try:
+            with obs.activate(self._tracer), obs.span(
+                "serve.collect", engines=len(plan)
+            ):
+                for _, engine, was_rolled in plan:
+                    if was_rolled:
+                        engine.settle()
+                    else:
+                        engine.collect_chunk()
+        finally:
+            with self._lock:
+                for _, engine, _ in plan:
+                    engine.busy = False
+        with self._lock:
+            with obs.activate(self._tracer):
+                self.scheduler.round_end(keyer, stats, rolled)
+            self._finish_round(stats)
+        return stats
+
+    def _finish_round(self, stats: RoundStats) -> None:
+        """The locked round tail shared by both pump shapes: counters,
+        gauges, the per-round metrics record, the live prom snapshot."""
         self._completed += stats.completed
         self._rounds += 1
         self._c_rounds.inc()
@@ -441,6 +532,11 @@ class SimulationService:
         self._occupancy_sum += occ
         self._g_queue_depth.set(stats.queue_depth)
         self._g_occupancy.set(occ)
+        depth = sum(1 for e in self.scheduler.engines.values() if e.inflight)
+        self._g_pipeline_depth.set(depth)
+        idle_delta = self.scheduler.idle_seconds_delta()
+        if idle_delta > 0:
+            self._c_device_idle.inc(idle_delta)
         for key, count in self.scheduler.compile_counts().items():
             self._g_compiles.labels(compile_key=_key_bucket(key)).set(count)
         elapsed = self.clock() - self._t0
@@ -448,6 +544,7 @@ class SimulationService:
         self.recorder.record(
             {
                 "kind": "serve",
+                "pump": "pipelined" if self.config.pipeline else "sync",
                 "elapsed_s": elapsed,
                 "queue_depth": stats.queue_depth,
                 "batch_occupancy": occ,
@@ -459,6 +556,10 @@ class SimulationService:
                 "sessions_per_sec": self._completed / elapsed
                 if elapsed > 0
                 else 0.0,
+                # the overlap stamps: in-flight chunks after this round's
+                # dispatches, and cumulative engine-idle wall seconds
+                "pipeline_depth": depth,
+                "device_idle_s": self._c_device_idle.value,
                 # live distribution snapshots (null until first sample):
                 # the per-round record carries its histograms' quantiles so
                 # a tailing consumer sees latency drift round by round
@@ -475,7 +576,6 @@ class SimulationService:
             # of only at close — a Prometheus file scraper watching a
             # long-lived serve sees queue depth move, not a stale zero
             self._write_prom()
-        return stats
 
     def _write_prom(self) -> None:
         path = self.config.prom_file
@@ -483,18 +583,33 @@ class SimulationService:
         with ckpt_atomic_publish(Path(path)) as tmp:
             tmp.write_text(self.registry.prom_text())
 
+    def flush(self) -> None:
+        """Wait out any still-in-flight device chunks without running a
+        new round.  The drain tail calls this after ``idle()`` turns true:
+        a chunk whose sessions were all cancelled mid-flight is otherwise
+        left executing with nobody to collect it."""
+        with self._lock:
+            self.scheduler.flush_inflight()
+
     def release_idle_engines(self) -> int:
         """Free engines (device batch + compiled program) whose keys have
         no resident sessions — for quiet periods of a long-lived service;
         returning traffic for a released key costs one recompile."""
         with self._lock:
+            # harvest the idle tail first: deltas on a deleted engine are
+            # gone, and the counter must stay monotonic across releases
+            idle_delta = self.scheduler.idle_seconds_delta()
+            if idle_delta > 0:
+                self._c_device_idle.inc(idle_delta)
             return self.scheduler.release_idle_engines()
 
     def close(self) -> None:
         """Flush telemetry and release held resources: the registry
         snapshot lands in the JSONL sink, the Prometheus snapshot in
-        ``prom_file``, the trace file is written, idle engines freed."""
+        ``prom_file``, the trace file is written, in-flight chunks
+        collected, idle engines freed."""
         with self._lock:
+            self.scheduler.flush_inflight()
             self.recorder.close()
             if self.config.prom_file:
                 self._write_prom()
@@ -517,6 +632,9 @@ class SimulationService:
         return {
             "run_id": self.run_id,
             "draining": self._draining,
+            "pump": "pipelined" if self.config.pipeline else "sync",
+            "pipeline_depth": self._g_pipeline_depth.value,
+            "device_idle_seconds": self._c_device_idle.value,
             "queue_wait_p50": self._h_queue_wait.quantile(0.5),
             "queue_wait_p95": self._h_queue_wait.quantile(0.95),
             "queue_wait_p99": self._h_queue_wait.quantile(0.99),
