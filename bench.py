@@ -576,6 +576,138 @@ def run_serve_pipeline_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def run_failover_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_failover capture (ISSUE 8): durability's price and its
+    payoff as one record — the same staggered, uneven-budget session mix
+    with the spill store OFF and then ON (rounds/s, so the overhead is a
+    measured fraction, not a guess), plus recovery-time-to-first-resumed-
+    round: abandon a spilling service mid-flight (the in-process SIGKILL
+    proxy), read the spills back, resume every session on a fresh
+    service, and time spill-read -> first completed round."""
+    actual, pinned = _pin_and_verify(args, platform)
+
+    import shutil
+    import tempfile
+
+    from tpu_life.models.patterns import random_board
+    from tpu_life.serve import ServeConfig, SimulationService
+    from tpu_life.serve.spill import read_spill_sessions
+
+    n = args.serve_size
+    sessions = args.serve_sessions
+    steps = args.serve_steps
+    boards = [random_board(n, n, seed=i) for i in range(min(sessions, 8))]
+    budgets = [
+        max(1, steps - (steps * i) // (2 * max(sessions - 1, 1)))
+        for i in range(sessions)
+    ]
+    spill_root = tempfile.mkdtemp(prefix="tpu-life-bench-spill-")
+    try:
+        legs = {}
+        for mode, spill_dir in (
+            ("spill_off", None),
+            ("spill_on", os.path.join(spill_root, "on")),
+        ):
+            svc = SimulationService(
+                ServeConfig(
+                    capacity=args.serve_capacity,
+                    chunk_steps=args.serve_chunk_steps,
+                    max_queue=max(sessions, 1),
+                    backend=args.backend,
+                    spill_dir=spill_dir,
+                    spill_every=args.failover_spill_every,
+                )
+            )
+            # warm the engine's compiled chunk before timing: the legs
+            # compare SPILL cost, so neither may eat the one-time XLA
+            # compile inside its timed window
+            svc.submit(boards[0], args.rule, 1)
+            svc.drain()
+            elapsed, stats = _drive_serve_mix(svc, boards, args.rule, budgets)
+            svc.close()
+            legs[mode] = {
+                "rounds": stats["rounds"],
+                "rounds_per_sec": stats["rounds"] / elapsed if elapsed > 0 else 0.0,
+                "sessions_per_sec": stats["done"] / elapsed if elapsed > 0 else 0.0,
+                "done": stats["done"],
+                "elapsed_s": elapsed,
+                "snapshot_seconds": stats.get("snapshot_seconds", 0.0),
+            }
+        off, on = legs["spill_off"], legs["spill_on"]
+
+        # recovery: spill a live mix, abandon it, resume on a fresh service
+        recover_dir = os.path.join(spill_root, "recover")
+        victim = SimulationService(
+            ServeConfig(
+                capacity=args.serve_capacity,
+                chunk_steps=args.serve_chunk_steps,
+                max_queue=max(sessions, 1),
+                backend=args.backend,
+                spill_dir=recover_dir,
+                spill_every=1,
+            )
+        )
+        # budgets that OUTLIVE the abandonment: the point is resuming
+        # in-flight work, so no victim may finish before the "kill"
+        victim_steps = max(steps, args.serve_chunk_steps * 8)
+        for i in range(min(sessions, args.serve_capacity)):
+            victim.submit(boards[i % len(boards)], args.rule, victim_steps)
+        for _ in range(3):
+            victim.pump()  # progress + spills, then "SIGKILL" (abandon)
+        t0 = time.monotonic()
+        records, _corrupt = read_spill_sessions(recover_dir)
+        survivor = SimulationService(
+            ServeConfig(
+                capacity=args.serve_capacity,
+                chunk_steps=args.serve_chunk_steps,
+                max_queue=max(sessions, 1),
+                backend=args.backend,
+            )
+        )
+        for rec in records:
+            survivor.submit(
+                rec.board,
+                rec.rule,
+                rec.remaining,
+                seed=rec.seed,
+                temperature=rec.temperature,
+                start_step=rec.step,
+            )
+        survivor.pump()  # the first resumed round
+        recovery_s = time.monotonic() - t0
+        survivor.drain()
+        survivor.close()
+        victim.close()
+    finally:
+        shutil.rmtree(spill_root, ignore_errors=True)
+    return {
+        "metric": "serve_failover_rounds_per_sec",
+        "value": on["rounds_per_sec"],
+        "unit": "rounds/s",
+        "rule": args.rule,
+        "platform": platform,
+        "platform_actual": actual,
+        "platform_pinned": pinned,
+        "backend": args.backend,
+        "size": n,
+        "steps": steps,
+        "sessions": sessions,
+        "batch_capacity": args.serve_capacity,
+        "chunk_steps": args.serve_chunk_steps,
+        "spill_every": args.failover_spill_every,
+        "spill_off": off,
+        "spill_on": on,
+        "spill_overhead_frac": (
+            1.0 - on["rounds_per_sec"] / off["rounds_per_sec"]
+            if off["rounds_per_sec"] > 0
+            else 0.0
+        ),
+        "resumed_sessions": len(records),
+        "recovery_s": recovery_s,
+        "degraded": degraded,
+    }
+
+
 def run_mc_bench(args, platform: str, degraded: bool) -> dict:
     """The BENCH_mc capture: Metropolis checkerboard sweep throughput
     (sweeps/s and spin-updates/s) through the stochastic tier
@@ -819,6 +951,14 @@ def main() -> None:
                    "under both the host-synchronous and the pipelined "
                    "pump (emits serve_pipeline_rounds_per_sec with "
                    "sync/pipelined legs and device-idle fractions)")
+    # the BENCH_failover capture (ISSUE 8): spill-store overhead (rounds/s
+    # with the spill on vs off) + recovery-time-to-first-resumed-round
+    p.add_argument("--failover", action="store_true",
+                   help="durability bench: the serve session mix with the "
+                   "spill store off vs on, plus spill-read -> resume "
+                   "recovery timing (emits serve_failover_rounds_per_sec)")
+    p.add_argument("--failover-spill-every", type=int, default=2,
+                   help="rounds between spill passes in the spill-on leg")
     # the BENCH_mc capture: Metropolis sweep throughput through the
     # stochastic tier (sweeps/s, spin-updates/s; docs/STOCHASTIC.md)
     p.add_argument("--mc", action="store_true",
@@ -914,7 +1054,10 @@ def main() -> None:
         args.steps = 1000 if on_accel else DEGRADED_STEPS
     if args.base_steps is None:
         args.base_steps = 100 if on_accel else DEGRADED_BASE_STEPS
-    if not (args.serve or args.serve_pipeline) and args.steps <= args.base_steps:
+    if (
+        not (args.serve or args.serve_pipeline or args.failover)
+        and args.steps <= args.base_steps
+    ):
         p.error("--steps must be greater than --base-steps (delta timing)")
     # serve workload knobs follow the same accel/degraded split: the CPU
     # fallback must finish in seconds while still filling the batch
@@ -940,7 +1083,7 @@ def main() -> None:
     # The serve bench defaults to the vmapped jax engine on every platform
     # (the batched path is the thing being measured).
     if args.backend is None:
-        if args.serve or args.serve_pipeline or args.mc:
+        if args.serve or args.serve_pipeline or args.failover or args.mc:
             # the vmapped/fused single-device XLA path is the thing being
             # measured on both service-shaped benches
             args.backend = "jax"
@@ -974,6 +1117,8 @@ def main() -> None:
     try:
         if args.serve_pipeline:
             result = run_serve_pipeline_bench(args, platform, degraded)
+        elif args.failover:
+            result = run_failover_bench(args, platform, degraded)
         elif args.serve:
             result = run_serve_bench(args, platform, degraded)
         elif args.mc:
@@ -1005,10 +1150,16 @@ def main() -> None:
                     cmd += [flag, str(value)]
             if args.no_bitpack:
                 cmd.append("--no-bitpack")
-            if args.serve or args.serve_pipeline:
+            if args.serve or args.serve_pipeline or args.failover:
                 # the retry must measure the same MODE, not fall back to
                 # the kernel bench and mislabel the record
-                cmd.append("--serve-pipeline" if args.serve_pipeline else "--serve")
+                if args.failover:
+                    cmd += ["--failover", "--failover-spill-every",
+                            str(args.failover_spill_every)]
+                else:
+                    cmd.append(
+                        "--serve-pipeline" if args.serve_pipeline else "--serve"
+                    )
                 cmd += ["--serve-capacity", str(args.serve_capacity)]
                 cmd += ["--serve-chunk-steps", str(args.serve_chunk_steps)]
             if args.mc:
@@ -1032,6 +1183,9 @@ def main() -> None:
         if args.serve_pipeline:
             metric, unit = "serve_pipeline_rounds_per_sec", "rounds/s"
             size, steps = args.serve_size, args.serve_steps
+        elif args.failover:
+            metric, unit = "serve_failover_rounds_per_sec", "rounds/s"
+            size, steps = args.serve_size, args.serve_steps
         elif args.serve:
             metric, unit = "serve_sessions_per_sec", "sessions/s"
             size, steps = args.serve_size, args.serve_steps
@@ -1053,7 +1207,7 @@ def main() -> None:
             "degraded_reason": "error",
             "error": repr(e)[:500],
         }
-        if args.serve or args.serve_pipeline:
+        if args.serve or args.serve_pipeline or args.failover:
             failure["sessions"] = args.serve_sessions
             failure["batch_capacity"] = args.serve_capacity
         elif args.mc:

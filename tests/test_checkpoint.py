@@ -110,7 +110,41 @@ def test_snapshot_dir_has_no_leftover_tmp(tmp_path):
     b = random_board(12, 12, seed=2)
     save_snapshot(tmp_path / "snaps", 3, b, rule="B3/S23")
     names = sorted(f.name for f in (tmp_path / "snaps").iterdir())
-    assert names == ["board_000000003.json", "board_000000003.txt"]
+    assert names == [
+        "board_000000003.crc",
+        "board_000000003.json",
+        "board_000000003.txt",
+    ]
+
+
+def test_bit_flip_fails_intact_check(tmp_path):
+    """The CRC satellite: size-preserving corruption (bit rot, a torn
+    multi-writer publish) must fail ``snapshot_intact`` — the size check
+    alone cannot see it."""
+    b = random_board(6, 7, seed=4)
+    p = save_snapshot(tmp_path / "snaps", 5, b, rule="B3/S23")
+    assert snapshot_intact(p, 6, 7)
+    raw = bytearray(p.read_bytes())
+    raw[2] ^= 0x01  # same length, different bytes
+    p.write_bytes(raw)
+    assert not snapshot_intact(p, 6, 7)
+    # a snapshot with NO crc sidecar (older writer, streamed collective
+    # path) still validates by size alone — backward compatible
+    from tpu_life.runtime.checkpoint import crc_path
+
+    crc_path(p).unlink()
+    assert snapshot_intact(p, 6, 7)
+
+
+def test_prune_removes_crc_sidecars(tmp_path):
+    from tpu_life.runtime.checkpoint import crc_path, prune_snapshots, snapshot_path
+
+    b = random_board(4, 4, seed=5)
+    for step in (2, 4):
+        save_snapshot(tmp_path / "snaps", step, b, rule="B3/S23")
+    prune_snapshots(tmp_path / "snaps", 1, [2, 4])
+    assert not crc_path(snapshot_path(tmp_path / "snaps", 2)).exists()
+    assert crc_path(snapshot_path(tmp_path / "snaps", 4)).exists()
 
 
 def test_snapshot_retention(tmp_path):

@@ -1,0 +1,666 @@
+"""Durable sessions: spill-store round-trips, resume bit-identity, and
+the migration state machine on fakes (ISSUE 8).
+
+The spine: a session's spilled (board, absolute step, manifest) must
+resume — on another service instance, possibly another process — and
+finish byte-identical to the uninterrupted oracle, for deterministic
+rules (pure function of the board) and the stochastic tier (counter-
+based key schedule + ``start_step``).  The fleet-level state machine
+(MIGRATING 409s, re-pins, 410 reasons, double death) runs here on
+injected fakes; tests/test_failover_e2e.py kills real subprocesses.
+"""
+
+import base64
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_life import obs
+from tpu_life.fleet.migrate import Migrator, resume_request, worker_spill_dir
+from tpu_life.fleet.registry import Pin, SessionRegistry, fleet_sid
+from tpu_life.fleet.router import WorkerUnreachable
+from tpu_life.gateway import protocol
+from tpu_life.gateway.errors import ApiError
+from tpu_life.io.codec import encode_board
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.serve import ServeConfig, SimulationService
+from tpu_life.serve.spill import SpillRecord, SpillStore, read_spill_sessions
+
+
+# -- spill store -------------------------------------------------------------
+def _save(store, sid, board, step, **kw):
+    defaults = dict(
+        rule="conway",
+        steps_total=100,
+        seed=None,
+        temperature=None,
+        timeout_s=None,
+    )
+    defaults.update(kw)
+    return store.save(sid, board, step, **defaults)
+
+
+def test_spill_round_trip_and_retention(tmp_path):
+    store = SpillStore(tmp_path)
+    board = random_board(12, 10, seed=4)
+    kw = dict(seed=3, temperature=2.2, timeout_s=4.5, rule="ising",
+              steps_total=40)
+    assert _save(store, "s000001", board, 8, **kw)
+    # same step again: idempotent no-op, not churn
+    assert not _save(store, "s000001", board, 8, **kw)
+    for step in (16, 24):
+        assert _save(
+            store, "s000001", run_np(board, get_rule("conway"), 1), step, **kw
+        )
+    # retention: newest 2 snapshots only
+    snaps = sorted((tmp_path / "s000001").glob("board_*.txt"))
+    assert [int(p.stem.split("_")[1]) for p in snaps] == [16, 24]
+    assert store.spilled_count() == 1
+
+    records, corrupt = read_spill_sessions(tmp_path)
+    assert corrupt == []
+    (rec,) = records
+    assert (rec.sid, rec.step, rec.steps_total) == ("s000001", 24, 40)
+    assert rec.rule == "ising" and rec.seed == 3 and rec.temperature == 2.2
+    assert rec.timeout_s == 4.5 and rec.remaining == 16
+
+    store.delete("s000001")
+    assert not (tmp_path / "s000001").exists()
+    assert read_spill_sessions(tmp_path) == ([], [])
+
+
+def test_bit_flipped_spill_demotes_to_previous(tmp_path):
+    """The CRC satellite: a corrupt-but-right-sized newest snapshot must
+    demote to the intact predecessor, not resume garbage."""
+    store = SpillStore(tmp_path)
+    b1 = random_board(10, 10, seed=1)
+    b2 = run_np(b1, get_rule("conway"), 4)
+    _save(store, "s000000", b1, 4)
+    _save(store, "s000000", b2, 8)
+    newest = tmp_path / "s000000" / "board_000000008.txt"
+    raw = bytearray(newest.read_bytes())
+    raw[3] ^= 0x01  # same size, different bytes
+    newest.write_bytes(raw)
+    records, corrupt = read_spill_sessions(tmp_path)
+    assert corrupt == []
+    (rec,) = records
+    assert rec.step == 4
+    np.testing.assert_array_equal(rec.board, b1)
+
+
+def test_all_snapshots_corrupt_reports_spill_corrupt(tmp_path):
+    store = SpillStore(tmp_path)
+    _save(store, "s000002", random_board(8, 8, seed=2), 4)
+    f = tmp_path / "s000002" / "board_000000004.txt"
+    raw = bytearray(f.read_bytes())
+    raw[0] ^= 0x01
+    f.write_bytes(raw)
+    records, corrupt = read_spill_sessions(tmp_path)
+    assert records == [] and corrupt == ["s000002"]
+
+
+def test_unreadable_manifest_reports_corrupt(tmp_path):
+    store = SpillStore(tmp_path)
+    _save(store, "s000003", random_board(8, 8, seed=3), 4)
+    (tmp_path / "s000003" / "manifest.json").write_text("{not json")
+    records, corrupt = read_spill_sessions(tmp_path)
+    assert records == [] and corrupt == ["s000003"]
+
+
+# -- service-level spill + resume bit-identity -------------------------------
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_spill_resume_deterministic_bit_identical(tmp_path, pipeline):
+    board = random_board(24, 20, seed=9, density=0.4)
+    steps = 40
+    oracle = run_np(board, get_rule("conway"), steps)
+    a = SimulationService(
+        ServeConfig(
+            capacity=2, chunk_steps=4, backend="numpy",
+            pipeline=pipeline, spill_dir=str(tmp_path / "spill"), spill_every=1,
+        )
+    )
+    a.submit(board, "conway", steps)
+    for _ in range(5):  # abandon mid-flight (the simulated SIGKILL)
+        a.pump()
+    records, corrupt = read_spill_sessions(tmp_path / "spill")
+    assert corrupt == [] and len(records) == 1
+    rec = records[0]
+    assert 0 < rec.step < steps and rec.steps_total == steps
+    b = SimulationService(ServeConfig(capacity=2, chunk_steps=4, backend="numpy"))
+    sid = b.submit(
+        rec.board, rec.rule, rec.remaining,
+        seed=rec.seed, temperature=rec.temperature, start_step=rec.step,
+    )
+    b.drain()
+    out = b.store.result(sid)
+    assert out.tobytes() == oracle.tobytes()
+    # views report ABSOLUTE progress through the resume
+    view = b.poll(sid)
+    assert (view.steps, view.steps_done) == (steps, steps)
+
+
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_spill_resume_ising_bit_identical(tmp_path, pipeline):
+    """Stochastic resume: the counter-based key schedule + start_step
+    re-enters the exact stream — resume-then-finish == uninterrupted."""
+    from tpu_life import mc
+    from tpu_life.mc.engine import MCHostRunner
+
+    board = mc.seeded_board(16, 16, 0.5, states=2, seed=5)
+    steps, seed, temp = 30, 11, 2.3
+    oracle = MCHostRunner(board, get_rule("ising"), seed=seed, temperature=temp)
+    oracle.advance(steps)
+    a = SimulationService(
+        ServeConfig(
+            capacity=2, chunk_steps=4, backend="jax",
+            pipeline=pipeline, spill_dir=str(tmp_path / "spill"), spill_every=2,
+        )
+    )
+    a.submit(board, "ising", steps, seed=seed, temperature=temp)
+    for _ in range(4):
+        a.pump()
+    records, _ = read_spill_sessions(tmp_path / "spill")
+    rec = records[0]
+    assert 0 < rec.step < steps
+    b = SimulationService(ServeConfig(capacity=2, chunk_steps=4, backend="jax"))
+    sid = b.submit(
+        rec.board, rec.rule, rec.remaining,
+        seed=rec.seed, temperature=rec.temperature, start_step=rec.step,
+    )
+    b.drain()
+    assert b.store.result(sid).tobytes() == oracle.fetch().tobytes()
+
+
+def test_terminal_sessions_drop_their_spills(tmp_path):
+    svc = SimulationService(
+        ServeConfig(
+            capacity=2, chunk_steps=2, backend="numpy",
+            spill_dir=str(tmp_path / "spill"), spill_every=1,
+        )
+    )
+    s_done = svc.submit(random_board(8, 8, seed=1), "conway", 4)
+    s_cancel = svc.submit(random_board(8, 8, seed=2), "conway", 100)
+    svc.pump()
+    assert (tmp_path / "spill" / s_cancel).exists()
+    svc.cancel(s_cancel)
+    assert not (tmp_path / "spill" / s_cancel).exists()
+    svc.drain()
+    svc.flush()
+    assert not (tmp_path / "spill" / s_done).exists()
+    assert svc.stats()["spilled_sessions"] == 0
+    assert svc.stats()["snapshot_seconds"] > 0.0
+
+
+def test_queued_sessions_spill_too(tmp_path):
+    """Capacity 1, two sessions: the queued one must be resumable as
+    well — zero accepted work lost, not zero running work."""
+    svc = SimulationService(
+        ServeConfig(
+            capacity=1, chunk_steps=2, backend="numpy",
+            spill_dir=str(tmp_path / "spill"), spill_every=1,
+        )
+    )
+    svc.submit(random_board(8, 8, seed=1), "conway", 50)
+    svc.submit(random_board(8, 8, seed=2), "conway", 50)
+    svc.pump()
+    records, _ = read_spill_sessions(tmp_path / "spill")
+    assert len(records) == 2
+    queued = next(r for r in records if r.step == 0)
+    assert queued.remaining == 50
+
+
+# -- the resume wire format --------------------------------------------------
+def test_parse_submit_resume_round_trip():
+    board = random_board(9, 7, seed=3)
+    spec = protocol.parse_submit(
+        {
+            "rule": "conway",
+            "steps": 5,
+            "start_step": 12,
+            "resume_b64": base64.b64encode(encode_board(board)).decode(),
+            "height": 9,
+            "width": 7,
+        }
+    )
+    np.testing.assert_array_equal(spec.board, board)
+    assert spec.start_step == 12 and spec.steps == 5
+    assert spec.board.tobytes() == board.tobytes()
+
+
+def test_resume_request_parses_back_identically():
+    board = random_board(6, 6, seed=8)
+    rec = SpillRecord(
+        sid="s000004", rule="ising", board=board, step=9, steps_total=20,
+        seed=4, temperature=2.1, timeout_s=3.0, height=6, width=6,
+    )
+    spec = protocol.parse_submit(resume_request(rec))
+    assert spec.board.tobytes() == board.tobytes()
+    assert spec.start_step == 9 and spec.steps == 11
+    assert spec.seed == 4 and spec.temperature == 2.1 and spec.timeout_s == 3.0
+
+
+@pytest.mark.parametrize(
+    "payload,code",
+    [
+        ({"steps": 1, "resume_b64": "!!", "height": 4, "width": 4},
+         "invalid_request"),
+        ({"steps": 1, "resume_b64": "AAAA", "width": 4}, "invalid_request"),
+        ({"steps": 1, "resume_b64": base64.b64encode(b"xx").decode(),
+          "height": 4, "width": 4}, "invalid_board"),
+        ({"steps": 1, "start_step": -1, "size": 4}, "invalid_request"),
+    ],
+)
+def test_resume_malformations_are_typed_400s(payload, code):
+    with pytest.raises(ApiError) as exc:
+        protocol.parse_submit(payload)
+    assert exc.value.status == 400 and exc.value.code == code
+
+
+def test_resume_board_states_validated():
+    board = np.full((4, 4), 1, np.int8)
+    board[0, 0] = 3  # conway has 2 states
+    with pytest.raises(ApiError) as exc:
+        protocol.parse_submit(
+            {
+                "steps": 1,
+                "resume_b64": base64.b64encode(encode_board(board)).decode(),
+                "height": 4,
+                "width": 4,
+            }
+        )
+    assert exc.value.code == "invalid_board"
+
+
+def test_service_rejects_negative_start_step():
+    svc = SimulationService(ServeConfig(capacity=1, backend="numpy"))
+    with pytest.raises(ValueError, match="start_step"):
+        svc.submit(np.zeros((4, 4), np.int8), "conway", 1, start_step=-3)
+
+
+# -- obs: the spill stamps ride records, stats, and the merge path -----------
+def test_spill_metrics_in_records_stats_and_merge(tmp_path):
+    from tpu_life.obs import stats as obs_stats
+
+    sinks = []
+    for i in range(2):
+        sink = tmp_path / f"w{i}.jsonl"
+        svc = SimulationService(
+            ServeConfig(
+                capacity=2, chunk_steps=2, backend="numpy",
+                metrics=True, metrics_file=str(sink),
+                spill_dir=str(tmp_path / f"spill{i}"), spill_every=1,
+            )
+        )
+        svc.submit(random_board(8, 8, seed=i), "conway", 8)
+        svc.drain()
+        svc.close()
+        sinks.append(sink)
+        # prometheus families are present on the registry
+        prom = svc.registry.prom_text()
+        assert "serve_snapshot_seconds" in prom
+        assert "serve_spilled_sessions" in prom
+
+    records = []
+    for sink in sinks:
+        records.extend(obs_stats.load_records(str(sink)))
+    rounds = [r for r in records if r.get("kind") == "serve"]
+    assert all("snapshot_s" in r and "spilled_sessions" in r for r in rounds)
+    merged = obs_stats.summarize(records)
+    # two run_ids -> the fleet merge path: spill seconds SUM, peak MAXes
+    assert merged["serve"]["runs_merged"] == 2
+    assert merged["serve"]["snapshot_seconds"] > 0.0
+    assert merged["serve"]["spilled_sessions_max"] >= 1
+    per_run = [r["serve"]["snapshot_seconds"] for r in merged["runs"].values()]
+    assert abs(sum(per_run) - merged["serve"]["snapshot_seconds"]) < 1e-9
+    # the human table renders the durability line
+    assert "snapshot_s=" in obs_stats.render(merged)
+
+
+# -- the migration state machine on fakes ------------------------------------
+class FakeWorker:
+    def __init__(self, name, generation=1, alive=True):
+        self.name = name
+        self.generation = generation
+        self.alive = alive
+
+
+class FakeSupervisor:
+    def __init__(self, workers):
+        self.workers = workers
+
+    def ready_workers(self):
+        return [w for w in self.workers if w.alive]
+
+
+class PassBalancer:
+    def candidates(self, workers):
+        return list(workers)
+
+    def invalidate(self, worker):
+        pass
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _spill_one(root, worker, gen, sid, board, step, steps_total, **kw):
+    store = SpillStore(worker_spill_dir(root, worker, gen))
+    defaults = dict(rule="conway", seed=None, temperature=None, timeout_s=None)
+    defaults.update(kw)
+    store.save(sid, board, step, steps_total=steps_total, **defaults)
+
+
+def _make_migrator(tmp_path, forward, workers, sessions=None, clock=None,
+                   timeout_s=5.0):
+    clock = clock or FakeClock()
+    mig = Migrator(
+        spill_root=str(tmp_path),
+        supervisor=FakeSupervisor(workers),
+        sessions=sessions if sessions is not None else SessionRegistry(),
+        registry=obs.MetricsRegistry(),
+        balancer=PassBalancer(),
+        forward=forward,
+        clock=clock,
+        sleep=lambda s: setattr(clock, "t", clock.t + s),
+        timeout_s=timeout_s,
+    )
+    return mig
+
+
+def _run_sync(mig, name, gen):
+    """Drive one migration run on the caller's thread (determinism)."""
+    mig._active.add((name, gen))
+    mig._run(name, gen)
+
+
+def test_migration_repins_original_fsid_on_survivor(tmp_path):
+    board = random_board(8, 8, seed=1)
+    _spill_one(tmp_path, "w0", 1, "s000005", board, 6, 20)
+    sessions = SessionRegistry()
+    fsid = sessions.pin("w0", 1, "s000005")
+    survivor = FakeWorker("w1", generation=3)
+    submitted = []
+
+    def forward(worker, method, path, *, body=None, api_key=None):
+        submitted.append((worker.name, json.loads(body)))
+        return 201, None, {"session": "s000042"}
+
+    mig = _make_migrator(tmp_path, forward, [survivor], sessions)
+    # while the run is pending/active: MIGRATING, never lost
+    pin = sessions.resolve(fsid)
+    assert mig.status(fsid, pin) == ("migrating",)
+    _run_sync(mig, "w0", 1)
+    # re-pinned: the ORIGINAL fleet sid now resolves to the survivor
+    new_pin = sessions.resolve(fsid)
+    assert new_pin == Pin(worker="w1", generation=3, sid="s000042")
+    (worker_name, body) = submitted[0]
+    assert worker_name == "w1"
+    assert body["start_step"] == 6 and body["steps"] == 14
+    spec = protocol.parse_submit(body)
+    assert spec.board.tobytes() == board.tobytes()
+    # the victim's spill dir is gone (orphan cleanup)
+    assert not worker_spill_dir(tmp_path, "w0", 1).exists()
+
+
+def test_never_spilled_session_answers_never_snapshotted(tmp_path):
+    sessions = SessionRegistry()
+    fsid = sessions.pin("w0", 1, "s000000")  # pinned but never spilled
+    mig = _make_migrator(tmp_path, lambda *a, **k: (201, None, {}), [])
+    _run_sync(mig, "w0", 1)
+    assert mig.status(fsid, sessions.resolve(fsid)) == (
+        "lost", "never_snapshotted",
+    )
+
+
+def test_corrupt_spill_answers_spill_corrupt(tmp_path):
+    _spill_one(tmp_path, "w0", 1, "s000001", random_board(8, 8, seed=2), 4, 20)
+    f = worker_spill_dir(tmp_path, "w0", 1) / "s000001" / "board_000000004.txt"
+    raw = bytearray(f.read_bytes())
+    raw[1] ^= 0x01
+    f.write_bytes(raw)
+    mig = _make_migrator(tmp_path, lambda *a, **k: (201, None, {}), [])
+    _run_sync(mig, "w0", 1)
+    fsid = fleet_sid("w0", 1, "s000001")
+    assert mig.status(fsid, Pin("w0", 1, "s000001")) == ("lost", "spill_corrupt")
+
+
+def test_refusals_retry_until_capacity_frees(tmp_path):
+    _spill_one(tmp_path, "w0", 1, "s000002", random_board(8, 8, seed=3), 2, 10)
+    survivor = FakeWorker("w1")
+    calls = []
+
+    def forward(worker, method, path, *, body=None, api_key=None):
+        calls.append(1)
+        if len(calls) < 3:
+            return 503, 0.1, {"error": {"code": "queue_full", "message": "full"}}
+        return 201, None, {"session": "s000000"}
+
+    sessions = SessionRegistry()
+    fsid = sessions.pin("w0", 1, "s000002")
+    mig = _make_migrator(tmp_path, forward, [survivor], sessions)
+    _run_sync(mig, "w0", 1)
+    assert len(calls) == 3
+    assert sessions.resolve(fsid).worker == "w1"
+
+
+def test_rate_limited_resume_retries_until_bucket_refills(tmp_path):
+    """429 rejects BEFORE the session exists (token bucket), so a
+    rate-limited resume must retry like a refusal — recording it
+    migration_failed would terminally lose a recoverable session."""
+    _spill_one(tmp_path, "w0", 1, "s000007", random_board(8, 8, seed=9), 2, 10)
+    survivor = FakeWorker("w1")
+    calls = []
+
+    def forward(worker, method, path, *, body=None, api_key=None):
+        calls.append(1)
+        if len(calls) < 3:
+            return 429, 0.1, {"error": {"code": "rate_limited", "message": "slow"}}
+        return 201, None, {"session": "s000000"}
+
+    sessions = SessionRegistry()
+    fsid = sessions.pin("w0", 1, "s000007")
+    mig = _make_migrator(tmp_path, forward, [survivor], sessions)
+    _run_sync(mig, "w0", 1)
+    assert len(calls) == 3
+    assert sessions.resolve(fsid).worker == "w1"
+
+
+def test_crash_on_one_record_does_not_abort_the_rest(tmp_path):
+    """Per-record isolation: an unexpected exception resuming session A
+    must record A migration_failed and still migrate session B — never
+    mislabel B never_snapshotted or destroy its unread spill."""
+    _spill_one(tmp_path, "w0", 1, "s000001", random_board(8, 8, seed=1), 2, 10)
+    _spill_one(tmp_path, "w0", 1, "s000002", random_board(8, 8, seed=2), 2, 10)
+    survivor = FakeWorker("w1")
+    sessions = SessionRegistry()
+    fa = sessions.pin("w0", 1, "s000001")
+    fb = sessions.pin("w0", 1, "s000002")
+
+    calls = []
+
+    def forward(worker, method, path, *, body=None, api_key=None):
+        calls.append(1)
+        if len(calls) == 1:  # records migrate in sorted sid order: A first
+            raise RuntimeError("unexpected transport explosion")
+        return 201, None, {"session": "s-new"}
+
+    mig = _make_migrator(tmp_path, forward, [survivor], sessions)
+    _run_sync(mig, "w0", 1)
+    outcomes = {
+        f: mig.status(f, Pin("w0", 1, s))
+        for f, s in ((fa, "s000001"), (fb, "s000002"))
+    }
+    assert outcomes[fa] == ("lost", "migration_failed")
+    # B migrated despite A's crash
+    assert sessions.resolve(fb).worker == "w1"
+
+
+def test_midexchange_ambiguity_fails_without_duplicate(tmp_path):
+    _spill_one(tmp_path, "w0", 1, "s000003", random_board(8, 8, seed=4), 2, 10)
+    survivor = FakeWorker("w1")
+    calls = []
+
+    def forward(worker, method, path, *, body=None, api_key=None):
+        calls.append(1)
+        raise WorkerUnreachable(worker, False, TimeoutError("mid-exchange"))
+
+    mig = _make_migrator(tmp_path, forward, [survivor])
+    _run_sync(mig, "w0", 1)
+    assert len(calls) == 1  # never re-submitted: a duplicate could exist
+    fsid = fleet_sid("w0", 1, "s000003")
+    assert mig.status(fsid, Pin("w0", 1, "s000003")) == (
+        "lost", "migration_failed",
+    )
+
+
+def test_migration_times_out_when_no_worker_ready(tmp_path):
+    _spill_one(tmp_path, "w0", 1, "s000004", random_board(8, 8, seed=5), 2, 10)
+    mig = _make_migrator(tmp_path, lambda *a, **k: (201, None, {}), [],
+                         timeout_s=2.0)
+    _run_sync(mig, "w0", 1)
+    fsid = fleet_sid("w0", 1, "s000004")
+    assert mig.status(fsid, Pin("w0", 1, "s000004"))[1] == "migration_failed"
+
+
+def test_double_death_repins_the_original_sid(tmp_path):
+    """The survivor dies too: its re-spilled session must migrate again
+    under the fleet sid THE CLIENT HOLDS (the alias map), not a fresh
+    sid derived from the survivor's own numbering."""
+    board = random_board(8, 8, seed=6)
+    _spill_one(tmp_path, "w0", 1, "s000000", board, 4, 20)
+    w1 = FakeWorker("w1", generation=1)
+    w2 = FakeWorker("w2", generation=1)
+    sessions = SessionRegistry()
+    fsid = sessions.pin("w0", 1, "s000000")
+    hops = []
+
+    def forward(worker, method, path, *, body=None, api_key=None):
+        hops.append(worker.name)
+        return 201, None, {"session": f"s-on-{worker.name}"}
+
+    mig = _make_migrator(tmp_path, forward, [w1, w2], sessions)
+    mig.supervisor.workers = [w1]  # first hop: only w1 ready
+    _run_sync(mig, "w0", 1)
+    assert sessions.resolve(fsid).worker == "w1"
+    # w1 now dies having re-spilled the adopted session under ITS sid
+    _spill_one(tmp_path, "w1", 1, "s-on-w1", board, 8, 20)
+    w1.alive = False
+    mig.supervisor.workers = [w2]
+    _run_sync(mig, "w1", 1)
+    pin = sessions.resolve(fsid)
+    assert pin == Pin(worker="w2", generation=1, sid="s-on-w2")
+    assert hops == ["w1", "w2"]
+
+
+def test_worker_exit_hook_is_idempotent(tmp_path):
+    mig = _make_migrator(tmp_path, lambda *a, **k: (201, None, {}), [])
+    mig.worker_exit("w0", 1)
+    mig.worker_exit("w0", 1)  # duplicate death reports must not double-run
+    assert mig.wait_idle(timeout=10)
+    assert len([t for t in mig._threads]) == 1
+    assert ("w0", 1) in mig._completed
+
+
+# -- router resolution semantics --------------------------------------------
+def _router_fixture(tmp_path, spill=True):
+    """A real Router (ephemeral port, never started) over a fake-spawned
+    supervisor, with a migrator stub wired like the Fleet does."""
+    from tpu_life.fleet.router import Router
+    from tpu_life.fleet.supervisor import FleetConfig, Supervisor
+
+    registry = obs.MetricsRegistry()
+    cfg = FleetConfig(
+        workers=1,
+        log_dir=str(tmp_path / "logs"),
+        spill_dir=str(tmp_path / "spill") if spill else None,
+    )
+    procs = {}
+
+    def spawn(w):
+        class P:
+            def poll(self):
+                return procs.get(w.name)
+
+        w.proc = P()
+        w.url = "http://fake"
+
+    sup = Supervisor(cfg, registry, spawn=spawn, probe=lambda w: "ready")
+    sessions = SessionRegistry()
+    router = Router(cfg, sup, sessions, registry)
+    if spill:
+        mig = Migrator(
+            spill_root=cfg.spill_dir,
+            supervisor=sup,
+            sessions=sessions,
+            registry=registry,
+            balancer=PassBalancer(),
+            forward=lambda *a, **k: (201, None, {}),
+        )
+        router.migrator = mig
+    # spawn w0 at generation 1, alive
+    with sup._lock:
+        sup._spawn_worker(sup.workers[0], first=True)
+    sup.workers[0].state = __import__(
+        "tpu_life.fleet.supervisor", fromlist=["WorkerState"]
+    ).WorkerState.READY
+    return router, sup, sessions, procs
+
+
+def test_router_answers_409_migrating_while_rescue_pending(tmp_path):
+    router, sup, sessions, procs = _router_fixture(tmp_path)
+    fsid = sessions.pin("w0", 1, "s000000")
+    procs["w0"] = -9  # SIGKILLed: alive flips false before any tick
+    with pytest.raises(ApiError) as exc:
+        router.resolve(fsid)
+    assert exc.value.status == 409 and exc.value.code == "migrating"
+    assert exc.value.retry_after is not None
+    # a synthetic poll view keeps the unmodified wait() loop alive
+    view = router.migrating_view(fsid)
+    assert view["state"] == "running" and view["finished"] is False
+    router.close()
+
+
+def test_router_410_reason_after_completed_migration(tmp_path):
+    router, sup, sessions, procs = _router_fixture(tmp_path)
+    fsid = sessions.pin("w0", 1, "s000000")
+    procs["w0"] = -9
+    router.migrator._completed.add(("w0", 1))  # run found nothing for it
+    with pytest.raises(ApiError) as exc:
+        router.resolve(fsid)
+    assert exc.value.status == 410
+    assert exc.value.body()["error"]["reason"] == "never_snapshotted"
+    router.close()
+
+
+def test_router_unknown_past_generation_settles_to_410(tmp_path):
+    """A sid pinned into a generation the migrator never saw — a
+    previous fleet process, or a forged id — must settle to a terminal
+    410, not poll as 'migrating' forever (the no-record fallback only
+    covers a death of the CURRENT generation the tick hasn't processed)."""
+    router, sup, sessions, procs = _router_fixture(tmp_path)
+    stale = sessions.pin("w0", 7, "s000000")  # w0 is alive at generation 1
+    with pytest.raises(ApiError) as exc:
+        router.resolve(stale)
+    assert exc.value.status == 410
+    assert exc.value.body()["error"]["reason"] == "never_snapshotted"
+    router.close()
+
+
+def test_router_410_spill_disabled_without_migrator(tmp_path):
+    router, sup, sessions, procs = _router_fixture(tmp_path, spill=False)
+    fsid = sessions.pin("w0", 1, "s000000")
+    procs["w0"] = -9
+    with pytest.raises(ApiError) as exc:
+        router.resolve(fsid)
+    assert exc.value.status == 410
+    assert exc.value.body()["error"]["reason"] == "spill_disabled"
+    router.close()

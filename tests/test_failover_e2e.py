@@ -1,0 +1,125 @@
+"""End-to-end failover: SIGKILL a real worker, lose zero sessions.
+
+THE durability acceptance test (ISSUE 8): a 2-worker fleet with a spill
+dir, deterministic AND ising sessions in flight, ``kill -9`` on the
+busier worker — every victim session must complete on the survivor
+**under its original fleet sid**, polled by the unmodified PR 4
+``GatewayClient``, and every final board must be byte-identical to the
+uninterrupted sequential oracle.  The restarted worker's spill dir is
+per-generation and the victim's is cleaned up after the rescue.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from tpu_life.fleet import Fleet, FleetConfig
+from tpu_life.gateway.client import GatewayClient
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+
+
+@pytest.fixture
+def spill_fleet(tmp_path):
+    fleet = Fleet(
+        FleetConfig(
+            workers=2,
+            port=0,
+            worker_args=(
+                "--serve-backend", "numpy", "--capacity", "4",
+                "--chunk-steps", "2",
+            ),
+            log_dir=str(tmp_path / "logs"),
+            spill_dir=str(tmp_path / "spill"),
+            spill_every=1,
+            probe_interval_s=0.1,
+            backoff_base_s=0.2,
+        )
+    )
+    fleet.start()
+    assert fleet.wait_ready(timeout=90, min_workers=2), fleet.supervisor.states()
+    yield fleet
+    fleet.begin_drain()
+    if not fleet.wait(timeout=30):
+        for w in fleet.supervisor.workers:  # aid post-mortems
+            if w.log_path.exists():
+                print(f"--- {w.name} log tail ---")
+                print(w.log_path.read_text()[-2000:])
+    fleet.close()
+
+
+def test_sigkill_mid_session_loses_zero_work(spill_fleet, tmp_path):
+    fleet = spill_fleet
+    client = GatewayClient(f"http://127.0.0.1:{fleet.port}", retries=8)
+
+    det_boards = [random_board(24, 20, seed=700 + i, density=0.4) for i in range(4)]
+    det_steps = 1500
+    sids = [client.submit(board=b, rule="conway", steps=det_steps) for b in det_boards]
+    ising_steps, ising_seed, ising_temp = 1000, 7, 2.3
+    isid = client.submit(
+        size=16, steps=ising_steps, rule="ising",
+        temperature=ising_temp, seed=ising_seed,
+    )
+    sids.append(isid)
+
+    by_worker: dict = {}
+    for sid in sids:
+        by_worker.setdefault(client.poll(sid)["worker"], []).append(sid)
+
+    # wait until every session has a PUBLISHED spill: the recovery point
+    # is the last completed spill pass, so killing during the very first
+    # round could legitimately lose the session (never_snapshotted).
+    # steps_done >= 4 chunks means several rounds — and with
+    # spill_every=1, several published spill passes — are behind it.
+    deadline = time.monotonic() + 60
+    while True:
+        views = {sid: client.poll(sid) for sid in sids}
+        if all(8 <= v["steps_done"] < v["steps"] for v in views.values()):
+            break
+        assert time.monotonic() < deadline, views
+        time.sleep(0.05)
+
+    victim_name = max(by_worker, key=lambda k: len(by_worker[k]))
+    victim = fleet.supervisor.get(victim_name)
+    victim_gen = victim.generation
+    os.kill(victim.proc.pid, signal.SIGKILL)
+
+    # the UNMODIFIED client polls every original sid straight through the
+    # kill: synthetic running views + the re-pin keep wait() converging
+    for sid in sids:
+        view = client.wait(sid, timeout=180)
+        assert view["state"] == "done", (sid, view)
+        assert view["steps_done"] == view["steps"], view
+
+    # byte-identity against the uninterrupted oracles
+    for sid, board in zip(sids[:4], det_boards):
+        got = client.result_board(sid)
+        expect = run_np(board, get_rule("conway"), det_steps)
+        assert got.tobytes() == expect.tobytes(), sid
+
+    from tpu_life import mc
+    from tpu_life.mc.engine import MCHostRunner
+
+    ib = mc.seeded_board(16, 16, 0.5, states=2, seed=ising_seed)
+    oracle = MCHostRunner(
+        ib, get_rule("ising"), seed=ising_seed, temperature=ising_temp
+    )
+    oracle.advance(ising_steps)
+    assert client.result_board(isid).tobytes() == oracle.fetch().tobytes()
+
+    # the victims really moved: at least one migration succeeded, none
+    # were lost as corrupt/failed
+    migrations = fleet.stats()["migrations"]
+    assert migrations["migrated"] >= len(by_worker[victim_name]), migrations
+    assert migrations["corrupt"] == 0 and migrations["failed"] == 0
+
+    # the victim incarnation's spill dir was cleaned up after the rescue
+    from tpu_life.fleet.migrate import worker_spill_dir
+
+    assert not worker_spill_dir(
+        str(tmp_path / "spill"), victim_name, victim_gen
+    ).exists()

@@ -216,6 +216,27 @@ def test_stale_snapshots_cannot_hijack_recovery(tmp_path):
     )
 
 
+def test_bit_flipped_snapshot_demotes_to_previous(tmp_path):
+    """The torn-write drill, extended with size-preserving corruption:
+    a bit-flipped newest snapshot passes the old length check but fails
+    its CRC32 sidecar, so directory resume must demote to the previous
+    intact snapshot — resuming garbage is the one unacceptable outcome."""
+    from tpu_life.runtime.checkpoint import resolve_resume, snapshot_intact
+
+    board = random_board(12, 9, seed=5)
+    later = board.copy()
+    save_snapshot(tmp_path / "snaps", 10, board, rule="B3/S23")
+    save_snapshot(tmp_path / "snaps", 20, later, rule="B3/S23")
+    bad = tmp_path / "snaps" / "board_000000020.txt"
+    raw = bytearray(bad.read_bytes())
+    raw[5] ^= 0x01  # same size: the pre-CRC intact check would pass this
+    bad.write_bytes(raw)
+    assert not snapshot_intact(bad, 12, 9)
+    p, step, h, w = resolve_resume(tmp_path / "snaps", 12, 9)
+    assert step == 10 and p.name == "board_000000010.txt"
+    np.testing.assert_array_equal(read_board(p, h, w), board)
+
+
 def test_failure_during_initial_staging_is_retried(tmp_path, monkeypatch):
     # the very first board staging sits inside the recovery scope too: a
     # device still detaching at job start consumes a restart and retries

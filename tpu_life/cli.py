@@ -151,6 +151,14 @@ def build_parser() -> argparse.ArgumentParser:
                      "debugging and baseline timing (docs/SERVING.md)")
     srv.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                      help="default per-request deadline")
+    srv.add_argument("--spill-dir", default=None, metavar="DIR",
+                     help="durable sessions: spill every live session's "
+                     "board + manifest here through the checkpoint "
+                     "contract so a killed process's work is resumable "
+                     "(docs/SERVING.md durability)")
+    srv.add_argument("--spill-every", type=int, default=4, metavar="K",
+                     help="rounds between spill passes (recovery point = "
+                     "the last spilled chunk)")
     srv.add_argument("--metrics-file", default=None, metavar="JSONL",
                      help="append per-round serve metrics as JSON lines")
     srv.add_argument("--trace-events", default=None, metavar="FILE",
@@ -244,6 +252,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "pump (same semantics as `serve --sync-pump`)")
     gw.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline")
+    gw.add_argument("--spill-dir", default=None, metavar="DIR",
+                    help="durable sessions: spill live sessions here so a "
+                    "supervisor can migrate them after a kill "
+                    "(docs/FLEET.md failover; same semantics as "
+                    "`serve --spill-dir`)")
+    gw.add_argument("--spill-every", type=int, default=4, metavar="K",
+                    help="rounds between spill passes")
     gw.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
                     help="per-API-key token-bucket refill rate; 0 disables "
                     "rate limiting (the X-API-Key header names the key)")
@@ -297,6 +312,15 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--sync-pump", action="store_true",
                     help="workers run host-synchronous rounds instead of "
                     "the pipelined pump (forwarded to every gateway)")
+    fl.add_argument("--spill-dir", default=None, metavar="DIR",
+                    help="durable sessions (docs/FLEET.md): workers spill "
+                    "live sessions under per-generation subdirs here; on "
+                    "worker death the fleet resumes the intact spills on "
+                    "a survivor under the SAME session id — a SIGKILLed "
+                    "worker loses zero accepted work")
+    fl.add_argument("--spill-every", type=int, default=4, metavar="K",
+                    help="rounds between worker spill passes (recovery "
+                    "point = the last spilled chunk)")
     fl.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline (per worker)")
     fl.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
@@ -1048,6 +1072,8 @@ def _serve(args) -> int:
             profile=args.profile,
             trace_events=args.trace_events,
             prom_file=args.prom_file,
+            spill_dir=args.spill_dir,
+            spill_every=args.spill_every,
         )
     )
     # admit respecting backpressure: when the bounded queue fills, pump
@@ -1302,6 +1328,8 @@ def _gateway(args) -> int:
             metrics_file=args.metrics_file,
             trace_events=args.trace_events,
             prom_file=args.prom_file,
+            spill_dir=args.spill_dir,
+            spill_every=args.spill_every,
         )
     )
     gw = Gateway(
@@ -1405,6 +1433,8 @@ def _fleet(args) -> int:
             worker_args=tuple(worker_args),
             metrics_dir=args.metrics_dir,
             log_dir=args.log_dir,
+            spill_dir=args.spill_dir,
+            spill_every=args.spill_every,
             probe_interval_s=args.probe_interval,
             backoff_base_s=args.restart_backoff,
             # the flag counts RESTARTS; the breaker counts consecutive
@@ -1451,6 +1481,13 @@ def _fleet(args) -> int:
                 "routed": stats["routed"],
                 "retries": stats["retries"],
                 "sessions_pinned": stats["sessions_pinned"],
+                # worker-death migrations by outcome (present only with
+                # --spill-dir): migrated / corrupt / failed
+                **(
+                    {"migrations": stats["migrations"]}
+                    if "migrations" in stats
+                    else {}
+                ),
                 # a breaker-open worker is a real failure even though the
                 # drain machinery shut everything down tidily — exit 1
                 "failed_workers": failed,

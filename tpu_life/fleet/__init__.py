@@ -10,6 +10,14 @@ backend.  One worker dying takes out only its own in-flight sessions
 (typed ``worker_lost`` errors); everything else keeps completing, and the
 restarted worker rejoins the rotation.
 
+With a ``spill_dir`` configured, worker death is *masked*, not merely
+isolated: each worker spills its live sessions through the checkpoint
+contract, and a :class:`~tpu_life.fleet.migrate.Migrator` resumes a dead
+worker's intact spills on a survivor under the SAME fleet sid — the
+unmodified client polls straight through a SIGKILL and the finished
+board is byte-identical to the uninterrupted run (docs/FLEET.md
+"durability").
+
 :class:`Fleet` wires the pieces together and owns the drain choreography:
 SIGTERM -> the router stops admitting, every worker drains gracefully,
 processes are reaped, and the CLI exits 0.
@@ -25,6 +33,7 @@ import time
 
 from tpu_life import obs
 from tpu_life.fleet.balancer import LeastDepthBalancer
+from tpu_life.fleet.migrate import Migrator
 from tpu_life.fleet.registry import SessionRegistry
 from tpu_life.fleet.router import Router, merge_prom_texts
 from tpu_life.fleet.supervisor import (
@@ -51,6 +60,19 @@ class Fleet:
         self.router = Router(
             self.config, self.supervisor, self.sessions, self.registry
         )
+        self.migrator = None
+        if self.config.spill_dir is not None:
+            self.migrator = Migrator(
+                spill_root=self.config.spill_dir,
+                supervisor=self.supervisor,
+                sessions=self.sessions,
+                registry=self.registry,
+                balancer=self.router.balancer,
+                forward=self.router.forward,
+                timeout_s=self.config.migrate_timeout_s,
+            )
+            self.router.migrator = self.migrator
+            self.supervisor.on_worker_exit = self.migrator.worker_exit
         self.host, self.port = self.router.host, self.router.port
 
     # -- lifecycle ---------------------------------------------------------
@@ -101,7 +123,7 @@ class Fleet:
                 "fleet_routed_total", labels=("worker",)
             ).series()
         }
-        return {
+        out = {
             "run_id": self.run_id,
             "workers": self.supervisor.states(),
             "generations": {w.name: w.generation for w in self.supervisor.workers},
@@ -110,12 +132,21 @@ class Fleet:
             "retries": self.registry.counter("fleet_retry_total").value,
             "sessions_pinned": len(self.sessions),
         }
+        if self.migrator is not None:
+            out["migrations"] = {
+                labels["outcome"]: inst.value
+                for labels, inst in self.registry.counter(
+                    "fleet_migrations_total", labels=("outcome",)
+                ).series()
+            }
+        return out
 
 
 __all__ = [
     "Fleet",
     "FleetConfig",
     "LeastDepthBalancer",
+    "Migrator",
     "Router",
     "SessionRegistry",
     "Supervisor",

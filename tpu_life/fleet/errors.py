@@ -3,12 +3,22 @@
 The router speaks the exact gateway error envelope
 (:class:`tpu_life.gateway.errors.ApiError`), so an unmodified
 ``GatewayClient`` sees fleet failures as the same typed JSON it already
-handles.  The fleet adds three failure modes a single gateway cannot have:
+handles.  The fleet adds failure modes a single gateway cannot have:
 
 - ``worker_lost`` (410): the worker holding a pinned session died (crash,
-  SIGKILL, restart).  Terminal and never retried — the session's state is
-  gone with the process, exactly like a single gateway's
-  ``session_failed``.
+  SIGKILL, restart) and the session could NOT be migrated.  Terminal and
+  never retried; the ``reason`` field says why durability didn't cover
+  it — ``never_snapshotted`` (death before the first spill),
+  ``spill_corrupt`` (every snapshot failed the CRC/size intact check),
+  ``migration_failed`` (no survivor could take it), or
+  ``spill_disabled`` (the fleet runs without a spill dir, so every
+  worker death is terminal for its sessions — the pre-durability
+  behavior).
+- ``migrating`` (409): the pinned worker died but its spilled sessions
+  are being resumed on a survivor — retry after ``Retry-After`` and the
+  original sid keeps working.  (Plain GET polls are answered with a
+  synthetic in-progress view instead, so a poll-until-done client rides
+  straight through the kill.)
 - ``fleet_unavailable`` (503): every worker refused the submission
   (shedding, queue-full, or draining).  Retryable with ``Retry-After`` —
   the fleet-wide twin of a single gateway's ``overloaded``.
@@ -24,12 +34,24 @@ from __future__ import annotations
 from tpu_life.gateway.errors import ApiError
 
 
-def worker_lost(worker: str, sid: str) -> ApiError:
+def worker_lost(worker: str, sid: str, reason: str = "spill_disabled") -> ApiError:
     return ApiError(
         410,
         "worker_lost",
-        f"session {sid} was pinned to worker {worker}, which is gone; "
-        f"its in-flight state is lost — resubmit to start over",
+        f"session {sid} was pinned to worker {worker}, which is gone, and "
+        f"could not be recovered ({reason}); its in-flight state is lost — "
+        f"resubmit to start over",
+        extra={"reason": reason},
+    )
+
+
+def migrating(sid: str, retry_after: float = 0.5) -> ApiError:
+    return ApiError(
+        409,
+        "migrating",
+        f"session {sid} is being migrated from a dead worker to a "
+        f"survivor; retry shortly — the same session id stays valid",
+        retry_after=retry_after,
     )
 
 

@@ -1,0 +1,356 @@
+"""Session migration: a dead worker's spilled sessions resume elsewhere.
+
+The failure-masking half of durability (docs/FLEET.md).  Each worker
+spills its live sessions through the checkpoint contract
+(``serve.spill``) into a per-generation directory the supervisor chose
+for it.  When a worker dies, the supervisor's exit hook hands the death
+to a :class:`Migrator`, which:
+
+1. marks the dead ``(worker, generation)`` MIGRATING — the router
+   answers the victim's pinned sids with a typed 409 ``migrating`` (+
+   ``Retry-After``) or a synthetic in-progress poll view, never a 410 —
+2. reads the victim's intact spills (CRC-verified; a corrupt-but-right-
+   sized snapshot demotes to its predecessor, a session with no intact
+   snapshot is recorded ``spill_corrupt``),
+3. re-submits each as a **resume request** (``resume_b64`` +
+   ``start_step`` + remaining budget + seed/temperature) to a survivor —
+   refusal-only retry, exactly the router's own no-duplicate rule — and
+4. re-pins the ORIGINAL fleet sid onto the survivor's session, so the
+   unmodified PR 4 client polls straight through the kill.
+
+Bit-identity is inherited, not re-proven: deterministic rules are pure
+functions of the board, and the MC tier's ``(seed, step, cell,
+substream)`` key schedule plus ``start_step`` makes a mid-stream restart
+re-enter the exact stream.
+
+Double death: when a survivor dies mid- or post-migration, the sessions
+it adopted migrate again — the ``alias`` map remembers which original
+fleet sid each adopted session answers to, so a second hop re-pins the
+sid the client actually holds.
+
+Sessions that were never spilled (death between admission and the first
+spill pass) stay lost: once the migration run completes, their sids
+answer 410 ``worker_lost`` with ``reason: never_snapshotted`` — the
+documented recovery-point bound of a K-round spill cadence.
+
+Everything is injectable (``forward``, ``clock``, ``sleep``) so the
+state machine unit-tests on fakes; the real wiring (``tpu_life.fleet``)
+hands it the router's forwarder and balancer.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import shutil
+import threading
+import time
+from collections import OrderedDict
+from pathlib import Path
+
+from tpu_life.fleet.registry import fleet_sid
+from tpu_life.fleet.router import REFUSAL_CODES, WorkerUnreachable
+from tpu_life.gateway.server import ROUTE_SESSIONS
+from tpu_life.io.codec import encode_board
+from tpu_life.runtime.metrics import log
+from tpu_life.serve.spill import SpillRecord, read_spill_sessions
+
+#: Bound on remembered per-sid outcomes / aliases (a months-running
+#: router must not grow without bound; an evicted outcome degrades to
+#: ``never_snapshotted`` — still a truthful 410).
+MAX_OUTCOMES = 100_000
+
+
+def worker_spill_dir(root: str, name: str, generation: int) -> Path:
+    """Where one worker incarnation spills: per-generation, so a respawn
+    can never read (or clobber) its predecessor's sessions."""
+    return Path(root) / f"{name}g{generation}"
+
+
+def resume_request(rec: SpillRecord) -> dict:
+    """The wire body that resumes one spilled session on a survivor."""
+    body = {
+        "rule": rec.rule,
+        "steps": rec.remaining,
+        "start_step": rec.step,
+        "resume_b64": base64.b64encode(encode_board(rec.board)).decode("ascii"),
+        "height": rec.height,
+        "width": rec.width,
+    }
+    if rec.seed is not None:
+        body["seed"] = rec.seed
+    if rec.temperature is not None:
+        body["temperature"] = rec.temperature
+    if rec.timeout_s is not None:
+        body["timeout_s"] = rec.timeout_s
+    return body
+
+
+class Migrator:
+    """Owns the migration state machine and the per-death worker threads."""
+
+    def __init__(
+        self,
+        *,
+        spill_root: str,
+        supervisor,
+        sessions,
+        registry,
+        balancer,
+        forward,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        timeout_s: float = 30.0,
+        retry_pause_s: float = 0.5,
+    ):
+        self.spill_root = spill_root
+        self.supervisor = supervisor
+        self.sessions = sessions
+        self.balancer = balancer
+        self.forward = forward
+        self.clock = clock
+        self.sleep = sleep
+        self.timeout_s = timeout_s
+        self.retry_pause_s = retry_pause_s
+        self._lock = threading.Lock()
+        self._active: set[tuple[str, int]] = set()
+        self._completed: set[tuple[str, int]] = set()
+        # fsid -> terminal non-migrated reason (spill_corrupt / migration_failed)
+        self._failed: OrderedDict[str, str] = OrderedDict()
+        # (worker, generation, worker-sid) -> the ORIGINAL fleet sid a
+        # client holds — consulted on double death so a second hop
+        # re-pins the sid that is actually out there
+        self._alias: OrderedDict[tuple[str, int, str], str] = OrderedDict()
+        # fsid -> (steps_total, steps_done) from the spill manifest, for
+        # synthetic poll views while the migration is in flight
+        self._progress: dict[str, tuple[int, int]] = {}
+        self._threads: list[threading.Thread] = []
+        self._c_migrations = registry.counter(
+            "fleet_migrations_total",
+            "sessions handled by worker-death migration, by outcome",
+            labels=("outcome",),
+        )
+        for outcome in ("migrated", "corrupt", "failed"):
+            self._c_migrations.labels(outcome=outcome)
+
+    # -- the supervisor hook (called under its lock: must be fast) ----------
+    def worker_exit(self, name: str, generation: int) -> None:
+        key = (name, generation)
+        with self._lock:
+            if key in self._active or key in self._completed:
+                return
+            self._active.add(key)
+        t = threading.Thread(
+            target=self._run,
+            args=(name, generation),
+            name=f"fleet-migrate-{name}g{generation}",
+            daemon=True,
+        )
+        # prune finished runs: a months-running fleet with restart churn
+        # must not retain one dead Thread object per worker death
+        self._threads = [x for x in self._threads if x.is_alive()]
+        self._threads.append(t)
+        t.start()
+
+    # -- the router's view --------------------------------------------------
+    def status(self, fsid: str, pin, *, pending_ok: bool = True) -> tuple[str, ...]:
+        """What a request for a sid whose pinned home is gone should get:
+        ``("migrating",)`` or ``("lost", reason)``.
+
+        ``pending_ok`` narrows the no-record fallback: True only when the
+        pin targets the worker's CURRENT generation (the just-died,
+        exit-hook-not-yet-fired window, where a rescue is imminent).  A
+        pin into an unknown PAST generation — a sid from a previous fleet
+        process, or a forged generation — has no rescue coming and must
+        settle to a terminal 410, never poll as migrating forever."""
+        with self._lock:
+            reason = self._failed.get(fsid)
+            if reason is not None:
+                return ("lost", reason)
+            key = (pin.worker, pin.generation)
+            if key in self._active:
+                return ("migrating",)
+            if key in self._completed:
+                # the run finished and neither re-pinned nor failed this
+                # sid: it was never spilled before the death
+                return ("lost", "never_snapshotted")
+        if pending_ok:
+            # the death has not reached the supervisor's exit hook yet
+            # (the monitor tick is on its way): migration is imminent
+            return ("migrating",)
+        return ("lost", "never_snapshotted")
+
+    def progress(self, fsid: str) -> tuple[int, int] | None:
+        with self._lock:
+            return self._progress.get(fsid)
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until every migration thread finished (tests, drains)."""
+        deadline = time.monotonic() + timeout
+        for t in list(self._threads):
+            t.join(max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                return False
+        return True
+
+    # -- one worker-death migration run -------------------------------------
+    def _run(self, name: str, generation: int) -> None:
+        d = worker_spill_dir(self.spill_root, name, generation)
+        cleanup = True
+        try:
+            try:
+                records, corrupt = read_spill_sessions(d)
+            except Exception:
+                # a read failure must not delete bytes nobody looked at
+                log.exception("fleet: cannot read spills of %s gen %d", name,
+                              generation)
+                records, corrupt, cleanup = [], [], False
+            log.info(
+                "fleet: migrating %d session(s) from dead %s gen %d "
+                "(%d corrupt)",
+                len(records),
+                name,
+                generation,
+                len(corrupt),
+            )
+            for sid in corrupt:
+                self._record_failure(
+                    self._target_fsid(name, generation, sid),
+                    "spill_corrupt",
+                    counter="corrupt",
+                )
+            # resolve every record's client-facing fsid and publish its
+            # last-known progress BEFORE any resume runs: synthetic poll
+            # views never regress to 0/0 while a session waits its turn
+            targets = [
+                (self._target_fsid(name, generation, rec.sid), rec)
+                for rec in records
+            ]
+            with self._lock:
+                for fsid, rec in targets:
+                    self._progress[fsid] = (rec.steps_total, rec.step)
+            # per-record isolation: a crash resuming record 3 must neither
+            # abort records 4..N unattempted nor mislabel them
+            # never_snapshotted — every session's fate gets recorded
+            for fsid, rec in targets:
+                try:
+                    self._migrate_one(fsid, rec)
+                except Exception:
+                    log.exception("fleet: resume of %s crashed", fsid)
+                    self._record_failure(fsid, "migration_failed")
+        finally:
+            with self._lock:
+                self._active.discard((name, generation))
+                self._completed.add((name, generation))
+            if cleanup:
+                # the victim's directory is orphaned now: every session
+                # either lives on a survivor (which spills it under its
+                # OWN dir) or is terminally lost — either way these bytes
+                # must not be resumed a second time
+                shutil.rmtree(d, ignore_errors=True)
+
+    def _target_fsid(self, name: str, generation: int, sid: str) -> str:
+        with self._lock:
+            return self._alias.pop((name, generation, sid), None) or fleet_sid(
+                name, generation, sid
+            )
+
+    def _migrate_one(self, fsid: str, rec: SpillRecord) -> None:
+        body = json.dumps(resume_request(rec)).encode()
+        deadline = self.clock() + self.timeout_s
+        while True:
+            ready = self.supervisor.ready_workers()
+            outcome = self._try_candidates(fsid, body, ready)
+            if outcome in ("migrated", "failed"):
+                break
+            # every candidate refused (or none ready): capacity pressure,
+            # not a verdict — pace and retry until the budget runs out
+            if self.clock() >= deadline:
+                self._record_failure(fsid, "migration_failed")
+                return
+            self.sleep(self.retry_pause_s)
+        if outcome == "failed":
+            self._record_failure(fsid, "migration_failed")
+        else:
+            with self._lock:
+                self._progress.pop(fsid, None)
+            self._c_migrations.labels(outcome="migrated").inc()
+
+    def _try_candidates(self, fsid: str, body: bytes, ready) -> str:
+        """One pass over the ready workers: 'migrated', 'failed'
+        (ambiguous or protocol rejection — do not retry), or 'refused'
+        (every candidate definitively declined — safe to retry)."""
+        for worker in self.balancer.candidates(ready):
+            # capture BEFORE the round-trip (the route_submit rule): a
+            # crash+respawn mid-forward must not alias the wrong life
+            target_gen = worker.generation
+            try:
+                status, _, doc = self.forward(
+                    worker, "POST", ROUTE_SESSIONS, body=body
+                )
+            except WorkerUnreachable as e:
+                if e.refused or not worker.alive:
+                    self.balancer.invalidate(worker)
+                    continue
+                # mid-exchange on a live worker: the resume may exist
+                # there — re-submitting could duplicate the trajectory
+                log.warning(
+                    "fleet: resume of %s on %s ambiguous (%s); not retried",
+                    fsid,
+                    worker.name,
+                    e.cause,
+                )
+                return "failed"
+            if status == 201:
+                wsid = doc.get("session")
+                if not isinstance(wsid, str):
+                    return "failed"
+                self.sessions.repin(fsid, worker.name, target_gen, wsid)
+                with self._lock:
+                    self._alias[(worker.name, target_gen, wsid)] = fsid
+                    while len(self._alias) > MAX_OUTCOMES:
+                        self._alias.popitem(last=False)
+                self.balancer.invalidate(worker)
+                log.info(
+                    "fleet: %s resumed on %s gen %d as %s",
+                    fsid,
+                    worker.name,
+                    target_gen,
+                    wsid,
+                )
+                return "migrated"
+            code = _error_code(doc)
+            if status == 503 and code in REFUSAL_CODES:
+                self.balancer.invalidate(worker)
+                continue
+            if status == 429:
+                # rate-limited: the token bucket rejects BEFORE anything
+                # is stored, so the session definitively was not created —
+                # retryable capacity pressure, never a terminal verdict
+                # (resumes share the workers' anonymous bucket)
+                self.balancer.invalidate(worker)
+                continue
+            # a protocol rejection (400 family) of a spill-derived resume
+            # is deterministic: failing N more times adds nothing
+            log.error(
+                "fleet: resume of %s rejected by %s: %s %s", fsid,
+                worker.name, status, code,
+            )
+            return "failed"
+        return "refused"
+
+    def _record_failure(
+        self, fsid: str, reason: str, *, counter: str = "failed"
+    ) -> None:
+        with self._lock:
+            self._failed[fsid] = reason
+            while len(self._failed) > MAX_OUTCOMES:
+                self._failed.popitem(last=False)
+            self._progress.pop(fsid, None)
+        self._c_migrations.labels(outcome=counter).inc()
+        log.warning("fleet: session %s not recovered (%s)", fsid, reason)
+
+
+def _error_code(doc: dict) -> str | None:
+    err = doc.get("error")
+    return err.get("code") if isinstance(err, dict) else None

@@ -70,6 +70,20 @@ class SessionRegistry:
                 self._pins.popitem(last=False)
         return fsid
 
+    def repin(self, fsid: str, worker: str, generation: int, sid: str) -> None:
+        """Point an EXISTING fleet sid at a new home (session migration:
+        the dead worker's session resumed on a survivor under the
+        survivor's own sid).  The fleet sid string keeps encoding the
+        ORIGINAL pin — that is what clients hold — so a migrated sid must
+        stay in the map to resolve; LRU eviction degrades it to the
+        encoded (dead) home and a typed 410, which resolution accepts as
+        the bounded-memory trade."""
+        with self._lock:
+            self._pins[fsid] = Pin(worker=worker, generation=generation, sid=sid)
+            self._pins.move_to_end(fsid)
+            while len(self._pins) > self.max_pins:
+                self._pins.popitem(last=False)
+
     def resolve(self, fsid: str) -> Pin | None:
         """The pin for a fleet sid; falls back to prefix parsing when the
         pin was LRU-evicted.  None = not a fleet sid at all (404)."""

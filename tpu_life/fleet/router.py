@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import ThreadingHTTPServer
@@ -87,6 +88,13 @@ class Router:
         )
         self._c_retry.labels()
         self.registry = registry
+        # the durability seam (docs/FLEET.md): set by the fleet when a
+        # spill dir is configured.  With a migrator, a dead worker's
+        # pinned sids answer 409 ``migrating`` (or a synthetic running
+        # view on plain polls) until the migration run settles them;
+        # without one, worker death stays terminal (410, reason
+        # ``spill_disabled``).
+        self.migrator = None
         self._server = _RouterHTTPServer((config.host, config.port), _Handler)
         self._server.router = self
         self.host, self.port = self._server.server_address[:2]
@@ -245,21 +253,48 @@ class Router:
 
     def resolve(self, fsid: str) -> tuple[Worker, str]:
         """Fleet sid -> (live worker of the pinned generation, worker sid);
-        typed 404/410 otherwise."""
+        typed 404 / 409 migrating / 410+reason otherwise.
+
+        A migrated sid's pin was re-pointed at its survivor, so it
+        resolves like any live pin.  A pin whose home is gone consults
+        the migrator: still being rescued -> 409 ``migrating`` (retry
+        later, same sid); settled without a rescue -> 410 with a
+        ``reason`` (never_snapshotted / spill_corrupt /
+        migration_failed); no migrator at all -> 410, reason
+        ``spill_disabled`` (the pre-durability contract: the successor
+        mints the same sid NUMBERS for new tenants — the generation in
+        the pin is what keeps them apart)."""
         pin = self.sessions.resolve(fsid)
         if pin is None:
             raise fl_errors.unknown_session(fsid)
         worker = self.supervisor.get(pin.worker)
         if worker is None:
             raise fl_errors.unknown_session(fsid)
-        if worker.generation != pin.generation:
-            # the owning process died and was replaced; its sessions died
-            # with it (the successor mints the same sid NUMBERS for new
-            # tenants — the generation in the pin is what keeps them apart)
-            raise fl_errors.worker_lost(worker.name, fsid)
-        if not worker.alive or worker.state in (WorkerState.DOWN, WorkerState.FAILED):
-            raise fl_errors.worker_lost(worker.name, fsid)
-        return worker, pin.sid
+        if (
+            worker.generation == pin.generation
+            and worker.alive
+            and worker.state not in (WorkerState.DOWN, WorkerState.FAILED)
+        ):
+            return worker, pin.sid
+        # the pinned incarnation is gone (dead, reaped, or replaced)
+        raise self._gone_error(fsid, pin)
+
+    def _gone_error(self, fsid: str, pin) -> ApiError:
+        """The typed answer for a sid whose pinned worker incarnation is
+        gone: 409 ``migrating`` while (or until) the migrator rescues it,
+        410 + reason once its fate is settled (or durability is off)."""
+        if self.migrator is not None:
+            # the "rescue imminent" fallback only applies when the pin
+            # targets the worker's CURRENT generation (a death the
+            # monitor tick hasn't processed yet) — a pin into an unknown
+            # past generation has no migration coming and must settle
+            w = self.supervisor.get(pin.worker)
+            pending_ok = w is not None and w.generation == pin.generation
+            st = self.migrator.status(fsid, pin, pending_ok=pending_ok)
+            if st[0] == "migrating":
+                return fl_errors.migrating(fsid)
+            return fl_errors.worker_lost(pin.worker, fsid, reason=st[1])
+        return fl_errors.worker_lost(pin.worker, fsid)
 
     def route_pinned(
         self, method: str, fsid: str, tail: str, api_key: str | None
@@ -270,19 +305,62 @@ class Router:
                 worker, method, f"{ROUTE_SESSIONS}/{sid}{tail}", api_key=api_key
             )
         except WorkerUnreachable as e:
-            if e.refused or not worker.alive:
+            dead = e.refused or not worker.alive
+            if not dead and method in ("GET", "DELETE"):
+                # a SIGKILL closes the worker's sockets a beat before the
+                # process becomes waitable: a poll reset in that window
+                # would misread as a 502.  GET/DELETE are idempotent, so
+                # re-checking liveness after a grace beat is safe (POST
+                # never reaches this path — pinned routes are GET/DELETE).
+                time.sleep(0.05)
+                dead = not worker.alive
+            if dead:
                 # no listener on the pinned port, or the process itself is
                 # dead (a freshly SIGKILLed worker answers with a reset
-                # before the supervisor reaps it): either way the session's
-                # state died with the process — typed-terminal, not a 502.
-                # A restart binds a fresh ephemeral port, so this can never
-                # reach the successor generation by accident.
+                # before the supervisor reaps it): the session's state died
+                # with the process — typed, not a 502.  A restart binds a
+                # fresh ephemeral port, so this can never reach the
+                # successor generation by accident.  Re-resolve the pin for
+                # the migrator consult: with durability on, this freshly
+                # observed death answers 409 migrating, not 410.
+                pin = self.sessions.resolve(fsid)
+                if pin is not None:
+                    raise self._gone_error(fsid, pin) from None
                 raise fl_errors.worker_lost(worker.name, fsid) from None
             raise fl_errors.upstream_error(worker.name, str(e.cause)) from None
         if isinstance(doc.get("session"), str):
             doc["session"] = fsid
         doc["worker"] = worker.name
         return status, retry_after, doc
+
+    def migrating_view(self, fsid: str) -> dict:
+        """A synthetic in-progress poll body for a sid mid-migration, so
+        an unmodified poll-until-done client (``GatewayClient.wait``)
+        rides straight through a worker kill: ``finished`` stays false,
+        progress is the last spilled position when the manifest has been
+        read, and the next poll after the re-pin lands on the survivor.
+        Only plain GET polls get this — result/cancel answer the typed
+        409 ``migrating`` (+ Retry-After) instead, because "here is a
+        board" and "it is cancelled" cannot be synthesized truthfully.
+        Progress comes from the spill manifest (published for every
+        record before any resume runs); in the short window before the
+        manifests are read, the progress keys are OMITTED rather than
+        reported as a regressed 0/0 — steps_done must only ever grow."""
+        view = {
+            "session": fsid,
+            "state": "running",
+            "migrating": True,
+            "finished": False,
+            "error": None,
+            "fleet": True,
+        }
+        progress = self.migrator.progress(fsid) if self.migrator else None
+        if progress is not None:
+            total, done = progress
+            view["steps"] = total
+            view["steps_done"] = done
+            view["progress"] = done / total if total else 0.0
+        return view
 
     # -- fleet endpoints ---------------------------------------------------
     def merged_metrics(self) -> str:
@@ -422,7 +500,18 @@ class _Handler(JsonHandler):
             if "/" not in rest:
                 if method not in ("GET", "DELETE"):
                     raise gw_errors.method_not_allowed(method, path)
-                status, retry_after, doc = rt.route_pinned(method, rest, "", api_key)
+                try:
+                    status, retry_after, doc = rt.route_pinned(
+                        method, rest, "", api_key
+                    )
+                except ApiError as e:
+                    if method == "GET" and e.code == "migrating":
+                        # a plain poll mid-migration answers 200 with a
+                        # synthetic running view — the poll-until-done
+                        # client loop never sees the failover at all
+                        self._send_json(200, rt.migrating_view(rest))
+                        return
+                    raise
                 self._send_json(status, doc, retry_after=retry_after)
                 return
             fsid, _, tail = rest.partition("/")

@@ -77,6 +77,14 @@ class FleetConfig:
     forward_timeout_s: float = 30.0  # router -> worker per-request bound
     max_body: int = protocol.MAX_BODY  # router request-body bound (413)
     max_pins: int = 100_000  # session-registry LRU cap
+    #: durable sessions (docs/FLEET.md failover): the spill root.  Each
+    #: worker incarnation spills its live sessions under
+    #: ``<spill_dir>/<name>g<generation>``; on worker death the migrator
+    #: resumes the intact spills on a survivor under the SAME fleet sid.
+    #: None = durability off (worker death answers 410 worker_lost).
+    spill_dir: str | None = None
+    spill_every: int = 4  # rounds between worker spill passes
+    migrate_timeout_s: float = 30.0  # per-session resume budget on death
 
 
 @dataclass
@@ -130,6 +138,10 @@ class Supervisor:
             Worker(name=f"w{i}", log_path=log_dir / f"w{i}.log")
             for i in range(config.workers)
         ]
+        #: worker-death callback: ``cb(name, generation)`` fires (under
+        #: the supervisor lock — keep it fast) for every non-drain exit;
+        #: the fleet wires the migrator's spill rescue here
+        self.on_worker_exit = None
         self._g_workers = registry.gauge(
             "fleet_workers", "supervised workers by state", labels=("state",)
         )
@@ -142,6 +154,7 @@ class Supervisor:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        self._sweep_orphan_spills()
         with self._lock:
             for w in self.workers:
                 self._spawn_worker(w, first=True)
@@ -150,6 +163,25 @@ class Supervisor:
             target=self._monitor, name="fleet-monitor", daemon=True
         )
         self._thread.start()
+
+    def _sweep_orphan_spills(self) -> None:
+        """Startup sweep: delete spill directories left by dead
+        generations of a PREVIOUS supervisor run.  This supervisor's
+        generations all start fresh (and get fresh per-generation dirs),
+        so at start every existing subdirectory is an orphan — without
+        this, a crashed worker's directory would sit on disk forever
+        (in-run orphans are deleted by the migrator after each rescue)."""
+        if self.config.spill_dir is None:
+            return
+        root = Path(self.config.spill_dir)
+        if not root.is_dir():
+            return
+        import shutil
+
+        for child in root.iterdir():
+            if child.is_dir():
+                log.info("fleet: sweeping orphan spill dir %s", child)
+                shutil.rmtree(child, ignore_errors=True)
 
     def begin_drain(self) -> None:
         """Fleet-wide graceful drain: SIGTERM every live worker (each
@@ -374,6 +406,15 @@ class Supervisor:
             w.state = WorkerState.DOWN
             log.info("fleet: %s exited rc=%s (drain)", w.name, rc)
             return
+        if self.on_worker_exit is not None:
+            # the durability hook: hand this incarnation's spills to the
+            # migrator BEFORE any respawn bumps the generation (the hook
+            # only records state and spawns a thread — it must stay fast,
+            # we hold the supervisor lock)
+            try:
+                self.on_worker_exit(w.name, w.generation)
+            except Exception:  # pragma: no cover - the hook must not kill reaping
+                log.exception("fleet: worker-exit hook failed for %s", w.name)
         uptime = now - w.started_at
         w.failures = w.failures + 1 if uptime < self.config.healthy_after_s else 1
         if w.failures >= self.config.breaker_threshold:
@@ -442,6 +483,17 @@ class Supervisor:
         if self.config.metrics_dir is not None:
             sink = Path(self.config.metrics_dir) / f"{w.name}.jsonl"
             argv += ["--metrics-file", str(sink)]
+        if self.config.spill_dir is not None:
+            # per-incarnation spill dir: a respawn must never read (or
+            # clobber) its predecessor's sessions — the migrator owns those
+            from tpu_life.fleet.migrate import worker_spill_dir
+
+            argv += [
+                "--spill-dir",
+                str(worker_spill_dir(self.config.spill_dir, w.name, w.generation)),
+                "--spill-every",
+                str(self.config.spill_every),
+            ]
         return argv
 
     def _default_spawn(self, w: Worker) -> None:

@@ -32,15 +32,23 @@ class ApiError(Exception):
         message: str,
         *,
         retry_after: float | None = None,
+        extra: dict | None = None,
     ):
         super().__init__(message)
         self.status = status
         self.code = code
         self.message = message
         self.retry_after = retry_after
+        # machine-readable qualifiers beyond the code (e.g. the fleet's
+        # worker_lost ``reason``) — merged into the error object so
+        # clients branching on code can refine on them without parsing
+        # the human message
+        self.extra = extra or {}
 
     def body(self) -> dict:
-        return {"error": {"code": self.code, "message": self.message}}
+        return {
+            "error": {"code": self.code, "message": self.message, **self.extra}
+        }
 
 
 def fmt_retry_after(seconds: float) -> str:

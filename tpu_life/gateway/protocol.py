@@ -24,6 +24,18 @@ is the ising Metropolis scalar (required there, a typed 400 elsewhere)::
     {"size": 128, "steps": 200, "rule": "ising",
      "temperature": 2.27, "seed": 42}
 
+Resume requests (docs/FLEET.md failover) stage a byte-exact prior state
+instead of a fresh board: ``resume_b64`` is base64 of the contract-codec
+board bytes (``io/codec.py`` — the spill/snapshot format), ``start_step``
+the absolute steps that board has already completed, ``steps`` the
+REMAINING budget.  Deterministic rules resume exactly because the board
+is the whole state; stochastic rules because the counter-based key
+schedule re-enters the stream at ``start_step``::
+
+    {"resume_b64": "...", "height": 128, "width": 128,
+     "rule": "ising", "steps": 120, "start_step": 80,
+     "seed": 42, "temperature": 2.27}
+
 Result payload (``GET /v1/sessions/{sid}/result?format=rle|raw``):
 ``rle`` is the ecosystem interchange text (``io/rle.py``); ``raw`` is
 base64 of the byte-exact contract board format (``io/codec.py``) — the
@@ -62,7 +74,9 @@ class SubmitSpec:
     ``seed``/``temperature`` are the stochastic-tier fields
     (docs/STOCHASTIC.md): the counter-based PRNG stream id and the
     per-session ising scalar.  ``seed`` is also set for seeded-geometry
-    deterministic requests (it named the staged board).
+    deterministic requests (it named the staged board).  ``start_step``
+    is the failover-resume field: absolute steps the staged board has
+    already completed (0 for fresh sessions).
     """
 
     board: np.ndarray
@@ -71,6 +85,7 @@ class SubmitSpec:
     timeout_s: float | None
     seed: int | None = None
     temperature: float | None = None
+    start_step: int = 0
 
 
 def _require_int(payload: dict, key: str, *, minimum: int = 0) -> int:
@@ -144,6 +159,41 @@ def parse_board(raw, states: int) -> np.ndarray:
     return board.astype(np.int8)
 
 
+def parse_resume_board(payload: dict, states: int) -> np.ndarray:
+    """``resume_b64`` + geometry -> the byte-exact int8 board, with typed
+    400s for malformed base64, geometry mismatch, or out-of-range states.
+    The bytes ARE the spill/snapshot contract format, so a resumed board
+    is identical down to the byte to what the dead worker spilled."""
+    height = _require_int(payload, "height", minimum=1)
+    width = _require_int(payload, "width", minimum=1)
+    if height * width > MAX_CELLS:
+        raise bad_request(
+            "board_too_large",
+            f"resume board has {height * width} cells; the limit is {MAX_CELLS}",
+        )
+    raw = payload["resume_b64"]
+    if not isinstance(raw, str):
+        raise bad_request("invalid_request", "'resume_b64' must be a string")
+    try:
+        buf = base64.b64decode(raw, validate=True)
+    except (base64.binascii.Error, ValueError) as e:
+        raise bad_request(
+            "invalid_request", f"'resume_b64' is not valid base64: {e}"
+        ) from None
+    try:
+        board = decode_board(buf, height, width)
+    except ValueError as e:
+        raise bad_request("invalid_board", str(e)) from None
+    lo, hi = int(board.min(initial=0)), int(board.max(initial=0))
+    if lo < 0 or hi >= states:
+        raise bad_request(
+            "invalid_board",
+            f"resume board states must be 0..{states - 1} for this rule; "
+            f"found {lo if lo < 0 else hi}",
+        )
+    return board
+
+
 def parse_submit(payload) -> SubmitSpec:
     """Request JSON -> :class:`SubmitSpec`; raises :class:`ApiError` (400s)."""
     if not isinstance(payload, dict):
@@ -184,6 +234,23 @@ def parse_submit(payload) -> SubmitSpec:
         if "seed" in payload
         else None
     )
+    start_step = (
+        _require_int(payload, "start_step") if "start_step" in payload else 0
+    )
+
+    if "resume_b64" in payload:
+        # failover resume: byte-exact contract-codec board + the absolute
+        # stream position it corresponds to (docs/FLEET.md)
+        board = parse_resume_board(payload, rule.states)
+        return SubmitSpec(
+            board=board,
+            rule=rule_name,
+            steps=steps,
+            timeout_s=timeout_s,
+            seed=seed,
+            temperature=temperature,
+            start_step=start_step,
+        )
 
     if "board" in payload:
         board = parse_board(payload["board"], rule.states)
@@ -194,6 +261,7 @@ def parse_submit(payload) -> SubmitSpec:
             timeout_s=timeout_s,
             seed=seed,
             temperature=temperature,
+            start_step=start_step,
         )
 
     # seeded geometry: the self-contained demo path (run --size over HTTP);
@@ -236,6 +304,7 @@ def parse_submit(payload) -> SubmitSpec:
         timeout_s=timeout_s,
         seed=staged_seed,
         temperature=temperature,
+        start_step=start_step,
     )
 
 
@@ -297,6 +366,7 @@ __all__ = [
     "SubmitSpec",
     "decode_result",
     "parse_board",
+    "parse_resume_board",
     "parse_submit",
     "render_result",
     "render_view",

@@ -451,6 +451,7 @@ class _Handler(JsonHandler):
                 timeout_s=spec.timeout_s,
                 seed=spec.seed,
                 temperature=spec.temperature,
+                start_step=spec.start_step,
             )
         except Exception as e:  # typed serve errors -> typed HTTP
             raise gw_errors.from_serve_error(e) from e

@@ -334,11 +334,13 @@ class MCVmapEngine(EngineBase):
 
             jax.block_until_ready(self._prev)
 
-    def fetch(self, slot: int) -> np.ndarray:
-        self._fetch_guard(slot)
+    def _peek_board(self, slot: int) -> np.ndarray:
+        # the double buffer is the newest materialized state: a frozen
+        # slot's board AND step counter are provably unchanged by the
+        # in-flight chunk (fetch), and a stepped slot's pre-chunk state
+        # pairs with peek_slot's lag — the stream position either implies
+        # is exact because the counter is a pure function of progress
         if self._inflight and self._prev is not None:
-            # frozen slot: board AND step counter are provably unchanged
-            # by the in-flight chunk, so the chunk input is its final state
             return np.asarray(self._prev[slot])
         return np.asarray(self._boards[slot])
 
@@ -389,8 +391,8 @@ class MCHostEngine(EngineBase):
             self._boards[slot] = b
             self._steps_abs[slot] = base + n
 
-    def fetch(self, slot: int) -> np.ndarray:
-        self._fetch_guard(slot)
+    def _peek_board(self, slot: int) -> np.ndarray:
+        # deferred-compute executor: pre-chunk state until collect runs
         return self._boards[slot].copy()
 
 

@@ -138,6 +138,15 @@ def _serve_summary(rounds: list[dict]) -> dict:
         out["pipeline_depth_max"] = max(
             r.get("pipeline_depth", 0) for r in rounds
         )
+    # the durability stamps (ISSUE 8) — present only when the run spilled
+    if any("snapshot_s" in r for r in rounds):
+        # snapshot_s is cumulative like device_idle_s: the last record is
+        # the run total; spilled_sessions is a gauge, so max is the peak
+        # number of sessions resumable at once
+        out["snapshot_seconds"] = last.get("snapshot_s") or 0.0
+        out["spilled_sessions_max"] = max(
+            r.get("spilled_sessions", 0) for r in rounds
+        )
     return out
 
 
@@ -190,6 +199,14 @@ def _merge_serve(per_run: dict) -> dict:
     ]
     if depths:
         merged["pipeline_depth_max"] = max(depths)
+    # durability merges like the idle metrics: spill seconds sum across
+    # workers, the peak resumable-session gauge maxes
+    snaps = [s["snapshot_seconds"] for s in summaries if "snapshot_seconds" in s]
+    if snaps:
+        merged["snapshot_seconds"] = sum(snaps)
+        merged["spilled_sessions_max"] = max(
+            s.get("spilled_sessions_max", 0) for s in summaries
+        )
     return merged
 
 
@@ -332,6 +349,11 @@ def render(summary: dict) -> str:
                 f"idle_fraction={_fmt(serve.get('device_idle_fraction'))}  "
                 f"pipeline_depth_max={_fmt(serve.get('pipeline_depth_max'))}"
                 + (f"  pump={pump}" if pump else "")
+            )
+        if "snapshot_seconds" in serve:
+            lines.append(
+                f"  snapshot_s={_fmt(serve['snapshot_seconds'])}  "
+                f"spilled_sessions_max={_fmt(serve.get('spilled_sessions_max'))}"
             )
         if "rejection_rate" in serve:
             lines.append(f"  rejection_rate={_fmt(serve['rejection_rate'])}")

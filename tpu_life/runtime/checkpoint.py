@@ -15,12 +15,13 @@ import json
 import logging
 import os
 import re
+import zlib
 from contextlib import contextmanager
 from pathlib import Path
 
 import numpy as np
 
-from tpu_life.io.codec import read_board, write_board
+from tpu_life.io.codec import encode_board, read_board, write_board
 
 _SNAP_RE = re.compile(r"^board_(\d+)\.txt$")
 
@@ -50,6 +51,23 @@ def snapshot_path(directory: str | os.PathLike, step: int) -> Path:
     return Path(directory) / f"board_{step:09d}.txt"
 
 
+def crc_path(p: Path) -> Path:
+    return p.with_suffix(".crc")
+
+
+def write_crc_sidecar(p: Path, crc: int) -> None:
+    """Publish the board file's CRC32 next to it (``board_N.crc``).
+
+    The size check in :func:`snapshot_intact` only catches truncation; a
+    bit-flipped but right-sized snapshot would resume garbage without
+    this.  Written through the same atomic publish as the board, so a
+    torn CRC file is impossible — a mismatching pair (crash between the
+    two publishes) simply demotes the snapshot, which is the safe answer.
+    """
+    with atomic_publish(crc_path(p)) as tmp:
+        tmp.write_text(f"{crc:08x}")
+
+
 def write_sidecar(p: Path, step: int, rule: str, height: int, width: int) -> None:
     # published atomically: snapshot_intact() demotes a snapshot whose
     # sidecar is unparseable, so a torn sidecar must be impossible even
@@ -70,9 +88,14 @@ def save_snapshot(
     d.mkdir(parents=True, exist_ok=True)
     p = snapshot_path(d, step)
     # the sidecar follows the board so it never describes bytes that
-    # aren't fully there
+    # aren't fully there; the CRC is computed from this writer's OWN
+    # in-memory encoding (write_board is exactly f.write(encode_board)),
+    # not a read-back — no extra filesystem pass, and it can never
+    # describe a hybrid of two racing writers' bytes
     with atomic_publish(p) as tmp:
         write_board(tmp, board)
+        crc = zlib.crc32(encode_board(board))
+    write_crc_sidecar(p, crc)
     write_sidecar(p, step, rule, int(board.shape[0]), int(board.shape[1]))
     return p
 
@@ -98,9 +121,14 @@ def latest_snapshot(directory: str | os.PathLike) -> tuple[int, Path] | None:
 def snapshot_intact(p: Path, height: int, width: int) -> bool:
     """True when the snapshot's byte size matches its geometry (from the
     sidecar when present, the caller's otherwise) — a file truncated by a
-    crash mid-write fails this.  Single-process writes publish atomically
-    (``atomic_publish``) so can't be truncated; multi-process collective
-    snapshot writes can, which is why directory resume checks this."""
+    crash mid-write fails this — AND, when a ``.crc`` sidecar exists, its
+    CRC32 matches the file bytes, so a corrupt-but-right-sized snapshot
+    (bit rot, a torn multi-writer publish) demotes to the previous
+    snapshot instead of resuming garbage.  Single-process writes publish
+    atomically (``atomic_publish``) so can't be truncated; multi-process
+    collective snapshot writes can, which is why directory resume checks
+    this.  Snapshots from writers that predate the CRC sidecar (or the
+    streamed collective writer) fall back to the size check alone."""
     h, w = height, width
     sidecar = p.with_suffix(".json")
     if sidecar.exists():
@@ -111,9 +139,18 @@ def snapshot_intact(p: Path, height: int, width: int) -> bool:
         except (ValueError, OSError):
             return False
     try:
-        return p.stat().st_size == h * (w + 1)
+        if p.stat().st_size != h * (w + 1):
+            return False
     except OSError:
         return False
+    crc_file = crc_path(p)
+    if crc_file.exists():
+        try:
+            expect = int(crc_file.read_text().strip(), 16)
+            return zlib.crc32(p.read_bytes()) == expect
+        except (ValueError, OSError):
+            return False
+    return True
 
 
 def prune_snapshots(
@@ -135,6 +172,7 @@ def prune_snapshots(
         p = snapshot_path(directory, step)
         p.unlink(missing_ok=True)
         p.with_suffix(".json").unlink(missing_ok=True)
+        crc_path(p).unlink(missing_ok=True)
     return kept
 
 

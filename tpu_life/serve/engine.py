@@ -235,6 +235,33 @@ class EngineBase:
         self._idle_reported = self.idle_seconds
         return delta
 
+    def fetch(self, slot: int) -> np.ndarray:
+        """The slot's materialized board — guarded: fetching a slot the
+        in-flight chunk is still STEPPING would return pre-chunk data, so
+        the scheduler only ever fetches frozen slots (the guard trips on
+        a pump bug).  One body for every executor: guard, then the same
+        newest-materialized read :meth:`peek_slot` uses — the two paths
+        must never diverge."""
+        self._fetch_guard(slot)
+        return self._peek_board(slot)
+
+    def peek_slot(self, slot: int) -> tuple[np.ndarray, int]:
+        """The newest MATERIALIZED board for a resident slot, plus how many
+        already-accounted steps that board lags the session bookkeeping
+        (the in-flight chunk's steps for this slot; 0 when settled).
+
+        The spill path (``serve.spill``) snapshots live slots with this:
+        after ``settle()`` the double buffer is materialized, so peeking
+        never blocks on the newest in-flight chunk — the snapshot's
+        recovery point is simply one chunk behind the accounting.  Unlike
+        :meth:`fetch` there is no in-flight guard: the caller pairs the
+        board with the returned lag instead of requiring lag zero.
+        """
+        return self._peek_board(slot), self._inflight.get(slot, 0)
+
+    def _peek_board(self, slot: int) -> np.ndarray:
+        raise NotImplementedError
+
     def _fetch_guard(self, slot: int) -> None:
         # fetching a slot the in-flight chunk is still STEPPING would
         # return pre-chunk data on the host executors; the scheduler only
@@ -260,9 +287,6 @@ class EngineBase:
         """Materialize the chunk ``_dispatch_impl`` launched; ``advanced``
         is its {slot: steps} accounting (host executors compute from it —
         ``_remaining`` has already been decremented)."""
-        raise NotImplementedError
-
-    def fetch(self, slot: int) -> np.ndarray:
         raise NotImplementedError
 
 
@@ -379,13 +403,14 @@ class VmapEngine(EngineBase):
 
             jax.block_until_ready(self._prev)
 
-    def fetch(self, slot: int) -> np.ndarray:
-        self._fetch_guard(slot)
+    def _peek_board(self, slot: int) -> np.ndarray:
+        # the double buffer is the newest MATERIALIZED state while a chunk
+        # flies: a slot frozen in that chunk (remaining == 0 — the freeze
+        # mask provably leaves it untouched) has the same value in the
+        # chunk INPUT as in its output, so fetch reads here instead of
+        # blocking on the newest chunk; a slot the chunk IS stepping reads
+        # its pre-chunk state — peek_slot's lag accounts for it
         if self._inflight and self._prev is not None:
-            # the slot is frozen in the in-flight chunk (remaining == 0 ->
-            # the freeze mask provably leaves it untouched), so its value
-            # in the chunk INPUT equals its value in the output — read the
-            # materialized buffer instead of blocking on the newest chunk
             return np.asarray(self._prev[slot])
         return np.asarray(self._boards[slot])
 
@@ -422,8 +447,10 @@ class HostBatchEngine(EngineBase):
                 b = step_np(b, rule)
             self._boards[slot] = b
 
-    def fetch(self, slot: int) -> np.ndarray:
-        self._fetch_guard(slot)
+    def _peek_board(self, slot: int) -> np.ndarray:
+        # deferred-compute executor: while a chunk is "in flight" (staged,
+        # not yet collected) the array still holds the PRE-chunk state,
+        # which is exactly what peek_slot's lag accounting expects
         return self._boards[slot].copy()
 
 
@@ -456,9 +483,8 @@ class SlotLoopEngine(EngineBase):
             if runner is not None:  # slot released since dispatch: work is moot
                 runner.advance(n)
 
-    def fetch(self, slot: int) -> np.ndarray:
-        self._fetch_guard(slot)
-        return self._runners[slot].fetch()
+    def _peek_board(self, slot: int) -> np.ndarray:
+        return np.asarray(self._runners[slot].fetch())
 
 
 def make_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:

@@ -221,13 +221,17 @@ class Scheduler:
                 continue
             try:
                 # seed/temperature are the stochastic per-slot state
-                # (validated at submit); deterministic engines ignore them
+                # (validated at submit); deterministic engines ignore them.
+                # start_step re-enters the counter-based PRNG stream at the
+                # session's absolute position — the resumed-after-failover
+                # case (start_step > 0) is bit-exact by construction
                 engine.load(
                     slot,
                     s.board,
                     s.steps_remaining,
                     seed=s.seed,
                     temperature=s.temperature,
+                    start_step=s.start_step + s.steps_done,
                 )
             except recovery.RECOVERABLE as e:
                 engine.release(slot)

@@ -98,6 +98,15 @@ class ServeConfig:
     trace_events: str | None = None
     prom_file: str | None = None  # Prometheus text snapshot, written at close
     run_id: str | None = None  # correlation id (generated when unset)
+    # durable sessions (docs/SERVING.md "durability"): when set, every
+    # ``spill_every`` rounds each live session's board + manifest is
+    # spilled to <spill_dir>/<sid>/ through the crash-consistent
+    # checkpoint contract, so a supervisor can resume a SIGKILLed
+    # worker's sessions on a survivor (docs/FLEET.md failover).  The
+    # spill write runs off the pipelined pump's unlocked settle window —
+    # it never blocks submit/poll/cancel.
+    spill_dir: str | None = None
+    spill_every: int = 4  # rounds between spill passes
 
 
 class SimulationService:
@@ -116,6 +125,10 @@ class SimulationService:
         if self.config.chunk_steps < 1:
             raise ValueError(
                 f"chunk_steps must be >= 1, got {self.config.chunk_steps}"
+            )
+        if self.config.spill_dir is not None and self.config.spill_every < 1:
+            raise ValueError(
+                f"spill_every must be >= 1, got {self.config.spill_every}"
             )
         self.clock = clock
         self.run_id = self.config.run_id or obs.new_run_id()
@@ -179,6 +192,15 @@ class SimulationService:
             "serve_device_idle_seconds_total",
             "wall seconds engines had no chunk in flight between dispatches",
         )
+        # the durability instruments (docs/SERVING.md): how long each
+        # spill pass takes (the failover overhead being paid) and how many
+        # sessions currently have a resumable spill on disk
+        self._h_snapshot = self.registry.histogram(
+            "serve_snapshot_seconds", "wall seconds per session-spill pass"
+        )
+        self._g_spilled = self.registry.gauge(
+            "serve_spilled_sessions", "live sessions with a spill on disk"
+        )
         # engine compile counts by CompileKey bucket (rule:HxW:backend —
         # a closed set in any sane deployment; the cap bounds the rest)
         self._g_compiles = self.registry.gauge(
@@ -199,8 +221,20 @@ class SimulationService:
             self._h_latency,
             self._g_pipeline_depth,
             self._c_device_idle,
+            self._h_snapshot,
+            self._g_spilled,
         ):
             fam.labels()
+        # the spill store (durable sessions): created eagerly so a bad
+        # spill path fails at construction, not at the first spill pass
+        if self.config.spill_dir is not None:
+            from tpu_life.serve.spill import SpillStore
+
+            self._spill: SpillStore | None = SpillStore(self.config.spill_dir)
+        else:
+            self._spill = None
+        self._rounds_since_spill = 0
+        self._snapshot_s_total = 0.0
         # the service OWNS its tracer rather than claiming the process-
         # global slot: emissions are routed through obs.activate() per
         # round, so a concurrently traced driver.run (or second service)
@@ -238,6 +272,7 @@ class SimulationService:
         fault_at: int = 0,
         seed: int | None = None,
         temperature: float | None = None,
+        start_step: int = 0,
     ) -> str:
         """Admit one simulation request; returns its session id.
 
@@ -255,6 +290,13 @@ class SimulationService:
         program.  A temperature on a non-ising rule, or a stochastic rule
         on an executor without the key schedule, is a typed rejection
         here — before anything is stored.
+
+        ``start_step`` is the failover-resume field (docs/FLEET.md): the
+        absolute steps a previous life of this trajectory already
+        completed.  ``board`` is that life's last snapshot, ``steps`` the
+        REMAINING budget; views report absolute progress and the MC
+        engines re-enter the PRNG stream at ``start_step`` — so
+        resume-then-finish equals the uninterrupted run bit-for-bit.
         """
         if isinstance(rule, str):
             rule = get_rule(rule)
@@ -293,6 +335,9 @@ class SimulationService:
         mc.validate_board_shape(rule, board.shape)
         if steps < 0:
             raise ValueError(f"steps must be >= 0, got {steps}")
+        start_step = int(start_step)
+        if start_step < 0:
+            raise ValueError(f"start_step must be >= 0, got {start_step}")
         # admission is a read-modify-write on the queue: everything from the
         # backpressure check to the enqueue happens under the lock, so two
         # racing submits can neither both squeeze past a full queue nor
@@ -322,6 +367,7 @@ class SimulationService:
                 fault_at=fault_at,
                 seed=seed,
                 temperature=None if temperature is None else float(temperature),
+                start_step=start_step,
             )
             self._c_submitted.inc()
             if steps == 0:
@@ -415,6 +461,9 @@ class SimulationService:
         failed / cancelled) ``latency_s`` after submission."""
         self._c_finished.labels(state=session.state.value).inc()
         self._h_latency.observe(latency_s)
+        if self._spill is not None:
+            # a terminal session must never resume: its spill dies with it
+            self._spill.delete(session.sid)
         if session.admitted_at is None:
             # it died waiting: close the still-open queue-wait interval
             obs.async_end("queue-wait", session.sid, outcome=session.state.value)
@@ -486,6 +535,13 @@ class SimulationService:
             "serve.round", round=self._rounds, pump="sync"
         ):
             stats = self.scheduler.round(self._keyer())
+            plan = self._spill_plan()
+            if plan:
+                # the sync pump is fully settled after round(): every lag
+                # is zero and every board materialized.  Spilling here
+                # holds the lock (the sync pump holds it anyway).
+                self._run_spill(plan)
+                self._sweep_spills(plan)
         self._finish_round(stats)
         return stats
 
@@ -500,6 +556,10 @@ class SimulationService:
                 rolled = {key for key, _, r in plan if r}
                 for _, engine, _ in plan:
                     engine.busy = True
+                # the spill plan is captured under the lock (the running
+                # map is verb-mutable) but WRITTEN after settle, outside
+                # it — durability must not block submit/poll/cancel
+                spill_plan = self._spill_plan()
         # -- the overlap window: no service lock held.  Device chunks (and
         # host-engine compute) complete here while submit/poll/cancel stay
         # serviceable; verb-triggered slot releases defer to the next begin.
@@ -512,6 +572,16 @@ class SimulationService:
                         engine.settle()
                     else:
                         engine.collect_chunk()
+            if spill_plan:
+                # engines are settled (double buffers materialized) and
+                # still marked busy, so verb releases stay deferred and
+                # every peek reads stable state; a session cancelled
+                # during the write is swept under the lock below.  The
+                # tracer is re-activated: this runs outside the round's
+                # activate block, and the spill span belongs to THIS
+                # service's timeline, not whatever is ambient.
+                with obs.activate(self._tracer):
+                    self._run_spill(spill_plan)
         finally:
             with self._lock:
                 for _, engine, _ in plan:
@@ -519,8 +589,72 @@ class SimulationService:
         with self._lock:
             with obs.activate(self._tracer):
                 self.scheduler.round_end(keyer, stats, rolled)
+            if spill_plan:
+                self._sweep_spills(spill_plan)
             self._finish_round(stats)
         return stats
+
+    # -- durable sessions: the spill pass (docs/SERVING.md) -----------------
+    def _spill_plan(self) -> list | None:
+        """Locked: decide whether this round spills and capture what —
+        ``(session, engine, slot)`` for every resident slot (engine=None
+        for queued sessions, whose board is still the submitted copy)."""
+        if self._spill is None:
+            return None
+        self._rounds_since_spill += 1
+        if self._rounds_since_spill < self.config.spill_every:
+            return None
+        self._rounds_since_spill = 0
+        plan = []
+        for key, slots in self.scheduler.running.items():
+            engine = self.scheduler.engines[key]
+            for slot, s in slots.items():
+                plan.append((s, engine, slot))
+        for s in self.scheduler.queue:
+            plan.append((s, None, None))
+        return plan
+
+    def _run_spill(self, plan: list) -> None:
+        """Pump thread, engines settled: write each planned session's
+        newest materialized board + manifest through the checkpoint
+        contract.  Sessions that went terminal since the plan was taken
+        are skipped (and swept under the lock afterwards)."""
+        t0 = time.monotonic()
+        now = self.clock()
+        with obs.span("serve.spill", sessions=len(plan)):
+            for s, engine, slot in plan:
+                if s.state in TERMINAL:
+                    continue
+                if engine is None:
+                    board, lag = s.board, 0
+                else:
+                    board, lag = engine.peek_slot(slot)
+                abs_step = s.start_step + s.steps_done - lag
+                timeout_s = (
+                    None if s.deadline is None else max(0.0, s.deadline - now)
+                )
+                self._spill.save(
+                    s.sid,
+                    board,
+                    abs_step,
+                    rule=s.rule.name,
+                    steps_total=s.start_step + s.steps,
+                    seed=s.seed,
+                    temperature=s.temperature,
+                    timeout_s=timeout_s,
+                )
+        dt = time.monotonic() - t0
+        self._h_snapshot.observe(dt)
+        self._snapshot_s_total += dt
+
+    def _sweep_spills(self, plan: list) -> None:
+        """Locked: drop spills of sessions that reached a terminal state
+        while (or after) the unlocked spill pass wrote them — closes the
+        cancel-races-the-writer window, so no terminal session ever
+        leaves a resumable spill behind."""
+        for s, _, _ in plan:
+            if s.state in TERMINAL:
+                self._spill.delete(s.sid)
 
     def _finish_round(self, stats: RoundStats) -> None:
         """The locked round tail shared by both pump shapes: counters,
@@ -537,6 +671,8 @@ class SimulationService:
         idle_delta = self.scheduler.idle_seconds_delta()
         if idle_delta > 0:
             self._c_device_idle.inc(idle_delta)
+        if self._spill is not None:
+            self._g_spilled.set(float(self._spill.spilled_count()))
         for key, count in self.scheduler.compile_counts().items():
             self._g_compiles.labels(compile_key=_key_bucket(key)).set(count)
         elapsed = self.clock() - self._t0
@@ -560,6 +696,17 @@ class SimulationService:
                 # dispatches, and cumulative engine-idle wall seconds
                 "pipeline_depth": depth,
                 "device_idle_s": self._c_device_idle.value,
+                # the durability stamps (present only with a spill dir):
+                # sessions currently resumable from disk, and cumulative
+                # wall seconds spent writing spills
+                **(
+                    {
+                        "spilled_sessions": self._spill.spilled_count(),
+                        "snapshot_s": self._snapshot_s_total,
+                    }
+                    if self._spill is not None
+                    else {}
+                ),
                 # live distribution snapshots (null until first sample):
                 # the per-round record carries its histograms' quantiles so
                 # a tailing consumer sees latency drift round by round
@@ -635,6 +782,10 @@ class SimulationService:
             "pump": "pipelined" if self.config.pipeline else "sync",
             "pipeline_depth": self._g_pipeline_depth.value,
             "device_idle_seconds": self._c_device_idle.value,
+            "spilled_sessions": (
+                self._spill.spilled_count() if self._spill is not None else 0
+            ),
+            "snapshot_seconds": self._snapshot_s_total,
             "queue_wait_p50": self._h_queue_wait.quantile(0.5),
             "queue_wait_p95": self._h_queue_wait.quantile(0.95),
             "queue_wait_p99": self._h_queue_wait.quantile(0.99),

@@ -66,6 +66,13 @@ class Session:
     # is a full replay record.
     seed: int | None = None
     temperature: float | None = None
+    # failover resume (docs/FLEET.md): absolute steps already completed by
+    # a previous life of this trajectory before this service admitted it.
+    # ``steps`` stays the REMAINING budget this service must run; views
+    # report absolute progress (start_step + …) so a migrated session's
+    # client sees monotone progress across the worker boundary, and the
+    # MC engines re-enter the counter-based stream at the exact position.
+    start_step: int = 0
 
     @property
     def steps_remaining(self) -> int:
@@ -138,11 +145,14 @@ class SessionStore:
 
     def view(self, sid: str) -> SessionView:
         s = self.get(sid)
+        # absolute step space: a resumed session (start_step > 0) reports
+        # total-trajectory progress, so a client polling through a worker
+        # migration sees steps_done only ever grow
         return SessionView(
             sid=s.sid,
             state=s.state,
-            steps=s.steps,
-            steps_done=s.steps_done,
+            steps=s.start_step + s.steps,
+            steps_done=s.start_step + s.steps_done,
             result=s.result,
             error=s.error,
             rule=s.rule.name,

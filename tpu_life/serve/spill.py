@@ -1,0 +1,205 @@
+"""The serve-tier spill store: crash-durable session state on disk.
+
+Durable sessions (docs/SERVING.md "durability", docs/FLEET.md
+"failover") rest on the runtime's crash-consistent snapshot contract
+(``runtime.checkpoint``): a spilled session is a **board file in the
+contract codec** — atomic publish, CRC32 sidecar, intact-check demotion —
+plus a tiny JSON manifest carrying everything a *different* process
+needs to resume the trajectory bit-exactly:
+
+- the rule spec (``get_rule`` round-trips every registered name and
+  parameterized ``noisy:`` spec),
+- the absolute step budget and the PRNG ``seed`` / ising ``temperature``
+  (the counter-based key schedule makes a mid-stream restart re-enter
+  the exact stream — docs/STOCHASTIC.md),
+- the remaining deadline budget at spill time (deadlines are
+  monotonic-clock absolutes and do not survive a process boundary).
+
+The snapshot's own sidecar records the **absolute completed step** the
+board corresponds to, so ``steps remaining = steps_total - step`` and a
+resumed deterministic rule (pure function of the board) or stochastic
+rule (pure function of ``(seed, step, cell, substream)``) finishes
+byte-identical to the uninterrupted run.
+
+Layout: ``<root>/<sid>/board_<step>.txt`` (+ ``.json`` / ``.crc``
+sidecars) and ``<root>/<sid>/manifest.json``.  Retention keeps the
+newest two snapshots per session (``prune_snapshots``); retire / cancel
+/ failure deletes the whole session directory — a spill outliving its
+session is exactly the resurrection bug failover must not have.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from tpu_life.io.codec import read_board
+from tpu_life.runtime.checkpoint import (
+    atomic_publish,
+    list_snapshots,
+    prune_snapshots,
+    save_snapshot,
+    snapshot_intact,
+)
+from tpu_life.runtime.metrics import log
+
+#: Snapshots retained per session (newest N): one extra generation so a
+#: crash mid-publish of the newest still leaves an intact predecessor.
+KEEP_SNAPSHOTS = 2
+
+MANIFEST = "manifest.json"
+
+
+@dataclass(frozen=True)
+class SpillRecord:
+    """One resumable session read back from a spill directory."""
+
+    sid: str  # the spilling worker's own session id
+    rule: str  # rule spec (round-trips through get_rule)
+    board: np.ndarray  # board at ``step`` (int8, contract codec bytes)
+    step: int  # absolute steps completed at the snapshot
+    steps_total: int  # absolute step budget of the whole session
+    seed: int | None
+    temperature: float | None
+    timeout_s: float | None  # deadline budget remaining at spill time
+    height: int
+    width: int
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.steps_total - self.step)
+
+
+class SpillStore:
+    """Per-session spill directories under one root (one root per worker).
+
+    Writes happen on the pump thread only; ``delete`` may be called from
+    verb threads (cancel) — both ends are plain filesystem operations on
+    disjoint per-session directories, and every publish is atomic, so no
+    extra locking is needed beyond the service's own.
+    """
+
+    def __init__(self, root: str | os.PathLike):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        # per-sid steps this store wrote (prune only ever touches its own
+        # writes — the checkpoint retention contract)
+        self._written: dict[str, list[int]] = {}
+
+    def save(
+        self,
+        sid: str,
+        board: np.ndarray,
+        step: int,
+        *,
+        rule: str,
+        steps_total: int,
+        seed: int | None,
+        temperature: float | None,
+        timeout_s: float | None,
+    ) -> bool:
+        """Spill one session's state; returns False when ``step`` is
+        already the newest spilled step (a queued or retire-lagged
+        session — rewriting identical bytes would be pure churn)."""
+        written = self._written.setdefault(sid, [])
+        if written and written[-1] == step:
+            return False
+        d = self.root / sid
+        save_snapshot(d, step, board, rule=rule)
+        manifest = {
+            "sid": sid,
+            "rule": rule,
+            "steps_total": int(steps_total),
+            "seed": seed,
+            "temperature": temperature,
+            "timeout_s": timeout_s,
+            "height": int(board.shape[0]),
+            "width": int(board.shape[1]),
+        }
+        with atomic_publish(d / MANIFEST) as tmp:
+            tmp.write_text(json.dumps(manifest))
+        written.append(step)
+        self._written[sid] = prune_snapshots(d, KEEP_SNAPSHOTS, written)
+        return True
+
+    def delete(self, sid: str) -> None:
+        """Drop a session's spill (terminal transition: done / failed /
+        cancelled) — from here on the session must never resume."""
+        if self._written.pop(sid, None) is not None or (self.root / sid).exists():
+            shutil.rmtree(self.root / sid, ignore_errors=True)
+
+    def spilled_count(self) -> int:
+        return len(self._written)
+
+    def spilled_sids(self) -> list[str]:
+        return list(self._written)
+
+
+def read_spill_sessions(
+    root: str | os.PathLike,
+) -> tuple[list[SpillRecord], list[str]]:
+    """Read every resumable session under a (dead worker's) spill root.
+
+    Returns ``(records, corrupt_sids)``: a session whose manifest is
+    unreadable or whose snapshots all fail the intact check (size + CRC)
+    lands in ``corrupt_sids`` — the migration tier answers those with a
+    typed 410 ``spill_corrupt`` instead of resuming garbage.  A corrupt
+    *newest* snapshot with an intact predecessor demotes silently (the
+    recovery-point moves back one spill interval — the same contract as
+    directory resume).
+    """
+    rootp = Path(root)
+    records: list[SpillRecord] = []
+    corrupt: list[str] = []
+    if not rootp.is_dir():
+        return records, corrupt
+    for d in sorted(p for p in rootp.iterdir() if p.is_dir()):
+        sid = d.name
+        try:
+            meta = json.loads((d / MANIFEST).read_text())
+            height = int(meta["height"])
+            width = int(meta["width"])
+            steps_total = int(meta["steps_total"])
+            rule = str(meta["rule"])
+        except (OSError, ValueError, KeyError, TypeError):
+            log.warning("spill: %s has no readable manifest; corrupt", d)
+            corrupt.append(sid)
+            continue
+        chosen = None
+        for step, f in list_snapshots(d):  # newest first
+            if snapshot_intact(f, height, width):
+                chosen = (step, f)
+                break
+            log.warning("spill: %s failed the intact check; demoting", f)
+        if chosen is None:
+            corrupt.append(sid)
+            continue
+        step, f = chosen
+        try:
+            board = read_board(f, height, width)
+        except (OSError, ValueError):
+            corrupt.append(sid)
+            continue
+        seed = meta.get("seed")
+        temperature = meta.get("temperature")
+        timeout_s = meta.get("timeout_s")
+        records.append(
+            SpillRecord(
+                sid=sid,
+                rule=rule,
+                board=board,
+                step=step,
+                steps_total=steps_total,
+                seed=None if seed is None else int(seed),
+                temperature=None if temperature is None else float(temperature),
+                timeout_s=None if timeout_s is None else float(timeout_s),
+                height=height,
+                width=width,
+            )
+        )
+    return records, corrupt
