@@ -708,6 +708,124 @@ def run_failover_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def _drive_fleet_leg(args, workers: int, placement: str) -> dict:
+    """One fleet measurement: N gateway worker subprocesses behind the
+    router, ``sessions`` boards submitted through the UNMODIFIED client,
+    wall-clocked from first submit to last completion.  Placement
+    ``auto`` gives every worker its own forced-host-device overlay (the
+    CPU-testable MPMD seam); the drain/close runs even when the leg
+    fails, so a bench crash never leaks worker processes."""
+    from tpu_life.fleet import Fleet, FleetConfig
+    from tpu_life.gateway.client import GatewayClient
+    from tpu_life.models.patterns import random_board
+
+    n = args.serve_size
+    steps = args.serve_steps
+    sessions = args.serve_sessions
+    fleet = Fleet(
+        FleetConfig(
+            workers=workers,
+            port=0,
+            worker_args=(
+                "--serve-backend", args.backend,
+                "--capacity", str(args.serve_capacity),
+                "--chunk-steps", str(args.serve_chunk_steps),
+                "--max-queue", str(max(sessions, 1)),
+            ),
+            placement=placement,
+            devices_per_worker=(args.fleet_devices_per_worker,) * workers
+            if placement == "auto"
+            else None,
+            placement_platform="cpu",
+            probe_interval_s=0.1,
+        )
+    )
+    fleet.start()
+    try:
+        if not fleet.wait_ready(timeout=240, min_workers=workers):
+            raise RuntimeError(
+                f"fleet never became ready: {fleet.supervisor.states()}"
+            )
+        client = GatewayClient(f"http://{fleet.host}:{fleet.port}", retries=8)
+        boards = [random_board(n, n, seed=i) for i in range(min(sessions, 8))]
+        # warm every worker's compiled chunk before timing: the legs
+        # compare SCALING, so none may eat a one-time XLA compile inside
+        # its timed window (the failover bench's warmup rule)
+        warm = [
+            client.submit(board=boards[0], rule=args.rule, steps=1)
+            for _ in range(workers * 2)
+        ]
+        for sid in warm:
+            client.wait(sid, timeout=240)
+        t0 = time.monotonic()
+        sids = [
+            client.submit(
+                board=boards[i % len(boards)], rule=args.rule, steps=steps
+            )
+            for i in range(sessions)
+        ]
+        for sid in sids:
+            final = client.wait(sid, timeout=600)
+            if final.get("state") != "done":
+                raise RuntimeError(f"session {sid} ended {final.get('state')}")
+        elapsed = time.monotonic() - t0
+        stats = fleet.stats()
+    finally:
+        fleet.begin_drain()
+        fleet.wait(timeout=60)
+        fleet.close()
+    cells = float(sessions) * steps * n * n
+    return {
+        "workers": workers,
+        "sessions": sessions,
+        "elapsed_s": elapsed,
+        "cells_per_sec": cells / elapsed if elapsed > 0 else 0.0,
+        "sessions_per_sec": sessions / elapsed if elapsed > 0 else 0.0,
+        "routed": stats["routed"],
+        "devices_total": stats["devices_total"],
+    }
+
+
+def run_fleet_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_fleet capture (ISSUE 9): horizontal scaling as one
+    record — aggregate cells/s through an N-worker fleet vs N x one solo
+    worker, with ``scaling_efficiency = fleet / (N * solo)`` stamped.
+    Runs next to the MULTICHIP records: MULTICHIP measures one process
+    sharding a board across chips (SPMD), this measures many single-
+    owner processes behind the router (MPMD, docs/FLEET.md placement).
+
+    The bench process itself stays jax-free (workers are subprocesses
+    that own all device work), so there is no ``_pin_and_verify`` leg —
+    the platform/degraded stamps come from the probe like every record.
+    """
+    placement = "auto" if platform == "cpu" else "none"
+    solo = _drive_fleet_leg(args, 1, placement)
+    fleet_leg = _drive_fleet_leg(args, args.fleet_workers, placement)
+    ideal = args.fleet_workers * solo["cells_per_sec"]
+    return {
+        "metric": "fleet_cells_per_sec",
+        "value": fleet_leg["cells_per_sec"],
+        "unit": "cells/s",
+        "rule": args.rule,
+        "platform": platform,
+        "backend": args.backend,
+        "size": args.serve_size,
+        "steps": args.serve_steps,
+        "sessions": args.serve_sessions,
+        "batch_capacity": args.serve_capacity,
+        "chunk_steps": args.serve_chunk_steps,
+        "workers": args.fleet_workers,
+        "placement": placement,
+        "devices_per_worker": args.fleet_devices_per_worker,
+        "solo": solo,
+        "fleet": fleet_leg,
+        "scaling_efficiency": (
+            fleet_leg["cells_per_sec"] / ideal if ideal > 0 else 0.0
+        ),
+        "degraded": degraded,
+    }
+
+
 def run_mc_bench(args, platform: str, degraded: bool) -> dict:
     """The BENCH_mc capture: Metropolis checkerboard sweep throughput
     (sweeps/s and spin-updates/s) through the stochastic tier
@@ -959,6 +1077,19 @@ def main() -> None:
                    "recovery timing (emits serve_failover_rounds_per_sec)")
     p.add_argument("--failover-spill-every", type=int, default=2,
                    help="rounds between spill passes in the spill-on leg")
+    # the BENCH_fleet capture (ISSUE 9): aggregate cells/s through an
+    # N-worker fleet vs N x one solo worker — the horizontal-scaling
+    # (MPMD) twin of the MULTICHIP (SPMD) records
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet-scaling bench: the serve session mix "
+                   "through an N-worker fleet vs N x a solo worker "
+                   "(emits fleet_cells_per_sec with scaling_efficiency)")
+    p.add_argument("--fleet-workers", type=int, default=2,
+                   help="workers in the scaled leg (the solo leg is "
+                   "always 1)")
+    p.add_argument("--fleet-devices-per-worker", type=int, default=1,
+                   help="forced host devices per worker when the bench "
+                   "runs with --placement auto semantics on cpu")
     # the BENCH_mc capture: Metropolis sweep throughput through the
     # stochastic tier (sweeps/s, spin-updates/s; docs/STOCHASTIC.md)
     p.add_argument("--mc", action="store_true",
@@ -1055,7 +1186,7 @@ def main() -> None:
     if args.base_steps is None:
         args.base_steps = 100 if on_accel else DEGRADED_BASE_STEPS
     if (
-        not (args.serve or args.serve_pipeline or args.failover)
+        not (args.serve or args.serve_pipeline or args.failover or args.fleet)
         and args.steps <= args.base_steps
     ):
         p.error("--steps must be greater than --base-steps (delta timing)")
@@ -1083,7 +1214,7 @@ def main() -> None:
     # The serve bench defaults to the vmapped jax engine on every platform
     # (the batched path is the thing being measured).
     if args.backend is None:
-        if args.serve or args.serve_pipeline or args.failover or args.mc:
+        if args.serve or args.serve_pipeline or args.failover or args.fleet or args.mc:
             # the vmapped/fused single-device XLA path is the thing being
             # measured on both service-shaped benches
             args.backend = "jax"
@@ -1119,6 +1250,8 @@ def main() -> None:
             result = run_serve_pipeline_bench(args, platform, degraded)
         elif args.failover:
             result = run_failover_bench(args, platform, degraded)
+        elif args.fleet:
+            result = run_fleet_bench(args, platform, degraded)
         elif args.serve:
             result = run_serve_bench(args, platform, degraded)
         elif args.mc:
@@ -1150,12 +1283,17 @@ def main() -> None:
                     cmd += [flag, str(value)]
             if args.no_bitpack:
                 cmd.append("--no-bitpack")
-            if args.serve or args.serve_pipeline or args.failover:
+            if args.serve or args.serve_pipeline or args.failover or args.fleet:
                 # the retry must measure the same MODE, not fall back to
                 # the kernel bench and mislabel the record
                 if args.failover:
                     cmd += ["--failover", "--failover-spill-every",
                             str(args.failover_spill_every)]
+                elif args.fleet:
+                    cmd += ["--fleet",
+                            "--fleet-workers", str(args.fleet_workers),
+                            "--fleet-devices-per-worker",
+                            str(args.fleet_devices_per_worker)]
                 else:
                     cmd.append(
                         "--serve-pipeline" if args.serve_pipeline else "--serve"
@@ -1186,6 +1324,9 @@ def main() -> None:
         elif args.failover:
             metric, unit = "serve_failover_rounds_per_sec", "rounds/s"
             size, steps = args.serve_size, args.serve_steps
+        elif args.fleet:
+            metric, unit = "fleet_cells_per_sec", "cells/s"
+            size, steps = args.serve_size, args.serve_steps
         elif args.serve:
             metric, unit = "serve_sessions_per_sec", "sessions/s"
             size, steps = args.serve_size, args.serve_steps
@@ -1207,9 +1348,11 @@ def main() -> None:
             "degraded_reason": "error",
             "error": repr(e)[:500],
         }
-        if args.serve or args.serve_pipeline or args.failover:
+        if args.serve or args.serve_pipeline or args.failover or args.fleet:
             failure["sessions"] = args.serve_sessions
             failure["batch_capacity"] = args.serve_capacity
+            if args.fleet:
+                failure["workers"] = args.fleet_workers
         elif args.mc:
             # the replay record must name what the run actually used:
             # the measured rule, and None temperature for non-ising rules

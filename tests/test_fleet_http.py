@@ -301,3 +301,53 @@ def test_fleet_cli_sigterm_drains_to_exit_zero(tmp_path):
     assert merged["serve"]["runs_merged"] == 2
     assert merged["serve"]["sessions_done"] == 4
     assert set(merged["runs"]) == set(merged["run_ids"])
+
+
+def test_heterogeneous_placement_routes_by_capacity(make_fleet):
+    """The ISSUE 9 acceptance spread: 2 CPU workers under placement
+    ``auto`` with forced host device counts (1, 4) report DISTINCT
+    capacities back through their startup lines, and weighted
+    least-depth routes sessions ~1:4 toward the bigger worker."""
+    fleet, client = make_fleet(
+        workers=2,
+        placement="auto",
+        devices_per_worker=(1, 4),
+        placement_platform="cpu",
+    )
+    caps = fleet.supervisor.capacities()
+    by_devices = {caps[w]["devices"]: w for w in caps}
+    assert set(by_devices) == {1, 4}, caps
+    big, small = by_devices[4], by_devices[1]
+    assert caps[big]["weight"] == 4.0 and caps[small]["weight"] == 1.0
+
+    # /healthz surfaces the capacity block + the aggregate chip count
+    health = client.healthz()
+    assert health["capacity"][big]["devices"] == 4, health
+    assert health["devices_total"] == 5, health
+
+    # each worker's /readyz carries its own resolved count/kind
+    for name, expect in ((big, 4), (small, 1)):
+        worker = fleet.supervisor.get(name)
+        with urllib.request.urlopen(worker.url + "/readyz", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["devices"] == expect, (name, doc)
+        assert doc["device_kind"] == "cpu", doc
+
+    # 10 quick sessions, each drained before the next submit (depths
+    # stay equal), spread by smooth weighted round-robin: 8 on the
+    # 4-chip worker, 2 on the 1-chip one
+    for i in range(10):
+        sid = client.submit(size=16, steps=2, seed=i)
+        assert client.wait(sid, timeout=120)["state"] == "done"
+    routed = fleet.stats()["routed"]
+    assert routed.get(small, 0) >= 1, routed
+    assert routed[big] >= 3 * routed[small], (
+        f"weighted routing must favor the 4-chip worker ~4:1, got {routed}"
+    )
+
+    # observability: the per-worker devices gauge rides the merged
+    # exposition, and the fleet summary carries the aggregate
+    merged = client.metrics()
+    assert f'fleet_worker_devices{{worker="{big}"}} 4' in merged
+    assert f'fleet_worker_devices{{worker="{small}"}} 1' in merged
+    assert fleet.stats()["devices_total"] == 5

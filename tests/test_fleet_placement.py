@@ -1,0 +1,491 @@
+"""Per-worker device placement units (docs/FLEET.md "Device placement").
+
+All fakes, no subprocesses: the planner's slice arithmetic, the env
+overlay merge, the supervisor's re-apply-on-restart and placed-worker
+fail-fast, the capacity-weighted balancer's spread, and the sticky-pin
+eviction fix for migrated sids.  tests/test_fleet_http.py carries the
+real-process heterogeneous-spread leg.
+"""
+
+import json
+
+import pytest
+
+from tpu_life import obs
+from tpu_life.fleet.balancer import LeastDepthBalancer
+from tpu_life.fleet.placement import (
+    HOST_DEVICE_FLAG,
+    PlacementError,
+    apply_env_overlay,
+    parse_devices_per_worker,
+    plan_placements,
+)
+from tpu_life.fleet.registry import SessionRegistry
+from tpu_life.fleet.supervisor import (
+    FleetConfig,
+    Supervisor,
+    WorkerState,
+    worker_weight,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- the planner -------------------------------------------------------------
+def test_plan_cpu_forces_host_device_counts():
+    plans = plan_placements(2, platform="cpu", devices_per_worker=(1, 4))
+    assert [p.devices for p in plans] == [1, 4]
+    assert all(p.kind == "cpu" and p.device_ids is None for p in plans)
+    assert plans[0].env["JAX_PLATFORMS"] == "cpu"
+    assert plans[0].env["XLA_FLAGS"] == f"{HOST_DEVICE_FLAG}=1"
+    assert plans[1].env["XLA_FLAGS"] == f"{HOST_DEVICE_FLAG}=4"
+    # auto on cpu: one forced host device each
+    assert [p.devices for p in plan_placements(3, platform="cpu")] == [1, 1, 1]
+
+
+def test_plan_accelerator_slices_are_disjoint_with_remainder():
+    # 10 chips over 4 workers: 3/3/2/2 — the remainder goes to the first
+    # workers, no chip idles, and every id appears exactly once
+    plans = plan_placements(4, platform="tpu", total_devices=10)
+    assert [p.devices for p in plans] == [3, 3, 2, 2]
+    ids = [d for p in plans for d in p.device_ids]
+    assert ids == sorted(ids) == list(range(10)), "slices must tile 0..9"
+    assert plans[0].env["TPU_VISIBLE_DEVICES"] == "0,1,2"
+    assert plans[3].env["TPU_VISIBLE_DEVICES"] == "8,9"
+    # explicit undersubscription is allowed (spare chips stay unassigned)
+    plans = plan_placements(2, platform="gpu", devices_per_worker=(1, 2),
+                            total_devices=8)
+    assert plans[1].env["CUDA_VISIBLE_DEVICES"] == "1,2"
+    assert plans[0].env["JAX_PLATFORMS"] == "cuda"
+
+
+def test_plan_failure_modes_are_typed_placement_errors():
+    with pytest.raises(PlacementError, match="oversubscribes"):
+        plan_placements(2, platform="tpu", devices_per_worker=(4, 4),
+                        total_devices=4)
+    with pytest.raises(PlacementError, match="at least one"):
+        plan_placements(5, platform="tpu", total_devices=4)
+    with pytest.raises(PlacementError, match="total-devices"):
+        plan_placements(2, platform="tpu")  # jax-free front can't count
+    with pytest.raises(PlacementError, match="unknown placement platform"):
+        plan_placements(2, platform="quantum", total_devices=2)
+
+
+def test_parse_devices_per_worker():
+    assert parse_devices_per_worker(None, 3) is None
+    assert parse_devices_per_worker("4", 3) == (4, 4, 4)
+    assert parse_devices_per_worker("1,4", 2) == (1, 4)
+    with pytest.raises(PlacementError, match="one count, or exactly one"):
+        parse_devices_per_worker("1,2,3", 2)
+    with pytest.raises(PlacementError, match=">= 1"):
+        parse_devices_per_worker("0", 2)
+    with pytest.raises(PlacementError, match="int or comma list"):
+        parse_devices_per_worker("lots", 2)
+
+
+def test_apply_env_overlay_appends_xla_flags_and_replaces_the_rest():
+    env = {
+        "XLA_FLAGS": f"--xla_foo {HOST_DEVICE_FLAG}=8",
+        "TPU_VISIBLE_DEVICES": "0,1,2,3",
+    }
+    apply_env_overlay(
+        env,
+        {"XLA_FLAGS": f"{HOST_DEVICE_FLAG}=2", "TPU_VISIBLE_DEVICES": "5"},
+    )
+    # the operator's unrelated flag survives; the stale forced-count
+    # token (which the overlay owns) is replaced, not duplicated
+    assert env["XLA_FLAGS"] == f"--xla_foo {HOST_DEVICE_FLAG}=2"
+    assert env["TPU_VISIBLE_DEVICES"] == "5"
+    # an empty overlay (placement none) is byte-for-byte identity
+    before = dict(env)
+    assert apply_env_overlay(env, {}) == before
+
+
+# -- the supervisor seam -----------------------------------------------------
+def make_placed_supervisor(tmp_path, *, devices=(1, 4), die_on_spawn=()):
+    """A 2-worker supervisor on fakes with placement auto: spawn records
+    the overlay it was handed per generation; workers named in
+    ``die_on_spawn`` are born dead (the invalid-slice startup crash)."""
+
+    class FakeProc:
+        def __init__(self, rc=None):
+            self.rc = rc
+
+        def poll(self):
+            return self.rc
+
+        def wait(self, timeout=None):
+            return self.rc
+
+        def kill(self):
+            self.rc = -9
+
+        def terminate(self):
+            self.rc = 0
+
+        def die(self, rc=1):
+            self.rc = rc
+
+    clock = FakeClock()
+    spawned: dict[str, list[dict]] = {}
+    procs: dict[str, FakeProc] = {}
+    probe_answers: dict[str, str] = {}
+
+    def spawn(w):
+        spawned.setdefault(w.name, []).append(dict(w.env_overlay))
+        procs[w.name] = w.proc = FakeProc(
+            rc=1 if w.name in die_on_spawn else None
+        )
+        w.url = f"http://fake/{w.name}/g{w.generation}"
+        probe_answers.setdefault(w.name, "ready")
+
+    cfg = FleetConfig(
+        workers=2,
+        log_dir=str(tmp_path / "logs"),
+        placement="auto",
+        devices_per_worker=tuple(devices),
+        placement_platform="cpu",
+        backoff_base_s=1.0,
+        breaker_threshold=5,
+        healthy_after_s=10.0,
+    )
+    s = Supervisor(
+        cfg,
+        obs.MetricsRegistry(),
+        spawn=spawn,
+        probe=lambda w: probe_answers.get(w.name, "unreachable"),
+        clock=clock,
+    )
+    with s._lock:
+        for w in s.workers:
+            s._spawn_worker(w, first=True)
+    s.tick()
+    return s, clock, procs, spawned
+
+
+def test_placement_none_keeps_the_shared_env(tmp_path):
+    cfg = FleetConfig(workers=2, log_dir=str(tmp_path / "logs"))
+    s = Supervisor(cfg, obs.MetricsRegistry(), spawn=lambda w: None,
+                   probe=lambda w: "ready")
+    assert s.placements is None
+    assert all(w.env_overlay == {} for w in s.workers), (
+        "placement none must spawn into the inherited env byte-for-byte"
+    )
+    assert all(w.devices is None for w in s.workers)
+
+
+def test_invalid_plan_fails_fast_at_construction(tmp_path):
+    # the typed error fires BEFORE any spawn: the restart budget is
+    # never burned respawning into a deterministically bad env
+    spawns = []
+    with pytest.raises(PlacementError, match="oversubscribes"):
+        Supervisor(
+            FleetConfig(
+                workers=2,
+                log_dir=str(tmp_path / "logs"),
+                placement="auto",
+                devices_per_worker=(4, 4),
+                placement_platform="tpu",
+                total_devices=4,
+            ),
+            obs.MetricsRegistry(),
+            spawn=spawns.append,
+            probe=lambda w: "ready",
+        )
+    assert spawns == []
+    with pytest.raises(PlacementError, match="unknown placement policy"):
+        Supervisor(
+            FleetConfig(workers=2, log_dir=str(tmp_path / "l2"),
+                        placement="sideways"),
+            obs.MetricsRegistry(),
+            spawn=spawns.append,
+            probe=lambda w: "ready",
+        )
+
+
+def test_restart_reapplies_the_same_slice(tmp_path):
+    s, clock, procs, spawned = make_placed_supervisor(tmp_path)
+    w1 = s.get("w1")
+    assert w1.devices == 4 and w1.device_kind == "cpu"
+    first_overlay = spawned["w1"][0]
+    assert first_overlay["XLA_FLAGS"] == f"{HOST_DEVICE_FLAG}=4"
+    assert s.workers[0].state is WorkerState.READY
+    # crash w1 after it was healthy, let the backoff elapse, respawn
+    procs["w1"].die(rc=1)
+    clock.t = 100.0
+    s.tick()
+    clock.t = 102.0
+    s.tick()
+    assert w1.generation == 2
+    assert spawned["w1"][1] == first_overlay, (
+        "a respawn must re-enter the dead worker's exact device slice"
+    )
+    assert w1.devices == 4, "the planned capacity survives the restart"
+    # the per-worker devices gauge tracks both slices
+    assert s._g_devices.labels(worker="w0").value == 1.0
+    assert s._g_devices.labels(worker="w1").value == 4.0
+
+
+def test_placed_worker_that_never_readies_fails_fast(tmp_path):
+    s, clock, procs, spawned = make_placed_supervisor(
+        tmp_path, die_on_spawn=("w1",)
+    )
+    w1 = s.get("w1")
+    assert w1.state is WorkerState.FAILED, (
+        "a placed worker dead at startup must open its breaker on the "
+        "FIRST exit (typed placement failure), not crash-loop"
+    )
+    assert w1.generation == 1 and len(spawned["w1"]) == 1
+    assert s.restarts() == 0.0
+    clock.t += 1000.0
+    s.tick()
+    assert w1.generation == 1, "FAILED means never respawned"
+    # the healthy placed worker is untouched
+    assert s.get("w0").state is WorkerState.READY
+
+
+def test_startup_line_reports_override_the_plan(tmp_path):
+    s, clock, procs, spawned = make_placed_supervisor(tmp_path)
+    w0 = s.get("w0")
+    log_doc = {
+        "mode": "gateway",
+        "url": "http://127.0.0.1:9999",
+        "run_id": "abc",
+        "devices": 2,
+        "device_kind": "tpu",
+    }
+    w0.log_path.parent.mkdir(parents=True, exist_ok=True)
+    w0.log_path.write_text(json.dumps(log_doc) + "\n")
+    w0.log_offset = 0
+    assert s._read_startup(w0) == log_doc
+    # the liveness pass applies the report: resolved beats planned
+    w0.url = None
+    w0.state = WorkerState.STARTING
+    s.tick()
+    assert w0.devices == 2 and w0.device_kind == "tpu"
+    assert w0.url == "http://127.0.0.1:9999"
+
+
+def test_capacities_view_and_worker_weight(tmp_path):
+    s, *_ = make_placed_supervisor(tmp_path)
+    caps = s.capacities()
+    assert caps["w0"] == {"devices": 1, "device_kind": "cpu", "weight": 1.0}
+    assert caps["w1"] == {"devices": 4, "device_kind": "cpu", "weight": 4.0}
+    # an unreported worker routes as a single-chip peer, never as zero
+    w = s.get("w0")
+    w.devices = None
+    assert worker_weight(w) == 1.0
+
+
+# -- the weighted balancer ---------------------------------------------------
+class FakeWorker:
+    def __init__(self, name, generation=1, devices=1):
+        self.name = name
+        self.generation = generation
+        self.devices = devices
+
+
+def test_weighted_balancer_spreads_idle_fleet_by_capacity():
+    """The acceptance ratio on fakes: a 4-chip worker absorbs ~4x the
+    sessions of a 1-chip worker when depths are equal (smooth WRR)."""
+    bal = LeastDepthBalancer(
+        lambda w: 0.0,
+        ttl_s=0.0,
+        clock=FakeClock(),
+        weight=lambda w: float(w.devices),
+    )
+    small, big = FakeWorker("w0", devices=1), FakeWorker("w1", devices=4)
+    first = [bal.candidates([small, big])[0].name for _ in range(10)]
+    assert first.count("w1") == 8 and first.count("w0") == 2, first
+
+
+def test_weighted_balancer_normalizes_depth_by_capacity():
+    depths = {"w0": 1.0, "w1": 2.0}
+    bal = LeastDepthBalancer(
+        lambda w: depths[w.name],
+        ttl_s=0.0,
+        clock=FakeClock(),
+        weight=lambda w: float(w.devices),
+    )
+    small, big = FakeWorker("w0", devices=1), FakeWorker("w1", devices=4)
+    # raw least-depth would pick w0 (1 < 2); normalized, w1's 2/4=0.5
+    # beats w0's 1/1=1.0 — the 4-chip worker drains its deeper queue faster
+    assert [w.name for w in bal.candidates([small, big])] == ["w1", "w0"]
+
+
+def test_weighted_balancer_follows_live_weight_changes():
+    # the weight callable reads the CURRENT worker state: a startup-line
+    # report (or a heterogeneous restart) retargets routing immediately
+    bal = LeastDepthBalancer(
+        lambda w: 0.0,
+        ttl_s=0.0,
+        clock=FakeClock(),
+        weight=lambda w: float(w.devices),
+    )
+    a, b = FakeWorker("w0", devices=1), FakeWorker("w1", devices=1)
+    [bal.candidates([a, b]) for _ in range(2)]
+    b.devices = 9
+    first = [bal.candidates([a, b])[0].name for _ in range(10)]
+    assert first.count("w1") >= 8, first
+
+
+def test_weighted_balancer_departed_worker_forfeits_credit():
+    bal = LeastDepthBalancer(
+        lambda w: 0.0, ttl_s=0.0, clock=FakeClock(),
+        weight=lambda w: float(w.devices),
+    )
+    a, b = FakeWorker("w0", devices=1), FakeWorker("w1", devices=4)
+    bal.candidates([a, b])
+    bal.candidates([a])  # b left the rotation
+    assert set(bal._credits) == {"w0"}
+
+
+# -- the sticky-pin eviction fix (PR 8 known limit) --------------------------
+def test_migrated_pin_survives_lru_churn():
+    """Regression (ISSUE 9 satellite): a MIGRATED sid's pin is the only
+    record of its survivor home — LRU eviction used to degrade it to the
+    encoded DEAD home and a spurious 410.  Ordinary pins must evict
+    around it."""
+    reg = SessionRegistry(max_pins=2)
+    fsid = reg.pin("w0", 1, "s000000")
+    reg.repin(fsid, "w1", 1, "s000007")  # rescued onto the survivor
+    for i in range(1, 6):  # churn far past the cap
+        reg.pin("w0", 1, f"s{i:06d}")
+    pin = reg.resolve(fsid)
+    assert (pin.worker, pin.generation, pin.sid) == ("w1", 1, "s000007"), (
+        "a rescued session must stay reachable through routine pin churn"
+    )
+    assert len(reg) == 2, "the memory bound still holds"
+
+
+def test_all_sticky_registry_still_bounds_memory():
+    reg = SessionRegistry(max_pins=2)
+    fsids = [reg.pin("w0", 1, f"s{i:06d}") for i in range(3)]
+    for i, fsid in enumerate(fsids):
+        reg.repin(fsid, "w1", 1, f"s{i + 10:06d}")
+    assert len(reg) == 2, "sticky pins must not break the absolute cap"
+    # the evicted (oldest) sticky pin degrades to the encoded home — the
+    # documented trade when the registry is overrun by migrations alone
+    assert reg.resolve(fsids[0]).worker == "w0"
+    assert reg.resolve(fsids[2]).worker == "w1"
+
+
+def test_forget_releases_stickiness():
+    reg = SessionRegistry(max_pins=2)
+    fsid = reg.pin("w0", 1, "s000000")
+    reg.repin(fsid, "w1", 1, "s000009")
+    reg.forget(fsid)
+    assert fsid not in reg._sticky
+    assert reg.resolve(fsid).worker == "w0"  # back to the parse fallback
+
+
+def test_supervisor_recycle_kill_is_not_a_placement_failure(tmp_path):
+    """A supervisor-initiated kill (startup timeout / unready recycle)
+    of a never-ready placed worker may be nothing more than a slow
+    device attach: it must ride the normal restart budget, NOT the
+    placement fail-fast."""
+    s, clock, procs, spawned = make_placed_supervisor(tmp_path)
+    w0 = s.get("w0")
+    # simulate the unready-recycle path: the supervisor kills it
+    w0.ever_ready = False
+    w0.recycling = True
+    procs["w0"].kill()
+    clock.t = 100.0
+    s.tick()
+    assert w0.state is not WorkerState.FAILED, (
+        "a self-inflicted kill must take the backoff/restart path"
+    )
+    assert w0.failures == 1
+    # ...and the respawn clears the flag, so a subsequent SELF-crash
+    # before ever-ready does fail fast
+    clock.t = 102.0
+    s.tick()
+    assert w0.generation == 2 and w0.recycling is False
+    procs["w0"].die(rc=1)
+    clock.t = 103.0
+    s.tick()
+    assert w0.state is WorkerState.FAILED
+
+
+def test_probe_tuple_reports_capacity_after_startup(tmp_path):
+    """Device resolution is async in the worker: a readyz that grows
+    devices/device_kind AFTER the startup line must still reach the
+    supervisor (the default probe forwards the readyz body)."""
+    s, clock, procs, spawned = make_placed_supervisor(tmp_path)
+    w0 = s.get("w0")
+    assert w0.devices == 1  # the planned value
+    s._apply_probe(w0, ("ready", {"devices": 3, "device_kind": "tpu"}), 0.0)
+    assert w0.devices == 3 and w0.device_kind == "tpu"
+    # a bare-string answer (injected fakes, draining) still works
+    s._apply_probe(w0, "draining", 0.0)
+    assert w0.state is WorkerState.DRAINING
+
+
+def test_stats_devices_total_not_double_counted_across_generations():
+    """A fleet worker's sink spans its restarts (fresh run_id per
+    generation): the devices aggregate must count each SINK once —
+    last snapshot wins — not once per dead generation."""
+    from tpu_life.obs.stats import summarize
+
+    def snap(run_id, value, sink):
+        return {"kind": "metric", "run_id": run_id, "_sink": sink,
+                "metric": "serve_devices", "type": "gauge", "value": value}
+
+    recs = [
+        # w0's sink: gen 1 crashed, gen 2 (live) re-entered the slice
+        snap("gen1", 4, 0), snap("gen2", 4, 0),
+        # w1's sink: one generation
+        snap("solo", 1, 1),
+    ]
+    assert summarize(recs)["serve"]["devices_total"] == 5
+    # without sink provenance (records handed in raw) run_id still keys
+    raw = [{k: v for k, v in r.items() if k != "_sink"} for r in recs[1:]]
+    assert summarize(raw)["serve"]["devices_total"] == 5
+
+
+def test_devices_total_sums_only_disjoint_slices(tmp_path):
+    """The capacity-planning aggregate: placed slices are disjoint and
+    SUM; shared-env workers (placement none) co-claim ONE device set, so
+    the honest aggregate is that set's size, not workers x it."""
+    s, *_ = make_placed_supervisor(tmp_path)
+    assert s.devices_total() == 5  # 1 + 4, disjoint by construction
+    shared = Supervisor(
+        FleetConfig(workers=4, log_dir=str(tmp_path / "shared")),
+        obs.MetricsRegistry(),
+        spawn=lambda w: None,
+        probe=lambda w: "ready",
+    )
+    for w in shared.workers:
+        w.devices = 4  # every worker resolved the SAME 4-chip host
+    assert shared.devices_total() == 4, (
+        "a shared device set must be counted once, not per claimant"
+    )
+
+
+def test_weighted_balancer_credits_stay_bounded_under_depth_imbalance():
+    """Sustained depth imbalance pins routing to one worker; the WRR
+    credits must stay bounded through it (the leader pays, nginx-style)
+    so the spread does not burst-invert when depths re-equalize."""
+    depths = {"w0": 0.0, "w1": 8.0}
+    bal = LeastDepthBalancer(
+        lambda w: depths[w.name],
+        ttl_s=0.0,
+        clock=FakeClock(),
+        weight=lambda w: float(w.devices),
+    )
+    small, big = FakeWorker("w0", devices=1), FakeWorker("w1", devices=4)
+    for _ in range(200):
+        assert bal.candidates([small, big])[0].name == "w0"  # depth wins
+    total = 5.0
+    assert all(abs(c) <= total for c in bal._credits.values()), bal._credits
+    depths["w1"] = 0.0  # the long session finished: depths equal again
+    first = [bal.candidates([small, big])[0].name for _ in range(10)]
+    assert first.count("w1") == 8 and first.count("w0") == 2, (
+        f"the spread must return straight to capacity ratio, got {first}"
+    )

@@ -347,6 +347,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="health-check cadence (liveness + /readyz)")
     fl.add_argument("--platform", default=None,
                     help="force a JAX platform in every worker (cpu/tpu)")
+    fl.add_argument("--placement", default="none", choices=["auto", "none"],
+                    help="per-worker device placement (docs/FLEET.md): "
+                    "auto assigns each worker a DISJOINT device slice as "
+                    "an env overlay (JAX_PLATFORMS + visible-device vars; "
+                    "on cpu, forced host device counts — fully testable "
+                    "without chips) so an N-worker accelerator fleet "
+                    "stops fighting over one device set; none keeps "
+                    "today's shared spawning env byte-for-byte")
+    fl.add_argument("--devices-per-worker", default=None, metavar="K[,K...]",
+                    help="devices per worker for --placement auto: one "
+                    "count for all workers, or a comma list with exactly "
+                    "one count per worker (e.g. 1,4 for a heterogeneous "
+                    "pair); default: an even split")
+    fl.add_argument("--total-devices", type=int, default=None, metavar="N",
+                    help="how many devices the host has (tpu/gpu "
+                    "placement only — the jax-free fleet front cannot "
+                    "count chips itself); oversubscribing it is a typed "
+                    "placement error at startup, before any worker spawns")
     fl.add_argument("--verbose", "-v", action="store_true")
 
     cl = sub.add_parser(
@@ -949,8 +967,13 @@ def _stats(args) -> int:
     from tpu_life.obs import stats as obs_stats
 
     records = []
-    for path in args.metrics_file:
-        records.extend(obs_stats.load_records(path))
+    for i, path in enumerate(args.metrics_file):
+        for rec in obs_stats.load_records(path):
+            # sink provenance: one file = one worker across ALL its
+            # restarts (each a fresh run_id) — the devices aggregate
+            # needs the worker identity, not the generation's
+            rec.setdefault("_sink", i)
+            records.append(rec)
     summary = obs_stats.summarize(records)
     if args.json:
         print(json.dumps(summary))
@@ -1345,20 +1368,26 @@ def _gateway(args) -> int:
     )
     gw.install_signal_handlers()
     gw.start()
-    print(
-        json.dumps(
-            {
-                "mode": "gateway",
-                "url": f"http://{gw.host}:{gw.port}",
-                "run_id": svc.run_id,
-                "backend": args.serve_backend,
-                "capacity": args.capacity,
-                "max_queue": args.max_queue,
-                "api_rate": args.api_rate,
-            }
-        ),
-        flush=True,
-    )
+    # a fleet supervisor reads the resolved device count/kind from this
+    # line to weight routing (docs/FLEET.md placement).  Resolution runs
+    # on a background thread; wait a BOUNDED beat for it — on CPU (and
+    # any healthy attach) it lands well inside this — but a slow or
+    # wedged accelerator must not delay the startup line past the
+    # supervisor's startup timeout: the fields are simply omitted and
+    # the supervisor picks them up from /readyz once they exist.
+    startup = {
+        "mode": "gateway",
+        "url": f"http://{gw.host}:{gw.port}",
+        "run_id": svc.run_id,
+        "backend": args.serve_backend,
+        "capacity": args.capacity,
+        "max_queue": args.max_queue,
+        "api_rate": args.api_rate,
+    }
+    info = gw.device_info(wait_s=10.0)
+    if info is not None:
+        startup["devices"], startup["device_kind"] = info
+    print(json.dumps(startup), flush=True)
     try:
         gw.wait()
     finally:
@@ -1406,6 +1435,7 @@ def _fleet(args) -> int:
     import json
 
     from tpu_life.fleet import Fleet, FleetConfig, WorkerState
+    from tpu_life.fleet.placement import PlacementError, parse_devices_per_worker
     from tpu_life.runtime.metrics import configure_logging
 
     configure_logging(args.verbose)
@@ -1425,24 +1455,53 @@ def _fleet(args) -> int:
         worker_args += ["--platform", args.platform]
     if args.verbose:
         worker_args += ["--verbose"]
-    fleet = Fleet(
-        FleetConfig(
-            workers=args.workers,
-            host=args.host,
-            port=args.port,
-            worker_args=tuple(worker_args),
-            metrics_dir=args.metrics_dir,
-            log_dir=args.log_dir,
-            spill_dir=args.spill_dir,
-            spill_every=args.spill_every,
-            probe_interval_s=args.probe_interval,
-            backoff_base_s=args.restart_backoff,
-            # the flag counts RESTARTS; the breaker counts consecutive
-            # failures, of which the initial crash is the first — so N
-            # permitted restarts means the breaker opens on failure N+1
-            breaker_threshold=args.max_restarts + 1,
+    try:
+        if args.placement == "none" and (
+            args.devices_per_worker is not None or args.total_devices is not None
+        ):
+            raise PlacementError(
+                "--devices-per-worker/--total-devices have no effect "
+                "without --placement auto — pass it explicitly (refusing "
+                "to silently keep the shared spawning env)"
+            )
+        fleet = Fleet(
+            FleetConfig(
+                workers=args.workers,
+                host=args.host,
+                port=args.port,
+                worker_args=tuple(worker_args),
+                metrics_dir=args.metrics_dir,
+                log_dir=args.log_dir,
+                spill_dir=args.spill_dir,
+                spill_every=args.spill_every,
+                probe_interval_s=args.probe_interval,
+                backoff_base_s=args.restart_backoff,
+                # the flag counts RESTARTS; the breaker counts consecutive
+                # failures, of which the initial crash is the first — so N
+                # permitted restarts means the breaker opens on failure N+1
+                breaker_threshold=args.max_restarts + 1,
+                placement=args.placement,
+                devices_per_worker=parse_devices_per_worker(
+                    args.devices_per_worker, args.workers
+                ),
+                total_devices=args.total_devices,
+                placement_platform=args.platform or "cpu",
+            )
         )
-    )
+    except PlacementError as e:
+        # a plan that can never come up healthy fails FAST and typed —
+        # before any worker process exists, never via the restart budget
+        print(
+            json.dumps(
+                {
+                    "mode": "fleet",
+                    "error": {"code": "placement_invalid", "message": str(e)},
+                }
+            ),
+            flush=True,
+        )
+        print(f"fleet: placement error: {e}", file=sys.stderr)
+        return 2
     fleet.install_signal_handlers()
     fleet.start()
     print(
@@ -1456,6 +1515,12 @@ def _fleet(args) -> int:
                 "capacity": args.capacity,
                 "max_queue": args.max_queue,
                 "log_dir": str(fleet.supervisor.log_dir),
+                "placement": args.placement,
+                # planned devices per worker (the startup view; workers
+                # overwrite with what their jax init actually resolved)
+                "devices": {
+                    w.name: w.devices for w in fleet.supervisor.workers
+                },
             }
         ),
         flush=True,
@@ -1481,6 +1546,10 @@ def _fleet(args) -> int:
                 "routed": stats["routed"],
                 "retries": stats["retries"],
                 "sessions_pinned": stats["sessions_pinned"],
+                # per-worker resolved devices + routing weights, and the
+                # fleet's aggregate chip count (docs/FLEET.md placement)
+                "capacity": stats["capacity"],
+                "devices_total": stats["devices_total"],
                 # worker-death migrations by outcome (present only with
                 # --spill-dir): migrated / corrupt / failed
                 **(

@@ -22,9 +22,13 @@ board is byte-identical to the uninterrupted run (docs/FLEET.md
 SIGTERM -> the router stops admitting, every worker drains gracefully,
 processes are reaped, and the CLI exits 0.
 
-Total capacity is ``workers x per-worker batch capacity``; the ROADMAP's
-"heavy traffic" story is this tier stamped out behind a real load
-balancer.
+With ``placement="auto"`` each worker also owns a DISJOINT device slice
+(env overlay via the planner in ``fleet.placement``; restarts re-enter
+the same slice), reports its resolved capacity back, and the router
+weights least-depth routing by it — so total capacity is ``sum(per-worker
+chips x per-worker batch capacity)`` and a multi-chip host is saturated
+by one fleet; the ROADMAP's "heavy traffic" story is this tier stamped
+out behind a real load balancer.
 """
 
 from __future__ import annotations
@@ -34,6 +38,12 @@ import time
 from tpu_life import obs
 from tpu_life.fleet.balancer import LeastDepthBalancer
 from tpu_life.fleet.migrate import Migrator
+from tpu_life.fleet.placement import (
+    Placement,
+    PlacementError,
+    parse_devices_per_worker,
+    plan_placements,
+)
 from tpu_life.fleet.registry import SessionRegistry
 from tpu_life.fleet.router import Router, merge_prom_texts
 from tpu_life.fleet.supervisor import (
@@ -123,6 +133,7 @@ class Fleet:
                 "fleet_routed_total", labels=("worker",)
             ).series()
         }
+        capacity = self.supervisor.capacities()
         out = {
             "run_id": self.run_id,
             "workers": self.supervisor.states(),
@@ -131,6 +142,11 @@ class Fleet:
             "routed": routed,
             "retries": self.registry.counter("fleet_retry_total").value,
             "sessions_pinned": len(self.sessions),
+            # device placement (docs/FLEET.md): per-worker resolved
+            # devices/kind + routing weight, and the aggregate chip
+            # count (sums only when placement makes slices disjoint)
+            "capacity": capacity,
+            "devices_total": self.supervisor.devices_total(),
         }
         if self.migrator is not None:
             out["migrations"] = {
@@ -147,10 +163,14 @@ __all__ = [
     "FleetConfig",
     "LeastDepthBalancer",
     "Migrator",
+    "Placement",
+    "PlacementError",
     "Router",
     "SessionRegistry",
     "Supervisor",
     "Worker",
     "WorkerState",
     "merge_prom_texts",
+    "parse_devices_per_worker",
+    "plan_placements",
 ]

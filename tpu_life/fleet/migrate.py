@@ -14,9 +14,19 @@ to a :class:`Migrator`, which:
    snapshot is recorded ``spill_corrupt``),
 3. re-submits each as a **resume request** (``resume_b64`` +
    ``start_step`` + remaining budget + seed/temperature) to a survivor —
-   refusal-only retry, exactly the router's own no-duplicate rule — and
-4. re-pins the ORIGINAL fleet sid onto the survivor's session, so the
-   unmodified PR 4 client polls straight through the kill.
+   refusal-only retry, exactly the router's own no-duplicate rule, and
+   through the same capacity-WEIGHTED balancer, so a rescued batch
+   lands on survivors in proportion to their device slices — and
+4. re-pins the ORIGINAL fleet sid onto the survivor's session (a STICKY
+   pin: LRU churn evicts ordinary pins around it, because the sid
+   string encodes the DEAD home and a parse-fallback would answer a
+   spurious 410), so the unmodified PR 4 client polls straight through
+   the kill.
+
+Placement interplay (docs/FLEET.md "Device placement"): the supervisor
+re-applies the dead worker's env overlay verbatim when it respawns, so
+the fresh generation re-enters the SAME device slice while its former
+sessions finish on survivors — capacity returns without re-planning.
 
 Bit-identity is inherited, not re-proven: deterministic rules are pure
 functions of the board, and the MC tier's ``(seed, step, cell,

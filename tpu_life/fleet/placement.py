@@ -1,0 +1,208 @@
+"""Per-worker device placement: disjoint device slices as env overlays.
+
+The MPMD seam (docs/FLEET.md "Device placement"): every fleet worker is an
+independently-compiled gateway process, so giving each one its OWN device
+subset turns ``--workers N`` on a multi-chip host from "N claimants
+fighting over the same chips" into N single-owner programs behind the
+thin router — the many-workers-one-coordinator shape the TPU-cluster
+Ising work scales by.
+
+The planner never touches jax (the fleet front tier stays jax-free): a
+placement is just an **environment overlay** the supervisor applies when
+spawning the worker — the worker's own jax init resolves it, and the
+worker reports what it actually got back through its startup line and
+``/readyz`` (the capacity-feedback half, ``fleet.balancer``).
+
+Overlay semantics by platform kind:
+
+=========  ====================================================  =========
+kind       overlay                                               disjoint?
+=========  ====================================================  =========
+``cpu``    ``JAX_PLATFORMS=cpu`` +                               synthetic
+           ``XLA_FLAGS=--xla_force_host_platform_device_count=K``
+``tpu``    ``JAX_PLATFORMS=tpu`` + ``TPU_VISIBLE_DEVICES=i,...``  real ids
+``gpu``    ``JAX_PLATFORMS=cuda`` + ``CUDA_VISIBLE_DEVICES=...``  real ids
+=========  ====================================================  =========
+
+CPU placement forces K *host* devices per worker (XLA's fake-device
+platform) — there is nothing to collide on, so any K per worker is
+valid and the whole multi-"chip" seam is testable on CPU CI.  TPU/GPU
+placement slices real integer device ids ``0..total_devices-1`` into
+disjoint contiguous runs, worker order; an explicit per-worker request
+that oversubscribes the host is a :class:`PlacementError` at PLAN time —
+before any process is spawned — because respawning into the same bad env
+can never succeed (the fail-fast contract ``fleet --max-restarts``
+relies on).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: The XLA flag that fakes K host devices on the CPU platform — the knob
+#: that makes multi-"chip" placement fully testable on CPU CI.
+HOST_DEVICE_FLAG = "--xla_force_host_platform_device_count"
+
+#: Platform kind -> (JAX_PLATFORMS value, visible-device env var).  CPU is
+#: special-cased (synthetic devices via XLA_FLAGS, no visibility var).
+_ACCEL_ENV = {
+    "tpu": ("tpu", "TPU_VISIBLE_DEVICES"),
+    "gpu": ("cuda", "CUDA_VISIBLE_DEVICES"),
+    "cuda": ("cuda", "CUDA_VISIBLE_DEVICES"),
+}
+
+
+class PlacementError(ValueError):
+    """A device-placement plan that can never come up healthy: wrong
+    worker/device arithmetic, an oversubscribed host, or an unknown
+    platform kind.  Raised at PLAN time (fleet construction) so the
+    supervisor never burns its restart budget respawning a worker into
+    an env that is deterministically broken."""
+
+
+@dataclass(frozen=True)
+class Placement:
+    """One worker's planned slice: how many devices, which kind, which
+    concrete ids (None for CPU's synthetic host devices), and the env
+    overlay that realizes it in the spawned process."""
+
+    worker: str
+    devices: int
+    kind: str
+    device_ids: tuple[int, ...] | None
+    env: dict
+
+
+def parse_devices_per_worker(spec: str | None, workers: int) -> tuple[int, ...] | None:
+    """``--devices-per-worker`` parser: ``"4"`` = 4 for every worker,
+    ``"1,4"`` = per-worker counts (length must equal ``workers``)."""
+    if spec is None:
+        return None
+    try:
+        counts = tuple(int(part) for part in str(spec).split(","))
+    except ValueError:
+        raise PlacementError(
+            f"--devices-per-worker must be an int or comma list, got {spec!r}"
+        ) from None
+    if any(c < 1 for c in counts):
+        raise PlacementError(
+            f"every per-worker device count must be >= 1, got {spec!r}"
+        )
+    if len(counts) == 1:
+        return counts * workers
+    if len(counts) != workers:
+        raise PlacementError(
+            f"--devices-per-worker lists {len(counts)} counts for "
+            f"{workers} workers (give one count, or exactly one per worker)"
+        )
+    return counts
+
+
+def plan_placements(
+    workers: int,
+    *,
+    platform: str = "cpu",
+    devices_per_worker: tuple[int, ...] | None = None,
+    total_devices: int | None = None,
+) -> list[Placement]:
+    """Assign every worker a disjoint device subset; raises
+    :class:`PlacementError` for any plan that cannot come up healthy.
+
+    ``devices_per_worker`` is per-worker (already normalized — see
+    :func:`parse_devices_per_worker`); None auto-splits.  CPU auto is one
+    forced host device each; accelerator auto splits ``total_devices``
+    evenly with the remainder going to the first workers (so a 10-chip
+    host under 4 workers plans 3/3/2/2 — no chip idles).  Explicit
+    accelerator counts may undersubscribe (spare chips stay unassigned
+    for other tenants) but never oversubscribe.
+    """
+    if workers < 1:
+        raise PlacementError(f"workers must be >= 1, got {workers}")
+    if devices_per_worker is not None and len(devices_per_worker) != workers:
+        raise PlacementError(
+            f"devices_per_worker has {len(devices_per_worker)} entries "
+            f"for {workers} workers"
+        )
+    if platform == "cpu":
+        counts = devices_per_worker or (1,) * workers
+        return [
+            Placement(
+                worker=f"w{i}",
+                devices=k,
+                kind="cpu",
+                device_ids=None,
+                env={
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": f"{HOST_DEVICE_FLAG}={k}",
+                },
+            )
+            for i, k in enumerate(counts)
+        ]
+    if platform not in _ACCEL_ENV:
+        raise PlacementError(
+            f"unknown placement platform {platform!r} "
+            f"(expected cpu, tpu, or gpu)"
+        )
+    if total_devices is None or total_devices < 1:
+        raise PlacementError(
+            f"{platform} placement needs --total-devices (the fleet front "
+            f"tier is jax-free and cannot count the host's chips itself)"
+        )
+    if devices_per_worker is None:
+        base, extra = divmod(total_devices, workers)
+        if base == 0:
+            raise PlacementError(
+                f"{workers} workers over {total_devices} {platform} "
+                f"device(s): every worker needs at least one — use fewer "
+                f"workers or --placement none"
+            )
+        counts = tuple(base + (1 if i < extra else 0) for i in range(workers))
+    else:
+        counts = devices_per_worker
+        if sum(counts) > total_devices:
+            raise PlacementError(
+                f"devices_per_worker={counts} oversubscribes the host: "
+                f"{sum(counts)} requested, {total_devices} available"
+            )
+    jax_platform, visible_var = _ACCEL_ENV[platform]
+    plans: list[Placement] = []
+    cursor = 0
+    for i, k in enumerate(counts):
+        ids = tuple(range(cursor, cursor + k))
+        cursor += k
+        plans.append(
+            Placement(
+                worker=f"w{i}",
+                devices=k,
+                kind=platform,
+                device_ids=ids,
+                env={
+                    "JAX_PLATFORMS": jax_platform,
+                    visible_var: ",".join(str(d) for d in ids),
+                },
+            )
+        )
+    return plans
+
+
+def apply_env_overlay(env: dict, overlay: dict) -> dict:
+    """Merge a placement overlay into a spawn environment, in place.
+
+    ``XLA_FLAGS`` is additive by contract (a space-separated flag list an
+    operator may already be using), so the overlay's flags are APPENDED —
+    after stripping any existing forced-host-device-count token, which
+    the overlay owns.  Every other overlay var replaces the inherited
+    value outright (a worker's visible-device set must be exactly its
+    slice, not a merge with whatever the parent had).
+    """
+    for key, value in overlay.items():
+        if key == "XLA_FLAGS":
+            inherited = [
+                tok
+                for tok in env.get("XLA_FLAGS", "").split()
+                if not tok.startswith(HOST_DEVICE_FLAG + "=")
+            ]
+            env[key] = " ".join(inherited + [value]) if inherited else value
+        else:
+            env[key] = value
+    return env

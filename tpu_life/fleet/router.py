@@ -5,8 +5,10 @@ Clients talk to the router exactly as they would to a single gateway —
 the unmodified ``GatewayClient`` works against it — and the router
 forwards:
 
-- ``POST /v1/sessions``: pick a worker (least queue depth, TTL-cached
-  ``/metrics`` scrape, ties rotated), forward the body verbatim, pin the
+- ``POST /v1/sessions``: pick a worker (weighted least queue depth —
+  depth normalized by the worker's resolved device count — from a
+  TTL-cached ``/metrics`` scrape, ties spread by smooth weighted
+  round-robin), forward the body verbatim, pin the
   returned sid in the session registry, and answer with the namespaced
   fleet sid (``w1g2-s000042`` — worker, generation, worker's own sid).  A worker that *refuses* — connection
   refused (the request was never seen) or a typed 503 (shedding /
@@ -40,7 +42,13 @@ from urllib.parse import urlsplit
 from tpu_life.fleet import errors as fl_errors
 from tpu_life.fleet.balancer import LeastDepthBalancer, prom_value
 from tpu_life.fleet.registry import SessionRegistry
-from tpu_life.fleet.supervisor import FleetConfig, Supervisor, Worker, WorkerState
+from tpu_life.fleet.supervisor import (
+    FleetConfig,
+    Supervisor,
+    Worker,
+    WorkerState,
+    worker_weight,
+)
 from tpu_life.gateway import errors as gw_errors
 from tpu_life.gateway.errors import ApiError, parse_retry_after
 from tpu_life.gateway.server import ROUTE_SESSIONS, JsonHandler
@@ -76,8 +84,11 @@ class Router:
         self.config = config
         self.supervisor = supervisor
         self.sessions = sessions
+        # weighted least-depth (docs/FLEET.md "Device placement"): depth
+        # is normalized by the worker's resolved device count, so a
+        # 4-chip worker absorbs ~4x the sessions of a 1-chip peer
         self.balancer = LeastDepthBalancer(
-            self._fetch_depth, ttl_s=config.depth_ttl_s
+            self._fetch_depth, ttl_s=config.depth_ttl_s, weight=worker_weight
         )
         self._c_routed = registry.counter(
             "fleet_routed_total", "sessions routed, by worker", labels=("worker",)
@@ -456,8 +467,21 @@ class _Handler(JsonHandler):
         api_key = self.headers.get("X-API-Key")
         if path == "/healthz":
             self._require(method, "GET", path)
+            capacity = rt.supervisor.capacities()
             self._send_json(
-                200, {"status": "ok", "workers": rt.supervisor.states()}
+                200,
+                {
+                    "status": "ok",
+                    "workers": rt.supervisor.states(),
+                    # per-worker resolved devices + routing weight, and
+                    # the fleet's aggregate device count — the capacity-
+                    # planning numbers (docs/FLEET.md "Device placement";
+                    # per-worker counts SUM only when placement makes the
+                    # slices disjoint — shared-env workers co-claim one
+                    # device set and report its size, once)
+                    "capacity": capacity,
+                    "devices_total": rt.supervisor.devices_total(),
+                },
             )
             return
         if path == "/readyz":

@@ -21,6 +21,17 @@ under parallel CI).  A monitor thread ticks every ``probe_interval_s``:
   each gateway finishes its in-flight sessions and exits 0 — and stops
   restarting; ``drained()`` turns true once every process is reaped.
 
+With ``placement="auto"`` the supervisor also owns the **per-worker
+device seam** (docs/FLEET.md "Device placement"): a planner assigns each
+worker a disjoint device slice as an env overlay
+(``fleet.placement``), applied at every spawn — so a restart or recycle
+re-enters the dead worker's exact slice — and each worker's startup line
+reports the device count/kind its own jax init actually resolved, which
+feeds the capacity-weighted balancer.  A placed worker that dies without
+EVER becoming ready fails fast (typed :class:`PlacementError`, breaker
+OPEN) instead of burning the restart budget respawning into the same
+deterministically bad env.
+
 Everything is injectable (``spawn``, ``probe``, ``clock``) so the restart
 and breaker logic unit-test with fake processes and a fake clock; the
 default implementations spawn real ``sys.executable -m tpu_life gateway``
@@ -43,6 +54,11 @@ import urllib.request
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from tpu_life.fleet.placement import (
+    PlacementError,
+    apply_env_overlay,
+    plan_placements,
+)
 from tpu_life.gateway import protocol
 from tpu_life.runtime.metrics import log
 
@@ -85,6 +101,20 @@ class FleetConfig:
     spill_dir: str | None = None
     spill_every: int = 4  # rounds between worker spill passes
     migrate_timeout_s: float = 30.0  # per-session resume budget on death
+    #: device placement (docs/FLEET.md "Device placement"): ``"none"``
+    #: keeps today's shared spawning env byte-for-byte; ``"auto"`` plans a
+    #: disjoint device slice per worker and applies it as an env overlay
+    #: at every spawn (restarts re-apply the dead worker's slice)
+    placement: str = "none"
+    #: per-worker device counts for the planner (normalized: one entry
+    #: per worker); None = auto split (one forced host device each on
+    #: cpu, an even slice of ``total_devices`` on accelerators)
+    devices_per_worker: tuple[int, ...] | None = None
+    #: how many real devices the host has (tpu/gpu placement only — the
+    #: jax-free front tier cannot count chips itself)
+    total_devices: int | None = None
+    #: platform kind the planner targets (cpu / tpu / gpu)
+    placement_platform: str = "cpu"
 
 
 @dataclass
@@ -104,6 +134,21 @@ class Worker:
     unready: int = 0  # consecutive failed probes while alive
     log_offset: int = 0  # startup line scan starts here (per generation)
     exit_codes: list[int] = field(default_factory=list)
+    #: placement env overlay applied at every spawn of this worker —
+    #: stable across generations, so a restart re-enters the SAME slice
+    env_overlay: dict = field(default_factory=dict)
+    #: resolved device count/kind, reported by the worker's startup line
+    #: (planned values until the first report lands)
+    devices: int | None = None
+    device_kind: str | None = None
+    #: True once ANY generation answered ready — the placed-worker
+    #: fail-fast gate (a slice that never came up is presumed invalid)
+    ever_ready: bool = False
+    #: True while the SUPERVISOR is killing this worker (startup timeout,
+    #: unready recycle): that exit is self-inflicted — possibly just a
+    #: slow attach — and must ride the restart budget, never the
+    #: placement fail-fast
+    recycling: bool = False
 
     @property
     def alive(self) -> bool:
@@ -138,6 +183,27 @@ class Supervisor:
             Worker(name=f"w{i}", log_path=log_dir / f"w{i}.log")
             for i in range(config.workers)
         ]
+        # device placement (docs/FLEET.md): plan ONCE, at construction —
+        # an invalid plan (oversubscribed slice, unknown platform) raises
+        # the typed PlacementError here, before any process exists, so a
+        # deterministically broken env never burns the restart budget
+        self.placements = None
+        if config.placement == "auto":
+            self.placements = plan_placements(
+                config.workers,
+                platform=config.placement_platform,
+                devices_per_worker=config.devices_per_worker,
+                total_devices=config.total_devices,
+            )
+            for w, p in zip(self.workers, self.placements):
+                w.env_overlay = dict(p.env)
+                w.devices = p.devices  # planned; startup line overwrites
+                w.device_kind = p.kind
+        elif config.placement != "none":
+            raise PlacementError(
+                f"unknown placement policy {config.placement!r} "
+                f"(expected auto or none)"
+            )
         #: worker-death callback: ``cb(name, generation)`` fires (under
         #: the supervisor lock — keep it fast) for every non-drain exit;
         #: the fleet wires the migrator's spill rescue here
@@ -149,6 +215,11 @@ class Supervisor:
             "fleet_restarts_total", "worker respawns after a crash"
         )
         self._c_restarts.labels()
+        self._g_devices = registry.gauge(
+            "fleet_worker_devices",
+            "devices resolved by each worker (planned until reported)",
+            labels=("worker",),
+        )
         for st in WorkerState:
             self._g_workers.labels(state=st.value).set(0.0)
 
@@ -272,6 +343,33 @@ class Supervisor:
                 out[w.name] = st.value
             return out
 
+    def capacities(self) -> dict:
+        """Per-worker capacity view for ``/healthz`` / ``stats``: resolved
+        (or planned) device count + kind, and the routing weight the
+        balancer normalizes queue depth by."""
+        with self._lock:
+            return {
+                w.name: {
+                    "devices": w.devices,
+                    "device_kind": w.device_kind,
+                    "weight": worker_weight(w),
+                }
+                for w in self.workers
+            }
+
+    def devices_total(self) -> int:
+        """The fleet's aggregate device count — the capacity-planning
+        number.  Slices are disjoint only under placement auto, so only
+        then do per-worker counts SUM; under the shared spawning env
+        (placement none) every worker co-claims ONE device set, and the
+        honest aggregate is that set's size (the max report), not
+        workers x it."""
+        with self._lock:
+            values = [w.devices or 0 for w in self.workers]
+        if self.placements is not None:
+            return sum(values)
+        return max(values, default=0)
+
     def restarts(self) -> float:
         return self._c_restarts.value
 
@@ -359,23 +457,50 @@ class Supervisor:
                 self._spawn_worker(w)
             return False  # freshly spawned: startup line read next tick
         if w.state is WorkerState.STARTING and w.url is None:
-            w.url, w.run_id = self._read_startup(w)
-            if w.url is None:
+            doc = self._read_startup(w)
+            if doc is None:
                 if now - w.started_at > self.config.startup_timeout_s:
                     log.warning(
                         "fleet: %s produced no startup line in %.0fs; killing",
                         w.name,
                         self.config.startup_timeout_s,
                     )
+                    w.recycling = True
                     w.proc.kill()
                 return False
-            log.info("fleet: %s gen %d at %s", w.name, w.generation, w.url)
+            w.url = doc["url"]
+            w.run_id = doc.get("run_id")
+            # the capacity-feedback half of placement: what the worker's
+            # OWN jax init resolved wins over the planner's intent — the
+            # balancer weights by what the chips actually came up as
+            if doc.get("devices"):
+                w.devices = int(doc["devices"])
+                w.device_kind = doc.get("device_kind") or w.device_kind
+            log.info(
+                "fleet: %s gen %d at %s (%s device(s), kind %s)",
+                w.name,
+                w.generation,
+                w.url,
+                w.devices if w.devices is not None else "?",
+                w.device_kind or "?",
+            )
         return True
 
-    def _apply_probe(self, w: Worker, status: str, now: float) -> None:
+    def _apply_probe(self, w: Worker, status, now: float) -> None:
+        # the default probe answers ("ready", <readyz doc>) so capacity
+        # reported AFTER the startup line (device resolution is async in
+        # the worker — a slow attach must not block its readiness) still
+        # reaches the balancer; injected fakes may answer plain strings
+        info = None
+        if isinstance(status, tuple):
+            status, info = status
         if status == "ready":
             w.state = WorkerState.READY
+            w.ever_ready = True
             w.unready = 0
+            if isinstance(info, dict) and info.get("devices"):
+                w.devices = int(info["devices"])
+                w.device_kind = info.get("device_kind") or w.device_kind
             if w.failures and now - w.started_at >= self.config.healthy_after_s:
                 w.failures = 0  # survived long enough: breaker resets
         elif status == "draining":
@@ -385,6 +510,7 @@ class Supervisor:
             if w.state is WorkerState.STARTING:
                 if now - w.started_at > self.config.startup_timeout_s:
                     log.warning("fleet: %s never became ready; killing", w.name)
+                    w.recycling = True
                     w.proc.kill()
                 return
             w.unready += 1
@@ -394,6 +520,7 @@ class Supervisor:
                     w.name,
                     w.unready,
                 )
+                w.recycling = True
                 w.proc.kill()
 
     def _on_exit(self, w: Worker, now: float) -> None:
@@ -415,6 +542,27 @@ class Supervisor:
                 self.on_worker_exit(w.name, w.generation)
             except Exception:  # pragma: no cover - the hook must not kill reaping
                 log.exception("fleet: worker-exit hook failed for %s", w.name)
+        if w.env_overlay and not w.ever_ready and not w.recycling:
+            # a PLACED worker that died ON ITS OWN without ever answering
+            # ready: its device slice is presumed invalid
+            # (oversubscription the planner could not see, a hostile
+            # visible-device var, ...).  The overlay is re-applied
+            # verbatim on every respawn, so retrying is deterministic
+            # failure — fail fast with the typed placement error instead
+            # of burning the restart budget respawning into the same bad
+            # env.  A supervisor-initiated kill (startup timeout, unready
+            # recycle — ``recycling``) is excluded: that may be nothing
+            # more than a slow device attach, and it takes the normal
+            # restart/backoff/breaker path like an unplaced worker.
+            w.failures += 1
+            w.state = WorkerState.FAILED
+            err = PlacementError(
+                f"worker {w.name} exited rc={rc} before ever becoming "
+                f"ready under placement overlay {w.env_overlay!r} — the "
+                f"device slice appears invalid; not respawning"
+            )
+            log.error("fleet: %s circuit breaker OPEN (placement): %s", w.name, err)
+            return
         uptime = now - w.started_at
         w.failures = w.failures + 1 if uptime < self.config.healthy_after_s else 1
         if w.failures >= self.config.breaker_threshold:
@@ -455,6 +603,7 @@ class Supervisor:
         w.url = None
         w.run_id = None
         w.unready = 0
+        w.recycling = False
         w.state = WorkerState.STARTING
         if not first:
             self._c_restarts.inc()
@@ -464,6 +613,8 @@ class Supervisor:
         counts = {st: 0 for st in WorkerState}
         for w in self.workers:
             counts[w.state] += 1
+            if w.devices is not None:
+                self._g_devices.labels(worker=w.name).set(float(w.devices))
         for st, n in counts.items():
             self._g_workers.labels(state=st.value).set(float(n))
 
@@ -506,6 +657,10 @@ class Supervisor:
             if env.get("PYTHONPATH")
             else pkg_root
         )
+        if w.env_overlay:
+            # the placement seam: this worker's device slice, re-applied
+            # verbatim at every spawn so a restart re-enters the SAME env
+            apply_env_overlay(env, w.env_overlay)
         w.log_offset = w.log_path.stat().st_size if w.log_path.exists() else 0
         with open(w.log_path, "ab") as logf:
             w.proc = subprocess.Popen(
@@ -519,15 +674,16 @@ class Supervisor:
             )
         log.debug("fleet: spawned %s gen %d pid %d", w.name, w.generation, w.proc.pid)
 
-    def _read_startup(self, w: Worker) -> tuple[str | None, str | None]:
+    def _read_startup(self, w: Worker) -> dict | None:
         """Scan the worker's log (from this generation's offset) for the
-        gateway startup JSON line; returns (url, run_id) or (None, None)."""
+        gateway startup JSON line; returns the parsed line (url, run_id,
+        resolved devices/device_kind, ...) or None."""
         try:
             with open(w.log_path, "rb") as f:
                 f.seek(w.log_offset)
                 data = f.read()
         except OSError:
-            return None, None
+            return None
         for raw in data.split(b"\n")[:-1]:  # complete lines only
             raw = raw.strip()
             if not raw.startswith(b"{"):
@@ -537,20 +693,34 @@ class Supervisor:
             except json.JSONDecodeError:
                 continue
             if doc.get("mode") == "gateway" and "url" in doc:
-                return doc["url"], doc.get("run_id")
-        return None, None
+                return doc
+        return None
 
-    def _default_probe(self, w: Worker) -> str:
+    def _default_probe(self, w: Worker):
         if w.url is None:
             return "unreachable"
         try:
             req = urllib.request.Request(w.url + "/readyz")
-            with urllib.request.urlopen(req, timeout=1.0):
-                return "ready"
+            with urllib.request.urlopen(req, timeout=1.0) as resp:
+                try:
+                    doc = json.loads(resp.read())
+                except (json.JSONDecodeError, OSError):
+                    doc = {}
+                # carry the readyz body: it grows devices/device_kind
+                # once the worker's async device resolution lands
+                return ("ready", doc)
         except urllib.error.HTTPError as e:
             return "draining" if e.code == 503 else "unreachable"
         except Exception:
             return "unreachable"
+
+
+def worker_weight(w: Worker) -> float:
+    """The capacity weight weighted-least-depth routing normalizes queue
+    depth by: the worker's resolved device count (planned until its
+    startup line reports), never below 1 — a worker that has not said
+    what it owns routes as a single-chip peer, not as zero capacity."""
+    return float(max(1, w.devices or 1))
 
 
 def propagate_signals(on_signal) -> None:

@@ -100,6 +100,16 @@ class Gateway:
         )
         self._c_limited.labels()
         self._c_shed.labels()
+        # resolved device count of this worker's backend (docs/FLEET.md
+        # "Device placement"): set on first resolution, so it lands in
+        # /metrics, the prom snapshot, AND the final JSONL registry
+        # snapshot — which is how `tpu-life stats` sums a fleet's
+        # aggregate device count from the per-worker sinks
+        self._g_devices = registry.gauge(
+            "serve_devices", "devices visible to this worker's backend"
+        )
+        self._device_info: tuple[int, str] | None = None
+        self._device_thread: threading.Thread | None = None
         self.buckets = KeyedBuckets(self.config.api_rate, self.config.api_burst)
         high_water = self.config.shed_high_water
         if high_water is None:
@@ -135,6 +145,10 @@ class Gateway:
         )
         self._pump_thread.start()
         self._serve_thread.start()
+        self._device_thread = threading.Thread(
+            target=self._resolve_devices, name="gateway-devices", daemon=True
+        )
+        self._device_thread.start()
         log.info(
             "gateway listening on http://%s:%d (run_id=%s)",
             self.host,
@@ -219,6 +233,32 @@ class Gateway:
                     break
         self._drained.set()
         self._server.shutdown()
+
+    def device_info(self, wait_s: float = 0.0) -> tuple[int, str] | None:
+        """``(devices, kind)`` this worker's backend resolved, or None
+        while resolution is still in flight — what the startup line and
+        ``/readyz`` report to a fleet supervisor.
+
+        Resolution runs on a BACKGROUND thread kicked off by
+        :meth:`start`: the first device query can take minutes on a
+        slow accelerator attach (and 180 s on a wedged plugin), and
+        blocking the startup line or a readiness probe on it would get
+        the worker killed by its supervisor's startup timeout — the
+        exact worker this seam exists to place.  Callers that can
+        afford a bounded wait (the CLI's startup line) pass ``wait_s``;
+        probes pass 0 and report the fields once they exist.
+        """
+        t = self._device_thread
+        if self._device_info is None and t is not None and wait_s > 0:
+            t.join(wait_s)
+        return self._device_info
+
+    def _resolve_devices(self) -> None:
+        from tpu_life.utils.platform import device_info
+
+        info = device_info()
+        self._g_devices.set(float(info[0]))
+        self._device_info = info
 
     def wake(self) -> None:
         self._wake.set()
@@ -416,7 +456,15 @@ class _Handler(JsonHandler):
                 retry_after=1.0,
             )
             return 503
-        self._send_json(200, {"ready": True, "draining": False})
+        body = {"ready": True, "draining": False}
+        info = self.gw.device_info()  # non-blocking: None while resolving
+        if info is not None:
+            # capacity feedback for a fleet supervisor: what THIS
+            # worker's backend resolved (docs/FLEET.md placement).  The
+            # fields appear once resolution lands — readiness must never
+            # block behind a slow (or wedged) accelerator attach.
+            body["devices"], body["device_kind"] = info
+        self._send_json(200, body)
         return 200
 
     def _metrics(self) -> int:

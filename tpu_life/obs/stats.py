@@ -251,7 +251,17 @@ def summarize(records: list[dict]) -> dict:
     if metrics:
         summary["metrics"] = []
         counters = {}
+        devices_by_worker: dict = {}
         for rec in metrics:
+            if rec["metric"] == "serve_devices" and rec.get("value"):
+                # keyed by SINK when the loader stamped one (a fleet
+                # worker's file spans its restarts, each generation a
+                # fresh run_id — summing per run_id would double-count
+                # the dead generations' chips), else by run_id; either
+                # way the LAST snapshot per key wins (the live one)
+                devices_by_worker[
+                    rec.get("_sink", rec.get("run_id"))
+                ] = rec["value"]
             entry = {
                 "metric": rec["metric"],
                 "type": rec["type"],
@@ -294,6 +304,18 @@ def summarize(records: list[dict]) -> dict:
         if submitted or rejected:
             summary.setdefault("serve", {})["rejection_rate"] = (
                 rejected / (submitted + rejected) if (submitted + rejected) else 0.0
+            )
+        if devices_by_worker:
+            # the fleet's aggregate device count: each worker snapshot
+            # carries its own resolved serve_devices gauge, and the
+            # workers ran concurrently, so the fleet owns the sum.
+            # CAVEAT: the sum assumes DISJOINT device slices (placement
+            # auto); sinks carry no placement record, so shared-env
+            # workers (placement none) co-claiming one device set are
+            # counted once each — the router's /healthz devices_total is
+            # the authoritative number in that mode (docs/FLEET.md)
+            summary.setdefault("serve", {})["devices_total"] = int(
+                sum(devices_by_worker.values())
             )
 
     return summary
@@ -357,6 +379,8 @@ def render(summary: dict) -> str:
             )
         if "rejection_rate" in serve:
             lines.append(f"  rejection_rate={_fmt(serve['rejection_rate'])}")
+        if "devices_total" in serve:
+            lines.append(f"  devices_total={_fmt(serve['devices_total'])}")
     runs = summary.get("runs")
     if runs:
         lines.append("per run:")

@@ -65,3 +65,23 @@ def devices_with_watchdog(timeout_s: float | None = None):
     if "error" in result:
         raise result["error"]
     return result["devices"]
+
+
+def device_info(timeout_s: float | None = None) -> tuple[int, str]:
+    """``(device_count, platform_kind)`` of the default JAX backend.
+
+    The capacity-reporting half of per-worker placement (docs/FLEET.md):
+    a gateway worker resolves what its (possibly overlaid) environment
+    actually gave it — e.g. ``XLA_FLAGS=--xla_force_host_platform_
+    device_count=4`` under ``JAX_PLATFORMS=cpu`` resolves to ``(4,
+    "cpu")`` — and reports it in its startup line and ``/readyz`` so the
+    fleet balancer can weight routing by real capacity.  Goes through
+    :func:`devices_with_watchdog` (a wedged plugin must degrade the
+    report, not hang the worker); any failure reports ``(1, "host")`` —
+    a worker that cannot say what it owns routes as a single-chip peer.
+    """
+    try:
+        devices = devices_with_watchdog(timeout_s)
+        return len(devices), devices[0].platform
+    except Exception:  # noqa: BLE001 — reporting must never kill a worker
+        return 1, "host"
