@@ -49,6 +49,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -655,7 +656,7 @@ def run_failover_bench(args, platform: str, degraded: bool) -> dict:
         for _ in range(3):
             victim.pump()  # progress + spills, then "SIGKILL" (abandon)
         t0 = time.monotonic()
-        records, _corrupt = read_spill_sessions(recover_dir)
+        records, _corrupt, _disabled = read_spill_sessions(recover_dir)
         survivor = SimulationService(
             ServeConfig(
                 capacity=args.serve_capacity,
@@ -822,6 +823,86 @@ def run_fleet_bench(args, platform: str, degraded: bool) -> dict:
         "scaling_efficiency": (
             fleet_leg["cells_per_sec"] / ideal if ideal > 0 else 0.0
         ),
+        "degraded": degraded,
+    }
+
+
+def run_chaos_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_chaos capture (docs/CHAOS.md): throughput-under-faults
+    as one record — the seeded chaos drill (injected spill/socket/engine
+    faults + a SIGKILL) next to a fault-free twin of the same workload,
+    with per-kill recovery times and the invariant verdicts stamped.
+    Every number is replayable: the record carries the chaos seed and
+    the plan digest (the seed-stamping contract of the stochastic tier,
+    applied to robustness numbers).
+
+    Like the fleet bench, the bench process stays jax-free — workers
+    are numpy-engine subprocesses, so the capture runs anywhere CI does.
+    """
+    import tempfile
+
+    from tpu_life.chaos import ChaosPlan
+    from tpu_life.chaos.drill import DEFAULT_POINTS, DrillConfig, run_drill
+
+    def leg(points, kills, tag):
+        workdir = tempfile.mkdtemp(prefix=f"tpu-life-bench-chaos-{tag}-")
+        try:
+            summary = run_drill(
+                DrillConfig(
+                    seed=args.chaos_seed,
+                    workers=args.chaos_workers,
+                    det_sessions=6,
+                    ising_sessions=2,
+                    steps=args.serve_steps * 20,
+                    kills=kills,
+                    points=points,
+                    workdir=workdir,
+                )
+            )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return {
+            "ok": summary["ok"],
+            "sessions": summary["sessions"],
+            "delivered": summary["delivered"],
+            "resubmits": summary["resubmits"],
+            "outcomes": summary["outcomes"],
+            "injections": summary["injections"],
+            "kills": summary["kills"],
+            "recovery_s_max": summary["recovery_s_max"],
+            "elapsed_s": summary["elapsed_s"],
+            "sessions_per_sec": summary["sessions_per_sec"],
+        }
+
+    fault_free = leg({}, 0, "clean")
+    chaotic = leg(None, args.chaos_kills, "chaos")  # None = DEFAULT_POINTS
+    recoveries = sorted(
+        k["recovery_s"]
+        for k in chaotic["kills"]
+        if k.get("recovery_s") is not None
+    )
+    plan = ChaosPlan(args.chaos_seed, DEFAULT_POINTS)
+    return {
+        "metric": "chaos_sessions_per_sec",
+        "value": chaotic["sessions_per_sec"],
+        "unit": "sessions/s",
+        "platform": platform,
+        "backend": "numpy",
+        "workers": args.chaos_workers,
+        "kills": args.chaos_kills,
+        # the replay stamp: every robustness number names its adversity
+        "chaos_seed": args.chaos_seed,
+        "plan_digest": plan.digest(),
+        "fault_free": fault_free,
+        "chaos": chaotic,
+        "throughput_under_faults_frac": (
+            chaotic["sessions_per_sec"] / fault_free["sessions_per_sec"]
+            if fault_free["sessions_per_sec"] > 0
+            else 0.0
+        ),
+        "recovery_s_p50": recoveries[len(recoveries) // 2] if recoveries else None,
+        "recovery_s_max": recoveries[-1] if recoveries else None,
+        "invariants_ok": fault_free["ok"] and chaotic["ok"],
         "degraded": degraded,
     }
 
@@ -1090,6 +1171,17 @@ def main() -> None:
     p.add_argument("--fleet-devices-per-worker", type=int, default=1,
                    help="forced host devices per worker when the bench "
                    "runs with --placement auto semantics on cpu")
+    # the BENCH_chaos capture (docs/CHAOS.md): the seeded drill vs its
+    # fault-free twin — throughput under faults + recovery percentiles,
+    # seed + plan digest stamped so every robustness number replays
+    p.add_argument("--chaos", action="store_true",
+                   help="robustness bench: the seeded chaos drill (spill "
+                   "ENOSPC, snapshot bit-flips, socket resets, engine "
+                   "faults, a SIGKILL) vs a fault-free twin (emits "
+                   "chaos_sessions_per_sec)")
+    p.add_argument("--chaos-seed", type=int, default=0)
+    p.add_argument("--chaos-workers", type=int, default=2)
+    p.add_argument("--chaos-kills", type=int, default=1)
     # the BENCH_mc capture: Metropolis sweep throughput through the
     # stochastic tier (sweeps/s, spin-updates/s; docs/STOCHASTIC.md)
     p.add_argument("--mc", action="store_true",
@@ -1252,6 +1344,8 @@ def main() -> None:
             result = run_failover_bench(args, platform, degraded)
         elif args.fleet:
             result = run_fleet_bench(args, platform, degraded)
+        elif args.chaos:
+            result = run_chaos_bench(args, platform, degraded)
         elif args.serve:
             result = run_serve_bench(args, platform, degraded)
         elif args.mc:
@@ -1300,6 +1394,13 @@ def main() -> None:
                     )
                 cmd += ["--serve-capacity", str(args.serve_capacity)]
                 cmd += ["--serve-chunk-steps", str(args.serve_chunk_steps)]
+            if args.chaos:
+                # the retry must re-run the SAME seeded drill: seed and
+                # shape ride along so the replay contract holds
+                cmd += ["--chaos",
+                        "--chaos-seed", str(args.chaos_seed),
+                        "--chaos-workers", str(args.chaos_workers),
+                        "--chaos-kills", str(args.chaos_kills)]
             if args.mc:
                 cmd.append("--mc")
                 cmd += ["--mc-temperature", str(args.mc_temperature)]
@@ -1323,6 +1424,9 @@ def main() -> None:
             size, steps = args.serve_size, args.serve_steps
         elif args.failover:
             metric, unit = "serve_failover_rounds_per_sec", "rounds/s"
+            size, steps = args.serve_size, args.serve_steps
+        elif args.chaos:
+            metric, unit = "chaos_sessions_per_sec", "sessions/s"
             size, steps = args.serve_size, args.serve_steps
         elif args.fleet:
             metric, unit = "fleet_cells_per_sec", "cells/s"
@@ -1353,6 +1457,10 @@ def main() -> None:
             failure["batch_capacity"] = args.serve_capacity
             if args.fleet:
                 failure["workers"] = args.fleet_workers
+        elif args.chaos:
+            # the replay stamp survives even a failed capture
+            failure["chaos_seed"] = args.chaos_seed
+            failure["workers"] = args.chaos_workers
         elif args.mc:
             # the replay record must name what the run actually used:
             # the measured rule, and None temperature for non-ising rules

@@ -69,6 +69,13 @@ def pytest_configure(config):
         "them in isolation with `pytest -m pipeline`; all are tier-1 "
         "safe (not slow)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: tests that ARM a fault-injection plan (docs/CHAOS.md) — "
+        "every unmarked test asserts chaos.injection_count() did not "
+        "move, so the disarmed zero-overhead path is proven across the "
+        "whole tier-1 suite",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -86,3 +93,25 @@ def rng_board():
         return random_board(h, w, density, states=states, seed=seed)
 
     return make
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disarmed_guard(request):
+    """The suite-wide disarmed-path assertion (docs/CHAOS.md): outside
+    the tests that explicitly arm a plan (marker ``chaos``), not one
+    injection may fire and no plan may leak armed — so the acceptance
+    property "disarmed => injection_count() == 0 across tier-1" is
+    enforced structurally, on every single test."""
+    from tpu_life import chaos
+
+    before = chaos.injection_count()
+    yield
+    if request.node.get_closest_marker("chaos") is None:
+        assert chaos.injection_count() == before, (
+            "chaos injections fired inside a test that never armed a plan "
+            "(a plan leaked, or a seam fires while disarmed)"
+        )
+    assert not chaos.armed(), (
+        "a chaos plan is still armed after the test — arm via "
+        "chaos.armed_plan(...) so disarm is guaranteed"
+    )

@@ -1,0 +1,645 @@
+"""Chaos tier units (ISSUE 10): the injection registry's determinism and
+typed errors, each armed seam exercised on fakes / in-process services,
+the disk-full graceful degradation, and the stuck-MIGRATING watchdog.
+
+The conftest guard enforces the other half of the contract suite-wide:
+every test WITHOUT the ``chaos`` marker asserts ``injection_count()``
+did not move — the zero-overhead disarmed path, proven over the whole
+tier-1 run.  tests/test_chaos_drill.py drives the real 2-worker fleet.
+"""
+
+import errno
+import json
+
+import numpy as np
+import pytest
+
+from tpu_life import chaos, obs
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.serve import ServeConfig, SimulationService
+from tpu_life.serve.sessions import SessionState
+from tpu_life.serve.spill import DISABLED, SpillStore, read_spill_sessions
+
+
+# -- the registry ------------------------------------------------------------
+def test_same_seed_same_schedule():
+    """THE reproducibility contract: the fault schedule is a pure
+    function of (seed, point, call index) — two plans of equal seed
+    agree decision-for-decision, live or previewed."""
+    spec = {"spill.write": {"rate": 0.5, "mode": "enospc"}}
+    a = chaos.ChaosPlan(42, spec)
+    b = chaos.ChaosPlan(42, spec)
+    sched = a.preview("spill.write", 64)
+    assert any(sched) and not all(sched)  # a real mix at rate 0.5
+    assert sched == b.preview("spill.write", 64)
+    live = [b.decide("spill.write") is not None for _ in range(64)]
+    assert live == sched
+    # a different seed names a different schedule
+    assert chaos.ChaosPlan(43, spec).preview("spill.write", 64) != sched
+
+
+def test_unknown_point_and_mode_are_typed_errors():
+    with pytest.raises(chaos.ChaosError, match="unknown chaos point"):
+        chaos.ChaosPlan(0, {"nope.such.point": {"mode": "enospc"}})
+    with pytest.raises(chaos.ChaosError, match="no mode"):
+        chaos.ChaosPlan(0, {"spill.write": {"mode": "bitflip"}})
+    with pytest.raises(chaos.ChaosError, match="rate"):
+        chaos.ChaosPlan(0, {"spill.write": {"mode": "enospc", "rate": 2.0}})
+    with pytest.raises(chaos.ChaosError, match="needs a mode"):
+        chaos.ChaosPlan(0, {"spill.write": {"rate": 1.0}})
+    with pytest.raises(chaos.ChaosError, match="unknown keys"):
+        chaos.ChaosPlan(0, {"spill.write": {"mode": "enospc", "bogus": 1}})
+    with pytest.raises(chaos.ChaosError, match="not valid JSON"):
+        chaos.ChaosPlan.from_spec("{broken")
+    with pytest.raises(chaos.ChaosError, match="unknown keys"):
+        chaos.ChaosPlan.from_spec({"seed": 1, "pionts": {}})
+
+
+def test_spec_round_trip_and_digest_stability():
+    p = chaos.ChaosPlan(
+        7,
+        {
+            "spill.write": {"rate": 1.0, "mode": "enospc", "times": 2},
+            "worker.hang": {"rate": 0.1, "mode": "sleep", "seconds": 2.5},
+        },
+    )
+    rt = chaos.ChaosPlan.from_spec(json.dumps(p.spec()))
+    assert rt.spec() == p.spec() and rt.digest() == p.digest()
+    # the digest names the plan: any knob change renames it
+    q = chaos.ChaosPlan(7, {"spill.write": {"rate": 1.0, "mode": "enospc"}})
+    assert q.digest() != p.digest()
+
+
+def test_disarmed_is_a_noop_and_counts_nothing():
+    before = chaos.injection_count()
+    assert not chaos.armed()
+    chaos.inject("spill.write")
+    assert chaos.delay("worker.hang") == 0.0
+    assert chaos.skew("probe.skew") == 0.0
+    data = b"\x01\x02\x03"
+    assert chaos.corrupt("snapshot.corrupt", data) is data
+    assert chaos.decide("engine.dispatch") is None
+    assert chaos.injection_count() == before
+
+
+@pytest.mark.chaos
+def test_times_bound_and_injection_count():
+    with chaos.armed_plan(
+        {"seed": 3, "points": {"spill.write": {"mode": "enospc", "times": 2}}}
+    ):
+        before = chaos.injection_count()
+        fired = 0
+        for _ in range(10):
+            try:
+                chaos.inject("spill.write")
+            except OSError as e:
+                assert e.errno == errno.ENOSPC
+                fired += 1
+        assert fired == 2  # the bound holds no matter how many calls
+        assert chaos.injection_count() == before + 2
+    assert not chaos.armed()  # armed_plan always disarms
+
+
+@pytest.mark.chaos
+def test_env_arming_round_trip():
+    spec = {"seed": 9, "points": {"spill.read": {"mode": "oserror"}}}
+    plan = chaos.maybe_arm_from_env({chaos.ENV_VAR: json.dumps(spec)})
+    try:
+        assert plan is not None and chaos.armed()
+        assert chaos.active_plan().spec()["seed"] == 9
+    finally:
+        chaos.disarm()
+    assert chaos.maybe_arm_from_env({}) is None and not chaos.armed()
+    with pytest.raises(chaos.ChaosError):
+        chaos.maybe_arm_from_env({chaos.ENV_VAR: "{bad"})
+    chaos.disarm()
+
+
+@pytest.mark.chaos
+def test_corrupt_is_deterministic():
+    data = bytes(range(64))
+    spec = {"seed": 5, "points": {"snapshot.corrupt": {"mode": "bitflip"}}}
+    with chaos.armed_plan(spec):
+        a = chaos.corrupt("snapshot.corrupt", data)
+    with chaos.armed_plan(spec):
+        b = chaos.corrupt("snapshot.corrupt", data)
+    assert a == b and a != data
+    # exactly one bit differs (bitflip, not scrambling)
+    diff = np.bitwise_xor(
+        np.frombuffer(a, np.uint8), np.frombuffer(data, np.uint8)
+    )
+    assert bin(int(diff.sum())).count("1") == 1 and np.count_nonzero(diff) == 1
+
+
+@pytest.mark.chaos
+def test_crash_seam_exits_hard(monkeypatch):
+    codes = []
+    monkeypatch.setattr(chaos.os, "_exit", lambda rc: codes.append(rc))
+    with chaos.armed_plan(
+        {"seed": 1, "points": {"worker.crash": {"mode": "exit", "times": 1}}}
+    ):
+        chaos.crash("worker.crash")
+        chaos.crash("worker.crash")  # exhausted: no second exit
+    assert codes == [23]
+
+
+@pytest.mark.chaos
+def test_registry_binding_counts_fires():
+    reg = obs.MetricsRegistry()
+    chaos.bind_registry(reg)
+    with chaos.armed_plan(
+        {"seed": 2, "points": {"spill.write": {"mode": "oserror", "times": 1}}}
+    ):
+        with pytest.raises(OSError):
+            chaos.inject("spill.write")
+    fam = reg.counter("chaos_injections_total", labels=("point", "outcome"))
+    assert fam.labels(point="spill.write", outcome="oserror").value == 1.0
+
+
+# -- spill seams: ENOSPC degradation + snapshot corruption -------------------
+@pytest.mark.chaos
+def test_enospc_degrades_session_and_service_keeps_serving(tmp_path):
+    """The disk-full satellite end to end: every spill write fails, yet
+    drain completes, results stay byte-exact, the counter ticks once per
+    session, and the DISABLED markers tell the migration tier the truth."""
+    board = random_board(16, 16, seed=3)
+    steps = 12
+    oracle = run_np(board, get_rule("conway"), steps)
+    svc = SimulationService(
+        ServeConfig(
+            capacity=2, chunk_steps=4, backend="numpy",
+            spill_dir=str(tmp_path / "spill"), spill_every=1,
+        )
+    )
+    with chaos.armed_plan(
+        {"seed": 1, "points": {"spill.write": {"mode": "enospc"}}}
+    ):
+        sids = [svc.submit(board, "conway", steps) for _ in range(2)]
+        svc.drain()
+    for sid in sids:
+        assert svc.poll(sid).state is SessionState.DONE
+        assert svc.result(sid).tobytes() == oracle.tobytes()
+    stats = svc.stats()
+    assert stats["spill_errors"] == 2.0  # once per session, not per retry
+    assert stats["spilled_sessions"] == 0
+    # the truthful marker: a post-death migration answers spill_disabled…
+    markers = list((tmp_path / "spill").glob(f"*/{DISABLED}"))
+    # …except for sessions that went terminal (their dirs are swept);
+    # mid-run both sessions carried one — prove via a fresh live session
+    with chaos.armed_plan(
+        {"seed": 1, "points": {"spill.write": {"mode": "enospc"}}}
+    ):
+        live = svc.submit(board, "conway", 400)
+        for _ in range(3):
+            svc.pump()
+        records, corrupt, disabled = read_spill_sessions(tmp_path / "spill")
+        assert disabled == [live] and records == [] and corrupt == []
+        assert (tmp_path / "spill" / live / DISABLED).exists()
+        svc.cancel(live)
+    svc.close()
+    assert markers == []  # terminal sessions left nothing behind
+
+
+@pytest.mark.chaos
+def test_corrupt_newest_snapshot_demotes(tmp_path):
+    """The bit-flip drill: a chaos-mangled newest snapshot fails the CRC
+    intact check and demotes to the clean predecessor."""
+    store = SpillStore(tmp_path)
+    b1 = random_board(10, 10, seed=1)
+    b2 = run_np(b1, get_rule("conway"), 4)
+    kw = dict(rule="conway", steps_total=20, seed=None, temperature=None,
+              timeout_s=None)
+    store.save("s000000", b1, 4, **kw)  # clean (disarmed)
+    with chaos.armed_plan(
+        {"seed": 6, "points": {"snapshot.corrupt": {"mode": "bitflip"}}}
+    ):
+        store.save("s000000", b2, 8, **kw)  # newest: bit-flipped on disk
+    records, corrupt, disabled = read_spill_sessions(tmp_path)
+    assert corrupt == [] and disabled == []
+    (rec,) = records
+    assert rec.step == 4
+    np.testing.assert_array_equal(rec.board, b1)
+
+
+@pytest.mark.chaos
+def test_all_snapshots_corrupt_is_spill_corrupt(tmp_path):
+    store = SpillStore(tmp_path)
+    kw = dict(rule="conway", steps_total=20, seed=None, temperature=None,
+              timeout_s=None)
+    with chaos.armed_plan(
+        {"seed": 6, "points": {"snapshot.corrupt": {"mode": "truncate"}}}
+    ):
+        store.save("s000001", random_board(8, 8, seed=2), 4, **kw)
+    records, corrupt, disabled = read_spill_sessions(tmp_path)
+    assert records == [] and corrupt == ["s000001"] and disabled == []
+
+
+@pytest.mark.chaos
+def test_spill_read_fault_lands_in_corrupt(tmp_path):
+    store = SpillStore(tmp_path)
+    kw = dict(rule="conway", steps_total=20, seed=None, temperature=None,
+              timeout_s=None)
+    store.save("s000002", random_board(8, 8, seed=3), 4, **kw)
+    with chaos.armed_plan(
+        {"seed": 1, "points": {"spill.read": {"mode": "oserror"}}}
+    ):
+        records, corrupt, disabled = read_spill_sessions(tmp_path)
+    assert records == [] and corrupt == ["s000002"]
+    # the bytes survived the failed read: a later clean pass resumes them
+    records, corrupt, _ = read_spill_sessions(tmp_path)
+    assert corrupt == [] and len(records) == 1
+
+
+# -- engine chunk faults: per-key isolation ----------------------------------
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", ["engine.dispatch", "engine.collect"])
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_engine_chunk_fault_fails_only_that_key(tmp_path, point, pipeline):
+    """A chunk-level device fault costs one CompileKey's tenants, typed —
+    the other key keeps stepping bit-exactly and the pump survives."""
+    svc = SimulationService(
+        ServeConfig(capacity=4, chunk_steps=4, backend="numpy",
+                    pipeline=pipeline)
+    )
+    conway = random_board(12, 12, seed=1)
+    bb = random_board(12, 12, seed=2, states=3)
+    steps = 8
+    with chaos.armed_plan(
+        {"seed": 4, "points": {point: {"mode": "fault", "times": 1}}}
+    ):
+        victim_a = svc.submit(conway, "conway", steps)
+        victim_b = svc.submit(conway, "conway", steps)
+        other = svc.submit(bb, "brians_brain", steps)
+        svc.drain(max_rounds=50)
+    va, vb = svc.poll(victim_a), svc.poll(victim_b)
+    assert va.state is SessionState.FAILED and "InjectedFault" in va.error
+    assert vb.state is SessionState.FAILED and "InjectedFault" in vb.error
+    ov = svc.poll(other)
+    assert ov.state is SessionState.DONE
+    expect = run_np(bb, get_rule("brians_brain"), steps)
+    assert svc.result(other).tobytes() == expect.tobytes()
+    # the failed key is reusable: a fresh session completes clean
+    retry = svc.submit(conway, "conway", steps)
+    svc.drain(max_rounds=50)
+    assert svc.poll(retry).state is SessionState.DONE
+    expect = run_np(conway, get_rule("conway"), steps)
+    assert svc.result(retry).tobytes() == expect.tobytes()
+    svc.close()
+
+
+@pytest.mark.chaos
+def test_chunk_fault_never_rewrites_a_finished_outcome():
+    """A session whose compute already finished (awaiting the pipelined
+    retirement lag) must retire DONE through a later chunk fault — the
+    sync pump retired it a round earlier, and the overlap must never
+    change an outcome."""
+    pts = {"engine.dispatch": {"mode": "fault", "rate": 0.5, "times": 1}}
+    # a seed whose schedule spares the FIRST dispatch and hits the second
+    seed = next(
+        s for s in range(200)
+        if chaos.ChaosPlan(s, pts).preview("engine.dispatch", 2) == [False, True]
+    )
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="numpy", pipeline=True)
+    )
+    board = random_board(12, 12, seed=9)
+    oracle = run_np(board, get_rule("conway"), 4)
+    with chaos.armed_plan({"seed": seed, "points": pts}):
+        fin = svc.submit(board, "conway", 4)  # finishes inside chunk 1
+        mid = svc.submit(board, "conway", 12)  # mid-flight at the fault
+        svc.pump()  # round 1: clean dispatch; fin finished, retire pending
+        svc.pump()  # round 2: dispatch faults — salvage fin, fail mid
+        svc.drain(max_rounds=20)
+    assert svc.poll(fin).state is SessionState.DONE
+    assert svc.result(fin).tobytes() == oracle.tobytes()
+    mv = svc.poll(mid)
+    assert mv.state is SessionState.FAILED and "InjectedFault" in mv.error
+    svc.close()
+
+
+# -- worker readiness refusal -------------------------------------------------
+@pytest.mark.chaos
+def test_worker_unready_answers_500_not_draining():
+    """The unready seam: an armed /readyz answers 500 — a supervisor
+    probe reads that as UNREACHABLE (kill/recycle path), never as the
+    graceful 'draining' a real 503 means — then recovers when the bound
+    is exhausted."""
+    import urllib.error
+    import urllib.request
+
+    from tpu_life.gateway import Gateway, GatewayConfig
+
+    svc = SimulationService(ServeConfig(capacity=2, backend="numpy"))
+    gw = Gateway(svc, GatewayConfig(port=0))
+    gw.start()
+    try:
+        url = f"http://{gw.host}:{gw.port}/readyz"
+        with chaos.armed_plan(
+            {"seed": 1,
+             "points": {"worker.unready": {"mode": "refuse", "times": 1}}}
+        ):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=5)
+            assert ei.value.code == 500  # unreachable-shaped, not 503
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200  # bound exhausted: ready again
+    finally:
+        gw.begin_drain()
+        gw.wait(timeout=10)
+        gw.close()
+
+
+# -- supervisor probe-clock skew ---------------------------------------------
+@pytest.mark.chaos
+def test_probe_skew_kill_rides_restart_budget(tmp_path):
+    """A skewed monitor clock may kill a slow-starting worker (startup
+    'timeout'), but that is supervisor-initiated: it must take the
+    restart path, never the breaker."""
+    from tpu_life.fleet.supervisor import FleetConfig, Supervisor, WorkerState
+
+    class FakeProc:
+        def __init__(self):
+            self.rc = None
+            self.killed = False
+
+        def poll(self):
+            return self.rc
+
+        def kill(self):
+            self.killed = True
+            self.rc = -9
+
+    t = [0.0]
+    procs = {}
+
+    def spawn(w):
+        procs[w.name] = w.proc = FakeProc()
+        w.url = None  # never produces a startup line
+
+    cfg = FleetConfig(
+        workers=1, log_dir=str(tmp_path / "logs"),
+        startup_timeout_s=30.0, breaker_threshold=3,
+    )
+    sup = Supervisor(
+        cfg, obs.MetricsRegistry(), spawn=spawn, probe=lambda w: "ready",
+        clock=lambda: t[0],
+    )
+    with sup._lock:
+        for w in sup.workers:
+            sup._spawn_worker(w, first=True)
+    w = sup.workers[0]
+    with chaos.armed_plan(
+        {"seed": 2,
+         "points": {"probe.skew": {"mode": "skew", "seconds": 1e6}}}
+    ):
+        sup.tick()  # skewed far past the startup timeout: worker killed
+    assert procs["w0"].killed and w.recycling
+    sup.tick()  # reap: the exit is a recycle — restart scheduled
+    assert w.state is WorkerState.DOWN
+    assert w.state is not WorkerState.FAILED  # breaker untouched
+
+
+# -- migrator: migrate.die + the stuck watchdog ------------------------------
+class _Pin:
+    def __init__(self, worker, generation):
+        self.worker = worker
+        self.generation = generation
+
+
+def _make_migrator(tmp_path, clock):
+    from tpu_life.fleet.migrate import Migrator
+
+    class NullBalancer:
+        def candidates(self, ready):
+            return list(ready)
+
+        def invalidate(self, w):
+            pass
+
+    return Migrator(
+        spill_root=str(tmp_path),
+        supervisor=None,
+        sessions=None,
+        registry=obs.MetricsRegistry(),
+        balancer=NullBalancer(),
+        forward=lambda *a, **k: (_ for _ in ()).throw(RuntimeError("unused")),
+        clock=clock,
+        sleep=lambda s: None,
+        timeout_s=5.0,
+        stuck_after_s=60.0,
+    )
+
+
+@pytest.mark.chaos
+def test_dead_migrator_thread_settles_via_watchdog(tmp_path):
+    """THE stuck-MIGRATING satellite: kill the migration thread at birth
+    (injection point) — without the watchdog its sids would answer
+    synthetic in-progress views forever; with it they settle to a
+    terminal 410 ``migration_failed`` after the deadline."""
+    t = [100.0]
+    mig = _make_migrator(tmp_path, lambda: t[0])
+    with chaos.armed_plan(
+        {"seed": 1, "points": {"migrate.die": {"mode": "die"}}}
+    ):
+        mig.worker_exit("w0", 3)
+    assert ("w0", 3) in mig._active and not mig._threads  # no thread ran
+    pin = _Pin("w0", 3)
+    assert mig.status("fsid-1", pin) == ("migrating",)
+    t[0] += 59.0
+    assert mig.status("fsid-1", pin) == ("migrating",)
+    t[0] += 2.0  # past stuck_after_s
+    assert mig.status("fsid-1", pin) == ("lost", "migration_failed")
+    # settled is sticky and fast — no re-derivation on later polls
+    assert mig.status("fsid-1", pin) == ("lost", "migration_failed")
+
+
+def test_pending_fallback_settles_via_watchdog(tmp_path):
+    """The exit-hook-never-fired twin: a sid covered only by the
+    'rescue imminent' fallback must also settle, not poll forever."""
+    t = [50.0]
+    mig = _make_migrator(tmp_path, lambda: t[0])
+    pin = _Pin("w1", 7)  # no record at all: neither active nor completed
+    assert mig.status("fsid-9", pin, pending_ok=True) == ("migrating",)
+    t[0] += 30.0
+    assert mig.status("fsid-9", pin, pending_ok=True) == ("migrating",)
+    t[0] += 31.0
+    assert mig.status("fsid-9", pin, pending_ok=True) == (
+        "lost", "migration_failed",
+    )
+    # and a past-generation pin still settles immediately (unchanged)
+    assert mig.status("fsid-8", pin, pending_ok=False) == (
+        "lost", "never_snapshotted",
+    )
+
+
+def test_watchdog_settled_sid_is_never_resumed(tmp_path):
+    """Once the watchdog told a client its sid is terminally lost, a
+    late-arriving migration run must honor that answer — resuming it
+    would execute the trajectory twice (the client already resubmitted)."""
+    from tpu_life.fleet.migrate import worker_spill_dir
+
+    d = worker_spill_dir(str(tmp_path), "w0", 1)
+    SpillStore(d).save(
+        "s000005", random_board(8, 8, seed=1), 4,
+        rule="conway", steps_total=20, seed=None, temperature=None,
+        timeout_s=None,
+    )
+    t = [10.0]
+    mig = _make_migrator(tmp_path, lambda: t[0])
+    mig._failed["w0g1-s000005"] = "migration_failed"  # the watchdog's verdict
+    mig._active[("w0", 1)] = t[0]
+    mig._run("w0", 1)  # forward raises if ever called: no resume may run
+    assert mig.status("w0g1-s000005", _Pin("w0", 1)) == (
+        "lost", "migration_failed",
+    )
+    assert mig._c_migrations.labels(outcome="migrated").value == 0.0
+
+
+def test_live_run_heartbeats_past_the_watchdog(tmp_path):
+    """A legitimately long, PROGRESSING rescue must not trip the stuck
+    watchdog: each settled record refreshes the run's clock, so the
+    deadline bounds one record's stall, not the whole run."""
+    from tpu_life.fleet.migrate import worker_spill_dir
+
+    d = worker_spill_dir(str(tmp_path), "w0", 1)
+    store = SpillStore(d)
+    for i in range(3):
+        store.save(
+            f"s00000{i}", random_board(8, 8, seed=i), 4,
+            rule="conway", steps_total=20, seed=None, temperature=None,
+            timeout_s=None,
+        )
+    t = [0.0]
+    mig = _make_migrator(tmp_path, lambda: t[0])
+
+    class Worker:
+        name, generation, alive = "w1", 2, True
+
+    calls = []
+
+    def slow_forward(worker, method, path, *, body=None, api_key=None):
+        calls.append(path)
+        t[0] += 50.0  # each resume takes 50s; stuck_after_s is 60
+        return 201, None, {"session": f"s9{len(calls):05d}"}
+
+    class Sessions:
+        def repin(self, *a):
+            pass
+
+    mig.forward = slow_forward
+    mig.sessions = Sessions()
+    mig.supervisor = type("S", (), {"ready_workers": lambda self: [Worker()]})()
+    mig._active[("w0", 1)] = t[0]
+    mig._run("w0", 1)  # 3 records x 50s = 150s total, heartbeats between
+    assert len(calls) == 3  # nothing was watchdog-skipped mid-run
+    assert mig._c_migrations.labels(outcome="migrated").value == 3.0
+    assert not mig._failed
+
+
+@pytest.mark.chaos
+def test_disabled_spills_answer_spill_disabled(tmp_path):
+    """A worker that degraded a session to spill-disabled dies: the
+    migration run records the truthful 410 reason for it."""
+    from tpu_life.fleet.migrate import worker_spill_dir
+
+    d = worker_spill_dir(str(tmp_path), "w0", 2)
+    store = SpillStore(d)
+    store.save(
+        "s000005", random_board(8, 8, seed=1), 4,
+        rule="conway", steps_total=20, seed=None, temperature=None,
+        timeout_s=None,
+    )
+    store.mark_disabled("s000005")
+    t = [10.0]
+    mig = _make_migrator(tmp_path, lambda: t[0])
+    mig._active[("w0", 2)] = t[0]
+    mig._run("w0", 2)
+    assert mig.status("w0g2-s000005", _Pin("w0", 2)) == (
+        "lost", "spill_disabled",
+    )
+    fam = mig._c_migrations
+    assert fam.labels(outcome="disabled").value == 1.0
+
+
+# -- router transport seams ---------------------------------------------------
+@pytest.mark.chaos
+def test_router_presend_reset_is_a_refusal(tmp_path):
+    """A POST reset before the request is written classifies as REFUSED —
+    the no-duplicate rule: the next candidate can safely take it."""
+    from tpu_life.fleet.registry import SessionRegistry
+    from tpu_life.fleet.router import Router, WorkerUnreachable
+    from tpu_life.fleet.supervisor import FleetConfig, Supervisor, Worker
+
+    cfg = FleetConfig(workers=1, port=0, log_dir=str(tmp_path / "logs"))
+    reg = obs.MetricsRegistry()
+    sup = Supervisor(cfg, reg, spawn=lambda w: None, probe=lambda w: "ready")
+    router = Router(cfg, sup, SessionRegistry(), reg)
+    try:
+        w = Worker(name="w9", log_path=tmp_path / "w9.log")
+        w.url = "http://127.0.0.1:9"  # never dialed: the injection fires first
+        with chaos.armed_plan(
+            {"seed": 1,
+             "points": {"router.submit.reset": {"mode": "reset"}}}
+        ):
+            with pytest.raises(WorkerUnreachable) as ei:
+                router.forward(w, "POST", "/v1/sessions", body=b"{}")
+        assert ei.value.refused  # refusal => safe to retry elsewhere
+    finally:
+        router.close()
+
+
+@pytest.mark.chaos
+def test_router_poll_resets_mid_exchange_and_mid_body(tmp_path):
+    """GET resets: mid_exchange surfaces as the AMBIGUOUS (not-refused)
+    transport failure, mid_body as a truncated (empty) response body —
+    the two shapes the idempotent-retry machinery must absorb."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tpu_life.fleet.registry import SessionRegistry
+    from tpu_life.fleet.router import Router, WorkerUnreachable
+    from tpu_life.fleet.supervisor import FleetConfig, Supervisor, Worker
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            payload = b'{"finished": false, "state": "running"}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    cfg = FleetConfig(workers=1, port=0, log_dir=str(tmp_path / "logs"))
+    reg = obs.MetricsRegistry()
+    sup = Supervisor(cfg, reg, spawn=lambda w: None, probe=lambda w: "ready")
+    router = Router(cfg, sup, SessionRegistry(), reg)
+    try:
+        w = Worker(name="w9", log_path=tmp_path / "w9.log")
+        w.url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with chaos.armed_plan(
+            {"seed": 1,
+             "points": {"router.poll.reset":
+                        {"mode": "mid_exchange", "times": 1}}}
+        ):
+            with pytest.raises(WorkerUnreachable) as ei:
+                router.forward(w, "GET", "/v1/sessions/s1")
+            assert not ei.value.refused  # ambiguous, never blind-retried
+            # exhausted: the next forward goes through untouched
+            status, _, doc = router.forward(w, "GET", "/v1/sessions/s1")
+        assert status == 200 and doc["state"] == "running"
+        with chaos.armed_plan(
+            {"seed": 1,
+             "points": {"router.poll.reset": {"mode": "mid_body"}}}
+        ):
+            status, _, doc = router.forward(w, "GET", "/v1/sessions/s1")
+        assert status == 200 and doc == {}  # truncated body parses empty
+    finally:
+        router.close()
+        httpd.shutdown()
+        httpd.server_close()

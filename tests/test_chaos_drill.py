@@ -1,0 +1,59 @@
+"""The 2-worker chaos drill end to end (ISSUE 10 acceptance).
+
+One seeded run of the real thing: subprocess gateway workers behind the
+router, spill-backed failover on, the default fault mix armed (spill
+ENOSPC, snapshot bit-flips, pre-send submit resets, mid-body poll
+garbling, one engine fault) plus one drill-driven SIGKILL — and every
+machine-verified invariant must hold.  The summary's replay stamp (seed
++ plan digest) is asserted too: a failing drill must name the exact
+adversity that broke it.
+"""
+
+import pytest
+
+from tpu_life import chaos
+from tpu_life.chaos.drill import DEFAULT_POINTS, DrillConfig, run_drill
+
+
+@pytest.mark.chaos
+def test_two_worker_drill_masks_the_default_fault_mix(tmp_path):
+    cfg = DrillConfig(
+        seed=7,
+        workers=2,
+        det_sessions=4,
+        ising_sessions=1,
+        steps=900,
+        kills=1,
+        workdir=str(tmp_path),
+        wait_timeout_s=150,
+        summary_file=str(tmp_path / "drill.jsonl"),
+    )
+    summary = run_drill(cfg)
+    failed = {
+        name: v["violations"]
+        for name, v in summary["invariants"].items()
+        if not v["ok"]
+    }
+    assert summary["ok"], failed
+
+    # the replay stamp: seed + the canonical plan + its digest
+    assert summary["seed"] == 7
+    assert summary["plan"]["points"] == DEFAULT_POINTS
+    assert summary["plan_digest"] == chaos.ChaosPlan(7, DEFAULT_POINTS).digest()
+
+    # the adversity was real: a worker died and came back bounded…
+    real_kills = [k for k in summary["kills"] if k.get("recovery_s") is not None]
+    assert real_kills, summary["kills"]
+    assert all(k["recovery_s"] <= cfg.recovery_bound_s for k in real_kills)
+    # …and the always-fire (times-bounded, rate 1.0) points actually hit
+    for point in ("spill.write", "snapshot.corrupt", "router.submit.reset"):
+        assert summary["injections"].get(point, 0) >= 1, summary["injections"]
+
+    # every workload item delivered its oracle board despite everything
+    assert summary["delivered"] == summary["sessions"]
+
+    # the drill left the process clean for the rest of the suite
+    assert not chaos.armed()
+
+    # the summary JSONL landed (the seed-replay artifact CI uploads)
+    assert (tmp_path / "drill.jsonl").read_text().count("\n") == 1

@@ -61,7 +61,7 @@ def test_spill_round_trip_and_retention(tmp_path):
     assert [int(p.stem.split("_")[1]) for p in snaps] == [16, 24]
     assert store.spilled_count() == 1
 
-    records, corrupt = read_spill_sessions(tmp_path)
+    records, corrupt, _disabled = read_spill_sessions(tmp_path)
     assert corrupt == []
     (rec,) = records
     assert (rec.sid, rec.step, rec.steps_total) == ("s000001", 24, 40)
@@ -70,7 +70,7 @@ def test_spill_round_trip_and_retention(tmp_path):
 
     store.delete("s000001")
     assert not (tmp_path / "s000001").exists()
-    assert read_spill_sessions(tmp_path) == ([], [])
+    assert read_spill_sessions(tmp_path) == ([], [], [])
 
 
 def test_bit_flipped_spill_demotes_to_previous(tmp_path):
@@ -85,7 +85,7 @@ def test_bit_flipped_spill_demotes_to_previous(tmp_path):
     raw = bytearray(newest.read_bytes())
     raw[3] ^= 0x01  # same size, different bytes
     newest.write_bytes(raw)
-    records, corrupt = read_spill_sessions(tmp_path)
+    records, corrupt, _disabled = read_spill_sessions(tmp_path)
     assert corrupt == []
     (rec,) = records
     assert rec.step == 4
@@ -99,7 +99,7 @@ def test_all_snapshots_corrupt_reports_spill_corrupt(tmp_path):
     raw = bytearray(f.read_bytes())
     raw[0] ^= 0x01
     f.write_bytes(raw)
-    records, corrupt = read_spill_sessions(tmp_path)
+    records, corrupt, _disabled = read_spill_sessions(tmp_path)
     assert records == [] and corrupt == ["s000002"]
 
 
@@ -107,7 +107,7 @@ def test_unreadable_manifest_reports_corrupt(tmp_path):
     store = SpillStore(tmp_path)
     _save(store, "s000003", random_board(8, 8, seed=3), 4)
     (tmp_path / "s000003" / "manifest.json").write_text("{not json")
-    records, corrupt = read_spill_sessions(tmp_path)
+    records, corrupt, _disabled = read_spill_sessions(tmp_path)
     assert records == [] and corrupt == ["s000003"]
 
 
@@ -126,7 +126,7 @@ def test_spill_resume_deterministic_bit_identical(tmp_path, pipeline):
     a.submit(board, "conway", steps)
     for _ in range(5):  # abandon mid-flight (the simulated SIGKILL)
         a.pump()
-    records, corrupt = read_spill_sessions(tmp_path / "spill")
+    records, corrupt, _disabled = read_spill_sessions(tmp_path / "spill")
     assert corrupt == [] and len(records) == 1
     rec = records[0]
     assert 0 < rec.step < steps and rec.steps_total == steps
@@ -163,7 +163,7 @@ def test_spill_resume_ising_bit_identical(tmp_path, pipeline):
     a.submit(board, "ising", steps, seed=seed, temperature=temp)
     for _ in range(4):
         a.pump()
-    records, _ = read_spill_sessions(tmp_path / "spill")
+    records, _, _ = read_spill_sessions(tmp_path / "spill")
     rec = records[0]
     assert 0 < rec.step < steps
     b = SimulationService(ServeConfig(capacity=2, chunk_steps=4, backend="jax"))
@@ -207,7 +207,7 @@ def test_queued_sessions_spill_too(tmp_path):
     svc.submit(random_board(8, 8, seed=1), "conway", 50)
     svc.submit(random_board(8, 8, seed=2), "conway", 50)
     svc.pump()
-    records, _ = read_spill_sessions(tmp_path / "spill")
+    records, _, _ = read_spill_sessions(tmp_path / "spill")
     assert len(records) == 2
     queued = next(r for r in records if r.step == 0)
     assert queued.remaining == 50
@@ -378,7 +378,7 @@ def _make_migrator(tmp_path, forward, workers, sessions=None, clock=None,
 
 def _run_sync(mig, name, gen):
     """Drive one migration run on the caller's thread (determinism)."""
-    mig._active.add((name, gen))
+    mig._active[(name, gen)] = mig.clock()
     mig._run(name, gen)
 
 

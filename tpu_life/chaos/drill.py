@@ -1,0 +1,635 @@
+"""The chaos drill: a seeded, machine-verified fleet-survival exercise.
+
+``run_drill`` drives a REAL N-worker CPU fleet (subprocess gateways
+behind the router, spill-backed failover on) under a seeded fault
+schedule — the armed :mod:`tpu_life.chaos` plan plus drill-driven
+SIGKILLs — while a mixed det+ising workload with staggered budgets flows
+through the standard client protocol.  Nothing in the serving stack is
+modified for the drill; the faults land at the production seams.
+
+The drill then checks the **invariants** (docs/CHAOS.md) that define
+"robust" for this fleet:
+
+- ``all_terminal``: every accepted session reaches a terminal
+  observation (done / typed 410 / failed) within the wait budget — no
+  sid polls "migrating" or "running" forever (the stuck-MIGRATING
+  watchdog's contract).
+- ``bit_identity``: every session observed DONE returns a board
+  byte-identical to its solo oracle (``run_np`` / ``MCHostRunner``) —
+  failover, resets and retries may delay an answer, never change it.
+- ``legal_410``: every terminal loss is TYPED — a ``worker_lost`` 410
+  carries a reason from the legal set, a failed session carries its
+  error string.  Silent loss (a 404 for an accepted sid, an unreasoned
+  410) is a violation.
+- ``no_lost_work``: every workload item ultimately yields its oracle
+  board.  Typed losses are recoverable by the documented client
+  recourse — resubmit from scratch — and the drill plays that client,
+  so "no lost accepted work" means: loss is bounded, typed, and always
+  recoverable, never silent or sticky.
+- ``recovery_bounded``: after each SIGKILL the supervisor returns the
+  fleet to full ready strength within ``recovery_bound_s``.
+- ``metrics_consistent``: the fleet's merged accounting adds up —
+  ``fleet_routed_total`` equals the sessions the clients actually got
+  accepted (201s), and the migration counters cover every post-kill
+  outcome.
+
+Every summary is stamped with the chaos **seed** and the plan
+**digest**: a failed CI drill prints its seed, and rerunning with that
+seed replays the exact injection schedule (docs/CHAOS.md "seed replay").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_life import chaos, mc
+from tpu_life.gateway import protocol
+from tpu_life.gateway.client import GatewayClient
+from tpu_life.mc.engine import MCHostRunner
+from tpu_life.mc.prng import key_halves, threefry2x32
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.runtime.metrics import log
+
+#: 410 reasons the durability contract is allowed to answer (docs/FLEET.md).
+LEGAL_410_REASONS = frozenset(
+    {"never_snapshotted", "spill_corrupt", "migration_failed", "spill_disabled"}
+)
+
+#: The default fault mix for ``tpu-life chaos`` / ``bench --chaos``: every
+#: armed family fires a BOUNDED number of times (``times``), so the drill
+#: exercises each seam without degenerating into pure noise — and the
+#: bounds make "did every armed point actually fire?" a deterministic
+#: question on any run long enough to reach each seam.
+DEFAULT_POINTS: dict[str, dict] = {
+    "spill.write": {"rate": 1.0, "mode": "enospc", "times": 1},
+    "snapshot.corrupt": {"rate": 1.0, "mode": "bitflip", "times": 2},
+    "router.submit.reset": {"rate": 1.0, "mode": "reset", "times": 2},
+    "router.poll.reset": {"rate": 0.02, "mode": "mid_body"},
+    # low-rate so the fault lands MID-flight (a first-dispatch wipeout
+    # would just retest admission); bounded so one fault, not a storm
+    "engine.dispatch": {"rate": 0.02, "mode": "fault", "times": 1},
+}
+
+
+@dataclass
+class DrillConfig:
+    seed: int = 0
+    workers: int = 2
+    det_sessions: int = 6
+    ising_sessions: int = 2
+    size: int = 20  # det board edge (ising boards are 16x16 — even dims)
+    steps: int = 900  # base budget; staggered downward per session
+    kills: int = 1
+    min_progress: int = 8  # steps a victim must have banked before a kill
+    points: dict | None = None  # chaos plan points (None = DEFAULT_POINTS)
+    backend: str = "numpy"  # worker engine executor (CPU drills)
+    capacity: int = 4
+    chunk_steps: int = 2
+    spill_every: int = 1
+    resubmit_lost: int = 3  # client recourse: resubmits per lost item
+    recovery_bound_s: float = 60.0
+    wait_timeout_s: float = 180.0
+    migrate_stuck_after_s: float = 60.0
+    workdir: str = "."  # spill/ and logs/ land under here
+    summary_file: str | None = None  # append the summary as one JSONL line
+
+
+@dataclass
+class WorkItem:
+    """One workload trajectory and its precomputed solo oracle."""
+
+    tag: str
+    rule: str
+    board: np.ndarray
+    steps: int
+    seed: int
+    temperature: float | None
+    oracle: bytes
+    sid: str | None = None
+    outcome: str = "pending"  # done | lost | failed | pending
+    detail: str = ""
+    resubmits: int = 0
+    delivered: bool = False  # a DONE answer matched the oracle
+
+
+def _build_items(cfg: DrillConfig) -> list[WorkItem]:
+    items: list[WorkItem] = []
+    rule = get_rule("conway")
+    for i in range(cfg.det_sessions):
+        # staggered budgets: the same uneven mix the serve benches drive
+        steps = max(cfg.chunk_steps * cfg.min_progress,
+                    cfg.steps - (cfg.steps * i) // (2 * max(cfg.det_sessions, 1)))
+        seed = cfg.seed * 1000 + i
+        board = mc.seeded_board(cfg.size, cfg.size, 0.45, seed=seed)
+        items.append(
+            WorkItem(
+                tag=f"det{i}",
+                rule="conway",
+                board=board,
+                steps=steps,
+                seed=seed,
+                temperature=None,
+                oracle=run_np(board, rule, steps).tobytes(),
+            )
+        )
+    irule = get_rule("ising")
+    for i in range(cfg.ising_sessions):
+        seed = cfg.seed * 1000 + 500 + i
+        temp = 2.0 + 0.3 * i
+        steps = max(cfg.chunk_steps * cfg.min_progress, cfg.steps // 2)
+        board = mc.seeded_board(16, 16, 0.5, seed=seed)
+        oracle = MCHostRunner(board, irule, seed=seed, temperature=temp)
+        oracle.advance(steps)
+        items.append(
+            WorkItem(
+                tag=f"ising{i}",
+                rule="ising",
+                board=board,
+                steps=steps,
+                seed=seed,
+                temperature=temp,
+                oracle=oracle.fetch().tobytes(),
+            )
+        )
+    return items
+
+
+def _http_json(url: str, timeout: float = 10.0) -> tuple[int, dict]:
+    """GET returning (status, parsed body) — errors included, so the
+    drill reads full typed error envelopes (reason fields and all)."""
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url), timeout=timeout
+        ) as resp:
+            return resp.status, _parse(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, _parse(e.read())
+
+
+def _parse(raw: bytes) -> dict:
+    try:
+        doc = json.loads(raw or b"{}")
+        return doc if isinstance(doc, dict) else {}
+    except json.JSONDecodeError:
+        return {}
+
+
+class _Driller:
+    """One drill run's state: the fleet, the client, the verdicts."""
+
+    def __init__(self, cfg: DrillConfig):
+        self.cfg = cfg
+        self.items = _build_items(cfg)
+        self.plan = chaos.ChaosPlan(
+            cfg.seed, DEFAULT_POINTS if cfg.points is None else cfg.points
+        )
+        self.accepted = 0  # 201s the clients received (== routed, invariant)
+        self.kills: list[dict] = []
+        self.violations: dict[str, list[str]] = {}
+        self.injection_scrapes: dict[str, dict[str, float]] = {}
+        self.fleet = None
+        self.base_url = ""
+
+    # -- plumbing ----------------------------------------------------------
+    def violate(self, invariant: str, detail: str) -> None:
+        self.violations.setdefault(invariant, []).append(detail)
+        log.error("chaos drill: %s violated: %s", invariant, detail)
+
+    def _draw(self, label: str, n: int) -> int:
+        """A seeded drill-side draw (victim choice) — same Threefry
+        discipline as the plan, so the kill schedule replays too."""
+        k0, k1 = key_halves(self.cfg.seed)
+        u, _ = threefry2x32(
+            np, k0, k1, np.uint32(zlib.crc32(label.encode())), np.uint32(n)
+        )
+        return int(u)
+
+    def _scrape_injections(self) -> None:
+        """Merge chaos_injections_total from the fleet's merged /metrics
+        (fleet-process + live workers) into the running per-point view —
+        best-effort evidence of which seams actually fired."""
+        try:
+            req = urllib.request.Request(self.base_url + "/metrics")
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                text = resp.read().decode()
+        except Exception:
+            return
+        for line in text.splitlines():
+            if not line.startswith("chaos_injections_total{"):
+                continue
+            labels, _, value = line.rpartition(" ")
+            point = outcome = worker = ""
+            inner = labels[labels.find("{") + 1 : labels.rfind("}")]
+            for part in inner.split(","):
+                k, _, v = part.partition("=")
+                v = v.strip('"')
+                if k == "point":
+                    point = v
+                elif k == "outcome":
+                    outcome = v
+                elif k == "worker":
+                    worker = v
+            if not point:
+                continue
+            series = self.injection_scrapes.setdefault(point, {})
+            key = f"{worker or 'fleet'}:{outcome}"
+            try:
+                # counters reset when a worker respawns under the same
+                # label: keep the max ever seen per series — a floor on
+                # the true total, never an overcount of one incarnation
+                series[key] = max(series.get(key, 0.0), float(value))
+            except ValueError:
+                continue
+
+    def injections_by_point(self) -> dict[str, float]:
+        return {
+            point: sum(series.values())
+            for point, series in sorted(self.injection_scrapes.items())
+        }
+
+    # -- workload ----------------------------------------------------------
+    def submit_item(self, client: GatewayClient, item: WorkItem) -> bool:
+        try:
+            item.sid = client.submit(
+                board=item.board,
+                rule=item.rule,
+                steps=item.steps,
+                seed=item.seed,
+                temperature=item.temperature,
+            )
+        except Exception as e:  # noqa: BLE001 - a refused submit is data
+            item.outcome = "rejected"
+            item.detail = str(e)
+            return False
+        self.accepted += 1
+        item.outcome = "pending"
+        return True
+
+    def poll_until_terminal(self, client: GatewayClient, item: WorkItem) -> None:
+        """Poll one sid to a terminal observation, riding out transient
+        502s (injected resets) and garbled bodies; on DONE fetch and
+        byte-check the result; on typed loss record the reason."""
+        deadline = time.monotonic() + self.cfg.wait_timeout_s
+        url = f"{self.base_url}/v1/sessions/{item.sid}"
+        while True:
+            if time.monotonic() > deadline:
+                item.outcome = "stuck"
+                item.detail = "never reached a terminal observation"
+                self.violate(
+                    "all_terminal",
+                    f"{item.tag} ({item.sid}) still non-terminal after "
+                    f"{self.cfg.wait_timeout_s:.0f}s",
+                )
+                return
+            try:
+                status, doc = _http_json(url)
+            except Exception as e:  # noqa: BLE001 - transport noise: retry
+                log.debug("chaos drill: poll %s transport error %s", item.sid, e)
+                time.sleep(0.1)
+                continue
+            if status == 200 and "finished" not in doc:
+                # a chaos mid-body truncation: retry, it is transient
+                time.sleep(0.05)
+                continue
+            if status == 200 and not doc["finished"]:
+                time.sleep(0.05)
+                continue
+            if status == 200:
+                state = doc.get("state")
+                if state == "done":
+                    self._check_result(item)
+                else:
+                    item.outcome = "failed"
+                    item.detail = str(doc.get("error") or "")
+                    if not item.detail:
+                        self.violate(
+                            "legal_410",
+                            f"{item.tag} failed without an error string",
+                        )
+                return
+            if status in (409, 502):
+                # migrating / injected upstream ambiguity: both transient
+                time.sleep(0.1)
+                continue
+            if status == 410:
+                err = doc.get("error") or {}
+                item.outcome = "lost"
+                item.detail = str(err.get("reason") or err.get("code") or "")
+                if err.get("code") == "worker_lost":
+                    if err.get("reason") not in LEGAL_410_REASONS:
+                        self.violate(
+                            "legal_410",
+                            f"{item.tag} 410 with illegal reason "
+                            f"{err.get('reason')!r}",
+                        )
+                elif err.get("code") != "session_failed":
+                    self.violate(
+                        "legal_410",
+                        f"{item.tag} 410 with unexpected code {err.get('code')!r}",
+                    )
+                return
+            # anything else for an accepted sid is silent loss (404 means
+            # the fleet forgot a session it admitted)
+            item.outcome = "lost"
+            item.detail = f"unexpected status {status}"
+            self.violate(
+                "legal_410", f"{item.tag} answered {status} {doc!r}"
+            )
+            return
+
+    def _check_result(self, item: WorkItem) -> None:
+        url = f"{self.base_url}/v1/sessions/{item.sid}/result?format=raw"
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                status, doc = _http_json(url)
+            except Exception:  # noqa: BLE001 - transport noise: retry
+                status, doc = 502, {}
+            if status == 200:
+                try:
+                    board = protocol.decode_result(doc)
+                except Exception:  # noqa: BLE001 - injected mid-body garble
+                    board = None
+                if board is not None:
+                    item.outcome = "done"
+                    if board.tobytes() == item.oracle:
+                        item.delivered = True
+                    else:
+                        self.violate(
+                            "bit_identity",
+                            f"{item.tag} ({item.sid}) differs from its "
+                            f"solo oracle",
+                        )
+                    return
+            elif status not in (409, 502):
+                # DONE then no board is a contract violation, not retry noise
+                self.violate(
+                    "bit_identity",
+                    f"{item.tag} done but result answered {status}",
+                )
+                item.outcome = "failed"
+                item.detail = f"result {status}"
+                return
+            if time.monotonic() > deadline:
+                self.violate(
+                    "bit_identity",
+                    f"{item.tag} done but its result never materialized",
+                )
+                item.outcome = "failed"
+                item.detail = "result unavailable"
+                return
+            time.sleep(0.1)
+
+    # -- the kill schedule --------------------------------------------------
+    def run_kills(self, client: GatewayClient) -> None:
+        sup = self.fleet.supervisor
+        for k in range(self.cfg.kills):
+            victim = self._wait_for_victim(client, k)
+            if victim == "drained":
+                # every session finished before this kill could land: not
+                # an invariant violation, but the summary shows the gap
+                self.kills.append({"worker": None, "skipped": "drained"})
+                continue
+            if victim is None:
+                self.violate(
+                    "recovery_bounded",
+                    f"kill {k}: no worker ever owned a progressed session",
+                )
+                return
+            self._scrape_injections()  # evidence BEFORE the worker dies
+            gen0 = victim.generation
+            t0 = time.monotonic()
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            log.info("chaos drill: SIGKILL %s (kill %d)", victim.name, k)
+            # recovery = kill -> the VICTIM's successor generation answers
+            # ready again AND the fleet is back to full ready strength.
+            # Requiring the generation bump keeps the timer honest: right
+            # after the SIGKILL the supervisor may not have observed the
+            # death yet, and "everything still looks ready" must not
+            # count as an instant recovery.
+            deadline = t0 + self.cfg.recovery_bound_s
+            while not (
+                victim.generation > gen0
+                and len(sup.ready_workers()) >= self.cfg.workers
+            ):
+                if time.monotonic() > deadline:
+                    self.kills.append(
+                        {"worker": victim.name, "recovery_s": None}
+                    )
+                    self.violate(
+                        "recovery_bounded",
+                        f"kill {k} ({victim.name}): fleet not back to "
+                        f"{self.cfg.workers} ready within "
+                        f"{self.cfg.recovery_bound_s:.0f}s",
+                    )
+                    return
+                time.sleep(0.05)
+            self.kills.append(
+                {"worker": victim.name, "recovery_s": time.monotonic() - t0}
+            )
+
+    def _wait_for_victim(self, client: GatewayClient, k: int):
+        """A ready worker owning at least one live, progressed session —
+        chosen by a seeded draw among the candidates, so the kill
+        schedule replays with the seed."""
+        deadline = time.monotonic() + self.cfg.wait_timeout_s
+        while time.monotonic() < deadline:
+            owners: dict[str, int] = {}
+            in_flight = 0
+            for item in self.items:
+                if item.sid is None or item.outcome != "pending":
+                    continue
+                try:
+                    status, doc = _http_json(
+                        f"{self.base_url}/v1/sessions/{item.sid}", timeout=5.0
+                    )
+                except Exception:  # noqa: BLE001
+                    continue
+                if status != 200 or doc.get("finished") is not False:
+                    continue
+                in_flight += 1
+                worker = doc.get("worker")
+                done = doc.get("steps_done", 0)
+                if worker and done >= self.cfg.min_progress:
+                    owners[worker] = owners.get(worker, 0) + 1
+            if in_flight == 0:
+                # every accepted session already finished: budgets were
+                # too short for this kill — nothing left worth killing
+                return "drained"
+            ready = {w.name: w for w in self.fleet.supervisor.ready_workers()}
+            candidates = sorted(n for n in owners if n in ready)
+            if candidates:
+                pick = self._draw("drill.kill", k) % len(candidates)
+                return ready[candidates[pick]]
+            time.sleep(0.1)
+        return None
+
+    # -- invariants ----------------------------------------------------------
+    def check_metrics(self) -> None:
+        stats = self.fleet.stats()
+        routed = sum(stats.get("routed", {}).values())
+        if routed != self.accepted:
+            self.violate(
+                "metrics_consistent",
+                f"fleet_routed_total {routed} != accepted 201s {self.accepted}",
+            )
+        outcomes = {i.outcome for i in self.items}
+        if "pending" in outcomes:
+            self.violate(
+                "metrics_consistent", "an item finished the drill still pending"
+            )
+        mig = stats.get("migrations", {})
+        lost_410 = sum(1 for i in self.items if i.outcome == "lost")
+        covered = sum(mig.values()) if mig else 0
+        if lost_410 and not mig:
+            self.violate(
+                "metrics_consistent",
+                f"{lost_410} typed losses but no migration accounting at all",
+            )
+        self._migration_summary = {"migrations": mig, "covered": covered}
+
+    def verdicts(self) -> dict[str, dict]:
+        out = {}
+        for name in (
+            "all_terminal",
+            "bit_identity",
+            "legal_410",
+            "no_lost_work",
+            "recovery_bounded",
+            "metrics_consistent",
+        ):
+            probs = self.violations.get(name, [])
+            out[name] = {"ok": not probs, "violations": probs}
+        return out
+
+
+def run_drill(cfg: DrillConfig) -> dict:
+    """Run one seeded chaos drill; returns the summary record (also
+    appended to ``cfg.summary_file`` when set).  ``summary["ok"]`` is the
+    single pass/fail verdict; on failure the summary names the seed and
+    plan digest that replay the run verbatim."""
+    from tpu_life.fleet import Fleet, FleetConfig
+
+    d = _Driller(cfg)
+    spec = d.plan.spec()
+    t_start = time.monotonic()
+    prev_env = os.environ.get(chaos.ENV_VAR)
+    os.environ[chaos.ENV_VAR] = json.dumps(spec)  # workers inherit this
+    chaos.arm(d.plan)  # this process: router/supervisor/migrator seams
+    workdir = cfg.workdir
+    fleet = Fleet(
+        FleetConfig(
+            workers=cfg.workers,
+            port=0,
+            worker_args=(
+                "--serve-backend", cfg.backend,
+                "--capacity", str(cfg.capacity),
+                "--chunk-steps", str(cfg.chunk_steps),
+                "--max-queue", str(4 * (cfg.det_sessions + cfg.ising_sessions)),
+            ),
+            log_dir=os.path.join(workdir, "logs"),
+            spill_dir=os.path.join(workdir, "spill"),
+            spill_every=cfg.spill_every,
+            probe_interval_s=0.1,
+            backoff_base_s=0.2,
+            migrate_stuck_after_s=cfg.migrate_stuck_after_s,
+        )
+    )
+    d.fleet = fleet
+    try:
+        fleet.start()
+        if not fleet.wait_ready(timeout=120, min_workers=cfg.workers):
+            raise RuntimeError(
+                f"fleet never became ready: {fleet.supervisor.states()}"
+            )
+        d.base_url = f"http://127.0.0.1:{fleet.port}"
+        client = GatewayClient(d.base_url, retries=8)
+        for item in d.items:
+            d.submit_item(client, item)
+        d.run_kills(client)
+        # poll everything to terminal; play the documented client
+        # recourse for typed losses (resubmit from scratch, fresh sid)
+        for item in d.items:
+            if item.sid is None:
+                continue
+            d.poll_until_terminal(client, item)
+            while (
+                item.outcome in ("lost", "failed")
+                and item.resubmits < cfg.resubmit_lost
+            ):
+                item.resubmits += 1
+                if not d.submit_item(client, item):
+                    break
+                d.poll_until_terminal(client, item)
+        for item in d.items:
+            # EVERY workload item must deliver — including one whose
+            # submission was rejected outright (sid None): a drill that
+            # dropped work at admission must not certify itself ok
+            if not item.delivered:
+                d.violate(
+                    "no_lost_work",
+                    f"{item.tag} never yielded its oracle board "
+                    f"(final: {item.outcome} {item.detail})",
+                )
+        d._scrape_injections()
+        d.check_metrics()
+    finally:
+        try:
+            fleet.begin_drain()
+            fleet.wait(timeout=60)
+        finally:
+            fleet.close()
+            chaos.disarm()
+            if prev_env is None:
+                os.environ.pop(chaos.ENV_VAR, None)
+            else:
+                os.environ[chaos.ENV_VAR] = prev_env
+    elapsed = time.monotonic() - t_start
+    verdicts = d.verdicts()
+    outcomes: dict[str, int] = {}
+    for item in d.items:
+        outcomes[item.outcome] = outcomes.get(item.outcome, 0) + 1
+    recoveries = [
+        k["recovery_s"] for k in d.kills if k.get("recovery_s") is not None
+    ]
+    done = outcomes.get("done", 0)
+    summary = {
+        "kind": "chaos_drill",
+        # the replay stamp (docs/CHAOS.md): seed + canonical plan + its
+        # digest — a failed CI drill is rerun locally from exactly these
+        "seed": cfg.seed,
+        "plan": spec,
+        "plan_digest": d.plan.digest(),
+        "workers": cfg.workers,
+        "kills": d.kills,
+        "sessions": len(d.items),
+        "accepted": d.accepted,
+        "outcomes": outcomes,
+        "resubmits": sum(i.resubmits for i in d.items),
+        "delivered": sum(1 for i in d.items if i.delivered),
+        "injections": d.injections_by_point(),
+        "injections_local": chaos.counts(),
+        "migrations": getattr(d, "_migration_summary", {}).get("migrations", {}),
+        "invariants": verdicts,
+        "ok": all(v["ok"] for v in verdicts.values()),
+        "recovery_s_max": max(recoveries) if recoveries else None,
+        "elapsed_s": elapsed,
+        "sessions_per_sec": done / elapsed if elapsed > 0 else 0.0,
+    }
+    if cfg.summary_file:
+        from tpu_life import obs
+
+        obs.ensure_parent(cfg.summary_file)
+        with open(cfg.summary_file, "a") as f:
+            f.write(json.dumps(summary) + "\n")
+    return summary

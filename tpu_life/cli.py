@@ -367,6 +367,55 @@ def build_parser() -> argparse.ArgumentParser:
                     "placement error at startup, before any worker spawns")
     fl.add_argument("--verbose", "-v", action="store_true")
 
+    ch = sub.add_parser(
+        "chaos",
+        help="seeded chaos drill (docs/CHAOS.md): drive a real N-worker "
+        "CPU fleet under a deterministic fault schedule (spill ENOSPC, "
+        "snapshot bit-flips, socket resets, engine faults, SIGKILLs) "
+        "and machine-verify the failure-masking invariants",
+    )
+    ch.add_argument("--seed", type=int, default=0,
+                    help="the chaos seed: the fault schedule (and the "
+                    "kill schedule) is a pure function of it — a failed "
+                    "drill replays verbatim from its printed seed")
+    ch.add_argument("--workers", type=int, default=2)
+    ch.add_argument("--sessions", type=int, default=6,
+                    help="deterministic (conway) sessions in the mix")
+    ch.add_argument("--ising-sessions", type=int, default=2,
+                    help="stochastic (ising) sessions in the mix")
+    ch.add_argument("--size", type=int, default=20,
+                    help="deterministic board edge (ising runs 16x16)")
+    ch.add_argument("--steps", type=int, default=900,
+                    help="base step budget; staggered downward per session")
+    ch.add_argument("--kills", type=int, default=1,
+                    help="drill-driven SIGKILLs of session-owning workers")
+    ch.add_argument("--plan", default=None, metavar="JSON",
+                    help="chaos point spec as JSON (the plan's 'points' "
+                    "object; default: the documented drill mix — spill "
+                    "ENOSPC, snapshot bit-flip, submit/poll resets, one "
+                    "engine fault)")
+    ch.add_argument("--backend", default="numpy",
+                    choices=["numpy", "jax"],
+                    help="worker engine executor (numpy keeps the drill "
+                    "CPU-cheap; jax exercises the device engines)")
+    ch.add_argument("--capacity", type=int, default=4)
+    ch.add_argument("--chunk-steps", type=int, default=2)
+    ch.add_argument("--spill-every", type=int, default=1)
+    ch.add_argument("--recovery-bound", type=float, default=60.0,
+                    metavar="SECONDS",
+                    help="per-kill bound on fleet recovery to full ready "
+                    "strength (the recovery_bounded invariant)")
+    ch.add_argument("--wait-timeout", type=float, default=180.0,
+                    metavar="SECONDS",
+                    help="per-session bound on reaching a terminal state "
+                    "(the all_terminal invariant)")
+    ch.add_argument("--workdir", default=None, metavar="DIR",
+                    help="where spill/ and logs/ land (default: a fresh "
+                    "temp dir)")
+    ch.add_argument("--summary-file", default=None, metavar="JSONL",
+                    help="append the drill summary as one JSON line")
+    ch.add_argument("--verbose", "-v", action="store_true")
+
     cl = sub.add_parser(
         "client",
         help="talk to a running gateway: submit boards, poll, fetch "
@@ -653,6 +702,19 @@ def main(argv: list[str] | None = None) -> int:
         argv = ["run", *argv]  # default command
     args = parser.parse_args(argv)
 
+    # deterministic fault injection (docs/CHAOS.md): arm once, at entry,
+    # from TPU_LIFE_CHAOS when present — this is how a chaos drill arms
+    # the gateway worker subprocesses a fleet spawns (they inherit the
+    # exported spec).  Unset (the overwhelmingly common case), this is
+    # one dict lookup; a malformed spec fails loudly here, typed.
+    from tpu_life import chaos
+
+    try:
+        chaos.maybe_arm_from_env()
+    except chaos.ChaosError as e:
+        print(f"tpu_life: bad {chaos.ENV_VAR}: {e}", file=sys.stderr)
+        return 2
+
     if args.command == "info":
         return _info()
     if args.command == "gen":
@@ -673,6 +735,10 @@ def main(argv: list[str] | None = None) -> int:
         # the front tier is stdlib plumbing: only the worker SUBPROCESSES
         # touch jax, so the supervisor/router process needs no watchdog
         return _fleet(args)
+    if args.command == "chaos":
+        # the drill process is numpy-only (oracles + HTTP); the worker
+        # subprocesses own any jax — no watchdog needed here either
+        return _chaos_drill(args)
 
     from tpu_life.utils.platform import devices_with_watchdog, ensure_platform
 
@@ -1375,6 +1441,17 @@ def _gateway(args) -> int:
     # wedged accelerator must not delay the startup line past the
     # supervisor's startup timeout: the fields are simply omitted and
     # the supervisor picks them up from /readyz once they exist.
+    # chaos seam (docs/CHAOS.md): a worker that is slow out of the gate —
+    # the startup line (which the fleet supervisor blocks on) is delayed,
+    # exercising the startup-timeout / recycle path without a real slow
+    # accelerator attach
+    from tpu_life import chaos as _chaos
+
+    _delay = _chaos.delay("worker.start_delay")
+    if _delay > 0:
+        import time as _time
+
+        _time.sleep(_delay)
     startup = {
         "mode": "gateway",
         "url": f"http://{gw.host}:{gw.port}",
@@ -1565,6 +1642,76 @@ def _fleet(args) -> int:
         flush=True,
     )
     return 1 if failed else 0
+
+
+def _chaos_drill(args) -> int:
+    """The seeded chaos drill (docs/CHAOS.md): a real fleet under a
+    deterministic fault schedule, machine-verified invariants, one JSON
+    summary line.  Exit 0 only when every invariant held; on failure the
+    summary (and a stderr line) carries the seed + plan digest that
+    replay the run verbatim — the CI seed-replay contract.
+    """
+    import json
+    import tempfile
+
+    from tpu_life import chaos
+    from tpu_life.chaos.drill import DrillConfig, run_drill
+    from tpu_life.runtime.metrics import configure_logging
+
+    configure_logging(args.verbose)
+    points = None
+    if args.plan is not None:
+        try:
+            points = json.loads(args.plan)
+            if not isinstance(points, dict):
+                raise ValueError("plan must be a JSON object of points")
+            # validate NOW, typed — before any worker is spawned
+            chaos.ChaosPlan(args.seed, points)
+        except (ValueError, chaos.ChaosError) as e:
+            print(f"chaos: bad --plan: {e}", file=sys.stderr)
+            return 2
+    cfg = DrillConfig(
+        seed=args.seed,
+        workers=args.workers,
+        det_sessions=args.sessions,
+        ising_sessions=args.ising_sessions,
+        size=args.size,
+        steps=args.steps,
+        kills=args.kills,
+        points=points,
+        backend=args.backend,
+        capacity=args.capacity,
+        chunk_steps=args.chunk_steps,
+        spill_every=args.spill_every,
+        recovery_bound_s=args.recovery_bound,
+        wait_timeout_s=args.wait_timeout,
+        workdir=args.workdir or tempfile.mkdtemp(prefix="tpu-life-chaos-"),
+        summary_file=args.summary_file,
+    )
+    print(
+        json.dumps(
+            {
+                "mode": "chaos",
+                "seed": cfg.seed,
+                "workers": cfg.workers,
+                "sessions": cfg.det_sessions + cfg.ising_sessions,
+                "kills": cfg.kills,
+                "workdir": cfg.workdir,
+            }
+        ),
+        flush=True,
+    )
+    summary = run_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    if not summary["ok"]:
+        print(
+            f"chaos: INVARIANT FAILURE — replay verbatim with: "
+            f"tpu-life chaos --seed {cfg.seed} "
+            f"(plan digest {summary['plan_digest']})",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 def _client(parser, args) -> int:

@@ -65,6 +65,11 @@ class Fleet:
         self.config = config or FleetConfig()
         self.run_id = obs.new_run_id()
         self.registry = obs.MetricsRegistry()
+        # chaos observability (docs/CHAOS.md): router/supervisor/migrator
+        # injections fired in THIS process surface in the merged /metrics
+        from tpu_life import chaos
+
+        chaos.bind_registry(self.registry)
         self.supervisor = Supervisor(self.config, self.registry)
         self.sessions = SessionRegistry(self.config.max_pins)
         self.router = Router(
@@ -80,6 +85,7 @@ class Fleet:
                 balancer=self.router.balancer,
                 forward=self.router.forward,
                 timeout_s=self.config.migrate_timeout_s,
+                stuck_after_s=self.config.migrate_stuck_after_s,
             )
             self.router.migrator = self.migrator
             self.supervisor.on_worker_exit = self.migrator.worker_exit

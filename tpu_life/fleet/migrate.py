@@ -58,6 +58,7 @@ import time
 from collections import OrderedDict
 from pathlib import Path
 
+from tpu_life import chaos
 from tpu_life.fleet.registry import fleet_sid
 from tpu_life.fleet.router import REFUSAL_CODES, WorkerUnreachable
 from tpu_life.gateway.server import ROUTE_SESSIONS
@@ -112,6 +113,7 @@ class Migrator:
         sleep=time.sleep,
         timeout_s: float = 30.0,
         retry_pause_s: float = 0.5,
+        stuck_after_s: float = 120.0,
     ):
         self.spill_root = spill_root
         self.supervisor = supervisor
@@ -122,9 +124,23 @@ class Migrator:
         self.sleep = sleep
         self.timeout_s = timeout_s
         self.retry_pause_s = retry_pause_s
+        # the stuck-MIGRATING watchdog (docs/CHAOS.md): a migration run
+        # that neither finishes nor fails — its thread died, or the exit
+        # hook never fired — must not leave sids answering synthetic
+        # in-progress views forever.  Past this deadline WITHOUT
+        # PROGRESS (a live run heartbeats after every record it settles,
+        # so the clock bounds one record's stall, not the whole run —
+        # keep it comfortably above ``timeout_s``) a still-pending sid
+        # settles to a terminal 410 ``migration_failed``.
+        self.stuck_after_s = stuck_after_s
         self._lock = threading.Lock()
-        self._active: set[tuple[str, int]] = set()
+        # (worker, generation) -> when its migration run was activated
+        self._active: dict[tuple[str, int], float] = {}
         self._completed: set[tuple[str, int]] = set()
+        # fsid -> when the no-record "rescue imminent" fallback first
+        # answered migrating for it (the watchdog's clock for deaths
+        # whose exit hook never arrives)
+        self._pending_since: OrderedDict[str, float] = OrderedDict()
         # fsid -> terminal non-migrated reason (spill_corrupt / migration_failed)
         self._failed: OrderedDict[str, str] = OrderedDict()
         # (worker, generation, worker-sid) -> the ORIGINAL fleet sid a
@@ -140,7 +156,7 @@ class Migrator:
             "sessions handled by worker-death migration, by outcome",
             labels=("outcome",),
         )
-        for outcome in ("migrated", "corrupt", "failed"):
+        for outcome in ("migrated", "corrupt", "failed", "disabled"):
             self._c_migrations.labels(outcome=outcome)
 
     # -- the supervisor hook (called under its lock: must be fast) ----------
@@ -149,7 +165,20 @@ class Migrator:
         with self._lock:
             if key in self._active or key in self._completed:
                 return
-            self._active.add(key)
+            self._active[key] = self.clock()
+        # chaos seam (docs/CHAOS.md): the migration thread dies before it
+        # ever runs — the run is recorded ACTIVE but nothing will finish
+        # it.  Without the stuck watchdog this leaves every victim sid
+        # answering synthetic in-progress views forever; the drill arms
+        # this point and asserts they settle to 410 migration_failed.
+        if chaos.decide("migrate.die") is not None:
+            chaos.record_fire("migrate.die", "die")
+            log.error(
+                "chaos: migration thread for %s gen %d killed at birth",
+                name,
+                generation,
+            )
+            return
         t = threading.Thread(
             target=self._run,
             args=(name, generation),
@@ -172,23 +201,50 @@ class Migrator:
         exit-hook-not-yet-fired window, where a rescue is imminent).  A
         pin into an unknown PAST generation — a sid from a previous fleet
         process, or a forged generation — has no rescue coming and must
-        settle to a terminal 410, never poll as migrating forever."""
+        settle to a terminal 410, never poll as migrating forever.
+
+        Both migrating answers carry the stuck watchdog: an ACTIVE run
+        older than ``stuck_after_s`` (its thread died mid-flight), or a
+        pending-fallback sid that has waited that long for an exit hook
+        that never came, settles to a terminal 410 ``migration_failed``
+        instead of polling as migrating until the end of time."""
+        now = self.clock()
         with self._lock:
             reason = self._failed.get(fsid)
             if reason is not None:
                 return ("lost", reason)
             key = (pin.worker, pin.generation)
-            if key in self._active:
-                return ("migrating",)
-            if key in self._completed:
+            started = self._active.get(key)
+            if started is not None:
+                if now - started <= self.stuck_after_s:
+                    return ("migrating",)
+            elif key in self._completed:
                 # the run finished and neither re-pinned nor failed this
                 # sid: it was never spilled before the death
                 return ("lost", "never_snapshotted")
-        if pending_ok:
-            # the death has not reached the supervisor's exit hook yet
-            # (the monitor tick is on its way): migration is imminent
-            return ("migrating",)
-        return ("lost", "never_snapshotted")
+            elif not pending_ok:
+                return ("lost", "never_snapshotted")
+            else:
+                # the death has not reached the supervisor's exit hook yet
+                # (the monitor tick is on its way): migration is imminent —
+                # but start (and bound) the watchdog clock for this sid
+                first = self._pending_since.setdefault(fsid, now)
+                while len(self._pending_since) > MAX_OUTCOMES:
+                    self._pending_since.popitem(last=False)
+                if now - first <= self.stuck_after_s:
+                    return ("migrating",)
+        # the watchdog tripped: whatever was meant to settle this sid is
+        # presumed dead — record the terminal verdict (outside the lock;
+        # _record_failure re-acquires it) so every later request is a
+        # fast, consistent 410
+        log.warning(
+            "fleet: migration of %s stuck past %.0fs; settling to "
+            "migration_failed (watchdog)",
+            fsid,
+            self.stuck_after_s,
+        )
+        self._record_failure(fsid, "migration_failed")
+        return ("lost", "migration_failed")
 
     def progress(self, fsid: str) -> tuple[int, int] | None:
         with self._lock:
@@ -209,25 +265,35 @@ class Migrator:
         cleanup = True
         try:
             try:
-                records, corrupt = read_spill_sessions(d)
+                records, corrupt, disabled = read_spill_sessions(d)
             except Exception:
                 # a read failure must not delete bytes nobody looked at
                 log.exception("fleet: cannot read spills of %s gen %d", name,
                               generation)
-                records, corrupt, cleanup = [], [], False
+                records, corrupt, disabled, cleanup = [], [], [], False
             log.info(
                 "fleet: migrating %d session(s) from dead %s gen %d "
-                "(%d corrupt)",
+                "(%d corrupt, %d spill-disabled)",
                 len(records),
                 name,
                 generation,
                 len(corrupt),
+                len(disabled),
             )
             for sid in corrupt:
                 self._record_failure(
                     self._target_fsid(name, generation, sid),
                     "spill_corrupt",
                     counter="corrupt",
+                )
+            for sid in disabled:
+                # the worker itself degraded this session (a spill write
+                # failed — ENOSPC): the truthful reason is the
+                # degradation, not the misleading never_snapshotted
+                self._record_failure(
+                    self._target_fsid(name, generation, sid),
+                    "spill_disabled",
+                    counter="disabled",
                 )
             # resolve every record's client-facing fsid and publish its
             # last-known progress BEFORE any resume runs: synthetic poll
@@ -243,14 +309,35 @@ class Migrator:
             # abort records 4..N unattempted nor mislabel them
             # never_snapshotted — every session's fate gets recorded
             for fsid, rec in targets:
+                # the watchdog may have settled this sid to a terminal
+                # 410 while it waited its turn (behind a stalled
+                # predecessor record): the client was TOLD it is lost
+                # and the documented recourse is a fresh resubmission —
+                # resuming it now would run the trajectory twice.  The
+                # terminal answer is sticky; honor it.
+                with self._lock:
+                    settled = fsid in self._failed
+                if settled:
+                    log.warning(
+                        "fleet: %s settled by the stuck watchdog before "
+                        "its resume could run; not resuming", fsid,
+                    )
+                    continue
                 try:
                     self._migrate_one(fsid, rec)
                 except Exception:
                     log.exception("fleet: resume of %s crashed", fsid)
                     self._record_failure(fsid, "migration_failed")
+                # progress heartbeat: a LIVE run refreshes its watchdog
+                # clock after every record it settles, so stuck_after_s
+                # bounds one record's stall — never the wall time of a
+                # many-session rescue
+                with self._lock:
+                    if (name, generation) in self._active:
+                        self._active[(name, generation)] = self.clock()
         finally:
             with self._lock:
-                self._active.discard((name, generation))
+                self._active.pop((name, generation), None)
                 self._completed.add((name, generation))
             if cleanup:
                 # the victim's directory is orphaned now: every session
@@ -284,6 +371,7 @@ class Migrator:
         else:
             with self._lock:
                 self._progress.pop(fsid, None)
+                self._pending_since.pop(fsid, None)
             self._c_migrations.labels(outcome="migrated").inc()
 
     def _try_candidates(self, fsid: str, body: bytes, ready) -> str:
@@ -357,6 +445,7 @@ class Migrator:
             while len(self._failed) > MAX_OUTCOMES:
                 self._failed.popitem(last=False)
             self._progress.pop(fsid, None)
+            self._pending_since.pop(fsid, None)
         self._c_migrations.labels(outcome=counter).inc()
         log.warning("fleet: session %s not recovered (%s)", fsid, reason)
 

@@ -39,6 +39,7 @@ import urllib.request
 from http.server import ThreadingHTTPServer
 from urllib.parse import urlsplit
 
+from tpu_life import chaos
 from tpu_life.fleet import errors as fl_errors
 from tpu_life.fleet.balancer import LeastDepthBalancer, prom_value
 from tpu_life.fleet.registry import SessionRegistry
@@ -170,18 +171,52 @@ class Router:
             raise WorkerUnreachable(
                 worker, True, ConnectionRefusedError("worker has no bound URL")
             )
+        # chaos seam (docs/CHAOS.md): a socket reset BEFORE the request is
+        # written.  The worker never saw it, so the honest classification
+        # is a refusal — submits retry the next candidate (no duplicate is
+        # possible), exactly the path a NIC hiccup at connect exercises.
+        if method == "POST" and chaos.decide("router.submit.reset") is not None:
+            chaos.record_fire("router.submit.reset", "reset")
+            raise WorkerUnreachable(
+                worker, True, ConnectionResetError("chaos: pre-send reset")
+            )
+        poll_fault = (
+            chaos.decide("router.poll.reset")
+            if method in ("GET", "DELETE")
+            else None
+        )
         req = urllib.request.Request(worker.url + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", "application/json")
         if api_key is not None:
             req.add_header("X-API-Key", api_key)
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.config.forward_timeout_s
-            ) as resp:
-                return resp.status, None, _json_body(resp)
-        except urllib.error.HTTPError as e:
-            return e.code, parse_retry_after(e.headers), _json_body(e)
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.config.forward_timeout_s
+                ) as resp:
+                    status, retry_after, doc = resp.status, None, _json_body(resp)
+            except urllib.error.HTTPError as e:
+                # an error STATUS is still a completed exchange — the
+                # injected resets below apply to it exactly as to a 200
+                # (a 409/410 answer can be lost on the wire too)
+                status, retry_after, doc = (
+                    e.code, parse_retry_after(e.headers), _json_body(e)
+                )
+            if poll_fault is not None:
+                chaos.record_fire("router.poll.reset", poll_fault.fault.mode)
+                if poll_fault.fault.mode == "mid_exchange":
+                    # the exchange completed but the answer is lost on the
+                    # wire: ambiguous — the handlers must treat it as a
+                    # maybe-processed failure, never silently retry a POST
+                    raise WorkerUnreachable(
+                        worker,
+                        False,
+                        ConnectionResetError("chaos: mid-exchange reset"),
+                    )
+                # mid_body: the response truncated — the body parses empty
+                doc = {}
+            return status, retry_after, doc
         except (urllib.error.URLError, ConnectionError, socket.timeout, TimeoutError) as e:
             reason = getattr(e, "reason", e)
             refused = isinstance(reason, ConnectionRefusedError) or isinstance(

@@ -54,6 +54,7 @@ import urllib.request
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from tpu_life import chaos
 from tpu_life.fleet.placement import (
     PlacementError,
     apply_env_overlay,
@@ -101,6 +102,12 @@ class FleetConfig:
     spill_dir: str | None = None
     spill_every: int = 4  # rounds between worker spill passes
     migrate_timeout_s: float = 30.0  # per-session resume budget on death
+    #: stuck-MIGRATING watchdog (docs/CHAOS.md): a sid still answering
+    #: "migrating" this long after its run activated (or after the
+    #: rescue-imminent fallback first covered it) settles to a terminal
+    #: 410 ``migration_failed`` — a dead migrator thread must not leave
+    #: clients polling synthetic progress forever
+    migrate_stuck_after_s: float = 120.0
     #: device placement (docs/FLEET.md "Device placement"): ``"none"``
     #: keeps today's shared spawning env byte-for-byte; ``"auto"`` plans a
     #: disjoint device slice per worker and applies it as an env overlay
@@ -399,7 +406,13 @@ class Supervisor:
         duration.  Probe answers are re-validated against the generation
         before applying — the world may have moved while we waited.
         """
-        now = self.clock()
+        # chaos seam (docs/CHAOS.md): the monitor's clock reads skewed by
+        # a bounded, seeded amount — the "NTP stepped the clock" drill.
+        # Every deadline decision this tick makes (startup timeout,
+        # backoff expiry, healthy-uptime reset) sees the same skew, and
+        # the fleet must stay consistent: a skew-provoked kill is
+        # supervisor-initiated and rides the normal restart budget.
+        now = self.clock() + chaos.skew("probe.skew")
         to_probe: list[tuple[Worker, int]] = []
         with self._lock:
             for w in self.workers:

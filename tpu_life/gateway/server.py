@@ -38,6 +38,7 @@ from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+from tpu_life import chaos
 from tpu_life.gateway import errors as gw_errors
 from tpu_life.gateway import protocol
 from tpu_life.gateway.errors import ApiError, fmt_retry_after
@@ -220,6 +221,16 @@ class Gateway:
                 self._wake.wait(self.config.pump_idle_s)
                 self._wake.clear()
             else:
+                # chaos seams (docs/CHAOS.md): a worker that dies without
+                # warning (SIGKILL-grade — os._exit, no drain, no flush)
+                # and one that wedges mid-round.  Both fire from the pump
+                # loop because that is where a real worker death hurts:
+                # sessions mid-flight, spills mid-cadence, sockets open.
+                chaos.crash("worker.crash")
+                hang = chaos.delay("worker.hang")
+                if hang > 0:
+                    log.warning("chaos: pump hanging %.1fs (worker.hang)", hang)
+                    time.sleep(hang)
                 try:
                     svc.pump()
                 except Exception as e:
@@ -442,6 +453,13 @@ class _Handler(JsonHandler):
         return 200
 
     def _readyz(self) -> int:
+        # chaos seam: a worker that refuses its readiness probe while
+        # alive and stepping — the supervisor's unready-recycle path.
+        # 500 (not 503): the probe must read "unreachable", never the
+        # graceful "draining" a real 503 means.
+        if chaos.decide("worker.unready") is not None:
+            chaos.record_fire("worker.unready", "refuse")
+            raise ApiError(500, "chaos_unready", "chaos: injected unready probe")
         svc = self.gw.service
         if svc.draining:
             self._send_json(

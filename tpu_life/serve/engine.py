@@ -55,6 +55,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from tpu_life import chaos
 from tpu_life.models.rules import Rule
 
 
@@ -192,6 +193,12 @@ class EngineBase:
             if r > 0
         }
         if advanced:
+            # chaos seam: a launch-time device fault.  Raised BEFORE any
+            # state moves, so the engine stays consistent (nothing in
+            # flight, remaining untouched) and the scheduler's
+            # RECOVERABLE handling fails this key's sessions while every
+            # other key keeps stepping (per-key isolation).
+            chaos.inject("engine.dispatch")
             now = time.monotonic()
             if self._idle_since is not None:
                 self.idle_seconds += now - self._idle_since
@@ -207,6 +214,12 @@ class EngineBase:
         any slot reflects the chunk."""
         adv, self._inflight = self._inflight, {}
         if adv:
+            # chaos seam: the chunk's materialization fails (a device
+            # reset mid-chunk).  The in-flight accounting is already
+            # cleared, so the handler's slot releases leave the engine
+            # re-dispatchable; the chunk's results are simply lost and
+            # its sessions fail typed (per-key isolation again).
+            chaos.inject("engine.collect")
             self._collect_impl(adv)
             self._idle_since = time.monotonic()
         return adv

@@ -269,6 +269,58 @@ class Scheduler:
             stats.failed += 1
             log.info("serve: session %s failed in slot %d: %s", s.sid, slot, e)
 
+    def fail_engine_sessions(
+        self, key, error: str, stats: RoundStats | None = None
+    ) -> int:
+        """Fail the resident sessions of ONE engine after a chunk-level
+        RECOVERABLE fault (dispatch or collect raised) — with one
+        carve-out: sessions whose compute ALREADY finished in an earlier
+        chunk (this key's ``pending`` finishers, merely awaiting the
+        one-round retirement lag) are RETIRED, not failed.  The sync
+        pump retired them DONE a round ago, and the overlap must never
+        change an outcome; their boards come from chunks that predate
+        the fault, so collecting the engine's healthy in-flight chunk
+        (if any) materializes them.  If that collect ALSO faults, their
+        boards are genuinely unknowable and they fail with the rest.
+        ``_fresh`` finishers stay failed: their chunk IS the one that
+        died, so their final steps never materialized.  Every other key
+        keeps stepping untouched — the batch-level analogue of the
+        per-slot ``fault_at`` isolation: a device fault costs one key's
+        tenants, never the pump and never a completed result."""
+        stats = stats if stats is not None else RoundStats()
+        engine = self.engines.get(key)
+        slots = self.running.get(key, {})
+        salvage = [
+            (slot, s)
+            for slot, s in self.pending.get(key, [])
+            if slots.get(slot) is s
+        ]
+        if salvage and engine is not None and engine.inflight:
+            try:
+                engine.collect_chunk()
+            except recovery.RECOVERABLE:
+                salvage = []  # the settled boards are unreachable too
+        for slot, s in salvage:
+            self._retire_slot(engine, slots, slot, s, stats)
+        failed = 0
+        for slot, s in list(slots.items()):
+            del slots[slot]
+            if engine is not None:
+                engine.release(slot)
+            s.fail(error)
+            self._notify_finished(s)
+            failed += 1
+        self.pending.pop(key, None)
+        self._fresh.pop(key, None)
+        stats.failed += failed
+        if failed or salvage:
+            log.warning(
+                "serve: chunk fault on %r failed %d session(s), retired %d "
+                "already-finished: %s",
+                key, failed, len(salvage), error,
+            )
+        return failed
+
     def _retire_slot(
         self, engine: EngineBase, slots: dict, slot: int, s: Session,
         stats: RoundStats,
@@ -298,7 +350,16 @@ class Scheduler:
             with obs.span(
                 "serve.step-chunk", occupied=len(slots), steps=engine.chunk_steps
             ):
-                advanced = engine.advance_chunk()
+                try:
+                    advanced = engine.advance_chunk()
+                except recovery.RECOVERABLE as e:
+                    # a chunk-level device fault (the chaos engine.* drill,
+                    # or any real launch/materialize failure): this key's
+                    # tenants fail typed, the other keys' batches continue
+                    self.fail_engine_sessions(
+                        key, f"{type(e).__name__}: {e}", stats
+                    )
+                    continue
             with obs.span("serve.retire"):
                 for slot, n in advanced.items():
                     s = slots.get(slot)
@@ -369,7 +430,14 @@ class Scheduler:
         with obs.span(
             "serve.dispatch", occupied=len(slots), steps=engine.chunk_steps
         ):
-            advanced = engine.dispatch_chunk()
+            try:
+                advanced = engine.dispatch_chunk()
+            except recovery.RECOVERABLE as e:
+                # launch-time fault: nothing is in flight (the engine
+                # raises before any state moves), so failing this key's
+                # residents leaves the engine clean for new admissions
+                self.fail_engine_sessions(key, f"{type(e).__name__}: {e}", stats)
+                return False
         if not advanced:
             return False
         fresh = []
@@ -418,9 +486,14 @@ class Scheduler:
         round — the drain tail's last act before close, so no device work
         is abandoned mid-air (e.g. when every session of a chunk was
         cancelled while it flew)."""
-        for engine in self.engines.values():
+        for key, engine in self.engines.items():
             if engine.inflight:
-                engine.collect_chunk()
+                try:
+                    engine.collect_chunk()
+                except recovery.RECOVERABLE as e:
+                    # the chunk died on its way out: any still-resident
+                    # sessions fail typed instead of stranding the drain
+                    self.fail_engine_sessions(key, f"{type(e).__name__}: {e}")
 
     def idle_seconds_delta(self) -> float:
         """Device-idle seconds accumulated across engines since last asked
@@ -459,7 +532,10 @@ class Scheduler:
             if engine.inflight:
                 # don't strand a dispatched chunk mid-air (every session of
                 # it was cancelled): wait it out before dropping the engine
-                engine.collect_chunk()
+                try:
+                    engine.collect_chunk()
+                except recovery.RECOVERABLE:
+                    pass  # no residents by construction; the engine dies anyway
             del self.engines[k]
             del self.running[k]
             self.pending.pop(k, None)

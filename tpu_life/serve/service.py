@@ -64,8 +64,9 @@ from pathlib import Path
 
 import numpy as np
 
-from tpu_life import obs
+from tpu_life import chaos, obs
 from tpu_life.models.rules import Rule, get_rule
+from tpu_life.runtime import recovery
 from tpu_life.runtime.checkpoint import atomic_publish as ckpt_atomic_publish
 from tpu_life.runtime.metrics import MetricsRecorder, log
 from tpu_life.runtime.profiling import maybe_profile
@@ -201,6 +202,15 @@ class SimulationService:
         self._g_spilled = self.registry.gauge(
             "serve_spilled_sessions", "live sessions with a spill on disk"
         )
+        # disk-full graceful degradation (docs/CHAOS.md): spill writes
+        # that failed (ENOSPC, dead disk).  Each failure disables spill
+        # for THAT session only — it keeps running without durability —
+        # and the pump survives; the counter is the operator's signal
+        self._c_spill_errors = self.registry.counter(
+            "serve_spill_errors_total",
+            "failed session-spill writes (the session degrades to "
+            "spill-disabled; the service keeps serving)",
+        )
         # engine compile counts by CompileKey bucket (rule:HxW:backend —
         # a closed set in any sane deployment; the cap bounds the rest)
         self._g_compiles = self.registry.gauge(
@@ -223,8 +233,13 @@ class SimulationService:
             self._c_device_idle,
             self._h_snapshot,
             self._g_spilled,
+            self._c_spill_errors,
         ):
             fam.labels()
+        # chaos observability (docs/CHAOS.md): injections fired in this
+        # process land in the shared registry — /metrics, the prom file,
+        # the JSONL snapshot.  A disarmed process just never ticks it.
+        chaos.bind_registry(self.registry)
         # the spill store (durable sessions): created eagerly so a bad
         # spill path fails at construction, not at the first spill pass
         if self.config.spill_dir is not None:
@@ -540,7 +555,8 @@ class SimulationService:
                 # the sync pump is fully settled after round(): every lag
                 # is zero and every board materialized.  Spilling here
                 # holds the lock (the sync pump holds it anyway).
-                self._run_spill(plan)
+                failures = self._run_spill(plan)
+                self._apply_spill_failures(failures)
                 self._sweep_spills(plan)
         self._finish_round(stats)
         return stats
@@ -563,15 +579,24 @@ class SimulationService:
         # -- the overlap window: no service lock held.  Device chunks (and
         # host-engine compute) complete here while submit/poll/cancel stay
         # serviceable; verb-triggered slot releases defer to the next begin.
+        spill_failures: list = []
+        chunk_faults: list = []
         try:
             with obs.activate(self._tracer), obs.span(
                 "serve.collect", engines=len(plan)
             ):
-                for _, engine, was_rolled in plan:
-                    if was_rolled:
-                        engine.settle()
-                    else:
-                        engine.collect_chunk()
+                for key, engine, was_rolled in plan:
+                    try:
+                        if was_rolled:
+                            engine.settle()
+                        else:
+                            engine.collect_chunk()
+                    except recovery.RECOVERABLE as e:
+                        # a chunk-level fault while settling (the chaos
+                        # engine.collect drill, or a real device reset):
+                        # recorded here, handled under the lock below —
+                        # this key's sessions fail typed, the pump lives
+                        chunk_faults.append((key, f"{type(e).__name__}: {e}"))
             if spill_plan:
                 # engines are settled (double buffers materialized) and
                 # still marked busy, so verb releases stay deferred and
@@ -581,15 +606,18 @@ class SimulationService:
                 # activate block, and the spill span belongs to THIS
                 # service's timeline, not whatever is ambient.
                 with obs.activate(self._tracer):
-                    self._run_spill(spill_plan)
+                    spill_failures = self._run_spill(spill_plan)
         finally:
             with self._lock:
                 for _, engine, _ in plan:
                     engine.busy = False
         with self._lock:
+            for key, msg in chunk_faults:
+                self.scheduler.fail_engine_sessions(key, msg, stats)
             with obs.activate(self._tracer):
                 self.scheduler.round_end(keyer, stats, rolled)
             if spill_plan:
+                self._apply_spill_failures(spill_failures)
                 self._sweep_spills(spill_plan)
             self._finish_round(stats)
         return stats
@@ -609,21 +637,28 @@ class SimulationService:
         for key, slots in self.scheduler.running.items():
             engine = self.scheduler.engines[key]
             for slot, s in slots.items():
-                plan.append((s, engine, slot))
+                if not s.spill_disabled:
+                    plan.append((s, engine, slot))
         for s in self.scheduler.queue:
-            plan.append((s, None, None))
+            if not s.spill_disabled:
+                plan.append((s, None, None))
         return plan
 
-    def _run_spill(self, plan: list) -> None:
+    def _run_spill(self, plan: list) -> list:
         """Pump thread, engines settled: write each planned session's
         newest materialized board + manifest through the checkpoint
         contract.  Sessions that went terminal since the plan was taken
-        are skipped (and swept under the lock afterwards)."""
+        are skipped (and swept under the lock afterwards).  Returns the
+        ``(session, error)`` write failures — an ENOSPC (or any OSError)
+        must NOT escape into the pump (it would kill the whole worker
+        over one session's durability); the locked round tail degrades
+        those sessions to spill-disabled instead."""
         t0 = time.monotonic()
         now = self.clock()
+        failures: list = []
         with obs.span("serve.spill", sessions=len(plan)):
             for s, engine, slot in plan:
-                if s.state in TERMINAL:
+                if s.state in TERMINAL or s.spill_disabled:
                     continue
                 if engine is None:
                     board, lag = s.board, 0
@@ -633,19 +668,50 @@ class SimulationService:
                 timeout_s = (
                     None if s.deadline is None else max(0.0, s.deadline - now)
                 )
-                self._spill.save(
-                    s.sid,
-                    board,
-                    abs_step,
-                    rule=s.rule.name,
-                    steps_total=s.start_step + s.steps,
-                    seed=s.seed,
-                    temperature=s.temperature,
-                    timeout_s=timeout_s,
-                )
+                try:
+                    self._spill.save(
+                        s.sid,
+                        board,
+                        abs_step,
+                        rule=s.rule.name,
+                        steps_total=s.start_step + s.steps,
+                        seed=s.seed,
+                        temperature=s.temperature,
+                        timeout_s=timeout_s,
+                    )
+                except OSError as e:
+                    # the disk work of the degradation (drop the stale
+                    # snapshots, publish the DISABLED marker) happens
+                    # HERE, in the pump's unlocked window — a full or
+                    # HUNG disk must never stall the service lock; the
+                    # locked tail only flips the flag and the counter.
+                    # A session that goes terminal meanwhile is swept
+                    # (marker and all) by _sweep_spills, like any spill.
+                    self._spill.mark_disabled(s.sid)
+                    failures.append((s, e))
         dt = time.monotonic() - t0
         self._h_snapshot.observe(dt)
         self._snapshot_s_total += dt
+        return failures
+
+    def _apply_spill_failures(self, failures: list) -> None:
+        """Locked: degrade each failed write's session to spill-disabled —
+        one counter tick and ONE log line per session (it leaves the spill
+        plan, so it can never re-fail or re-log).  The DISABLED marker
+        was already published by the unlocked spill pass; the session
+        itself keeps running: a full disk costs durability, never the
+        service."""
+        for s, e in failures:
+            if s.spill_disabled:
+                continue
+            s.spill_disabled = True
+            self._c_spill_errors.inc()
+            log.warning(
+                "serve: spill write for %s failed (%s); durability disabled "
+                "for this session — it keeps running without failover cover",
+                s.sid,
+                e,
+            )
 
     def _sweep_spills(self, plan: list) -> None:
         """Locked: drop spills of sessions that reached a terminal state
@@ -703,6 +769,7 @@ class SimulationService:
                     {
                         "spilled_sessions": self._spill.spilled_count(),
                         "snapshot_s": self._snapshot_s_total,
+                        "spill_errors": self._c_spill_errors.value,
                     }
                     if self._spill is not None
                     else {}
@@ -786,6 +853,7 @@ class SimulationService:
                 self._spill.spilled_count() if self._spill is not None else 0
             ),
             "snapshot_seconds": self._snapshot_s_total,
+            "spill_errors": self._c_spill_errors.value,
             "queue_wait_p50": self._h_queue_wait.quantile(0.5),
             "queue_wait_p95": self._h_queue_wait.quantile(0.95),
             "queue_wait_p99": self._h_queue_wait.quantile(0.99),

@@ -73,6 +73,11 @@ class Session:
     # client sees monotone progress across the worker boundary, and the
     # MC engines re-enter the counter-based stream at the exact position.
     start_step: int = 0
+    # disk-full graceful degradation (docs/CHAOS.md): set when a spill
+    # write for this session failed.  The session keeps running but
+    # leaves the spill plan — durability is off for it alone; a worker
+    # death after this answers 410 ``spill_disabled``.
+    spill_disabled: bool = False
 
     @property
     def steps_remaining(self) -> int:

@@ -38,13 +38,16 @@ from pathlib import Path
 
 import numpy as np
 
+from tpu_life import chaos
 from tpu_life.io.codec import read_board
 from tpu_life.runtime.checkpoint import (
     atomic_publish,
+    crc_path,
     list_snapshots,
     prune_snapshots,
     save_snapshot,
     snapshot_intact,
+    snapshot_path,
 )
 from tpu_life.runtime.metrics import log
 
@@ -53,6 +56,13 @@ from tpu_life.runtime.metrics import log
 KEEP_SNAPSHOTS = 2
 
 MANIFEST = "manifest.json"
+
+#: Marker published when spill is disabled for a session (a write failure
+#: — ENOSPC, a dead disk).  The session keeps running WITHOUT durability;
+#: the marker makes the degradation visible to the migration tier, which
+#: answers the sid's post-death requests 410 ``spill_disabled`` instead
+#: of the misleading ``never_snapshotted``.
+DISABLED = "DISABLED.json"
 
 
 @dataclass(frozen=True)
@@ -110,7 +120,13 @@ class SpillStore:
         if written and written[-1] == step:
             return False
         d = self.root / sid
+        # chaos seam (docs/CHAOS.md): a disk-full / dead-disk write fails
+        # HERE, inside the store, exactly where a real one would — the
+        # service's spill pass catches the OSError, counts it, and
+        # degrades the session to spill-disabled instead of dying
+        chaos.inject("spill.write")
         save_snapshot(d, step, board, rule=rule)
+        self._maybe_corrupt(d, step)
         manifest = {
             "sid": sid,
             "rule": rule,
@@ -127,6 +143,42 @@ class SpillStore:
         self._written[sid] = prune_snapshots(d, KEEP_SNAPSHOTS, written)
         return True
 
+    def _maybe_corrupt(self, d: Path, step: int) -> None:
+        """Chaos seam: bit-flip (or truncate) the just-published snapshot
+        bytes — the disk-rot drill.  The CRC sidecar stays truthful to the
+        ORIGINAL bytes, so the intact check must demote this snapshot to
+        its predecessor instead of resuming garbage."""
+        if not chaos.armed():
+            return
+        p = snapshot_path(d, step)
+        data = p.read_bytes()
+        mangled = chaos.corrupt("snapshot.corrupt", data)
+        if mangled is not data:
+            p.write_bytes(mangled)
+
+    def mark_disabled(self, sid: str) -> None:
+        """Degrade one session to spill-disabled (a write failure — the
+        disk is full or dying): its snapshots are dropped — bytes we can
+        no longer keep fresh must not masquerade as a recovery point —
+        and a marker is published so a post-death migration answers the
+        truthful 410 ``spill_disabled``.  Best-effort: on a disk this
+        broken even the marker write may fail, which degrades the reason
+        to ``never_snapshotted`` — still a truthful 410."""
+        self._written.pop(sid, None)
+        d = self.root / sid
+        try:
+            if d.exists():
+                for step, f in list_snapshots(d):
+                    f.unlink(missing_ok=True)
+                    f.with_suffix(".json").unlink(missing_ok=True)
+                    crc_path(f).unlink(missing_ok=True)
+                (d / MANIFEST).unlink(missing_ok=True)
+            d.mkdir(parents=True, exist_ok=True)
+            with atomic_publish(d / DISABLED) as tmp:
+                tmp.write_text(json.dumps({"sid": sid, "reason": "spill_error"}))
+        except OSError:
+            log.warning("spill: could not publish disabled marker for %s", sid)
+
     def delete(self, sid: str) -> None:
         """Drop a session's spill (terminal transition: done / failed /
         cancelled) — from here on the session must never resume."""
@@ -142,25 +194,36 @@ class SpillStore:
 
 def read_spill_sessions(
     root: str | os.PathLike,
-) -> tuple[list[SpillRecord], list[str]]:
+) -> tuple[list[SpillRecord], list[str], list[str]]:
     """Read every resumable session under a (dead worker's) spill root.
 
-    Returns ``(records, corrupt_sids)``: a session whose manifest is
-    unreadable or whose snapshots all fail the intact check (size + CRC)
-    lands in ``corrupt_sids`` — the migration tier answers those with a
-    typed 410 ``spill_corrupt`` instead of resuming garbage.  A corrupt
-    *newest* snapshot with an intact predecessor demotes silently (the
+    Returns ``(records, corrupt_sids, disabled_sids)``: a session whose
+    manifest is unreadable or whose snapshots all fail the intact check
+    (size + CRC) lands in ``corrupt_sids`` — the migration tier answers
+    those with a typed 410 ``spill_corrupt`` instead of resuming
+    garbage — and a session the worker degraded to spill-disabled (a
+    write failure; the :data:`DISABLED` marker) lands in
+    ``disabled_sids`` (410 ``spill_disabled``).  A corrupt *newest*
+    snapshot with an intact predecessor demotes silently (the
     recovery-point moves back one spill interval — the same contract as
     directory resume).
     """
     rootp = Path(root)
     records: list[SpillRecord] = []
     corrupt: list[str] = []
+    disabled: list[str] = []
     if not rootp.is_dir():
-        return records, corrupt
+        return records, corrupt, disabled
     for d in sorted(p for p in rootp.iterdir() if p.is_dir()):
         sid = d.name
+        if (d / DISABLED).exists():
+            disabled.append(sid)
+            continue
         try:
+            # chaos seam: a read failure on the rescue path — the whole
+            # session must land in ``corrupt`` (never crash the migration
+            # run, never delete bytes nobody decoded)
+            chaos.inject("spill.read")
             meta = json.loads((d / MANIFEST).read_text())
             height = int(meta["height"])
             width = int(meta["width"])
@@ -202,4 +265,4 @@ def read_spill_sessions(
                 width=width,
             )
         )
-    return records, corrupt
+    return records, corrupt, disabled
