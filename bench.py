@@ -841,8 +841,7 @@ def run_chaos_bench(args, platform: str, degraded: bool) -> dict:
     """
     import tempfile
 
-    from tpu_life.chaos import ChaosPlan
-    from tpu_life.chaos.drill import DEFAULT_POINTS, DrillConfig, run_drill
+    from tpu_life.chaos.drill import DrillConfig, run_drill
 
     def leg(points, kills, tag):
         workdir = tempfile.mkdtemp(prefix=f"tpu-life-bench-chaos-{tag}-")
@@ -863,6 +862,7 @@ def run_chaos_bench(args, platform: str, degraded: bool) -> dict:
             shutil.rmtree(workdir, ignore_errors=True)
         return {
             "ok": summary["ok"],
+            "plan_digest": summary["plan_digest"],
             "sessions": summary["sessions"],
             "delivered": summary["delivered"],
             "resubmits": summary["resubmits"],
@@ -881,7 +881,6 @@ def run_chaos_bench(args, platform: str, degraded: bool) -> dict:
         for k in chaotic["kills"]
         if k.get("recovery_s") is not None
     )
-    plan = ChaosPlan(args.chaos_seed, DEFAULT_POINTS)
     return {
         "metric": "chaos_sessions_per_sec",
         "value": chaotic["sessions_per_sec"],
@@ -892,7 +891,7 @@ def run_chaos_bench(args, platform: str, degraded: bool) -> dict:
         "kills": args.chaos_kills,
         # the replay stamp: every robustness number names its adversity
         "chaos_seed": args.chaos_seed,
-        "plan_digest": plan.digest(),
+        "plan_digest": chaotic["plan_digest"],
         "fault_free": fault_free,
         "chaos": chaotic,
         "throughput_under_faults_frac": (
@@ -903,6 +902,61 @@ def run_chaos_bench(args, platform: str, degraded: bool) -> dict:
         "recovery_s_p50": recoveries[len(recoveries) // 2] if recoveries else None,
         "recovery_s_max": recoveries[-1] if recoveries else None,
         "invariants_ok": fault_free["ok"] and chaotic["ok"],
+        "degraded": degraded,
+    }
+
+
+def run_cross_host_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_cross_host capture (docs/FLEET.md "Cross-host
+    topology"): the two-control-plane drill — wire registration, a lease
+    expiry, a SIGKILL, seeded partitions and remote-spill faults — as one
+    record, with the lease/fence evidence and the invariant verdicts
+    stamped next to the throughput.  Replayable: the record carries the
+    seed and plan digest.
+    """
+    import tempfile
+
+    from tpu_life.chaos.crosshost import CrossHostConfig, run_cross_host_drill
+
+    workdir = tempfile.mkdtemp(prefix="tpu-life-bench-crosshost-")
+    try:
+        summary = run_cross_host_drill(
+            CrossHostConfig(
+                seed=args.chaos_seed,
+                workers=args.chaos_workers,
+                kills=args.chaos_kills,
+                workdir=workdir,
+            )
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    recoveries = sorted(
+        k["recovery_s"]
+        for k in summary["kills"]
+        if k.get("recovery_s") is not None
+    )
+    return {
+        "metric": "cross_host_sessions_per_sec",
+        "value": summary["sessions_per_sec"],
+        "unit": "sessions/s",
+        "platform": platform,
+        "backend": "numpy",
+        "workers_b": args.chaos_workers,
+        # the replay stamp: every robustness number names its adversity
+        "chaos_seed": args.chaos_seed,
+        "plan_digest": summary["plan_digest"],
+        "sessions": summary["sessions"],
+        "delivered": summary["delivered"],
+        "resubmits": summary["resubmits"],
+        "outcomes": summary["outcomes"],
+        "injections": summary["injections"],
+        "lease": summary["lease"],
+        "peer_rescues": summary["peer_rescues"],
+        "kills": summary["kills"],
+        "recovery_s_max": recoveries[-1] if recoveries else None,
+        "elapsed_s": summary["elapsed_s"],
+        "sessions_per_sec": summary["sessions_per_sec"],
+        "invariants_ok": summary["ok"],
         "degraded": degraded,
     }
 
@@ -1182,6 +1236,14 @@ def main() -> None:
     p.add_argument("--chaos-seed", type=int, default=0)
     p.add_argument("--chaos-workers", type=int, default=2)
     p.add_argument("--chaos-kills", type=int, default=1)
+    # the BENCH_cross_host capture (docs/FLEET.md "Cross-host topology"):
+    # the two-control-plane drill as one record — reuses the --chaos-*
+    # knobs (seed / workers / kills) for its shape
+    p.add_argument("--cross-host", action="store_true",
+                   help="robustness bench: the two-control-plane drill "
+                   "(wire registration, lease expiry + fence, SIGKILL, "
+                   "seeded partitions, remote-spill faults) — emits "
+                   "cross_host_sessions_per_sec")
     # the BENCH_mc capture: Metropolis sweep throughput through the
     # stochastic tier (sweeps/s, spin-updates/s; docs/STOCHASTIC.md)
     p.add_argument("--mc", action="store_true",
@@ -1346,6 +1408,8 @@ def main() -> None:
             result = run_fleet_bench(args, platform, degraded)
         elif args.chaos:
             result = run_chaos_bench(args, platform, degraded)
+        elif args.cross_host:
+            result = run_cross_host_bench(args, platform, degraded)
         elif args.serve:
             result = run_serve_bench(args, platform, degraded)
         elif args.mc:
@@ -1394,10 +1458,10 @@ def main() -> None:
                     )
                 cmd += ["--serve-capacity", str(args.serve_capacity)]
                 cmd += ["--serve-chunk-steps", str(args.serve_chunk_steps)]
-            if args.chaos:
+            if args.chaos or args.cross_host:
                 # the retry must re-run the SAME seeded drill: seed and
                 # shape ride along so the replay contract holds
-                cmd += ["--chaos",
+                cmd += ["--cross-host" if args.cross_host else "--chaos",
                         "--chaos-seed", str(args.chaos_seed),
                         "--chaos-workers", str(args.chaos_workers),
                         "--chaos-kills", str(args.chaos_kills)]
@@ -1428,6 +1492,9 @@ def main() -> None:
         elif args.chaos:
             metric, unit = "chaos_sessions_per_sec", "sessions/s"
             size, steps = args.serve_size, args.serve_steps
+        elif args.cross_host:
+            metric, unit = "cross_host_sessions_per_sec", "sessions/s"
+            size, steps = args.serve_size, args.serve_steps
         elif args.fleet:
             metric, unit = "fleet_cells_per_sec", "cells/s"
             size, steps = args.serve_size, args.serve_steps
@@ -1457,7 +1524,7 @@ def main() -> None:
             failure["batch_capacity"] = args.serve_capacity
             if args.fleet:
                 failure["workers"] = args.fleet_workers
-        elif args.chaos:
+        elif args.chaos or args.cross_host:
             # the replay stamp survives even a failed capture
             failure["chaos_seed"] = args.chaos_seed
             failure["workers"] = args.chaos_workers

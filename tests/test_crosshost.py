@@ -1,0 +1,964 @@
+"""Cross-host control plane: leases/fencing, the remote spill store's
+fault matrix, and the shared backoff machinery (ISSUE 11).
+
+Three layers, cheapest first:
+
+- pure units: ``backoff_delay``, the per-pair partition schedule
+  (``decide_pair`` — a seeded connectivity MASK, not a global coin);
+- membership on fakes: the supervisor's register/heartbeat/fence state
+  machine under an injected clock, and the worker-side ``Registrar``
+  against a scripted http callable — no sockets, no subprocesses;
+- the remote spill store: a real :class:`SpillHTTPServer` (threads, not
+  processes) under the documented fault matrix — timeout, connection
+  refused, reset mid-exchange, torn body, 5xx, CRC rot — each asserted
+  to its typed outcome (bounded retry / OSError-degradation / demotion
+  to the predecessor snapshot), plus a scripted misbehaving server for
+  the transport faults a healthy store never produces.
+
+The full two-control-plane drill (real subprocesses, wire registration,
+SIGKILL + partitions in one seeded run) is `tpu-life chaos --cross-host`
+— exercised by the CI "Cross-host smoke"; the end of this file drives
+the one expensive e2e slice tier-1 still owes: a real fleet rescuing a
+SIGKILLed worker's sessions THROUGH the remote store.
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_life import chaos, obs
+from tpu_life.fleet.membership import Registrar, heartbeat_every
+from tpu_life.fleet.supervisor import FleetConfig, Supervisor, WorkerState
+from tpu_life.gateway.errors import ApiError, backoff_delay
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.serve import ServeConfig, SimulationService
+from tpu_life.serve.spill import (
+    KEEP_SNAPSHOTS,
+    SpillBackend,
+    SpillStore,
+    make_spill_backend,
+    read_spill_sessions,
+)
+from tpu_life.serve.spill_http import (
+    HttpSpillBackend,
+    SpillHTTPServer,
+    read_remote_sessions,
+    snap_name,
+)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class FixedRng:
+    def __init__(self, v):
+        self.v = v
+
+    def uniform(self, lo, hi):
+        return self.v
+
+
+# -- the shared backoff formula ----------------------------------------------
+def test_backoff_delay_exponential_and_capped():
+    assert [
+        backoff_delay(a, base=0.1, cap=100.0, jitter=0.0) for a in (1, 2, 3, 4)
+    ] == pytest.approx([0.1, 0.2, 0.4, 0.8])
+    assert backoff_delay(30, base=0.1, cap=5.0, jitter=0.0) == 5.0
+
+
+def test_backoff_delay_jitter_spreads_but_cap_is_hard():
+    up = backoff_delay(1, base=1.0, cap=10.0, jitter=0.25, rng=FixedRng(0.25))
+    dn = backoff_delay(1, base=1.0, cap=10.0, jitter=0.25, rng=FixedRng(-0.25))
+    assert (up, dn) == (1.25, 0.75)
+    # the cap clamps AFTER jitter: it is a hard bound callers size
+    # against deadlines, never exceeded by an upward draw
+    assert backoff_delay(9, base=1.0, cap=3.0, jitter=0.25, rng=FixedRng(0.25)) == 3.0
+
+
+# -- the seeded per-pair connectivity mask -----------------------------------
+def test_decide_pair_schedule_is_pure_function_of_seed_and_pair():
+    pts = {"net.partition": {"rate": 0.5, "mode": "drop"}}
+    a, b = chaos.ChaosPlan(7, pts), chaos.ChaosPlan(7, pts)
+    mask = a.preview_pair("net.partition", "router->w0", 64)
+    assert mask == b.preview_pair("net.partition", "router->w0", 64)
+    assert 0 < sum(mask) < 64  # a mask, not a constant
+    # the live decision stream follows the previewed mask exactly
+    fired = [
+        a.decide_pair("net.partition", "router->w0") is not None
+        for _ in range(64)
+    ]
+    assert fired == mask
+    # one armed point, distinct links, distinct schedules — some sever,
+    # others spare: the asymmetric partition
+    assert b.preview_pair("net.partition", "router->w1", 64) != mask
+    assert chaos.ChaosPlan(8, pts).preview_pair(
+        "net.partition", "router->w0", 64
+    ) != mask
+
+
+def test_decide_pair_times_bounds_total_fires_across_pairs():
+    plan = chaos.ChaosPlan(
+        0, {"net.partition": {"rate": 1.0, "mode": "drop", "times": 3}}
+    )
+    fires = sum(
+        plan.decide_pair("net.partition", f"p{i % 4}") is not None
+        for i in range(32)
+    )
+    assert fires == 3  # the partition HEALS: drills need bounded severing
+
+
+@pytest.mark.chaos
+def test_partitioned_helper_fires_then_heals():
+    plan = chaos.ChaosPlan(
+        0, {"net.partition": {"rate": 1.0, "mode": "drop", "times": 2}}
+    )
+    chaos.arm(plan)
+    try:
+        hits = [chaos.partitioned("a", "b") for _ in range(5)]
+    finally:
+        chaos.disarm()
+    assert hits == [True, True, False, False, False]
+    assert chaos.partitioned("a", "b") is False  # disarmed: never severed
+
+
+@pytest.mark.chaos
+def test_peer_proxy_link_failure_is_retryable_503(tmp_path):
+    """A transient failure on the router->peer link answers the typed
+    retryable 503 ``peer_unreachable`` — never the non-retryable 502:
+    every proxied request is an idempotent GET/DELETE, so an unmodified
+    poll-until-done client rides through a link blip.  A severed
+    ``net.partition`` on the same link must look exactly the same."""
+    from tpu_life.fleet.registry import SessionRegistry
+    from tpu_life.fleet.router import Router
+
+    cfg = FleetConfig(workers=0, port=0, log_dir=str(tmp_path / "logs"))
+    reg = obs.MetricsRegistry()
+    sup = Supervisor(cfg, reg, spawn=lambda w: None, probe=lambda w: "ready")
+    router = Router(cfg, sup, SessionRegistry(), reg)
+    try:
+        peer = ("http://127.0.0.1:9", "b-w1g1-s000001")  # nothing listens
+        with pytest.raises(ApiError) as ei:
+            router._route_peer("GET", "a-w1g1-s000001", peer, "", None)
+        assert ei.value.status == 503
+        assert ei.value.code == "peer_unreachable"
+        assert ei.value.retry_after is not None
+        with chaos.armed_plan(
+            {"seed": 1, "points": {"net.partition": {"mode": "drop"}}}
+        ):
+            with pytest.raises(ApiError) as ei:
+                router._route_peer("GET", "a-w1g1-s000001", peer, "", None)
+        assert ei.value.status == 503
+        assert ei.value.code == "peer_unreachable"
+    finally:
+        router.close()
+
+
+# -- membership: the control-plane state machine on fakes --------------------
+@pytest.fixture
+def control(tmp_path):
+    """A zero-local-worker control plane with an injected clock and a
+    probe that always answers ready — membership logic only."""
+    clock = FakeClock()
+    cfg = FleetConfig(
+        workers=0,
+        log_dir=str(tmp_path / "logs"),
+        lease_ttl_s=10.0,
+        spill_url="http://store.invalid:1",
+        site="a-",
+    )
+    s = Supervisor(
+        cfg, obs.MetricsRegistry(),
+        spawn=lambda w: None, probe=lambda w: "ready", clock=clock,
+    )
+    return s, clock
+
+
+def test_malformed_registration_devices_is_typed_400_no_ghost(control):
+    """A registration whose ``devices`` cannot parse is refused with the
+    typed 400 BEFORE any slot mutation — a half-registered ghost (bumped
+    generation, zero lease) would be expired and pointlessly migrated by
+    the very next monitor tick."""
+    s, clock = control
+    for bad in ("abc", [4]):
+        with pytest.raises(ApiError) as ei:
+            s.register_worker(
+                {"url": "http://127.0.0.1:9", "devices": bad}
+            )
+        assert ei.value.status == 400
+        assert ei.value.code == "bad_registration"
+    assert s.workers == []  # nothing admitted, nothing half-mutated
+
+
+def test_cross_host_drill_refuses_kills_other_than_one(tmp_path):
+    """The scripted choreography performs exactly one adopter SIGKILL —
+    a summary stamped with any other kill count would lie about the
+    adversity, so the knob is validated before anything spawns."""
+    from tpu_life.chaos import ChaosError
+    from tpu_life.chaos.crosshost import CrossHostConfig, run_cross_host_drill
+
+    with pytest.raises(ChaosError, match="exactly one adopter"):
+        run_cross_host_drill(
+            CrossHostConfig(kills=2, workdir=str(tmp_path))
+        )
+
+
+def test_register_grants_name_generation_lease_and_namespace(control):
+    s, clock = control
+    grant = s.register_worker({"mode": "gateway", "url": "http://127.0.0.1:9"})
+    assert (grant["worker"], grant["generation"]) == ("w0", 1)
+    assert grant["lease_ttl_s"] == 10.0
+    assert grant["heartbeat_every_s"] == heartbeat_every(10.0)
+    # the grant names where THIS incarnation must spill — site-prefixed,
+    # so two fleets sharing a store stay disjoint
+    assert grant["spill"] == {
+        "url": "http://store.invalid:1",
+        "namespace": "a-w0g1",
+    }
+    s.tick()
+    assert [w.name for w in s.ready_workers()] == ["w0"]
+
+
+def test_register_requires_a_bound_url(control):
+    s, _ = control
+    with pytest.raises(ApiError) as ei:
+        s.register_worker({"mode": "gateway"})
+    assert (ei.value.status, ei.value.code) == (400, "bad_registration")
+
+
+def test_heartbeat_renews_expiry_fences_and_reregistration_readmits(control):
+    s, clock = control
+    exits = []
+    s.on_worker_exit = lambda name, gen: exits.append((name, gen))
+    s.register_worker({"url": "http://127.0.0.1:9"})
+    s.tick()
+    clock.t += 8
+    s.heartbeat("w0", 1)  # renewed with 2s to spare
+    clock.t += 8
+    s.tick()
+    assert s.ready_workers() and not exits  # the renewal held
+    clock.t += 11  # silence past the TTL
+    s.tick()
+    # the expiry IS a worker death: same hook, and the incarnation fences
+    assert exits == [("w0", 1)]
+    assert s.is_fenced("w0", 1)
+    assert not s.ready_workers()
+    with pytest.raises(ApiError) as ei:
+        s.heartbeat("w0", 1)
+    assert (ei.value.status, ei.value.code) == (410, "lease_expired")
+    # re-registration claims the slot under a FRESH generation
+    grant = s.register_worker({"url": "http://127.0.0.1:10", "worker": "w0"})
+    assert grant["generation"] == 2
+    s.tick()
+    assert len(s.ready_workers()) == 1
+    assert s.is_fenced("w0", 1) and not s.is_fenced("w0", 2)
+    # a heartbeat still claiming the fenced generation stays refused
+    with pytest.raises(ApiError):
+        s.heartbeat("w0", 1)
+    s.heartbeat("w0", 2)  # the new incarnation's beats land
+
+
+def test_reregistration_over_a_standing_lease_expires_it_first(control):
+    s, _ = control
+    exits = []
+    s.on_worker_exit = lambda name, gen: exits.append((name, gen))
+    s.register_worker({"url": "http://127.0.0.1:9"})
+    grant = s.register_worker({"url": "http://127.0.0.1:10", "worker": "w0"})
+    # claiming a slot whose lease still stands is an admission the old
+    # incarnation is gone: its sessions get the same rescue a death does
+    assert exits == [("w0", 1)]
+    assert grant["generation"] == 2 and s.is_fenced("w0", 1)
+
+
+def test_restarted_plane_honors_distinct_reregistration_claims(control):
+    s, _ = control
+    # a fresh (restarted) control plane: two old workers re-register,
+    # each claiming the name it used to hold — identities must stay
+    # distinct (not collide on one auto-minted slot and fence each
+    # other in a perpetual ping-pong)
+    g1 = s.register_worker({"url": "http://127.0.0.1:9", "worker": "w1"})
+    g0 = s.register_worker({"url": "http://127.0.0.1:10", "worker": "w0"})
+    assert (g1["worker"], g0["worker"]) == ("w1", "w0")
+    s.tick()
+    assert sorted(w.name for w in s.ready_workers()) == ["w0", "w1"]
+    assert s._c_lease_expired.value == 0  # neither expired the other
+    # an unclaimed registration auto-mints AROUND the taken names; a
+    # malformed claim is ignored, not honored into the sid namespace
+    assert s.register_worker({"url": "http://127.0.0.1:11"})["worker"] == "w2"
+    g = s.register_worker({"url": "http://127.0.0.1:12", "worker": "../evil"})
+    assert g["worker"] == "w3"
+
+
+def test_registrar_drops_a_refused_claim_and_registers_fresh():
+    seen, naps = [], []
+    http = _scripted_http(
+        [
+            # the restarted plane runs a LOCAL worker under our old name
+            (400, {"error": {"code": "bad_registration"}}),
+            (200, {"worker": "w3", "generation": 1, "lease_ttl_s": 5.0}),
+        ],
+        seen,
+    )
+    r = Registrar(
+        "http://cp", self_url="http://me:9", sleep=naps.append, http=http,
+    )
+    r.worker, r.generation = "w0", 7  # the stale claim from a dead plane
+    assert r._register_until_granted() is not None
+    # the refused claim was dropped (second attempt claims nothing) and
+    # the fresh grant was taken — never a retry-the-same-claim-forever
+    assert (r.worker, r.generation) == ("w3", 1)
+    assert seen[0][1].get("worker") == "w0"
+    assert "worker" not in seen[1][1]
+
+
+def test_heartbeat_unknown_worker_is_typed_404(control):
+    s, _ = control
+    with pytest.raises(ApiError) as ei:
+        s.heartbeat("w9", 1)
+    assert (ei.value.status, ei.value.code) == (404, "unknown_worker")
+
+
+def test_drain_revokes_remote_leases_and_refuses_registration(control):
+    s, _ = control
+    s.register_worker({"url": "http://127.0.0.1:9"})
+    s.begin_drain()
+    # a drain fence is NOT a lease-expiry fence: the worker's sessions
+    # were never re-homed, so the typed answer must tell it to finish
+    # them (503 draining), never to drop them (410 lease_expired) — and
+    # the refusal counter (the drill's fence evidence) must not move
+    with pytest.raises(ApiError) as ei:
+        s.heartbeat("w0", 1)
+    assert (ei.value.status, ei.value.code) == (503, "draining")
+    assert s._c_lease_refused.value == 0
+    with pytest.raises(ApiError) as ei:
+        s.register_worker({"url": "http://127.0.0.1:11"})
+    assert ei.value.status == 503
+
+
+def test_prior_lease_fence_survives_a_drain(control):
+    s, clock = control
+    s.register_worker({"url": "http://127.0.0.1:9"})
+    clock.t += 11  # silence past the TTL: a REAL fence, sessions re-homed
+    s.tick()
+    assert s.is_fenced("w0", 1)
+    s.begin_drain()
+    # the pre-drain fence keeps its 410: that incarnation's sessions WERE
+    # rescued, and only lease_expired tells it to drop its local copies
+    with pytest.raises(ApiError) as ei:
+        s.heartbeat("w0", 1)
+    assert (ei.value.status, ei.value.code) == (410, "lease_expired")
+
+
+def test_local_worker_name_cannot_be_claimed_over_the_wire(tmp_path):
+    class _Proc:
+        pid = 1
+
+        def poll(self):
+            return None
+
+    def spawn(w):
+        w.proc = _Proc()
+        w.url = "http://fake/w0"
+
+    s = Supervisor(
+        FleetConfig(workers=1, log_dir=str(tmp_path / "logs")),
+        obs.MetricsRegistry(),
+        spawn=spawn,
+        probe=lambda w: "ready",
+        clock=FakeClock(),
+    )
+    with s._lock:
+        s._spawn_worker(s.workers[0], first=True)
+    with pytest.raises(ApiError) as ei:
+        s.register_worker({"url": "http://127.0.0.1:9", "worker": "w0"})
+    assert (ei.value.status, ei.value.code) == (400, "bad_registration")
+
+
+def test_injection_retention_sums_generations_and_is_monotone(control):
+    s, _ = control
+    s.register_worker({"url": "http://127.0.0.1:9"})
+    w = s.get("w0")
+    with s._lock:
+        s._record_injections_locked(w, {"spill.write|error": 3.0})
+        # a re-scrape can only grow an incarnation's count
+        s._record_injections_locked(w, {"spill.write|error": 2.0})
+    assert s.injection_totals() == {"spill.write": {"error": 3.0}}
+    # a LOCAL respawn is a new process: its counters start a new
+    # generation key and the dead incarnation's retention still counts
+    with s._lock:
+        w.generation += 1
+        s._record_injections_locked(w, {"spill.write|error": 2.0})
+    assert s.injection_totals() == {"spill.write": {"error": 5.0}}
+    # a wire RE-registration is the same process carrying cumulative
+    # counters: its fresh scrapes supersede (no double count)
+    s.register_worker({"url": "http://127.0.0.1:9", "worker": "w0"})
+    assert s.injection_totals() == {}
+
+
+# -- membership: the worker-side registrar on a scripted http ----------------
+def _scripted_http(script, seen):
+    """``script`` is a list of (status, body) answers (or a callable /
+    an exception instance); every call is appended to ``seen``."""
+
+    def http(path, body):
+        seen.append((path, body))
+        step = script.pop(0)
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+    return http
+
+
+def test_registrar_registers_heartbeats_fences_and_reclaims():
+    seen, grants, fences, naps = [], [], [], []
+    http = _scripted_http(
+        [
+            (200, {"worker": "w0", "generation": 1, "lease_ttl_s": 0.3,
+                   "spill": {"namespace": "a-w0g1"}}),
+            (200, {}),  # heartbeat renews
+            (410, {"error": {"code": "lease_expired"}}),
+            (200, {"worker": "w0", "generation": 2, "lease_ttl_s": 0.3}),
+        ],
+        seen,
+    )
+    r = Registrar(
+        "http://cp",
+        self_url="http://me:9",
+        run_id="r1",
+        on_grant=grants.append,
+        on_fenced=fences.append,
+        sleep=naps.append,
+        http=http,
+    )
+    grant = r._register_until_granted()
+    assert (r.worker, r.generation, r.registrations) == ("w0", 1, 1)
+    assert grants and grants[0]["spill"]["namespace"] == "a-w0g1"
+    assert seen[0][1]["url"] == "http://me:9"  # the startup-JSON handshake
+    assert "worker" not in seen[0][1]  # a first registration claims nothing
+    r._heartbeat_until_fenced(grant)
+    # the typed fence: sessions were re-homed — drop state, re-register
+    assert r.fenced_count == 1 and fences == ["lease_expired"]
+    r._register_until_granted()
+    assert (r.worker, r.generation, r.registrations) == ("w0", 2, 2)
+    # the re-registration claimed the prior name (a respawn, not a ghost)
+    assert seen[-1][1]["worker"] == "w0"
+
+
+def test_registrar_retries_transport_noise_with_backoff():
+    seen, naps = [], []
+    http = _scripted_http(
+        [
+            ConnectionRefusedError("cp not up yet"),
+            (200, {"worker": "w0", "generation": 1, "lease_ttl_s": 5.0}),
+        ],
+        seen,
+    )
+    r = Registrar(
+        "http://cp", self_url="http://me:9", sleep=naps.append, http=http,
+        backoff_s=0.05, max_backoff_s=0.2,
+    )
+    assert r._register_until_granted() is not None
+    assert r.registrations == 1 and len(naps) == 1
+    assert 0 < naps[0] <= 0.2
+
+
+# -- the remote spill store: round trip + fault matrix -----------------------
+@pytest.fixture
+def store(tmp_path):
+    srv = SpillHTTPServer(str(tmp_path / "store"))
+    srv.start()
+    yield srv
+    srv.close()
+
+
+def _save(backend, sid, board, step, rule="conway", steps_total=50):
+    return backend.save(
+        sid, board, step,
+        rule=rule, steps_total=steps_total,
+        seed=None, temperature=None, timeout_s=None,
+    )
+
+
+def test_http_backend_round_trip_noop_rewrite_and_retention(store):
+    be = HttpSpillBackend(store.url, "a-w0g1")
+    b1 = random_board(8, 8, seed=1, density=0.4)
+    assert _save(be, "s000001", b1, 4) is True
+    assert _save(be, "s000001", b1, 4) is False  # newest-step rewrite: no-op
+    records, corrupt, disabled = read_remote_sessions(store.url, "a-w0g1")
+    assert corrupt == [] and disabled == []
+    (rec,) = records
+    assert (rec.sid, rec.step, rec.steps_total) == ("s000001", 4, 50)
+    assert rec.board.tobytes() == b1.tobytes()
+    for step in (6, 8, 10):
+        _save(be, "s000001", b1, step)
+    bare = [
+        p.name
+        for p in (store.root / "a-w0g1" / "s000001").iterdir()
+        if p.name.startswith("snap_") and not p.name.endswith(".crc32")
+    ]
+    assert sorted(bare) == [snap_name(8), snap_name(10)]  # newest KEEP
+    assert KEEP_SNAPSHOTS == 2
+
+
+def test_http_backend_disabled_marker_and_delete(store):
+    be = HttpSpillBackend(store.url, "ns1")
+    b = random_board(8, 8, seed=2)
+    _save(be, "s000001", b, 2)
+    _save(be, "s000002", b, 2)
+    be.mark_disabled("s000001")
+    be.delete("s000002")
+    records, corrupt, disabled = read_remote_sessions(store.url, "ns1")
+    assert (records, corrupt, disabled) == ([], [], ["s000001"])
+
+
+def test_remote_crc_rot_demotes_then_types_corrupt(store):
+    be = HttpSpillBackend(store.url, "ns2")
+    b1 = random_board(8, 8, seed=3, density=0.4)
+    b2 = random_board(8, 8, seed=4, density=0.4)
+    _save(be, "s000009", b1, 4)
+    _save(be, "s000009", b2, 8)
+    d = store.root / "ns2" / "s000009"
+    raw = bytearray((d / snap_name(8)).read_bytes())
+    raw[0] ^= 0x01  # storage rot under the newest snapshot
+    (d / snap_name(8)).write_bytes(bytes(raw))
+    records, corrupt, _ = read_remote_sessions(store.url, "ns2")
+    # the CRC is re-checked on the DOWNLOADED bytes: demote to predecessor
+    assert corrupt == []
+    assert records[0].step == 4
+    assert records[0].board.tobytes() == b1.tobytes()
+    # the predecessor rots too -> the sid is typed corrupt, not a crash
+    raw = bytearray((d / snap_name(4)).read_bytes())
+    raw[0] ^= 0x01
+    (d / snap_name(4)).write_bytes(bytes(raw))
+    records, corrupt, _ = read_remote_sessions(store.url, "ns2")
+    assert (records, corrupt) == ([], ["s000009"])
+
+
+def test_remote_truncated_stored_body_demotes(store):
+    be = HttpSpillBackend(store.url, "ns3")
+    b1 = random_board(8, 8, seed=5, density=0.4)
+    _save(be, "s000004", b1, 4)
+    _save(be, "s000004", b1, 8)
+    f = store.root / "ns3" / "s000004" / snap_name(8)
+    f.write_bytes(f.read_bytes()[: max(1, f.stat().st_size // 2)])  # torn
+    records, corrupt, _ = read_remote_sessions(store.url, "ns3")
+    assert corrupt == [] and records[0].step == 4
+
+
+def test_store_put_refuses_torn_upload_before_publishing(store):
+    body = b"x" * 64
+    req = urllib.request.Request(
+        store.url + "/v1/spill/ns/s1/obj", data=body, method="PUT"
+    )
+    req.add_header("X-CRC32", str((zlib.crc32(body) + 1) & 0xFFFFFFFF))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["error"]["code"] == "crc_mismatch"
+    # refuse-BEFORE-publish: the store never holds witness-less bytes
+    assert not (store.root / "ns" / "s1" / "obj").exists()
+    # and an upload with no witness at all is refused the same way
+    req = urllib.request.Request(
+        store.url + "/v1/spill/ns/s1/obj", data=body, method="PUT"
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_store_refuses_path_traversal(store):
+    conn = http.client.HTTPConnection(store.host, store.port, timeout=5)
+    conn.request("GET", "/v1/spill/ns/../other")
+    assert conn.getresponse().status == 400
+    conn.close()
+
+
+@pytest.mark.chaos
+def test_remote_timeout_surfaces_as_oserror_then_heals(store):
+    chaos.arm(chaos.ChaosPlan(
+        0, {"spill.remote.timeout": {"rate": 1.0, "mode": "timeout", "times": 1}}
+    ))
+    try:
+        be = HttpSpillBackend(store.url, "ns4", sleep=lambda s: None)
+        b = random_board(8, 8, seed=6)
+        with pytest.raises(OSError):
+            _save(be, "s000001", b, 2)  # a timeout is ambiguous: no retry
+        assert _save(be, "s000001", b, 2) is True  # times=1: healed
+    finally:
+        chaos.disarm()
+
+
+@pytest.mark.chaos
+def test_remote_torn_read_body_demotes_to_predecessor(store):
+    be = HttpSpillBackend(store.url, "ns5")
+    b1 = random_board(8, 8, seed=7, density=0.4)
+    b2 = random_board(8, 8, seed=8, density=0.4)
+    _save(be, "s000002", b1, 4)
+    _save(be, "s000002", b2, 8)
+    chaos.arm(chaos.ChaosPlan(
+        0, {"spill.remote.torn_body": {"rate": 1.0, "mode": "torn", "times": 1}}
+    ))
+    try:
+        records, corrupt, _ = read_remote_sessions(store.url, "ns5")
+    finally:
+        chaos.disarm()
+    # the newest snapshot's body tears on the wire -> CRC mismatch ->
+    # demoted exactly like disk rot; the predecessor read is clean
+    assert corrupt == []
+    assert records[0].step == 4
+    assert records[0].board.tobytes() == b1.tobytes()
+
+
+def test_garbled_crc_header_on_read_demotes_not_aborts():
+    # the read-path twin of the store's garbled-X-CRC32 guard: one bad
+    # header must demote ONE snapshot (None), never abort the whole
+    # migration read with a ValueError
+    from tpu_life.serve.spill_http import _fetch_snapshot
+
+    class _H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"xx"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-CRC32", "not-a-number")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: D102
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/x"
+        assert _fetch_snapshot(url, 8, 8, timeout_s=2.0) is None
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_connection_refused_retries_bounded_then_oserror():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens: every connect is a definitive refusal
+    naps = []
+    be = HttpSpillBackend(
+        f"http://127.0.0.1:{port}", "ns", retries=3,
+        backoff_s=0.01, max_backoff_s=0.02, sleep=naps.append,
+    )
+    with pytest.raises(OSError):
+        _save(be, "s000001", random_board(8, 8, seed=9), 2)
+    # refusals retry on the shared jittered curve, capped, then surface
+    assert len(naps) == 3
+    assert all(0 < n <= 0.02 for n in naps)
+
+
+class ScriptedServer:
+    """A deliberately misbehaving HTTP peer: each request consumes the
+    next scripted behavior (``503`` / ``503ra`` (with Retry-After: 5) /
+    ``500`` / ``reset`` / ``torn`` / ``ok``) — the transport faults a
+    healthy SpillHTTPServer never produces."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests = 0
+        outer = self
+
+        class _H(BaseHTTPRequestHandler):
+            def _do(self):
+                outer.requests += 1
+                mode = outer.script.pop(0) if outer.script else "ok"
+                if mode == "reset":
+                    self.connection.close()  # mid-exchange: no status line
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)
+                if mode in ("503", "503ra", "500"):
+                    self.send_response(int(mode[:3]))
+                    if mode == "503ra":
+                        self.send_header("Retry-After", "5")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                elif mode == "torn":
+                    body = b'{"sids": {}}'
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body) * 9))
+                    self.end_headers()
+                    self.wfile.write(body)  # short body, then close
+                    self.connection.close()
+                else:
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"{}")
+
+            do_GET = do_PUT = do_DELETE = _do
+
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self._srv.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._srv.server_address[1]}"
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_typed_503_refusals_retry_then_succeed():
+    srv = ScriptedServer(["503", "503", "ok", "ok"])  # snap x3, manifest
+    try:
+        naps = []
+        be = HttpSpillBackend(
+            srv.url, "ns", retries=3, backoff_s=0.01, max_backoff_s=0.02,
+            sleep=naps.append,
+        )
+        assert _save(be, "s000001", random_board(8, 8, seed=10), 2) is True
+        assert len(naps) == 2  # two paced retries, then both PUTs landed
+    finally:
+        srv.close()
+
+
+def test_503_retry_honors_explicit_retry_after():
+    """A refusal that names its own pace is honored un-jittered (the
+    shared Retry-After doctrine); an unhinted refusal still rides the
+    jittered backoff curve."""
+    srv = ScriptedServer(["503ra", "503", "ok", "ok"])
+    try:
+        naps = []
+        be = HttpSpillBackend(
+            srv.url, "ns", retries=3, backoff_s=0.01, max_backoff_s=0.02,
+            sleep=naps.append,
+        )
+        assert _save(be, "s000001", random_board(8, 8, seed=12), 2) is True
+        assert naps[0] == 5.0  # the store's hint, verbatim
+        assert naps[1] <= 0.02  # no hint: the capped backoff curve
+    finally:
+        srv.close()
+
+
+def test_5xx_write_is_oserror_without_retry():
+    srv = ScriptedServer(["500"])
+    try:
+        naps = []
+        be = HttpSpillBackend(srv.url, "ns", retries=3, sleep=naps.append)
+        with pytest.raises(OSError):
+            _save(be, "s000001", random_board(8, 8, seed=11), 2)
+        # a 500 is a verdict, not capacity pressure: no pacing, one request
+        assert naps == [] and srv.requests == 1
+    finally:
+        srv.close()
+
+
+def test_reset_mid_exchange_is_ambiguous_never_resent():
+    srv = ScriptedServer(["reset"])
+    try:
+        naps = []
+        be = HttpSpillBackend(srv.url, "ns", retries=3, sleep=naps.append)
+        with pytest.raises(OSError):
+            _save(be, "s000001", random_board(8, 8, seed=12), 2)
+        # the PUT may or may not have been applied over there: never
+        # blindly re-sent — one request, straight to the degradation path
+        assert naps == [] and srv.requests == 1
+    finally:
+        srv.close()
+
+
+def test_torn_response_body_is_oserror_on_both_paths():
+    # write path: the 200's own body tears mid-read (IncompleteRead must
+    # surface as the OSError the degradation path catches, not escape)
+    srv = ScriptedServer(["torn"])
+    try:
+        be = HttpSpillBackend(srv.url, "ns", retries=3, sleep=lambda s: None)
+        with pytest.raises(OSError):
+            _save(be, "s000001", random_board(8, 8, seed=13), 2)
+    finally:
+        srv.close()
+    # read path: a torn namespace listing is a typed OSError (the
+    # migration run records nothing and leaves the bytes for a retry)
+    srv = ScriptedServer(["torn"])
+    try:
+        with pytest.raises(OSError):
+            read_remote_sessions(srv.url, "ns")
+    finally:
+        srv.close()
+
+
+# -- the SpillBackend seam at the service ------------------------------------
+def test_make_spill_backend_selects_and_rejects():
+    assert isinstance(make_spill_backend(spill_dir="/tmp/x"), SpillStore)
+    be = make_spill_backend(spill_url="http://127.0.0.1:1", namespace="n1")
+    assert isinstance(be, HttpSpillBackend) and be.namespace == "n1"
+    with pytest.raises(ValueError):
+        make_spill_backend(spill_dir="/tmp/x", spill_url="http://127.0.0.1:1")
+    with pytest.raises(ValueError):
+        HttpSpillBackend("http://127.0.0.1:1", "../escape")
+
+
+class _FailingBackend(SpillBackend):
+    """The fake half of the fault matrix: every write fails."""
+
+    def __init__(self):
+        self.disabled = []
+
+    def save(self, sid, board, step, **kw):
+        raise OSError("injected backend failure")
+
+    def mark_disabled(self, sid):
+        self.disabled.append(sid)
+
+    def delete(self, sid):
+        pass
+
+    def spilled_count(self):
+        return 0
+
+    def spilled_sids(self):
+        return []
+
+
+def test_failing_backend_degrades_session_never_the_service(tmp_path):
+    svc = SimulationService(ServeConfig(
+        capacity=2, chunk_steps=4, backend="numpy",
+        spill_dir=str(tmp_path / "unused"), spill_every=1,
+    ))
+    svc._spill = _FailingBackend()  # any SpillBackend plugs into the seam
+    board = random_board(16, 16, seed=14, density=0.4)
+    oracle = run_np(board, get_rule("conway"), 24)
+    sid = svc.submit(board, "conway", 24)
+    svc.drain()
+    # the session finished byte-exactly; durability alone was sacrificed
+    assert svc.store.result(sid).tobytes() == oracle.tobytes()
+    assert svc._c_spill_errors.value >= 1
+    assert svc._spill.disabled == [sid]
+
+
+def test_unreachable_remote_store_degrades_to_spill_disabled(tmp_path):
+    # the HTTP half of the same matrix row: a dead store costs
+    # durability (typed, one line), never the pump
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    svc = SimulationService(ServeConfig(
+        capacity=2, chunk_steps=4, backend="numpy",
+        spill_url=f"http://127.0.0.1:{port}", spill_namespace="ns",
+        spill_every=1,
+    ))
+    svc._spill.retries = 0  # keep the refusal loop short for the test
+    board = random_board(16, 16, seed=15, density=0.4)
+    oracle = run_np(board, get_rule("conway"), 24)
+    sid = svc.submit(board, "conway", 24)
+    svc.drain()
+    assert svc.store.result(sid).tobytes() == oracle.tobytes()
+    assert svc.store.get(sid).spill_disabled
+    assert svc._c_spill_errors.value >= 1
+
+
+def test_spill_on_adopt_rides_the_first_round(tmp_path):
+    """The PR 8 known limit, fixed: an adopted (resumed) session spills
+    on the FIRST spill-capable round, cadence or not — between
+    resume-accept and that write a second kill would re-lose it."""
+    svc = SimulationService(ServeConfig(
+        capacity=4, chunk_steps=2, backend="numpy", pipeline=False,
+        spill_dir=str(tmp_path / "spill"), spill_every=10**6,
+    ))
+    board = random_board(8, 8, seed=16, density=0.4)
+    adopted = svc.submit(board, "conway", 20, start_step=4)
+    fresh = svc.submit(board, "conway", 20)
+    svc.pump()
+    svc.pump()
+    records, corrupt, disabled = read_spill_sessions(tmp_path / "spill")
+    assert corrupt == [] and disabled == []
+    assert [r.sid for r in records] == [adopted]  # urgent: written at once
+    # ordinary sessions still wait out the cadence
+    assert fresh not in [r.sid for r in records]
+
+
+# -- e2e: a SIGKILL rescued THROUGH the remote store -------------------------
+def test_sigkill_rescue_reads_through_the_remote_store(tmp_path):
+    """The cross-host read path against real worker subprocesses: the
+    fleet spills ONLY to the HTTP store (no shared spill directory), a
+    worker is SIGKILLed, and its sessions finish byte-identical under
+    their original sids — the migrator read the rescue off the wire."""
+    from tpu_life.fleet import Fleet, FleetConfig
+    from tpu_life.gateway.client import GatewayClient
+
+    store = SpillHTTPServer(str(tmp_path / "store"))
+    store.start()
+    fleet = Fleet(FleetConfig(
+        workers=2,
+        port=0,
+        worker_args=(
+            "--serve-backend", "numpy", "--capacity", "4",
+            "--chunk-steps", "2",
+        ),
+        log_dir=str(tmp_path / "logs"),
+        spill_url=store.url,
+        site="t-",
+        spill_every=1,
+        probe_interval_s=0.1,
+        backoff_base_s=0.2,
+    ))
+    try:
+        fleet.start()
+        assert fleet.wait_ready(timeout=90, min_workers=2), (
+            fleet.supervisor.states()
+        )
+        client = GatewayClient(f"http://127.0.0.1:{fleet.port}", retries=8)
+        boards = [
+            random_board(24, 20, seed=900 + i, density=0.4) for i in range(3)
+        ]
+        steps = 1500
+        sids = [client.submit(board=b, rule="conway", steps=steps) for b in boards]
+        by_worker: dict = {}
+        for sid in sids:
+            by_worker.setdefault(client.poll(sid)["worker"], []).append(sid)
+        deadline = time.monotonic() + 60
+        while True:  # wait for a published remote spill pass per session
+            views = {sid: client.poll(sid) for sid in sids}
+            if all(8 <= v["steps_done"] < v["steps"] for v in views.values()):
+                break
+            assert time.monotonic() < deadline, views
+            time.sleep(0.05)
+        victim_name = max(by_worker, key=lambda k: len(by_worker[k]))
+        victim = fleet.supervisor.get(victim_name)
+        victim_gen = victim.generation
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        for sid in sids:
+            view = client.wait(sid, timeout=180)
+            assert view["state"] == "done", (sid, view)
+        for sid, board in zip(sids, boards):
+            got = client.result_board(sid)
+            oracle = run_np(board, get_rule("conway"), steps)
+            assert got.tobytes() == oracle.tobytes(), sid
+        assert fleet.migrator.wait_idle(timeout=30)
+        # the victim incarnation's namespace was reaped after the rescue
+        assert not (store.root / f"t-{victim_name}g{victim_gen}").exists()
+    finally:
+        fleet.begin_drain()
+        fleet.wait(timeout=30)
+        fleet.close()
+        store.close()

@@ -85,6 +85,17 @@ POINTS: dict[str, tuple[str, ...]] = {
     # fleet supervisor / migrator
     "probe.skew": ("skew",),  # monitor clock reads skew by up to `seconds`
     "migrate.die": ("die",),  # the migration thread is never started
+    # cross-host control plane (docs/FLEET.md "Cross-host topology")
+    "lease.heartbeat.drop": ("drop",),  # registrar heartbeat never sent
+    "lease.register.reset": ("reset",),  # registration POST reset pre-send
+    # remote spill store (HTTP backend)
+    "spill.remote.timeout": ("timeout",),  # request times out client-side
+    "spill.remote.torn_body": ("torn",),  # response body truncated on read
+    # seeded per-peer connectivity mask: drawn PER PAIR via decide_pair,
+    # so one armed point partitions some links and spares others — the
+    # asymmetric-partition drill (router->worker, worker->control-plane,
+    # worker->spill-store all consult it with their own pair labels)
+    "net.partition": ("drop",),
 }
 
 
@@ -173,6 +184,9 @@ class ChaosPlan:
         self._lock = threading.Lock()
         self._calls: dict[str, int] = {}
         self._fired: dict[str, int] = {}
+        # per-(point, pair) call counters for decide_pair — each network
+        # link draws its own deterministic schedule
+        self._pair_calls: dict[tuple[str, str], int] = {}
 
     @classmethod
     def from_spec(cls, spec: dict | str) -> "ChaosPlan":
@@ -209,48 +223,86 @@ class ChaosPlan:
         blob = json.dumps(self.spec(), sort_keys=True).encode()
         return hashlib.sha256(blob).hexdigest()[:16]
 
+    def _hit(self, fault: Fault, word: int, n: int) -> tuple[bool, int]:
+        """ONE pure threefry draw for call ``n`` under counter ``word``:
+        ``(fired?, payload draw)``.  The single place the fire predicate
+        lives — the live deciders and the preview schedules share it, so
+        they can never desynchronize."""
+        u0, u1 = threefry2x32(
+            _np, self._k0, self._k1, _np.uint32(word), _np.uint32(n)
+        )
+        hit = fault.rate >= 1.0 or int(u0) < threshold_u32(fault.rate)
+        return hit, int(u1)
+
+    def _decide(
+        self, point: str, word: int, counts: dict, ckey
+    ) -> Decision | None:
+        fault = self.faults.get(point)
+        if fault is None:
+            return None
+        with self._lock:
+            n = counts.get(ckey, 0)
+            counts[ckey] = n + 1
+            if fault.times is not None and self._fired.get(point, 0) >= fault.times:
+                return None
+            hit, draw = self._hit(fault, word, n)
+            if not hit:
+                return None
+            self._fired[point] = self._fired.get(point, 0) + 1
+        return Decision(fault=fault, draw=draw)
+
     def decide(self, point: str) -> Decision | None:
         """The hot-path decision for one call at ``point``: ``None`` (the
         overwhelmingly common answer) or the fired :class:`Decision`.
         Unarmed points don't count calls — their schedule is independent
         of which other seams happen to be compiled in."""
+        return self._decide(
+            point, zlib.crc32(point.encode()), self._calls, point
+        )
+
+    def decide_pair(self, point: str, pair: str) -> Decision | None:
+        """Like :meth:`decide`, but the schedule is keyed by a ``pair``
+        label as well (``"router->w1"``): the first counter word mixes
+        ``crc32(point) ^ crc32(pair)``, the second counts calls *for that
+        pair*, so every network link sees its own pure-function schedule
+        under one armed point — a seeded connectivity MASK, not a global
+        coin.  ``times`` still bounds total fires across all pairs (a
+        partition drill must heal)."""
+        word = zlib.crc32(point.encode()) ^ zlib.crc32(pair.encode())
+        return self._decide(point, word, self._pair_calls, (point, pair))
+
+    def _preview(
+        self, point: str, word: int, calls: int, bound: bool
+    ) -> list[bool]:
         fault = self.faults.get(point)
         if fault is None:
-            return None
-        with self._lock:
-            n = self._calls.get(point, 0)
-            self._calls[point] = n + 1
-            if fault.times is not None and self._fired.get(point, 0) >= fault.times:
-                return None
-            u0, u1 = threefry2x32(
-                _np, self._k0, self._k1, _np.uint32(zlib.crc32(point.encode())),
-                _np.uint32(n),
-            )
-            if fault.rate < 1.0 and int(u0) >= threshold_u32(fault.rate):
-                return None
-            self._fired[point] = self._fired.get(point, 0) + 1
-        return Decision(fault=fault, draw=int(u1))
+            return [False] * calls
+        out: list[bool] = []
+        fired = 0
+        for n in range(calls):
+            if bound and fault.times is not None and fired >= fault.times:
+                out.append(False)
+                continue
+            hit, _ = self._hit(fault, word, n)
+            out.append(hit)
+            fired += hit
+        return out
+
+    def preview_pair(self, point: str, pair: str, calls: int) -> list[bool]:
+        """The pure fire/no-fire schedule :meth:`decide_pair` would draw
+        for one pair's first ``calls`` calls, without the live counters
+        (and without the cross-pair ``times`` interaction — this is the
+        per-link mask the determinism tests compare)."""
+        word = zlib.crc32(point.encode()) ^ zlib.crc32(pair.encode())
+        return self._preview(point, word, calls, bound=False)
 
     def preview(self, point: str, calls: int) -> list[bool]:
         """The pure fire/no-fire schedule for the first ``calls`` calls at
         ``point``, WITHOUT touching the live counters — what the
         determinism tests compare across plans of equal seed."""
-        fault = self.faults.get(point)
-        if fault is None:
-            return [False] * calls
-        out, fired = [], 0
-        for n in range(calls):
-            if fault.times is not None and fired >= fault.times:
-                out.append(False)
-                continue
-            u0, _ = threefry2x32(
-                _np, self._k0, self._k1, _np.uint32(zlib.crc32(point.encode())),
-                _np.uint32(n),
-            )
-            hit = fault.rate >= 1.0 or int(u0) < threshold_u32(fault.rate)
-            out.append(hit)
-            fired += hit
-        return out
+        return self._preview(
+            point, zlib.crc32(point.encode()), calls, bound=True
+        )
 
 
 # -- the process-global arming seam ------------------------------------------
@@ -435,6 +487,22 @@ def corrupt(point: str, data: bytes) -> bytes:
     return bytes(buf)
 
 
+def partitioned(src: str, dst: str) -> bool:
+    """True when the seeded connectivity mask severs the ``src -> dst``
+    link for this call (the ``net.partition`` point, drawn per pair via
+    :meth:`ChaosPlan.decide_pair`).  Callers translate True into their
+    transport's honest unreachable shape — a connect that never
+    establishes — so the production partition handling is what runs."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    d = plan.decide_pair("net.partition", f"{src}->{dst}")
+    if d is None:
+        return False
+    _record("net.partition", d.fault.mode)
+    return True
+
+
 def crash(point: str) -> None:
     """``os._exit`` the process when ``point`` fires (the worker-crash
     seam: a SIGKILL-grade death — no atexit, no drain, no flush)."""
@@ -472,6 +540,7 @@ __all__ = [
     "inject",
     "injection_count",
     "maybe_arm_from_env",
+    "partitioned",
     "record_fire",
     "skew",
 ]

@@ -225,7 +225,16 @@ class _Driller:
         except Exception:
             return
         for line in text.splitlines():
-            if not line.startswith("chaos_injections_total{"):
+            # chaos_injections_total: live per-process counters (the
+            # fleet's own + each reachable worker's, worker-labeled).
+            # fleet_chaos_injections: the supervisor's last-seen retention
+            # per worker — it SURVIVES the worker's death, so the merged
+            # accounting is exact rather than a pre-kill floor (the max
+            # below dedups it against the live series it mirrors).
+            if not (
+                line.startswith("chaos_injections_total{")
+                or line.startswith("fleet_chaos_injections{")
+            ):
                 continue
             labels, _, value = line.rpartition(" ")
             point = outcome = worker = ""

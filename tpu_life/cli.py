@@ -259,6 +259,24 @@ def build_parser() -> argparse.ArgumentParser:
                     "`serve --spill-dir`)")
     gw.add_argument("--spill-every", type=int, default=4, metavar="K",
                     help="rounds between spill passes")
+    gw.add_argument("--spill-url", default=None, metavar="URL",
+                    help="remote spill store (docs/FLEET.md cross-host "
+                    "topology): spill through this `tpu-life spill-store` "
+                    "HTTP store instead of a local directory, so a "
+                    "migrator on another machine can read the rescue; "
+                    "mutually exclusive with --spill-dir")
+    gw.add_argument("--spill-namespace", default=None, metavar="NAME",
+                    help="this worker incarnation's namespace in the "
+                    "remote store (default: the run_id; a registered "
+                    "worker rebinds to the namespace its lease grant "
+                    "names)")
+    gw.add_argument("--register", default=None, metavar="URL",
+                    help="wire registration (docs/FLEET.md cross-host "
+                    "topology): register with this fleet control plane "
+                    "instead of being spawned by one — hold a heartbeat-"
+                    "renewed lease, rebind the spill namespace per grant, "
+                    "and on a lease_expired fence drop the re-homed "
+                    "sessions and re-register fresh")
     gw.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
                     help="per-API-key token-bucket refill rate; 0 disables "
                     "rate limiting (the X-API-Key header names the key)")
@@ -321,6 +339,28 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--spill-every", type=int, default=4, metavar="K",
                     help="rounds between worker spill passes (recovery "
                     "point = the last spilled chunk)")
+    fl.add_argument("--spill-url", default=None, metavar="URL",
+                    help="remote spill store (docs/FLEET.md cross-host "
+                    "topology): workers spill through this `tpu-life "
+                    "spill-store` HTTP store under per-incarnation "
+                    "namespaces, so migration reads work when the "
+                    "survivor is on another machine; mutually exclusive "
+                    "with --spill-dir")
+    fl.add_argument("--site", default="", metavar="PREFIX",
+                    help="this control plane's namespace prefix in a "
+                    "SHARED spill store (e.g. 'a-'); two fleets sharing "
+                    "one store must use distinct sites")
+    fl.add_argument("--peer", action="append", default=None, metavar="URL",
+                    dest="peers",
+                    help="peer control-plane router URL (repeatable): when "
+                    "every local survivor refuses a rescue, the migrator "
+                    "re-homes the session onto a peer fleet — it keeps "
+                    "answering its ORIGINAL session id through this router")
+    fl.add_argument("--lease-ttl", type=float, default=15.0, metavar="SECONDS",
+                    help="lease TTL for wire-registered workers (gateway "
+                    "--register); an un-renewed lease fires the same "
+                    "migration a worker death does, then fences the "
+                    "generation")
     fl.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline (per worker)")
     fl.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
@@ -367,6 +407,21 @@ def build_parser() -> argparse.ArgumentParser:
                     "placement error at startup, before any worker spawns")
     fl.add_argument("--verbose", "-v", action="store_true")
 
+    ss = sub.add_parser(
+        "spill-store",
+        help="host a remote spill store (docs/FLEET.md cross-host "
+        "topology): a CRC-checked, atomically-published HTTP object "
+        "store workers spill through and migrators read rescues from — "
+        "stdlib only, any fleet process can carry it",
+    )
+    ss.add_argument("--root", required=True, metavar="DIR",
+                    help="directory the store publishes namespaces under")
+    ss.add_argument("--host", default="127.0.0.1")
+    ss.add_argument("--port", type=int, default=0,
+                    help="listen port (0 = ephemeral; the bound port is "
+                    "printed in the startup JSON line)")
+    ss.add_argument("--verbose", "-v", action="store_true")
+
     ch = sub.add_parser(
         "chaos",
         help="seeded chaos drill (docs/CHAOS.md): drive a real N-worker "
@@ -388,7 +443,9 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--steps", type=int, default=900,
                     help="base step budget; staggered downward per session")
     ch.add_argument("--kills", type=int, default=1,
-                    help="drill-driven SIGKILLs of session-owning workers")
+                    help="drill-driven SIGKILLs of session-owning workers "
+                    "(must be 1 with --cross-host: its choreography "
+                    "performs exactly one adopter kill)")
     ch.add_argument("--plan", default=None, metavar="JSON",
                     help="chaos point spec as JSON (the plan's 'points' "
                     "object; default: the documented drill mix — spill "
@@ -412,6 +469,16 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--workdir", default=None, metavar="DIR",
                     help="where spill/ and logs/ land (default: a fresh "
                     "temp dir)")
+    ch.add_argument("--cross-host", action="store_true",
+                    help="the two-control-plane drill (docs/FLEET.md "
+                    "cross-host topology): two supervisors with disjoint "
+                    "worker sets sharing one remote spill store, a wire-"
+                    "registered worker, SIGKILLs + lease expiries + "
+                    "seeded partitions + remote-spill faults in one "
+                    "seeded run")
+    ch.add_argument("--lease-ttl", type=float, default=8.0, metavar="SECONDS",
+                    help="cross-host drill: lease TTL for the wire-"
+                    "registered worker")
     ch.add_argument("--summary-file", default=None, metavar="JSONL",
                     help="append the drill summary as one JSON line")
     ch.add_argument("--verbose", "-v", action="store_true")
@@ -739,6 +806,9 @@ def main(argv: list[str] | None = None) -> int:
         # the drill process is numpy-only (oracles + HTTP); the worker
         # subprocesses own any jax — no watchdog needed here either
         return _chaos_drill(args)
+    if args.command == "spill-store":
+        # pure stdlib file + HTTP plumbing: no device, no watchdog
+        return _spill_store(args)
 
     from tpu_life.utils.platform import devices_with_watchdog, ensure_platform
 
@@ -1401,26 +1471,33 @@ def _gateway(args) -> int:
 
     from tpu_life.gateway import Gateway, GatewayConfig
     from tpu_life.gateway.protocol import MAX_BODY
-    from tpu_life.runtime.metrics import configure_logging
+    from tpu_life.runtime.metrics import configure_logging, log
     from tpu_life.serve import ServeConfig, SimulationService
 
     configure_logging(args.verbose)
-    svc = SimulationService(
-        ServeConfig(
-            capacity=args.capacity,
-            chunk_steps=args.chunk_steps,
-            max_queue=args.max_queue,
-            backend=args.serve_backend,
-            pipeline=not args.sync_pump,
-            default_timeout_s=args.timeout,
-            metrics=True,
-            metrics_file=args.metrics_file,
-            trace_events=args.trace_events,
-            prom_file=args.prom_file,
-            spill_dir=args.spill_dir,
-            spill_every=args.spill_every,
+    try:
+        svc = SimulationService(
+            ServeConfig(
+                capacity=args.capacity,
+                chunk_steps=args.chunk_steps,
+                max_queue=args.max_queue,
+                backend=args.serve_backend,
+                pipeline=not args.sync_pump,
+                default_timeout_s=args.timeout,
+                metrics=True,
+                metrics_file=args.metrics_file,
+                trace_events=args.trace_events,
+                prom_file=args.prom_file,
+                spill_dir=args.spill_dir,
+                spill_every=args.spill_every,
+                spill_url=args.spill_url,
+                spill_namespace=args.spill_namespace,
+            )
         )
-    )
+    except ValueError as e:
+        # e.g. --spill-dir with --spill-url: typed, before any socket
+        print(f"gateway: {e}", file=sys.stderr)
+        return 2
     gw = Gateway(
         svc,
         GatewayConfig(
@@ -1465,9 +1542,38 @@ def _gateway(args) -> int:
     if info is not None:
         startup["devices"], startup["device_kind"] = info
     print(json.dumps(startup), flush=True)
+    registrar = None
+    if args.register is not None:
+        # wire registration (docs/FLEET.md "Cross-host topology"): the
+        # startup line above IS the registration body; the registrar
+        # keeps the lease renewed, rebinds the spill namespace to each
+        # grant, and on a lease_expired fence drops the local copies of
+        # re-homed sessions (finishing them would double-execute) before
+        # re-registering for a fresh generation
+        from tpu_life.fleet.membership import Registrar
+
+        def _on_grant(grant: dict) -> None:
+            sp = grant.get("spill")
+            if isinstance(sp, dict) and sp.get("namespace"):
+                try:
+                    svc.rebind_spill(str(sp["namespace"]))
+                except ValueError as e:
+                    log.warning("gateway: cannot rebind spill: %s", e)
+
+        registrar = Registrar(
+            args.register,
+            self_url=startup["url"],
+            run_id=svc.run_id,
+            device_info=lambda: gw.device_info(wait_s=0.0),
+            on_grant=_on_grant,
+            on_fenced=lambda reason: svc.cancel_live(reason),
+        )
+        registrar.start()
     try:
         gw.wait()
     finally:
+        if registrar is not None:
+            registrar.stop()
         gw.close()
     stats = svc.stats()
     print(
@@ -1491,6 +1597,21 @@ def _gateway(args) -> int:
                 "batch_occupancy_mean": stats["batch_occupancy_mean"],
                 "queue_wait_p50": stats["queue_wait_p50"],
                 "completion_p50": stats["completion_p50"],
+                # wire membership evidence (docs/FLEET.md cross-host):
+                # how often this worker registered and how often it was
+                # fenced — the drill reads these back from the log
+                **(
+                    {
+                        "registrar": {
+                            "registrations": registrar.registrations,
+                            "fenced": registrar.fenced_count,
+                            "worker": registrar.worker,
+                            "generation": registrar.generation,
+                        }
+                    }
+                    if registrar is not None
+                    else {}
+                ),
             }
         ),
         flush=True,
@@ -1532,6 +1653,13 @@ def _fleet(args) -> int:
         worker_args += ["--platform", args.platform]
     if args.verbose:
         worker_args += ["--verbose"]
+    if args.spill_dir is not None and args.spill_url is not None:
+        print(
+            "fleet: --spill-dir and --spill-url are mutually exclusive "
+            "(a fleet spills locally OR through the remote store)",
+            file=sys.stderr,
+        )
+        return 2
     try:
         if args.placement == "none" and (
             args.devices_per_worker is not None or args.total_devices is not None
@@ -1551,6 +1679,10 @@ def _fleet(args) -> int:
                 log_dir=args.log_dir,
                 spill_dir=args.spill_dir,
                 spill_every=args.spill_every,
+                spill_url=args.spill_url,
+                site=args.site,
+                peers=tuple(args.peers or ()),
+                lease_ttl_s=args.lease_ttl,
                 probe_interval_s=args.probe_interval,
                 backoff_base_s=args.restart_backoff,
                 # the flag counts RESTARTS; the breaker counts consecutive
@@ -1578,6 +1710,10 @@ def _fleet(args) -> int:
             flush=True,
         )
         print(f"fleet: placement error: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        # e.g. a malformed --site prefix: typed, before any worker spawns
+        print(f"fleet: {e}", file=sys.stderr)
         return 2
     fleet.install_signal_handlers()
     fleet.start()
@@ -1644,6 +1780,35 @@ def _fleet(args) -> int:
     return 1 if failed else 0
 
 
+def _spill_store(args) -> int:
+    """Host the remote spill store until SIGTERM/SIGINT: one JSON line at
+    startup (bound URL, so scripts and supervisors can point workers at
+    it), one at shutdown."""
+    import json
+    import signal
+    import threading
+
+    from tpu_life.runtime.metrics import configure_logging
+    from tpu_life.serve.spill_http import SpillHTTPServer
+
+    configure_logging(args.verbose)
+    server = SpillHTTPServer(args.root, host=args.host, port=args.port)
+    server.start()
+    print(
+        json.dumps(
+            {"mode": "spill-store", "url": server.url, "root": str(server.root)}
+        ),
+        flush=True,
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda signum, frame: stop.set())
+    stop.wait()
+    server.close()
+    print(json.dumps({"mode": "spill-store", "stopped": True}), flush=True)
+    return 0
+
+
 def _chaos_drill(args) -> int:
     """The seeded chaos drill (docs/CHAOS.md): a real fleet under a
     deterministic fault schedule, machine-verified invariants, one JSON
@@ -1670,6 +1835,8 @@ def _chaos_drill(args) -> int:
         except (ValueError, chaos.ChaosError) as e:
             print(f"chaos: bad --plan: {e}", file=sys.stderr)
             return 2
+    if args.cross_host:
+        return _chaos_cross_host(args, points)
     cfg = DrillConfig(
         seed=args.seed,
         workers=args.workers,
@@ -1707,6 +1874,71 @@ def _chaos_drill(args) -> int:
         print(
             f"chaos: INVARIANT FAILURE — replay verbatim with: "
             f"tpu-life chaos --seed {cfg.seed} "
+            f"(plan digest {summary['plan_digest']})",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def _chaos_cross_host(args, points) -> int:
+    """The two-control-plane leg of ``tpu-life chaos`` (docs/FLEET.md
+    "Cross-host topology"): same contract as the single-plane drill —
+    one startup JSON line, one summary line, exit 0 only when every
+    invariant held, the seed echoed for verbatim replay on failure."""
+    import json
+    import tempfile
+
+    from tpu_life.chaos.crosshost import CrossHostConfig, run_cross_host_drill
+
+    if args.kills != 1:
+        # validate NOW, typed — before any plane or store is spawned
+        print(
+            "chaos: the cross-host drill performs exactly one adopter "
+            "SIGKILL (--kills must be 1); --kills N is the single-plane "
+            "drill's knob",
+            file=sys.stderr,
+        )
+        return 2
+    cfg = CrossHostConfig(
+        seed=args.seed,
+        workers=args.workers,
+        det_sessions=args.sessions,
+        ising_sessions=args.ising_sessions,
+        size=args.size,
+        steps=args.steps,
+        kills=args.kills,
+        points=points,
+        backend=args.backend,
+        capacity=args.capacity,
+        chunk_steps=args.chunk_steps,
+        spill_every=args.spill_every,
+        lease_ttl_s=args.lease_ttl,
+        recovery_bound_s=args.recovery_bound,
+        wait_timeout_s=args.wait_timeout,
+        workdir=args.workdir or tempfile.mkdtemp(prefix="tpu-life-crosshost-"),
+        summary_file=args.summary_file,
+    )
+    print(
+        json.dumps(
+            {
+                "mode": "chaos",
+                "cross_host": True,
+                "seed": cfg.seed,
+                "workers_b": cfg.workers,
+                "sessions": cfg.det_sessions + cfg.ising_sessions,
+                "lease_ttl_s": cfg.lease_ttl_s,
+                "workdir": cfg.workdir,
+            }
+        ),
+        flush=True,
+    )
+    summary = run_cross_host_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    if not summary["ok"]:
+        print(
+            f"chaos: CROSS-HOST INVARIANT FAILURE — replay verbatim with: "
+            f"tpu-life chaos --cross-host --seed {cfg.seed} "
             f"(plan digest {summary['plan_digest']})",
             file=sys.stderr,
         )

@@ -76,9 +76,15 @@ class Fleet:
             self.config, self.supervisor, self.sessions, self.registry
         )
         self.migrator = None
-        if self.config.spill_dir is not None:
+        if (
+            self.config.spill_dir is not None
+            or self.config.spill_url is not None
+        ):
             self.migrator = Migrator(
                 spill_root=self.config.spill_dir,
+                spill_url=self.config.spill_url,
+                site=self.config.site,
+                peers=self.config.peers,
                 supervisor=self.supervisor,
                 sessions=self.sessions,
                 registry=self.registry,

@@ -85,3 +85,65 @@ def upstream_error(worker: str, detail: str) -> ApiError:
 
 def unknown_session(sid: str) -> ApiError:
     return ApiError(404, "unknown_session", f"no session {sid!r} in this fleet")
+
+
+def lease_expired(worker: str, generation: int) -> ApiError:
+    """The generation fence (docs/FLEET.md "Cross-host topology"): a
+    heartbeat from a ``(worker, generation)`` whose lease already expired
+    is REFUSED — its sessions were (or are being) rescued onto survivors,
+    and accepting the heartbeat would re-admit a partitioned-but-alive
+    worker into a fleet that re-homed its work: split-brain double
+    execution.  410 (terminal for that incarnation): the worker's
+    recourse is to drop its adopted state and re-register fresh."""
+    return ApiError(
+        410,
+        "lease_expired",
+        f"the lease of {worker} generation {generation} expired and its "
+        f"sessions were re-homed; this incarnation is fenced — drop local "
+        f"state and re-register for a fresh generation",
+        extra={"worker": worker, "generation": generation},
+    )
+
+
+def draining(worker: str) -> ApiError:
+    """The drain answer to a remote worker's heartbeat: the control plane
+    is going away, but — unlike :func:`lease_expired` — the worker's
+    sessions were NOT rescued anywhere.  Cancelling them would lose
+    accepted work on a clean drain; the worker's correct move is to keep
+    serving them to completion and re-register when (if) a control plane
+    returns.  503 (retryable), so the generic transient path handles it."""
+    return ApiError(
+        503,
+        "draining",
+        f"this control plane is draining; {worker}'s lease is revoked but "
+        f"its sessions were not re-homed — finish them and re-register "
+        f"elsewhere (or here, after a restart)",
+        retry_after=5.0,
+    )
+
+
+def peer_unreachable(peer: str, detail: str) -> ApiError:
+    """A transient failure on the control-plane-to-peer link while
+    proxying a pinned request (docs/FLEET.md "Cross-host topology").
+    Unlike :func:`upstream_error`, every proxied request is an idempotent
+    GET/DELETE — re-asking cannot duplicate anything — so this is a
+    retryable 503, and an unmodified poll-until-done client rides through
+    a link blip (or a healing partition) the same way it rides through a
+    migration."""
+    return ApiError(
+        503,
+        "peer_unreachable",
+        f"peer control plane {peer} unreachable ({detail}); the session "
+        f"may be running fine there — retry shortly",
+        retry_after=0.5,
+    )
+
+
+def unknown_worker(worker: str) -> ApiError:
+    return ApiError(
+        404, "unknown_worker", f"no registered worker {worker!r} in this fleet"
+    )
+
+
+def bad_registration(message: str) -> ApiError:
+    return ApiError(400, "bad_registration", message)

@@ -60,11 +60,21 @@ from pathlib import Path
 
 from tpu_life import chaos
 from tpu_life.fleet.registry import fleet_sid
-from tpu_life.fleet.router import REFUSAL_CODES, WorkerUnreachable
+from tpu_life.fleet.router import (
+    REFUSAL_CODES,
+    WorkerUnreachable,
+    _error_code,
+    _json_body as _json,
+)
+from tpu_life.gateway.errors import backoff_delay
 from tpu_life.gateway.server import ROUTE_SESSIONS
 from tpu_life.io.codec import encode_board
 from tpu_life.runtime.metrics import log
 from tpu_life.serve.spill import SpillRecord, read_spill_sessions
+
+#: Peer-router 503 codes that mean "definitively not admitted" — the
+#: worker refusal set plus the router's own fleet-level refusal.
+PEER_REFUSAL_CODES = REFUSAL_CODES | {"fleet_unavailable"}
 
 #: Bound on remembered per-sid outcomes / aliases (a months-running
 #: router must not grow without bound; an evicted outcome degrades to
@@ -103,7 +113,7 @@ class Migrator:
     def __init__(
         self,
         *,
-        spill_root: str,
+        spill_root: str | None = None,
         supervisor,
         sessions,
         registry,
@@ -113,9 +123,24 @@ class Migrator:
         sleep=time.sleep,
         timeout_s: float = 30.0,
         retry_pause_s: float = 0.5,
+        max_retry_pause_s: float = 5.0,
         stuck_after_s: float = 120.0,
+        spill_url: str | None = None,
+        site: str = "",
+        peers: tuple[str, ...] = (),
     ):
         self.spill_root = spill_root
+        #: remote spill store (docs/FLEET.md "Cross-host topology"): read
+        #: a dead worker's sessions out of the shared HTTP store instead
+        #: of a local directory — the rescue works when the survivor is
+        #: on another machine.  ``site`` prefixes this control plane's
+        #: namespaces in a SHARED store.
+        self.spill_url = spill_url
+        self.site = site
+        #: peer control planes: when every LOCAL survivor refuses a
+        #: resume, re-submit to a peer fleet's router — the session then
+        #: answers its ORIGINAL sid through the peer proxy.
+        self.peers = tuple(peers)
         self.supervisor = supervisor
         self.sessions = sessions
         self.balancer = balancer
@@ -124,6 +149,7 @@ class Migrator:
         self.sleep = sleep
         self.timeout_s = timeout_s
         self.retry_pause_s = retry_pause_s
+        self.max_retry_pause_s = max_retry_pause_s
         # the stuck-MIGRATING watchdog (docs/CHAOS.md): a migration run
         # that neither finishes nor fails — its thread died, or the exit
         # hook never fired — must not leave sids answering synthetic
@@ -147,6 +173,9 @@ class Migrator:
         # client holds — consulted on double death so a second hop
         # re-pins the sid that is actually out there
         self._alias: OrderedDict[tuple[str, int, str], str] = OrderedDict()
+        # fsid -> (peer router url, peer fleet sid): sessions rescued
+        # onto a PEER control plane; the router proxies these
+        self._peer_pins: OrderedDict[str, tuple[str, str]] = OrderedDict()
         # fsid -> (steps_total, steps_done) from the spill manifest, for
         # synthetic poll views while the migration is in flight
         self._progress: dict[str, tuple[int, int]] = {}
@@ -156,7 +185,7 @@ class Migrator:
             "sessions handled by worker-death migration, by outcome",
             labels=("outcome",),
         )
-        for outcome in ("migrated", "corrupt", "failed", "disabled"):
+        for outcome in ("migrated", "peer", "corrupt", "failed", "disabled"):
             self._c_migrations.labels(outcome=outcome)
 
     # -- the supervisor hook (called under its lock: must be fast) ----------
@@ -250,6 +279,12 @@ class Migrator:
         with self._lock:
             return self._progress.get(fsid)
 
+    def peer_of(self, fsid: str) -> tuple[str, str] | None:
+        """``(peer router url, peer fleet sid)`` for a session rescued
+        onto a peer control plane, else None — the router's proxy seam."""
+        with self._lock:
+            return self._peer_pins.get(fsid)
+
     def wait_idle(self, timeout: float = 30.0) -> bool:
         """Block until every migration thread finished (tests, drains)."""
         deadline = time.monotonic() + timeout
@@ -261,11 +296,27 @@ class Migrator:
 
     # -- one worker-death migration run -------------------------------------
     def _run(self, name: str, generation: int) -> None:
-        d = worker_spill_dir(self.spill_root, name, generation)
+        remote_ns = None
+        d = None
+        if self.spill_url is not None:
+            # the wire read path (docs/FLEET.md "Cross-host topology"):
+            # the victim's namespace in the shared store, site-prefixed —
+            # identical triage to the directory read, CRC re-checked on
+            # the downloaded bytes
+            remote_ns = f"{self.site}{name}g{generation}"
+        else:
+            d = worker_spill_dir(self.spill_root, name, generation)
         cleanup = True
         try:
             try:
-                records, corrupt, disabled = read_spill_sessions(d)
+                if remote_ns is not None:
+                    from tpu_life.serve.spill_http import read_remote_sessions
+
+                    records, corrupt, disabled = read_remote_sessions(
+                        self.spill_url, remote_ns
+                    )
+                else:
+                    records, corrupt, disabled = read_spill_sessions(d)
             except Exception:
                 # a read failure must not delete bytes nobody looked at
                 log.exception("fleet: cannot read spills of %s gen %d", name,
@@ -340,11 +391,16 @@ class Migrator:
                 self._active.pop((name, generation), None)
                 self._completed.add((name, generation))
             if cleanup:
-                # the victim's directory is orphaned now: every session
+                # the victim's spills are orphaned now: every session
                 # either lives on a survivor (which spills it under its
-                # OWN dir) or is terminally lost — either way these bytes
-                # must not be resumed a second time
-                shutil.rmtree(d, ignore_errors=True)
+                # OWN namespace) or is terminally lost — either way these
+                # bytes must not be resumed a second time
+                if remote_ns is not None:
+                    from tpu_life.serve.spill_http import delete_remote_namespace
+
+                    delete_remote_namespace(self.spill_url, remote_ns)
+                else:
+                    shutil.rmtree(d, ignore_errors=True)
 
     def _target_fsid(self, name: str, generation: int, sid: str) -> str:
         with self._lock:
@@ -355,35 +411,63 @@ class Migrator:
     def _migrate_one(self, fsid: str, rec: SpillRecord) -> None:
         body = json.dumps(resume_request(rec)).encode()
         deadline = self.clock() + self.timeout_s
+        attempt = 0
         while True:
             ready = self.supervisor.ready_workers()
-            outcome = self._try_candidates(fsid, body, ready)
-            if outcome in ("migrated", "failed"):
+            outcome, hint = self._try_candidates(fsid, body, ready)
+            if outcome == "refused" and self.peers:
+                # every LOCAL survivor definitively declined (or none is
+                # ready): re-home across the host boundary — the peer
+                # control plane's router speaks the same protocol, and the
+                # original sid keeps answering through the peer proxy
+                outcome, peer_hint = self._try_peers(fsid, body)
+                hint = max(hint, peer_hint)
+            if outcome in ("migrated", "peer", "failed"):
                 break
-            # every candidate refused (or none ready): capacity pressure,
-            # not a verdict — pace and retry until the budget runs out
+            # everyone refused: capacity pressure, not a verdict — pace
+            # on the shared jittered-exponential curve (an explicit
+            # Retry-After hint wins, un-jittered: the refuser TOLD us
+            # when) and retry until the budget runs out.  Jitter matters
+            # here specifically: a mass rescue runs one of these loops
+            # per session, and a briefly-overloaded survivor must not be
+            # re-hammered by all of them in lockstep.
             if self.clock() >= deadline:
                 self._record_failure(fsid, "migration_failed")
                 return
-            self.sleep(self.retry_pause_s)
+            attempt += 1
+            self.sleep(
+                max(
+                    hint,
+                    backoff_delay(
+                        attempt,
+                        base=self.retry_pause_s,
+                        cap=self.max_retry_pause_s,
+                    ),
+                )
+            )
         if outcome == "failed":
             self._record_failure(fsid, "migration_failed")
         else:
             with self._lock:
                 self._progress.pop(fsid, None)
                 self._pending_since.pop(fsid, None)
-            self._c_migrations.labels(outcome="migrated").inc()
+            self._c_migrations.labels(
+                outcome="peer" if outcome == "peer" else "migrated"
+            ).inc()
 
-    def _try_candidates(self, fsid: str, body: bytes, ready) -> str:
-        """One pass over the ready workers: 'migrated', 'failed'
-        (ambiguous or protocol rejection — do not retry), or 'refused'
-        (every candidate definitively declined — safe to retry)."""
+    def _try_candidates(self, fsid: str, body: bytes, ready) -> tuple[str, float]:
+        """One pass over the ready workers: ``('migrated' | 'failed' |
+        'refused', retry_after_hint)`` — 'failed' is ambiguous or a
+        protocol rejection (do not retry); 'refused' means every candidate
+        definitively declined (safe to retry), with the largest
+        ``Retry-After`` any refuser volunteered as the pacing hint."""
+        hint = 0.0
         for worker in self.balancer.candidates(ready):
             # capture BEFORE the round-trip (the route_submit rule): a
             # crash+respawn mid-forward must not alias the wrong life
             target_gen = worker.generation
             try:
-                status, _, doc = self.forward(
+                status, retry_after, doc = self.forward(
                     worker, "POST", ROUTE_SESSIONS, body=body
                 )
             except WorkerUnreachable as e:
@@ -398,11 +482,13 @@ class Migrator:
                     worker.name,
                     e.cause,
                 )
-                return "failed"
+                return "failed", 0.0
+            if retry_after:
+                hint = max(hint, retry_after)
             if status == 201:
                 wsid = doc.get("session")
                 if not isinstance(wsid, str):
-                    return "failed"
+                    return "failed", 0.0
                 self.sessions.repin(fsid, worker.name, target_gen, wsid)
                 with self._lock:
                     self._alias[(worker.name, target_gen, wsid)] = fsid
@@ -416,7 +502,7 @@ class Migrator:
                     target_gen,
                     wsid,
                 )
-                return "migrated"
+                return "migrated", 0.0
             code = _error_code(doc)
             if status == 503 and code in REFUSAL_CODES:
                 self.balancer.invalidate(worker)
@@ -434,8 +520,87 @@ class Migrator:
                 "fleet: resume of %s rejected by %s: %s %s", fsid,
                 worker.name, status, code,
             )
-            return "failed"
-        return "refused"
+            return "failed", 0.0
+        return "refused", hint
+
+    def _try_peers(self, fsid: str, body: bytes) -> tuple[str, float]:
+        """One pass over the peer control planes: ``('peer' | 'failed' |
+        'refused', hint)``.  The same no-ambiguous-retry discipline as the
+        worker pass — a mid-exchange failure against a peer router may
+        have created the session over there, and re-submitting anywhere
+        would run the trajectory twice."""
+        import socket
+        import urllib.error
+        import urllib.request
+
+        hint = 0.0
+        for peer in self.peers:
+            if chaos.partitioned("migrate", peer):
+                log.warning(
+                    "fleet: peer %s unreachable for %s (partition)", peer, fsid
+                )
+                continue
+            req = urllib.request.Request(
+                peer.rstrip("/") + ROUTE_SESSIONS, data=body, method="POST"
+            )
+            req.add_header("Content-Type", "application/json")
+            try:
+                try:
+                    with urllib.request.urlopen(
+                        req, timeout=self.timeout_s
+                    ) as resp:
+                        status, retry_after, doc = resp.status, None, _json(resp)
+                except urllib.error.HTTPError as e:
+                    from tpu_life.gateway.errors import parse_retry_after
+
+                    status, retry_after, doc = (
+                        e.code, parse_retry_after(e.headers), _json(e)
+                    )
+            except (urllib.error.URLError, ConnectionError, socket.timeout, TimeoutError) as e:
+                reason = getattr(e, "reason", e)
+                refused = isinstance(reason, ConnectionRefusedError) or isinstance(
+                    e, ConnectionRefusedError
+                )
+                if refused:
+                    continue  # the peer never saw it: safe to try the next
+                log.warning(
+                    "fleet: resume of %s on peer %s ambiguous (%s); not retried",
+                    fsid,
+                    peer,
+                    e,
+                )
+                return "failed", 0.0
+            if retry_after:
+                hint = max(hint, retry_after)
+            if status == 201:
+                peer_sid = doc.get("session")
+                if not isinstance(peer_sid, str):
+                    return "failed", 0.0
+                with self._lock:
+                    self._peer_pins[fsid] = (peer.rstrip("/"), peer_sid)
+                    while len(self._peer_pins) > MAX_OUTCOMES:
+                        self._peer_pins.popitem(last=False)
+                log.info(
+                    "fleet: %s resumed on PEER %s as %s (cross-host rescue)",
+                    fsid,
+                    peer,
+                    peer_sid,
+                )
+                return "peer", 0.0
+            code = _error_code(doc)
+            if status in (429, 503) and (
+                status == 429 or code in PEER_REFUSAL_CODES
+            ):
+                continue  # definitively not admitted over there: next peer
+            log.error(
+                "fleet: resume of %s rejected by peer %s: %s %s",
+                fsid,
+                peer,
+                status,
+                code,
+            )
+            return "failed", 0.0
+        return "refused", hint
 
     def _record_failure(
         self, fsid: str, reason: str, *, counter: str = "failed"
@@ -449,7 +614,3 @@ class Migrator:
         self._c_migrations.labels(outcome=counter).inc()
         log.warning("fleet: session %s not recovered (%s)", fsid, reason)
 
-
-def _error_code(doc: dict) -> str | None:
-    err = doc.get("error")
-    return err.get("code") if isinstance(err, dict) else None

@@ -42,6 +42,7 @@ from urllib.parse import urlsplit
 from tpu_life import chaos
 from tpu_life.fleet import errors as fl_errors
 from tpu_life.fleet.balancer import LeastDepthBalancer, prom_value
+from tpu_life.fleet.membership import ROUTE_HEARTBEAT, ROUTE_REGISTER
 from tpu_life.fleet.registry import SessionRegistry
 from tpu_life.fleet.supervisor import (
     FleetConfig,
@@ -179,6 +180,20 @@ class Router:
             chaos.record_fire("router.submit.reset", "reset")
             raise WorkerUnreachable(
                 worker, True, ConnectionResetError("chaos: pre-send reset")
+            )
+        # chaos seam: the seeded per-peer connectivity mask severs THIS
+        # router->worker link (docs/CHAOS.md ``net.partition``).  The
+        # honest transport shape is a connect that never establishes —
+        # a refusal, so submits retry the next candidate and pinned
+        # requests consult the migrator exactly as a real partition would.
+        # The site prefix keeps the pair label unique when two control
+        # planes share one process (the cross-host drill): without it,
+        # plane A's and plane B's links to same-named workers would share
+        # one draw counter and the per-link schedule would depend on
+        # thread interleaving instead of the seed alone.
+        if chaos.partitioned(f"{self.config.site}router", worker.name):
+            raise WorkerUnreachable(
+                worker, True, ConnectionRefusedError("chaos: net partition")
             )
         poll_fault = (
             chaos.decide("router.poll.reset")
@@ -345,6 +360,14 @@ class Router:
     def route_pinned(
         self, method: str, fsid: str, tail: str, api_key: str | None
     ) -> tuple[int, float | None, dict]:
+        # a session rescued onto a PEER control plane (docs/FLEET.md
+        # "Cross-host topology") answers under its original sid: the pin
+        # still names the dead local home, so the peer map is consulted
+        # first and the request proxies to the peer router, which speaks
+        # the exact same protocol
+        peer = self.migrator.peer_of(fsid) if self.migrator is not None else None
+        if peer is not None:
+            return self._route_peer(method, fsid, peer, tail, api_key)
         worker, sid = self.resolve(fsid)
         try:
             status, retry_after, doc = self.forward(
@@ -377,6 +400,47 @@ class Router:
         if isinstance(doc.get("session"), str):
             doc["session"] = fsid
         doc["worker"] = worker.name
+        return status, retry_after, doc
+
+    def _route_peer(
+        self,
+        method: str,
+        fsid: str,
+        peer: tuple[str, str],
+        tail: str,
+        api_key: str | None,
+    ) -> tuple[int, float | None, dict]:
+        """Proxy one pinned request to the peer control plane that adopted
+        the session; the client keeps its original fleet sid."""
+        peer_url, peer_sid = peer
+        if chaos.partitioned(f"{self.config.site}router", peer_url):
+            raise fl_errors.peer_unreachable(
+                peer_url, "net partition to peer control plane"
+            )
+        req = urllib.request.Request(
+            f"{peer_url}{ROUTE_SESSIONS}/{peer_sid}{tail}", method=method
+        )
+        if api_key is not None:
+            req.add_header("X-API-Key", api_key)
+        try:
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.config.forward_timeout_s
+                ) as resp:
+                    status, retry_after, doc = resp.status, None, _json_body(resp)
+            except urllib.error.HTTPError as e:
+                status, retry_after, doc = (
+                    e.code, parse_retry_after(e.headers), _json_body(e)
+                )
+        except (urllib.error.URLError, ConnectionError, socket.timeout, TimeoutError) as e:
+            # the peer plane is unreachable, never a 410 — the session may
+            # be running fine over there.  Proxied requests are all
+            # idempotent GET/DELETE, so unlike the mid-exchange 502 this
+            # is a retryable 503: a poll loop rides through a link blip.
+            raise fl_errors.peer_unreachable(peer_url, str(e)) from None
+        if isinstance(doc.get("session"), str):
+            doc["session"] = fsid
+        doc["peer"] = peer_url
         return status, retry_after, doc
 
     def migrating_view(self, fsid: str) -> dict:
@@ -463,6 +527,17 @@ class _Handler(JsonHandler):
         control and belongs at the front."""
         return self._read_sized_body(self.rt.config.max_body)
 
+    def _read_json(self) -> dict:
+        """A bounded JSON object body for the fleet's OWN endpoints
+        (registration / heartbeat) — typed 400 on garbage."""
+        try:
+            doc = json.loads(self._read_body() or b"{}")
+        except json.JSONDecodeError as e:
+            raise fl_errors.bad_registration(f"body is not JSON: {e}") from None
+        if not isinstance(doc, dict):
+            raise fl_errors.bad_registration("body must be a JSON object")
+        return doc
+
     # -- dispatch ----------------------------------------------------------
     def do_GET(self):  # noqa: N802
         self._dispatch("GET")
@@ -547,6 +622,30 @@ class _Handler(JsonHandler):
         if path == "/metrics":
             self._require(method, "GET", path)
             self._send_text(200, rt.merged_metrics(), "text/plain; version=0.0.4")
+            return
+        if path == ROUTE_REGISTER:
+            # wire registration (docs/FLEET.md "Cross-host topology"):
+            # the body is the worker's startup JSON line — the contract
+            # that already existed IS the handshake
+            self._require(method, "POST", path)
+            self._send_json(200, rt.supervisor.register_worker(self._read_json()))
+            return
+        if path == ROUTE_HEARTBEAT:
+            self._require(method, "POST", path)
+            doc = self._read_json()
+            worker = doc.get("worker")
+            if not isinstance(worker, str):
+                raise fl_errors.bad_registration(
+                    f"heartbeat needs a worker name, got {worker!r}"
+                )
+            try:
+                generation = int(doc.get("generation"))
+            except (TypeError, ValueError):
+                raise fl_errors.bad_registration(
+                    f"heartbeat needs an integer generation, got "
+                    f"{doc.get('generation')!r}"
+                ) from None
+            self._send_json(200, rt.supervisor.heartbeat(worker, generation))
             return
         if path == ROUTE_SESSIONS:
             self._require(method, "POST", path)

@@ -43,6 +43,7 @@ from __future__ import annotations
 import enum
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -51,6 +52,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -62,6 +64,12 @@ from tpu_life.fleet.placement import (
 )
 from tpu_life.gateway import protocol
 from tpu_life.runtime.metrics import log
+
+#: Bound on remembered lease-expiry fences (a months-running control
+#: plane with a flapping remote worker must not grow without bound).
+#: Evicting the OLDEST fence is safe: its generation is long superseded,
+#: so ``heartbeat``'s generation-mismatch arm answers the same typed 410.
+MAX_FENCES = 10_000
 
 
 class WorkerState(enum.Enum):
@@ -101,6 +109,26 @@ class FleetConfig:
     #: None = durability off (worker death answers 410 worker_lost).
     spill_dir: str | None = None
     spill_every: int = 4  # rounds between worker spill passes
+    #: remote spill store (docs/FLEET.md "Cross-host topology"): workers
+    #: spill through this HTTP store instead of a local directory, under
+    #: per-incarnation namespaces (``<site><name>g<gen>``), so migration
+    #: reads work when the rescuer shares no filesystem with the victim.
+    #: Mutually exclusive with ``spill_dir``.
+    spill_url: str | None = None
+    #: this control plane's namespace prefix in a SHARED spill store (two
+    #: fleets sharing one store must not collide on ``w0g1``); also the
+    #: orphan-sweep scope — a fleet only ever reaps its own site's
+    #: namespaces.  Letters/digits/dash, e.g. ``"a-"``.
+    site: str = ""
+    #: peer control planes (router URLs): when every LOCAL survivor
+    #: refuses a rescue, the migrator re-submits the spilled session to a
+    #: peer fleet — cross-host failure masking (docs/FLEET.md).
+    peers: tuple[str, ...] = ()
+    #: lease TTL for wire-registered workers; their heartbeats renew it,
+    #: and an un-renewed lease fires the same migration hook a local
+    #: process death does, then FENCES the generation (typed
+    #: ``lease_expired`` on reconnect — never split-brain re-admission)
+    lease_ttl_s: float = 15.0
     migrate_timeout_s: float = 30.0  # per-session resume budget on death
     #: stuck-MIGRATING watchdog (docs/CHAOS.md): a sid still answering
     #: "migrating" this long after its run activated (or after the
@@ -156,9 +184,22 @@ class Worker:
     #: slow attach — and must ride the restart budget, never the
     #: placement fail-fast
     recycling: bool = False
+    #: wire-registered membership (docs/FLEET.md "Cross-host topology"):
+    #: True for workers the control plane did NOT spawn — they registered
+    #: over HTTP, hold a heartbeat-renewed lease, and are never respawned
+    #: by us (a fresh registration IS their respawn)
+    remote: bool = False
+    lease_expires_at: float = 0.0
+    #: the lease expired (or the fleet drained): this incarnation is
+    #: fenced — terminal until the worker re-registers a new generation
+    lease_dead: bool = False
 
     @property
     def alive(self) -> bool:
+        if self.remote:
+            # a remote worker is "alive" exactly while its lease stands:
+            # there is no process to poll, only the claim it keeps renewing
+            return self.url is not None and not self.lease_dead
         return self.proc is not None and self.proc.poll() is None
 
 
@@ -176,6 +217,16 @@ class Supervisor:
         clock=time.monotonic,
     ):
         self.config = config
+        if config.spill_url is not None and config.spill_dir is not None:
+            raise ValueError(
+                "spill_dir and spill_url are mutually exclusive (a fleet "
+                "spills locally OR through the remote store, never both)"
+            )
+        if not re.fullmatch(r"(?:[A-Za-z0-9][A-Za-z0-9-]*)?", config.site):
+            raise ValueError(
+                f"site must be letters/digits/dash starting with an "
+                f"alphanumeric (a spill-namespace prefix), got {config.site!r}"
+            )
         self.clock = clock
         self.spawn = spawn or self._default_spawn
         self.probe = probe or self._default_probe
@@ -212,9 +263,30 @@ class Supervisor:
                 f"(expected auto or none)"
             )
         #: worker-death callback: ``cb(name, generation)`` fires (under
-        #: the supervisor lock — keep it fast) for every non-drain exit;
-        #: the fleet wires the migrator's spill rescue here
+        #: the supervisor lock — keep it fast) for every non-drain exit
+        #: AND every lease expiry; the fleet wires the migrator's spill
+        #: rescue here
         self.on_worker_exit = None
+        #: fenced incarnations (docs/FLEET.md "Cross-host topology"): a
+        #: (name, generation) whose lease expired after its sessions were
+        #: re-homed — its heartbeats are refused with the typed 410
+        #: ``lease_expired``, never silently re-admitted.  Insertion-
+        #: ordered and bounded (a months-running plane with a flapping
+        #: remote worker must not grow without bound): an evicted fence
+        #: is generations-superseded, and ``heartbeat``'s generation-
+        #: mismatch arm still answers it the same typed 410
+        self._fenced: OrderedDict[tuple[str, int], None] = OrderedDict()
+        #: fences created by begin_drain rather than a lease expiry: the
+        #: worker's sessions were NOT re-homed, so its heartbeats answer
+        #: the typed 503 ``draining`` (finish your sessions, re-register
+        #: later) instead of the 410 that tells it to drop everything
+        self._drain_fenced: set[tuple[str, int]] = set()
+        #: chaos-injection retention (docs/CHAOS.md): last-seen
+        #: ``chaos_injections_total`` per (worker, generation, point,
+        #: outcome), scraped continuously while a plan is armed — a dead
+        #: worker's counters no longer die with its registry, so drill
+        #: accounting is per-incarnation exact instead of a pre-kill floor
+        self._injections: dict[tuple[str, int, str, str], float] = {}
         self._g_workers = registry.gauge(
             "fleet_workers", "supervised workers by state", labels=("state",)
         )
@@ -222,6 +294,26 @@ class Supervisor:
             "fleet_restarts_total", "worker respawns after a crash"
         )
         self._c_restarts.labels()
+        # the lease instruments (docs/FLEET.md "Cross-host topology")
+        self._c_lease_expired = registry.counter(
+            "fleet_lease_expired_total",
+            "remote-worker leases expired un-renewed (fires migration)",
+        )
+        self._c_lease_expired.labels()
+        self._c_lease_refused = registry.counter(
+            "fleet_lease_refusals_total",
+            "heartbeats refused because the (worker, generation) is fenced",
+        )
+        self._c_lease_refused.labels()
+        self._c_registrations = registry.counter(
+            "fleet_registrations_total", "wire registrations accepted"
+        )
+        self._c_registrations.labels()
+        self._g_injections = registry.gauge(
+            "fleet_chaos_injections",
+            "last-seen chaos_injections_total per worker (survives death)",
+            labels=("worker", "point", "outcome"),
+        )
         self._g_devices = registry.gauge(
             "fleet_worker_devices",
             "devices resolved by each worker (planned until reported)",
@@ -249,6 +341,31 @@ class Supervisor:
         so at start every existing subdirectory is an orphan — without
         this, a crashed worker's directory would sit on disk forever
         (in-run orphans are deleted by the migrator after each rescue)."""
+        if self.config.spill_url is not None:
+            # the remote twin: reap THIS SITE's namespaces from the shared
+            # store.  An empty site would sweep every fleet sharing the
+            # store, so the sweep is gated on a non-empty prefix (a solo
+            # fleet that wants the reap names a site; docs/FLEET.md).
+            if not self.config.site:
+                log.debug("fleet: no site prefix — skipping remote spill sweep")
+                return
+            from tpu_life.serve.spill_http import (
+                delete_remote_namespace,
+                list_remote_namespaces,
+            )
+
+            try:
+                spaces = list_remote_namespaces(self.config.spill_url)
+            except OSError as e:
+                # the store may simply not be up yet: durability degrades,
+                # the fleet must still come up
+                log.warning("fleet: remote spill sweep skipped: %s", e)
+                return
+            for ns in spaces:
+                if ns.startswith(self.config.site):
+                    log.info("fleet: sweeping orphan remote namespace %s", ns)
+                    delete_remote_namespace(self.config.spill_url, ns)
+            return
         if self.config.spill_dir is None:
             return
         root = Path(self.config.spill_dir)
@@ -272,6 +389,18 @@ class Supervisor:
             first = not self._draining
             self._draining = True
             for w in self.workers:
+                if w.remote:
+                    # not ours to signal: revoke the lease and fence the
+                    # generation — but as a DRAIN fence, so a late
+                    # heartbeat gets the typed 503 ``draining`` (its
+                    # sessions were not re-homed; it must finish them,
+                    # not drop them) rather than the 410 fence
+                    if not w.lease_dead:
+                        self._fence_locked(w)
+                        self._drain_fenced.add((w.name, w.generation))
+                        w.lease_dead = True
+                        w.state = WorkerState.DOWN
+                    continue
                 if w.alive:
                     if first:
                         log.info("fleet: draining %s (pid %d)", w.name, w.proc.pid)
@@ -314,7 +443,7 @@ class Supervisor:
             self._thread.join(timeout=5)
         with self._lock:
             for w in self.workers:
-                if w.alive:
+                if w.proc is not None and w.proc.poll() is None:
                     w.proc.kill()
             for w in self.workers:
                 if w.proc is not None:
@@ -426,8 +555,7 @@ class Supervisor:
             for w, gen, status in results:
                 if (
                     w.generation != gen
-                    or w.proc is None
-                    or w.proc.poll() is not None
+                    or not w.alive
                     or w.state in (WorkerState.DOWN, WorkerState.FAILED)
                 ):
                     continue  # stale answer: the next tick sees the truth
@@ -462,6 +590,16 @@ class Supervisor:
         over HTTP (it is alive with a bound URL)."""
         if w.state is WorkerState.FAILED:
             return False
+        if w.remote:
+            # wire-registered: liveness is the lease, not a process.  An
+            # un-renewed lease is this tier's "the process exited" — same
+            # hook, same migration, plus the generation fence.
+            if w.lease_dead:
+                return False
+            if now > w.lease_expires_at:
+                self._expire_lease_locked(w)
+                return False
+            return w.url is not None
         if w.proc is not None and w.proc.poll() is not None:
             self._on_exit(w, now)
             return False
@@ -507,6 +645,11 @@ class Supervisor:
         info = None
         if isinstance(status, tuple):
             status, info = status
+        if isinstance(info, dict) and "_chaos_injections" in info:
+            # the piggybacked injection scrape (docs/CHAOS.md): fold it
+            # into the per-incarnation retention whatever the readiness
+            # verdict was — evidence is evidence
+            self._record_injections_locked(w, info.pop("_chaos_injections"))
         if status == "ready":
             w.state = WorkerState.READY
             w.ever_ready = True
@@ -523,8 +666,13 @@ class Supervisor:
             if w.state is WorkerState.STARTING:
                 if now - w.started_at > self.config.startup_timeout_s:
                     log.warning("fleet: %s never became ready; killing", w.name)
-                    w.recycling = True
-                    w.proc.kill()
+                    if w.remote:
+                        # no process to kill: revoke the lease — the
+                        # worker re-registers when (if) it can reach us
+                        self._expire_lease_locked(w)
+                    else:
+                        w.recycling = True
+                        w.proc.kill()
                 return
             w.unready += 1
             if w.unready >= self.config.unready_threshold:
@@ -533,8 +681,11 @@ class Supervisor:
                     w.name,
                     w.unready,
                 )
-                w.recycling = True
-                w.proc.kill()
+                if w.remote:
+                    self._expire_lease_locked(w)
+                else:
+                    w.recycling = True
+                    w.proc.kill()
 
     def _on_exit(self, w: Worker, now: float) -> None:
         rc = w.proc.poll()
@@ -603,6 +754,220 @@ class Supervisor:
             delay,
         )
 
+    # -- wire-registered membership (docs/FLEET.md "Cross-host topology") --
+    def _expire_lease_locked(self, w: Worker) -> None:
+        """A remote worker's lease ran out (or it wedged): this
+        incarnation is dead to the fleet.  Fires the SAME migration hook
+        a local process exit does, then fences the generation — a
+        partitioned-but-alive worker that reconnects is refused typed,
+        never silently re-admitted over its rescued sessions."""
+        log.warning(
+            "fleet: lease of %s gen %d expired — fencing and migrating "
+            "its sessions",
+            w.name,
+            w.generation,
+        )
+        self._fence_locked(w)
+        w.lease_dead = True
+        w.state = WorkerState.DOWN
+        w.unready = 0
+        self._c_lease_expired.inc()
+        if self._draining:
+            return
+        if self.on_worker_exit is not None:
+            try:
+                self.on_worker_exit(w.name, w.generation)
+            except Exception:  # pragma: no cover - the hook must not kill the tick
+                log.exception("fleet: worker-exit hook failed for %s", w.name)
+
+    def register_worker(self, doc: dict) -> dict:
+        """Admit a wire-registered worker; ``doc`` is its startup JSON
+        line (the existing contract IS the handshake).  Returns the
+        grant: assigned name, fresh generation, lease TTL, heartbeat
+        cadence, and — when the fleet spills remotely — the spill
+        namespace this incarnation must write.
+
+        A re-registration claiming a known remote name bumps that slot's
+        generation (exactly a local respawn); if the prior generation's
+        lease was still standing, it is expired first — re-registration
+        is an admission that the old incarnation is gone, and its
+        sessions need rescuing like any death."""
+        from tpu_life.fleet import errors as fl_errors
+        from tpu_life.fleet.membership import heartbeat_every
+
+        url = doc.get("url")
+        if not isinstance(url, str) or not url.startswith("http"):
+            raise fl_errors.bad_registration(
+                f"registration needs the worker's bound url, got {url!r}"
+            )
+        # every wire field is validated BEFORE any slot mutation: a typed
+        # 400 must leave no half-registered ghost behind (a slot with a
+        # bumped generation and a zero lease would fence-and-migrate an
+        # incarnation that never existed)
+        devices: int | None = None
+        if doc.get("devices"):
+            try:
+                devices = int(doc["devices"])
+            except (TypeError, ValueError):
+                raise fl_errors.bad_registration(
+                    f"registration devices must be an integer, "
+                    f"got {doc['devices']!r}"
+                ) from None
+        with self._lock:
+            if self._draining:
+                raise fl_errors.no_ready_workers(len(self.workers))
+            claimed = doc.get("worker")
+            w = self.get(claimed) if isinstance(claimed, str) else None
+            if w is not None and not w.remote:
+                raise fl_errors.bad_registration(
+                    f"{claimed!r} is a locally supervised worker; remote "
+                    f"registration cannot claim it"
+                )
+            if w is None:
+                # honor a well-formed unclaimed name: two workers
+                # re-registering after a control-plane restart must keep
+                # their DISTINCT old identities, not collide on one
+                # auto-minted slot and fence each other in a ping-pong
+                if isinstance(claimed, str) and re.fullmatch(r"w\d+", claimed):
+                    name = claimed
+                else:
+                    taken = {x.name for x in self.workers}
+                    idx = len(self.workers)
+                    while f"w{idx}" in taken:
+                        idx += 1
+                    name = f"w{idx}"
+                w = Worker(
+                    name=name,
+                    log_path=self.log_dir / f"{name}.log",
+                    remote=True,
+                )
+                self.workers.append(w)
+            else:
+                if not w.lease_dead and w.url is not None:
+                    self._expire_lease_locked(w)
+                # a slot re-claim is the SAME worker process carrying
+                # cumulative chaos counters into its next generation: its
+                # fresh scrapes SUPERSEDE the old generation's retention
+                # (keeping both would double-count every prior injection)
+                self._injections = {
+                    k: v for k, v in self._injections.items() if k[0] != w.name
+                }
+            w.remote = True
+            w.generation += 1
+            w.proc = None
+            w.url = url
+            w.run_id = doc.get("run_id")
+            if devices is not None:
+                w.devices = devices
+                w.device_kind = doc.get("device_kind") or w.device_kind
+            w.lease_dead = False
+            w.lease_expires_at = self.clock() + self.config.lease_ttl_s
+            w.started_at = self.clock()
+            w.unready = 0
+            w.ever_ready = False
+            w.state = WorkerState.STARTING
+            self._c_registrations.inc()
+            self._update_gauges()
+            grant = {
+                "worker": w.name,
+                "generation": w.generation,
+                "lease_ttl_s": self.config.lease_ttl_s,
+                "heartbeat_every_s": heartbeat_every(self.config.lease_ttl_s),
+            }
+            if self.config.spill_url is not None:
+                grant["spill"] = {
+                    "url": self.config.spill_url,
+                    "namespace": self.spill_namespace(w.name, w.generation),
+                }
+            log.info(
+                "fleet: registered remote worker %s gen %d at %s",
+                w.name,
+                w.generation,
+                url,
+            )
+            return grant
+
+    def heartbeat(self, name: str, generation: int) -> dict:
+        """Renew a remote worker's lease; the typed 410 ``lease_expired``
+        for a fenced (or superseded) incarnation is the generation fence
+        the split-brain guarantee rests on."""
+        from tpu_life.fleet import errors as fl_errors
+
+        generation = int(generation)
+        with self._lock:
+            w = self.get(name)
+            if w is None or not w.remote:
+                raise fl_errors.unknown_worker(name)
+            if (name, generation) in self._drain_fenced:
+                # a drain fence, not a lease-expiry fence: the worker's
+                # sessions were never rescued, so it must NOT drop them —
+                # typed 503, and the refusal counter (fence evidence)
+                # stays untouched
+                raise fl_errors.draining(name)
+            if (
+                (name, generation) in self._fenced
+                or w.generation != generation
+                or w.lease_dead
+            ):
+                self._c_lease_refused.inc()
+                raise fl_errors.lease_expired(name, generation)
+            w.lease_expires_at = self.clock() + self.config.lease_ttl_s
+            return {
+                "worker": name,
+                "generation": generation,
+                "lease_ttl_s": self.config.lease_ttl_s,
+            }
+
+    def _fence_locked(self, w: Worker) -> None:
+        """Record the generation fence for ``w``'s current incarnation
+        (caller holds the lock), evicting the oldest fence past the
+        :data:`MAX_FENCES` bound."""
+        self._fenced[(w.name, w.generation)] = None
+        while len(self._fenced) > MAX_FENCES:
+            self._fenced.popitem(last=False)
+
+    def is_fenced(self, name: str, generation: int) -> bool:
+        with self._lock:
+            return (name, int(generation)) in self._fenced
+
+    def spill_namespace(self, name: str, generation: int) -> str:
+        """Where one worker incarnation spills in the REMOTE store: the
+        site-prefixed twin of ``worker_spill_dir`` (two fleets sharing a
+        store stay disjoint by site)."""
+        return f"{self.config.site}{name}g{generation}"
+
+    # -- chaos-injection retention (docs/CHAOS.md) --------------------------
+    def _record_injections_locked(self, w: Worker, series: dict) -> None:
+        """Fold one scrape of a worker's ``chaos_injections_total`` into
+        the per-(worker, generation) last-seen view.  Monotone max per
+        incarnation: a counter reset (respawn) starts a NEW generation
+        key instead of silently shrinking the old one."""
+        totals: dict[tuple[str, str, str], float] = {}
+        for key, v in series.items():
+            point, _, outcome = key.partition("|")
+            k = (w.name, w.generation, point, outcome)
+            self._injections[k] = max(self._injections.get(k, 0.0), float(v))
+        for (name, _gen, point, outcome), v in self._injections.items():
+            if name == w.name:
+                tk = (name, point, outcome)
+                totals[tk] = totals.get(tk, 0.0) + v
+        for (name, point, outcome), v in totals.items():
+            self._g_injections.labels(
+                worker=name, point=point, outcome=outcome
+            ).set(v)
+
+    def injection_totals(self) -> dict:
+        """``point -> outcome -> count`` summed over every worker
+        incarnation ever seen — the drill's exact accounting (a dead
+        worker's last-seen counters are retained here, not lost with its
+        registry)."""
+        with self._lock:
+            out: dict[str, dict[str, float]] = {}
+            for (_name, _gen, point, outcome), v in self._injections.items():
+                bucket = out.setdefault(point, {})
+                bucket[outcome] = bucket.get(outcome, 0.0) + v
+            return out
+
     def _spawn_worker(self, w: Worker, *, first: bool = False) -> None:
         if self._draining:
             # a SIGTERM can land between installing handlers and start()'s
@@ -655,6 +1020,17 @@ class Supervisor:
             argv += [
                 "--spill-dir",
                 str(worker_spill_dir(self.config.spill_dir, w.name, w.generation)),
+                "--spill-every",
+                str(self.config.spill_every),
+            ]
+        elif self.config.spill_url is not None:
+            # the remote twin: same per-incarnation isolation, expressed
+            # as a namespace in the shared store instead of a directory
+            argv += [
+                "--spill-url",
+                self.config.spill_url,
+                "--spill-namespace",
+                self.spill_namespace(w.name, w.generation),
                 "--spill-every",
                 str(self.config.spill_every),
             ]
@@ -719,13 +1095,56 @@ class Supervisor:
                     doc = json.loads(resp.read())
                 except (json.JSONDecodeError, OSError):
                     doc = {}
-                # carry the readyz body: it grows devices/device_kind
-                # once the worker's async device resolution lands
-                return ("ready", doc)
+            # the injection-retention scrape (docs/CHAOS.md): while a
+            # chaos plan is armed in THIS process (a drill), every probe
+            # also folds the worker's chaos_injections_total into the
+            # fleet registry — so a dead worker's counters no longer die
+            # with its own registry, and drill accounting is exact
+            # rather than a pre-kill floor
+            if chaos.armed():
+                series = _scrape_injection_series(w.url)
+                if series:
+                    doc["_chaos_injections"] = series
+            # carry the readyz body: it grows devices/device_kind
+            # once the worker's async device resolution lands
+            return ("ready", doc)
         except urllib.error.HTTPError as e:
             return "draining" if e.code == 503 else "unreachable"
         except Exception:
             return "unreachable"
+
+
+def _scrape_injection_series(url: str) -> dict[str, float] | None:
+    """One best-effort scrape of a worker's ``chaos_injections_total``
+    series: ``{"point|outcome": value}``, or None when the worker (or its
+    exposition) is unreadable — evidence collection must never fail a
+    probe that already answered ready."""
+    try:
+        req = urllib.request.Request(url + "/metrics")
+        with urllib.request.urlopen(req, timeout=1.0) as resp:
+            text = resp.read().decode()
+    except Exception:
+        return None
+    series: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line.startswith("chaos_injections_total{"):
+            continue
+        head, _, value = line.rpartition(" ")
+        inner = head[head.find("{") + 1 : head.rfind("}")]
+        point = outcome = ""
+        for part in inner.split(","):
+            k, _, v = part.partition("=")
+            if k == "point":
+                point = v.strip('"')
+            elif k == "outcome":
+                outcome = v.strip('"')
+        if not point:
+            continue
+        try:
+            series[f"{point}|{outcome}"] = float(value)
+        except ValueError:
+            continue
+    return series or None
 
 
 def worker_weight(w: Worker) -> float:

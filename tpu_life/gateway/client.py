@@ -23,7 +23,7 @@ import urllib.request
 import numpy as np
 
 from tpu_life.gateway import protocol
-from tpu_life.gateway.errors import parse_retry_after
+from tpu_life.gateway.errors import backoff_delay, parse_retry_after
 
 #: Statuses the client retries (with Retry-After / backoff): rate limit,
 #: and the 503 family (queue full / shedding / draining).
@@ -125,15 +125,16 @@ class GatewayClient:
                 wait = None
             attempt += 1
             if wait is None:
-                # no Retry-After: exponential backoff with bounded jitter —
-                # the multiplicative spread keeps a thundering herd of
-                # identical clients from re-arriving in lockstep.  Clamp
-                # AFTER jittering: max_backoff is a hard bound callers size
-                # against deadlines (downward jitter still spreads the cap)
-                wait = self.backoff * (2 ** (attempt - 1))
-                if self.jitter:
-                    wait *= 1.0 + self.rng.uniform(-self.jitter, self.jitter)
-                wait = min(self.max_backoff, wait)
+                # no Retry-After: the shared jittered-exponential formula
+                # (gateway.errors.backoff_delay — the migrator and remote
+                # spill backend pace on the same curve)
+                wait = backoff_delay(
+                    attempt,
+                    base=self.backoff,
+                    cap=self.max_backoff,
+                    jitter=self.jitter,
+                    rng=self.rng,
+                )
             self.sleep(wait)
 
     # -- the API -----------------------------------------------------------

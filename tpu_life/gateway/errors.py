@@ -70,6 +70,30 @@ def parse_retry_after(headers) -> float | None:
         return None
 
 
+def backoff_delay(
+    attempt: int,
+    *,
+    base: float,
+    cap: float,
+    jitter: float = 0.25,
+    rng=None,
+) -> float:
+    """The retry pause for the ``attempt``-th retry (1-based): exponential
+    from ``base``, spread by bounded multiplicative ``jitter`` so N peers
+    bounced together don't re-arrive in lockstep, clamped to ``cap``
+    AFTER jittering (the cap is a hard bound callers size against
+    deadlines; downward jitter still spreads it).  The one backoff
+    formula the client, the migrator's resume retry, and the remote
+    spill backend all share — an explicit ``Retry-After`` always wins
+    over it, un-jittered."""
+    import random
+
+    wait = base * (2 ** (max(1, attempt) - 1))
+    if jitter:
+        wait *= 1.0 + (rng or random).uniform(-jitter, jitter)
+    return min(cap, wait)
+
+
 def bad_request(code: str, message: str) -> ApiError:
     return ApiError(400, code, message)
 

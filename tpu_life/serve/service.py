@@ -108,6 +108,14 @@ class ServeConfig:
     # it never blocks submit/poll/cancel.
     spill_dir: str | None = None
     spill_every: int = 4  # rounds between spill passes
+    # the remote spill backend (docs/FLEET.md "Cross-host topology"):
+    # instead of a local directory, spill through an HTTP spill store
+    # (``tpu-life spill-store``) under ``spill_namespace`` — the same
+    # atomic-publish + CRC contract on the wire, so a migrator on
+    # ANOTHER machine can read the rescue.  Mutually exclusive with
+    # ``spill_dir`` (typed error at construction).
+    spill_url: str | None = None
+    spill_namespace: str | None = None  # default: this service's run_id
 
 
 class SimulationService:
@@ -127,7 +135,10 @@ class SimulationService:
             raise ValueError(
                 f"chunk_steps must be >= 1, got {self.config.chunk_steps}"
             )
-        if self.config.spill_dir is not None and self.config.spill_every < 1:
+        if (
+            self.config.spill_dir is not None
+            or self.config.spill_url is not None
+        ) and self.config.spill_every < 1:
             raise ValueError(
                 f"spill_every must be >= 1, got {self.config.spill_every}"
             )
@@ -240,15 +251,28 @@ class SimulationService:
         # process land in the shared registry — /metrics, the prom file,
         # the JSONL snapshot.  A disarmed process just never ticks it.
         chaos.bind_registry(self.registry)
-        # the spill store (durable sessions): created eagerly so a bad
-        # spill path fails at construction, not at the first spill pass
-        if self.config.spill_dir is not None:
-            from tpu_life.serve.spill import SpillStore
+        # the spill backend (durable sessions): created eagerly so a bad
+        # spill path — or a spill_dir/spill_url conflict — fails at
+        # construction, not at the first spill pass.  The seam is
+        # serve.spill.SpillBackend: local directory by default, the
+        # remote HTTP store when spill_url is set (cross-host failover)
+        if self.config.spill_dir is not None or self.config.spill_url is not None:
+            from tpu_life.serve.spill import SpillBackend, make_spill_backend
 
-            self._spill: SpillStore | None = SpillStore(self.config.spill_dir)
+            self._spill: SpillBackend | None = make_spill_backend(
+                spill_dir=self.config.spill_dir,
+                spill_url=self.config.spill_url,
+                namespace=self.config.spill_namespace or self.run_id,
+            )
         else:
             self._spill = None
         self._rounds_since_spill = 0
+        # count of admitted spill-urgent sessions (spill-on-adopt) that
+        # may still be awaiting their first write: lets off-cadence
+        # rounds skip the full slot walk in the steady state.  May
+        # overcount (self-healing: any urgent-pending walk recomputes
+        # it); never undercounts while a session is still urgent.
+        self._spill_urgent_pending = 0
         self._snapshot_s_total = 0.0
         # the service OWNS its tracer rather than claiming the process-
         # global slot: emissions are routed through obs.activate() per
@@ -384,6 +408,15 @@ class SimulationService:
                 temperature=None if temperature is None else float(temperature),
                 start_step=start_step,
             )
+            if start_step > 0 and self._spill is not None:
+                # spill-on-adopt (docs/FLEET.md): this submission carries a
+                # RESCUED trajectory — until it is spilled HERE, a second
+                # kill loses it (the PR 8 known limit).  Mark it urgent so
+                # the very next spill-capable round writes it, cadence or
+                # not, and back-to-back kills degrade to one extra rescue
+                # instead of a 410 never_snapshotted.
+                s.spill_urgent = True
+                self._spill_urgent_pending += 1
             self._c_submitted.inc()
             if steps == 0:
                 # nothing to run: complete at admission, never costs a slot
@@ -515,6 +548,32 @@ class SimulationService:
     def draining(self) -> bool:
         return self._draining
 
+    def rebind_spill(self, namespace: str) -> None:
+        """Re-point a REMOTE spill backend at a fresh incarnation
+        namespace (docs/FLEET.md "Cross-host topology"): a wire-registered
+        worker calls this when the control plane grants it a new
+        ``(worker, generation)`` — its spills must land in the namespace
+        the migrator will read for THAT incarnation.  Typed error on a
+        local (or absent) backend: only the HTTP store has namespaces."""
+        if self._spill is None or not hasattr(self._spill, "set_namespace"):
+            raise ValueError(
+                "rebind_spill needs a remote spill backend (spill_url)"
+            )
+        self._spill.set_namespace(namespace)
+
+    def cancel_live(self, reason: str = "cancelled") -> int:
+        """Cancel every non-terminal session; returns how many.  The
+        fenced-worker recourse (docs/FLEET.md): a worker refused with
+        ``lease_expired`` learned its sessions were RESCUED elsewhere —
+        finishing its local copies would double-execute trajectories the
+        fleet already re-homed, so it drops them before re-registering."""
+        with self._lock:
+            sids = [s.sid for s in self.store.live()]
+        n = sum(1 for sid in sids if self.cancel(sid))
+        if n:
+            log.warning("serve: cancelled %d live session(s): %s", n, reason)
+        return n
+
     def idle(self) -> bool:
         """True when nothing is queued or resident in any batch slot."""
         with self._lock:
@@ -630,19 +689,30 @@ class SimulationService:
         if self._spill is None:
             return None
         self._rounds_since_spill += 1
-        if self._rounds_since_spill < self.config.spill_every:
-            return None
-        self._rounds_since_spill = 0
+        due = self._rounds_since_spill >= self.config.spill_every
+        if due:
+            self._rounds_since_spill = 0
+        elif self._spill_urgent_pending == 0:
+            return None  # off-cadence, nothing urgent: the cheap path
         plan = []
+        # an URGENT session (a just-adopted rescue, spill-on-adopt) rides
+        # every round until its first successful write, cadence or not —
+        # between resume-accept and that write, a second kill would lose
+        # a trajectory a client was already promised survives kills
+        urgent = 0
         for key, slots in self.scheduler.running.items():
             engine = self.scheduler.engines[key]
             for slot, s in slots.items():
-                if not s.spill_disabled:
+                if not s.spill_disabled and (due or s.spill_urgent):
                     plan.append((s, engine, slot))
+                    urgent += s.spill_urgent
         for s in self.scheduler.queue:
-            if not s.spill_disabled:
+            if not s.spill_disabled and (due or s.spill_urgent):
                 plan.append((s, None, None))
-        return plan
+                urgent += s.spill_urgent
+        # the walk recomputes the truth: spent/terminal urgencies drop out
+        self._spill_urgent_pending = urgent
+        return plan or None
 
     def _run_spill(self, plan: list) -> list:
         """Pump thread, engines settled: write each planned session's
@@ -679,6 +749,11 @@ class SimulationService:
                         temperature=s.temperature,
                         timeout_s=timeout_s,
                     )
+                    # the adopted trajectory is durable again: the
+                    # spill-on-adopt urgency is spent (a plain bool flip —
+                    # benign against the locked plan capture; the worst
+                    # race costs one redundant spill next round)
+                    s.spill_urgent = False
                 except OSError as e:
                     # the disk work of the degradation (drop the stale
                     # snapshots, publish the DISABLED marker) happens

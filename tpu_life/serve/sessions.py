@@ -78,6 +78,12 @@ class Session:
     # leaves the spill plan — durability is off for it alone; a worker
     # death after this answers 410 ``spill_disabled``.
     spill_disabled: bool = False
+    # spill-on-adopt (docs/FLEET.md): a resumed session (start_step > 0 —
+    # it is carrying another worker's rescued trajectory) spills on the
+    # FIRST spill-capable round rather than waiting out the cadence, so a
+    # back-to-back kill degrades to one extra rescue instead of a 410
+    # ``never_snapshotted``.  Cleared after its first successful spill.
+    spill_urgent: bool = False
 
     @property
     def steps_remaining(self) -> int:

@@ -65,6 +65,78 @@ MANIFEST = "manifest.json"
 DISABLED = "DISABLED.json"
 
 
+class SpillBackend:
+    """The pluggable durability seam (docs/FLEET.md "Cross-host
+    topology"): where a worker's spilled sessions live.
+
+    Two implementations ship: :class:`SpillStore` (a local directory —
+    the default, and the only choice when the rescuing migrator shares a
+    filesystem with the victim) and
+    :class:`tpu_life.serve.spill_http.HttpSpillBackend` (a remote HTTP
+    object store any worker or supervisor can host, so migration works
+    when the survivor is on another machine).  Both speak the same
+    contract the service's spill pass relies on:
+
+    - ``save`` publishes atomically with a CRC32 witness and returns
+      False for a no-op rewrite of the newest spilled step;
+    - any write failure raises :class:`OSError` — the service catches it
+      in the unlocked settle window and degrades THAT session to
+      spill-disabled (the pump never stalls, the worker never dies over
+      durability);
+    - ``mark_disabled`` / ``delete`` are best-effort terminal
+      transitions; ``spilled_count`` / ``spilled_sids`` feed the gauges.
+    """
+
+    def save(
+        self,
+        sid: str,
+        board: np.ndarray,
+        step: int,
+        *,
+        rule: str,
+        steps_total: int,
+        seed: int | None,
+        temperature: float | None,
+        timeout_s: float | None,
+    ) -> bool:
+        raise NotImplementedError
+
+    def mark_disabled(self, sid: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, sid: str) -> None:
+        raise NotImplementedError
+
+    def spilled_count(self) -> int:
+        raise NotImplementedError
+
+    def spilled_sids(self) -> list[str]:
+        raise NotImplementedError
+
+
+def make_spill_backend(
+    *,
+    spill_dir: str | None = None,
+    spill_url: str | None = None,
+    namespace: str | None = None,
+) -> "SpillBackend":
+    """The one place a serve config becomes a backend: a ``spill_url``
+    selects the remote HTTP store (``namespace`` names this worker
+    incarnation's slice of it), otherwise the local directory.  Both at
+    once is a typed config error — the session would be split across two
+    stores and neither would hold a resumable whole."""
+    if spill_url is not None and spill_dir is not None:
+        raise ValueError(
+            "spill_dir and spill_url are mutually exclusive — a session "
+            "spilled half-local, half-remote could never be resumed whole"
+        )
+    if spill_url is not None:
+        from tpu_life.serve.spill_http import HttpSpillBackend
+
+        return HttpSpillBackend(spill_url, namespace or "default")
+    return SpillStore(spill_dir)
+
+
 @dataclass(frozen=True)
 class SpillRecord:
     """One resumable session read back from a spill directory."""
@@ -85,7 +157,7 @@ class SpillRecord:
         return max(0, self.steps_total - self.step)
 
 
-class SpillStore:
+class SpillStore(SpillBackend):
     """Per-session spill directories under one root (one root per worker).
 
     Writes happen on the pump thread only; ``delete`` may be called from
