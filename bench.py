@@ -967,8 +967,18 @@ def run_mc_bench(args, platform: str, degraded: bool) -> dict:
     (tpu_life.mc, docs/STOCHASTIC.md).  Same delta-timing methodology as
     the kernel bench — two fused runs of different sweep counts,
     differenced to cancel dispatch + readback latency — and every record
-    carries the (run_id, seed, temperature) triple that fully replays the
-    measured trajectory."""
+    carries the (run_id, seed, temperature, packed) stamp that fully
+    replays and attributes the measured trajectory.
+
+    Besides the primary measurement on ``--backend`` (packed by default,
+    ``--no-bitpack`` pins the roll path), the record carries the
+    **packed-vs-roll legs** (ISSUE 12): ``spin_updates_per_sec`` for both
+    storage paths on the numpy CPU reference executor at three lattice
+    sizes, plus the crossover size where the bitplane path starts
+    winning.  The legs run on the reference executor on every platform —
+    it is the oracle both paths are byte-compared against, and its
+    numbers isolate the storage-layout effect from XLA's fusion.
+    """
     actual, pinned = _pin_and_verify(args, platform)
 
     from tpu_life import mc
@@ -982,7 +992,7 @@ def run_mc_bench(args, platform: str, degraded: bool) -> dict:
     temperature = args.mc_temperature if isinstance(rule, IsingRule) else None
     n = args.mc_size
     board = mc.seeded_board(n, n, seed=args.mc_seed)
-    backend = get_backend(args.backend)
+    backend = get_backend(args.backend, bitpack=not args.no_bitpack)
     runner = make_runner(
         backend,
         board,
@@ -993,6 +1003,58 @@ def run_mc_bench(args, platform: str, degraded: bool) -> dict:
     per_sweep = delta_seconds_per_step(
         runner, args.mc_steps, args.mc_base_steps, repeats=args.repeats
     )
+
+    # -- the packed-vs-roll legs on the CPU reference executor -------------
+    legs: list[dict] = []
+    crossover = None
+    speedups: dict[str, float] = {}
+    if mc.packed_supports(rule):
+        sizes = (
+            tuple(int(s) for s in args.mc_sizes.split(","))
+            if args.mc_sizes
+            else (256, 512, 1024)
+        )
+        ref = get_backend("numpy")
+        base_size = min(sizes)
+        for size in sizes:
+            leg_board = mc.seeded_board(size, size, seed=args.mc_seed)
+            # scale sweeps down with area so every leg costs roughly what
+            # the smallest one does; delta timing floors at 3-over-1
+            scale = (base_size / size) ** 2
+            steps = max(3, int(round(args.mc_steps * scale)))
+            base_steps = max(1, steps // 6)
+            by_path: dict[bool, float] = {}
+            for packed in (False, True):
+                leg_runner = make_runner(
+                    ref,
+                    leg_board,
+                    rule,
+                    seed=args.mc_seed,
+                    temperature=temperature,
+                    packed=packed,
+                )
+                per = delta_seconds_per_step(
+                    leg_runner, steps, base_steps, repeats=args.repeats
+                )
+                by_path[packed] = size * size / per
+                legs.append(
+                    {
+                        "size": size,
+                        "packed": packed,
+                        "lanes": getattr(leg_runner, "lanes", None),
+                        "backend": "numpy",
+                        "sweeps_per_sec": 1.0 / per,
+                        "spin_updates_per_sec": by_path[packed],
+                        "steps": steps,
+                        "base_steps": base_steps,
+                        "seed": args.mc_seed,
+                        "temperature": temperature,
+                    }
+                )
+            speedups[str(size)] = by_path[True] / by_path[False]
+            if crossover is None and by_path[True] >= by_path[False]:
+                crossover = size
+
     return {
         "metric": "mc_sweeps_per_sec",
         "value": 1.0 / per_sweep,
@@ -1004,6 +1066,10 @@ def run_mc_bench(args, platform: str, degraded: bool) -> dict:
         "rule": args.mc_rule,
         "temperature": temperature,
         "seed": args.mc_seed,
+        # the storage-path stamp: which executor produced the primary
+        # number (mc.packed engines carry packed=True, lanes=32)
+        "packed": bool(getattr(runner, "packed", False)),
+        "lanes": getattr(runner, "lanes", None),
         "platform": platform,
         "platform_actual": actual,
         "platform_pinned": pinned,
@@ -1012,6 +1078,11 @@ def run_mc_bench(args, platform: str, degraded: bool) -> dict:
         "steps": args.mc_steps,
         "base_steps": args.mc_base_steps,
         "repeats": args.repeats,
+        # the packed-vs-roll comparison (empty legs for non-packable
+        # stochastic rules, e.g. noisy:*)
+        "legs": legs,
+        "packed_speedup": speedups,
+        "crossover_size": crossover,
         "degraded": degraded,
     }
 
@@ -1256,6 +1327,9 @@ def main() -> None:
     p.add_argument("--mc-base-steps", type=int, default=None,
                    help="sweeps in the baseline run of the delta pair "
                    "(default 40, 8 degraded)")
+    p.add_argument("--mc-sizes", default=None, metavar="N1,N2,N3",
+                   help="lattice edges of the packed-vs-roll legs on the "
+                   "numpy reference executor (default 256,512,1024)")
     p.add_argument("--mc-temperature", type=float, default=2.27,
                    help="Metropolis temperature (default ~ the Onsager "
                    "critical point, the hardest-mixing regime)")
@@ -1332,6 +1406,7 @@ def main() -> None:
         "--mc-size": args.mc_size,
         "--mc-steps": args.mc_steps,
         "--mc-base-steps": args.mc_base_steps,
+        "--mc-sizes": args.mc_sizes,
     }
     if args.size is None:
         args.size = 16384 if on_accel else DEGRADED_SIZE

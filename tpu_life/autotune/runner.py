@@ -68,6 +68,27 @@ def make_trial_board(key: TuneKey, shape: tuple[int, int]) -> np.ndarray:
     return board
 
 
+def _trial_runner_kwargs(rule: Rule) -> dict:
+    """Per-rule ``make_runner`` extras for a trial.
+
+    Stochastic rules consume the counter-based PRNG state: a fixed seed
+    keeps every candidate (and every re-tune) on the same workload, and
+    ising needs a temperature — measured at the critical point, the
+    hardest-mixing (most acceptance-table-consulting) regime, so the
+    tuned pick is honest for the worst case.
+    """
+    if not getattr(rule, "stochastic", False):
+        return {}
+    kw: dict = {"seed": 0}
+    from tpu_life.models.rules import IsingRule
+
+    if isinstance(rule, IsingRule):
+        from tpu_life.mc.ising import T_CRITICAL
+
+        kw["temperature"] = T_CRITICAL
+    return kw
+
+
 def _measure(
     cfg: TunedConfig,
     board: np.ndarray,
@@ -81,7 +102,7 @@ def _measure(
     from tpu_life.backends.base import get_backend, make_runner
 
     backend = get_backend(cfg.backend, rule=rule, **cfg.backend_kwargs())
-    runner = make_runner(backend, board, rule)
+    runner = make_runner(backend, board, rule, **_trial_runner_kwargs(rule))
     runner.advance(warmup_steps)  # absorbs compilation + staging
     runner.sync()
     samples: list[float] = []

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 from dataclasses import asdict, dataclass
 
-from tpu_life.models.rules import Rule
+from tpu_life.models.rules import IsingRule, Rule
 
 # block_steps grid: brackets the measured optimum (k=8, blocksweep r4) and
 # includes the degradation region (k>=32) so a measured sweep re-verifies
@@ -54,6 +54,12 @@ class TuneKey:
     boundary: str  # "clamped" | "torus"
     shape_bucket: tuple[int, int]  # padded (h, w) bucket, power-of-two ceil
     bitpack_ok: bool  # bit-sliced path eligible for this rule family
+    # stochastic (Monte-Carlo) rules tune a different candidate space:
+    # only the key-schedule executors are legal, and "bitpack" means the
+    # packed Metropolis engine (tpu_life.mc.packed), not the life-like
+    # adder tree.  Kept out of id() for deterministic keys so every
+    # pre-existing cache entry stays addressable.
+    stochastic: bool = False
 
     def id(self) -> str:
         """Stable string form — the JSON cache's entry key."""
@@ -63,6 +69,7 @@ class TuneKey:
             f"|{self.rule_name}|r{self.radius}s{self.states}"
             f"|{self.neighborhood}|{self.boundary}"
             f"|{h}x{w}|bp{int(self.bitpack_ok)}"
+            + ("|mc" if self.stochastic else "")
         )
 
     def to_dict(self) -> dict:
@@ -146,8 +153,13 @@ def shape_bucket(height: int, width: int) -> tuple[int, int]:
 
 def _bitpack_eligible(rule: Rule) -> bool:
     """Bit-sliced path eligibility from rule structure alone (mirrors
-    ``bitlife.supports_family`` + the diamond/torus variants) — kept
-    import-light so key construction never needs jax."""
+    ``bitlife.supports_family`` + the diamond/torus variants, and the
+    stochastic tier's ``mc.packed_supports``) — kept import-light so key
+    construction never needs jax."""
+    if getattr(rule, "stochastic", False):
+        # the packed Metropolis engine (tpu_life.mc.packed): ising only —
+        # noisy rules keep the int8 roll composition
+        return isinstance(rule, IsingRule)
     if rule.states != 2 or rule.include_center:
         return False
     if rule.neighborhood == "moore":
@@ -186,6 +198,7 @@ def tune_key_for(
         boundary=rule.boundary,
         shape_bucket=shape_bucket(h, w),
         bitpack_ok=_bitpack_eligible(rule),
+        stochastic=bool(getattr(rule, "stochastic", False)),
     )
 
 
@@ -223,6 +236,17 @@ def enumerate_candidates(
     """
     backends = tuple(backend_set or default_backend_set(key.device_kind))
     on_tpu = key.device_kind == "tpu"
+    if key.stochastic:
+        # stochastic keys: only the key-schedule executors are legal
+        # (mc.SUPPORTED_BACKENDS), and the knob that matters is the packed
+        # Metropolis engine vs the int8 roll path — both offered when the
+        # rule is packed-eligible so a measured sweep verifies the packed
+        # win instead of assuming it.  Sharded/pallas would be a typed
+        # rejection downstream; never propose them.
+        out = [TunedConfig("jax", None, "auto", key.bitpack_ok, 0)]
+        if key.bitpack_ok:
+            out.append(TunedConfig("jax", None, "auto", False, 0))
+        return out
     out: list[TunedConfig] = []
     for backend in backends:
         if backend == "jax":

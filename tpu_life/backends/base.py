@@ -100,6 +100,7 @@ def make_runner(
     seed: int = 0,
     temperature: float | None = None,
     start_step: int = 0,
+    packed: bool | None = None,
 ) -> Runner:
     """Stage ``board`` on the backend's devices and return a Runner.
 
@@ -109,7 +110,10 @@ def make_runner(
     counter-based PRNG state: ``seed`` names the stream, ``start_step``
     is the absolute resume point (so checkpoint/resume re-enters the
     stream exactly), ``temperature`` is the ising scalar.  Backends
-    without the key schedule are a typed rejection.
+    without the key schedule are a typed rejection.  ``packed`` pins the
+    stochastic bitplane path on or off (None = the backend's own
+    ``bitpack`` default); deterministic rules ignore it (their packing
+    is a backend-construction knob).
     """
     if getattr(rule, "stochastic", False):
         from tpu_life.mc.engine import mc_runner_for
@@ -121,6 +125,7 @@ def make_runner(
             seed=seed,
             temperature=temperature,
             start_step=start_step,
+            packed=packed,
         )
     prep = getattr(backend, "prepare", None)
     if prep is not None:

@@ -149,6 +149,11 @@ def build_parser() -> argparse.ArgumentParser:
                      "instead of the default pipelined (double-buffered) "
                      "pump — the bit-identical oracle shape, for "
                      "debugging and baseline timing (docs/SERVING.md)")
+    srv.add_argument("--no-bitpack", action="store_true",
+                     help="pin stochastic (ising) batches to the int8 "
+                     "roll engines instead of the default bitplane-packed "
+                     "path — bit-identical, the packed path's oracle "
+                     "(docs/STOCHASTIC.md)")
     srv.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                      help="default per-request deadline")
     srv.add_argument("--spill-dir", default=None, metavar="DIR",
@@ -216,6 +221,10 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--sync-pump", action="store_true",
                     help="host-synchronous rounds instead of the pipelined "
                     "pump (same semantics as `serve --sync-pump`)")
+    sw.add_argument("--no-bitpack", action="store_true",
+                    help="sweep on the int8 roll engines instead of the "
+                    "default bitplane-packed Metropolis path — "
+                    "bit-identical, the packed path's oracle")
     sw.add_argument("--output-dir", default=None, metavar="DIR",
                     help="also write each final lattice to "
                     "DIR/<session-id>.txt (contract board format)")
@@ -250,6 +259,9 @@ def build_parser() -> argparse.ArgumentParser:
     gw.add_argument("--sync-pump", action="store_true",
                     help="host-synchronous rounds instead of the pipelined "
                     "pump (same semantics as `serve --sync-pump`)")
+    gw.add_argument("--no-bitpack", action="store_true",
+                    help="pin stochastic (ising) batches to the int8 roll "
+                    "engines (same semantics as `serve --no-bitpack`)")
     gw.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline")
     gw.add_argument("--spill-dir", default=None, metavar="DIR",
@@ -687,7 +699,9 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
     r.add_argument(
         "--no-bitpack",
         action="store_true",
-        help="disable the bit-sliced fast path for life-like rules",
+        help="disable the bit-sliced fast paths: the life-like bitplane "
+        "adder tree AND the packed Metropolis engine for --rule ising "
+        "(both bit-identical to their int8 twins)",
     )
     r.add_argument("--snapshot-every", type=int, default=0)
     r.add_argument("--snapshot-dir", default="snapshots")
@@ -1233,6 +1247,7 @@ def _serve(args) -> int:
             prom_file=args.prom_file,
             spill_dir=args.spill_dir,
             spill_every=args.spill_every,
+            mc_packed=not args.no_bitpack,
         )
     )
     # admit respecting backpressure: when the bounded queue fills, pump
@@ -1373,6 +1388,20 @@ def _sweep(parser, args) -> int:
         parser.error("sweep needs --size (or --height/--width)")
     temps = _parse_temps(parser, args.temps)
     rule = get_rule(args.rule)
+    try:
+        # lattice contract checked BEFORE the board is staged: odd ising
+        # dimensions and the PRNG counter-width area cap reject typed
+        # here instead of after the staging work (the service re-checks
+        # at submit with the same capability)
+        mc.validate_board_shape(
+            rule,
+            (height, width),
+            wide_counter=mc.wide_counter_capable(
+                rule, args.serve_backend, bitpack=not args.no_bitpack
+            ),
+        )
+    except ValueError as e:
+        parser.error(str(e))
     board = mc.seeded_board(
         height, width, args.density, states=rule.states, seed=args.seed
     )
@@ -1386,6 +1415,7 @@ def _sweep(parser, args) -> int:
             pipeline=not args.sync_pump,
             metrics=bool(args.metrics_file),
             metrics_file=args.metrics_file,
+            mc_packed=not args.no_bitpack,
         )
     )
     try:
@@ -1492,6 +1522,7 @@ def _gateway(args) -> int:
                 spill_every=args.spill_every,
                 spill_url=args.spill_url,
                 spill_namespace=args.spill_namespace,
+                mc_packed=not args.no_bitpack,
             )
         )
     except ValueError as e:

@@ -53,6 +53,7 @@ import numpy as np
 from tpu_life.gateway.errors import ApiError, bad_request
 from tpu_life.io.codec import decode_board, encode_board
 from tpu_life.io.rle import emit_rle
+from tpu_life.mc import validate_board_shape as mc_validate_board_shape
 from tpu_life.mc import validate_params as mc_validate_params
 from tpu_life.mc.prng import seeded_board
 from tpu_life.models.rules import get_rule
@@ -284,6 +285,15 @@ def parse_submit(payload) -> SubmitSpec:
             "board_too_large",
             f"seeded board has {height * width} cells; the limit is {MAX_CELLS}",
         )
+    try:
+        # the stochastic lattice contract (tpu_life.mc) checked BEFORE the
+        # board is staged: odd ising dimensions (and, were MAX_CELLS ever
+        # raised past it, the PRNG counter width) reject as a typed 400
+        # instead of burning the staging work first.  The service's submit
+        # re-validates with its executor's actual wide-counter capability.
+        mc_validate_board_shape(rule, (height, width))
+    except ValueError as e:
+        raise bad_request("invalid_board", str(e)) from None
     density = payload.get("density", 0.5)
     if isinstance(density, bool) or not isinstance(density, (int, float)):
         raise bad_request("invalid_request", "'density' must be a number")
@@ -328,6 +338,13 @@ def render_view(view: SessionView) -> dict:
         out["seed"] = view.seed
     if view.temperature is not None:
         out["temperature"] = view.temperature
+    # execution-path attribution (docs/OBSERVABILITY.md): stamped once a
+    # stochastic session is admitted to an engine — True with a "lanes"
+    # width on the bitplane-packed path, False on the int8 roll path
+    if view.packed is not None:
+        out["packed"] = view.packed
+        if view.lanes is not None:
+            out["lanes"] = view.lanes
     return out
 
 

@@ -15,6 +15,10 @@ counter-based PRNG.  This package is that tier:
   per-session scalar folded into a 5-entry uint32 acceptance table.
 - :mod:`tpu_life.mc.noisy` — noisy-Life: any registered 2-state rule
   composed with a per-cell flip probability.
+- :mod:`tpu_life.mc.packed` — the bitplane-packed Metropolis fast path:
+  32 spins per uint32 lane, checkerboard folded into the packing,
+  acceptance evaluated per-lane — bit-identical to the roll path, and
+  the carrier of the wide (two-word) PRNG cell index for mega-boards.
 - :mod:`tpu_life.mc.engine` — the serve executors (vmapped device batch
   + numpy ground truth, mixed temperatures in ONE CompileKey) and the
   single-run Runners behind ``run --rule ising``.
@@ -83,7 +87,36 @@ def ensure_backend_supported(rule: Rule, backend_name: str) -> None:
         require_key_schedule(rule, backend_name)
 
 
-def validate_board_shape(rule: Rule, shape: tuple[int, int]) -> None:
+def packed_supports(rule: Rule) -> bool:
+    """True when the bitplane-packed Metropolis path (``tpu_life.mc.packed``)
+    covers ``rule`` — structural check only, import-light on purpose so
+    admission fronts can consult it without touching the packed module."""
+    return isinstance(rule, IsingRule)
+
+
+def wide_counter_capable(
+    rule: Rule, backend_name: str, *, bitpack: bool = True
+) -> bool:
+    """Whether this (rule, backend, bitpack) admission will run on an
+    executor implementing the two-word (wide) PRNG cell index.
+
+    Only the packed path carries the wide schedule; the int8 roll path
+    is pinned to the narrow one-word index, so over-2^32-cell boards on
+    it are a typed rejection (``validate_board_shape``), never a silent
+    counter wraparound.  ``auto`` resolves stochastic rules to jax, which
+    defaults to the packed path; explicit numpy stays the roll ground
+    truth (packed numpy runners are constructed explicitly).
+    """
+    return (
+        bitpack
+        and packed_supports(rule)
+        and backend_name in ("auto", "jax")
+    )
+
+
+def validate_board_shape(
+    rule: Rule, shape: tuple[int, int], *, wide_counter: bool = False
+) -> None:
     """Typed rejection for lattices the rule cannot run correctly.
 
     The ising checkerboard 2-coloring is only a valid independent-set
@@ -92,15 +125,32 @@ def validate_board_shape(rule: Rule, shape: tuple[int, int]) -> None:
     half-updates would step coupled spins simultaneously — no longer
     Metropolis.  Rejected loudly at every front rather than sampling
     the wrong distribution.
+
+    Board AREA is validated against the PRNG counter width for every
+    stochastic rule: past ``prng.MAX_NARROW_CELLS`` the one-word cell
+    index would wrap mod 2^32 and silently reuse draws — a typed
+    rejection on the narrow (roll) path, legal on executors carrying the
+    two-word wide index (``wide_counter=True``: the packed path).
     """
-    if isinstance(rule, IsingRule):
-        h, w = int(shape[0]), int(shape[1])
-        if h % 2 or w % 2:
-            raise ValueError(
-                f"rule {rule.name!r} needs even lattice dimensions (the "
-                f"torus checkerboard 2-coloring breaks across the wrap "
-                f"seam on odd sizes), got {h}x{w}"
-            )
+    if not getattr(rule, "stochastic", False):
+        return
+    h, w = int(shape[0]), int(shape[1])
+    if isinstance(rule, IsingRule) and (h % 2 or w % 2):
+        raise ValueError(
+            f"rule {rule.name!r} needs even lattice dimensions (the "
+            f"torus checkerboard 2-coloring breaks across the wrap "
+            f"seam on odd sizes), got {h}x{w}"
+        )
+    if h * w > prng.MAX_NARROW_CELLS and not wide_counter:
+        raise ValueError(
+            f"board has {h * w} cells, past the one-word PRNG cell index "
+            f"({prng.MAX_NARROW_CELLS} cells): the narrow counter would "
+            f"wrap and reuse draws.  Only the packed executors (wide "
+            f"two-word cell index) carry the schedule for boards this "
+            f"size, and staging one additionally needs shard-wise I/O "
+            f"(cell_uniforms(origin=...) blocks; see docs/STOCHASTIC.md "
+            f"limits) — or shrink the lattice"
+        )
 
 
 def make_step_fn(xp, rule: Rule):
@@ -175,8 +225,10 @@ __all__ = [
     "IsingRule",
     "NoisyRule",
     "ensure_backend_supported",
+    "packed_supports",
     "require_key_schedule",
     "validate_board_shape",
+    "wide_counter_capable",
     "ising",
     "key_halves",
     "make_step_fn",

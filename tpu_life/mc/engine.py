@@ -36,10 +36,12 @@ import numpy as np
 from tpu_life.mc import (
     ising,
     make_step_fn,
+    packed_supports,
     require_key_schedule,
     validate_board_shape,
     validate_params,
 )
+from tpu_life.mc import packed as packed_mod
 from tpu_life.mc.prng import key_halves
 from tpu_life.models.rules import IsingRule, Rule
 from tpu_life.serve.engine import CompileKey, EngineBase
@@ -56,6 +58,9 @@ def _thresholds_for(rule: Rule, temperature: float | None) -> np.ndarray:
 # -- single-run runners (the driver path) ----------------------------------
 class MCHostRunner:
     """NumPy ground-truth Runner for stochastic rules."""
+
+    packed = False
+    lanes = None
 
     def __init__(
         self,
@@ -97,6 +102,9 @@ class MCHostRunner:
 class MCDeviceRunner:
     """Single-device XLA Runner: fused scan with the step counter in the
     carry, donated buffers, no host round-trip per advance."""
+
+    packed = False
+    lanes = None
 
     def __init__(
         self,
@@ -162,6 +170,133 @@ class MCDeviceRunner:
         return int(np.count_nonzero(self.fetch() == 1))
 
 
+class MCPackedHostRunner:
+    """NumPy Runner on the bitplane-packed spin layout (32 spins/lane) —
+    bit-identical to :class:`MCHostRunner`, multiple-x fewer bytes moved
+    per sweep (tpu_life.mc.packed).  Carries the wide (two-word) PRNG
+    cell index, so it is the legal executor for over-2^32-cell lattices."""
+
+    packed = True
+    lanes = packed_mod.LANES
+
+    def __init__(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        *,
+        seed: int = 0,
+        temperature: float | None = None,
+        start_step: int = 0,
+    ):
+        validate_params(rule, temperature)
+        board = np.asarray(board, np.int8)
+        validate_board_shape(rule, board.shape, wide_counter=True)
+        self._shape = board.shape
+        self.x = packed_mod.pack_board(board)
+        self.step = int(start_step)
+        self._k0, self._k1 = key_halves(seed)
+        self._thr = _thresholds_for(rule, temperature)
+        self._fn = packed_mod.make_sweep(np, rule, board.shape)
+
+    def advance(self, steps: int) -> None:
+        for _ in range(steps):
+            self.x = self._fn(
+                self.x,
+                np.uint32(self._k0),
+                np.uint32(self._k1),
+                np.uint32(self.step),
+                self._thr,
+            )
+            self.step += 1
+
+    def sync(self) -> None:
+        pass
+
+    def fetch(self) -> np.ndarray:
+        return packed_mod.unpack_board(self.x, self._shape[1])
+
+    def snapshot(self):
+        return lambda x=self.x, w=self._shape[1]: packed_mod.unpack_board(x, w)
+
+    def live_count(self) -> int:
+        return packed_mod.live_count(self.x)
+
+
+class MCPackedDeviceRunner:
+    """Single-device XLA Runner on the packed layout: the fused-scan shape
+    of :class:`MCDeviceRunner` with the board as uint32 bitplanes."""
+
+    packed = True
+    lanes = packed_mod.LANES
+
+    def __init__(
+        self,
+        board: np.ndarray,
+        rule: Rule,
+        *,
+        seed: int = 0,
+        temperature: float | None = None,
+        start_step: int = 0,
+        device=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        validate_params(rule, temperature)
+        board = np.asarray(board, np.int8)
+        validate_board_shape(rule, board.shape, wide_counter=True)
+        self._shape = board.shape
+        k0, k1 = key_halves(seed)
+        self._k0 = jnp.uint32(k0)
+        self._k1 = jnp.uint32(k1)
+        self._thr = jax.device_put(
+            jnp.asarray(_thresholds_for(rule, temperature)), device
+        )
+        self.x = jax.device_put(
+            jnp.asarray(packed_mod.pack_board(board)), device
+        )
+        self._step = jnp.uint32(int(start_step))
+        sweep_fn = packed_mod.make_sweep(jnp, rule, board.shape)
+
+        def advance(x, st, k0, k1, thr, *, steps):
+            def body(carry, _):
+                b, s = carry
+                b = sweep_fn(b, k0, k1, s, thr)
+                return (b, s + jnp.uint32(1)), None
+
+            (x, st), _ = jax.lax.scan(body, (x, st), None, length=steps)
+            return x, st
+
+        self._advance = jax.jit(
+            advance, static_argnames=("steps",), donate_argnums=(0, 1)
+        )
+
+    def advance(self, steps: int) -> None:
+        if steps > 0:
+            self.x, self._step = self._advance(
+                self.x, self._step, self._k0, self._k1, self._thr, steps=steps
+            )
+
+    def sync(self) -> None:
+        import jax
+
+        jax.block_until_ready(self.x)
+        np.asarray(self.x[:1, :1])
+
+    def fetch(self) -> np.ndarray:
+        return packed_mod.unpack_board(np.asarray(self.x), self._shape[1])
+
+    def snapshot(self):
+        # valid until the next advance donates the buffer — materialize
+        # within the chunk callback, matching MCDeviceRunner's contract
+        return lambda x=self.x, w=self._shape[1]: packed_mod.unpack_board(
+            np.asarray(x), w
+        )
+
+    def live_count(self) -> int:
+        return packed_mod.live_count(np.asarray(self.x))
+
+
 def mc_runner_for(
     backend,
     board: np.ndarray,
@@ -170,27 +305,45 @@ def mc_runner_for(
     seed: int = 0,
     temperature: float | None = None,
     start_step: int = 0,
+    packed: bool | None = None,
 ):
     """Runner factory for stochastic rules, dispatched on the backend.
 
     Only the ``mc.SUPPORTED_BACKENDS`` executors implement the
     counter-based key schedule; anything else is a typed rejection
     (never a silent deterministic fallback).
+
+    ``packed`` selects the bitplane-packed Metropolis path (32 spins per
+    uint32 lane, bit-identical to the roll path).  ``None`` = auto: the
+    jax backend honors its ``bitpack`` knob (``--no-bitpack`` opts out);
+    numpy stays the int8 roll ground truth unless packed explicitly —
+    so the oracle the CI byte-compares against never silently moves.
     """
     name = getattr(backend, "name", "") or type(backend).__name__
     require_key_schedule(rule, name)
-    if name == "jax":
-        return MCDeviceRunner(
-            board,
-            rule,
-            seed=seed,
-            temperature=temperature,
-            start_step=start_step,
-            device=getattr(backend, "device", None),
+    if packed is None:
+        packed = (
+            name == "jax"
+            and getattr(backend, "bitpack", True)
+            and packed_supports(rule)
         )
-    return MCHostRunner(
-        board, rule, seed=seed, temperature=temperature, start_step=start_step
+    elif packed and not packed_supports(rule):
+        # an explicit packed=True must not silently measure the roll path
+        raise ValueError(
+            f"the packed Metropolis path supports the ising rule family "
+            f"only, got {rule.name!r}"
+        )
+    kwargs = dict(
+        seed=seed, temperature=temperature, start_step=start_step
     )
+    if name == "jax":
+        device = getattr(backend, "device", None)
+        if packed:
+            return MCPackedDeviceRunner(board, rule, device=device, **kwargs)
+        return MCDeviceRunner(board, rule, device=device, **kwargs)
+    if packed:
+        return MCPackedHostRunner(board, rule, **kwargs)
+    return MCHostRunner(board, rule, **kwargs)
 
 
 # -- batched serve engines -------------------------------------------------
@@ -202,6 +355,7 @@ class MCVmapEngine(EngineBase):
     compiled program — the MPMD parameter-sweep shape of the ISSUE."""
 
     ASYNC_ROLL = True
+    packed = False
 
     def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
         super().__init__(key, capacity, chunk_steps)
@@ -211,7 +365,8 @@ class MCVmapEngine(EngineBase):
         h, w = key.shape
         self._jnp = jnp
         self._prev = None  # the in-flight chunk's input batch (double buffer)
-        self._boards = jax.device_put(jnp.zeros((capacity, h, w), jnp.int8))
+        shape, dtype = self._board_batch_spec(capacity, h, w, jnp)
+        self._boards = jax.device_put(jnp.zeros(shape, dtype))
         self._rem_dev = jax.device_put(jnp.zeros(capacity, jnp.int32))
         self._k0 = jax.device_put(jnp.zeros(capacity, jnp.uint32))
         self._k1 = jax.device_put(jnp.zeros(capacity, jnp.uint32))
@@ -231,6 +386,12 @@ class MCVmapEngine(EngineBase):
 
         self._set_slot = jax.jit(set_slot, donate_argnums=(0, 1, 2, 3, 4, 5))
         self._chunk = None  # built lazily on first advance
+
+    def _board_batch_spec(self, capacity: int, h: int, w: int, jnp):
+        """(shape, dtype) of the device board batch — the packed subclass
+        substitutes its bitplane layout HERE so the int8 batch is never
+        allocated (it would be a transient 8x the packed footprint)."""
+        return (capacity, h, w), jnp.int8
 
     def load(self, slot, board, steps, *, seed=None, temperature=None, start_step=0):
         validate_params(self.key.rule, temperature)
@@ -350,6 +511,8 @@ class MCHostEngine(EngineBase):
     engine's equivalence tests pin against (same role as
     ``HostBatchEngine`` for deterministic rules)."""
 
+    packed = False
+
     def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
         super().__init__(key, capacity, chunk_steps)
         h, w = key.shape
@@ -396,12 +559,116 @@ class MCHostEngine(EngineBase):
         return self._boards[slot].copy()
 
 
-def make_mc_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
+class MCPackedVmapEngine(MCVmapEngine):
+    """The packed stochastic device path: :class:`MCVmapEngine`'s batch
+    (per-slot keys / step counters / acceptance tables, double-buffered
+    async chunks) with the boards stored as uint32 bitplanes — a whole
+    temperature sweep's sessions run packed under ONE CompileKey, 32
+    spins per lane.  Boards pack on load and unpack on peek/fetch, so
+    every caller above the engine still speaks int8."""
+
+    packed = True
+    lanes = packed_mod.LANES
+
+    def _board_batch_spec(self, capacity: int, h: int, w: int, jnp):
+        return (capacity, h, packed_mod.packed_width(w)), jnp.uint32
+
+    def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
+        jnp = self._jnp
+        seed, temperature, start_step = self._staged
+        k0, k1 = key_halves(seed)
+        thr = _thresholds_for(self.key.rule, temperature)
+        (
+            self._boards,
+            self._rem_dev,
+            self._k0,
+            self._k1,
+            self._steps_abs,
+            self._thr,
+        ) = self._set_slot(
+            self._boards,
+            self._rem_dev,
+            self._k0,
+            self._k1,
+            self._steps_abs,
+            self._thr,
+            jnp.int32(slot),
+            jnp.asarray(packed_mod.pack_board(np.asarray(board, np.int8))),
+            jnp.int32(steps),
+            jnp.uint32(k0),
+            jnp.uint32(k1),
+            jnp.uint32(start_step),
+            jnp.asarray(thr),
+        )
+
+    def _build_chunk(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_life import obs
+
+        obs.instant(
+            "serve.compile",
+            rule=self.key.rule.name,
+            shape=f"{self.key.shape[0]}x{self.key.shape[1]}",
+            backend=self.key.backend,
+            packed=True,
+        )
+        vstep = jax.vmap(packed_mod.make_sweep(jnp, self.key.rule, self.key.shape))
+        length = self.chunk_steps
+
+        def chunk(boards, rem, st, k0, k1, thr):
+            def body(carry, _):
+                bs, r, s = carry
+                stepped = vstep(bs, k0, k1, s, thr)
+                live = r > 0
+                bs = jnp.where(live[:, None, None], stepped, bs)
+                # frozen slot => frozen counter (see MCVmapEngine._build_chunk)
+                s = s + live.astype(jnp.uint32)
+                return (bs, jnp.maximum(r - 1, 0), s), None
+
+            (boards, rem, st), _ = jax.lax.scan(
+                body, (boards, rem, st), None, length=length
+            )
+            return boards, rem, st
+
+        self.compile_count += 1
+        # same donation rule as the parent: the board batch is the double
+        # buffer late retirement reads — donate only the scalar carries
+        return jax.jit(chunk, donate_argnums=(1, 2))
+
+    def _peek_board(self, slot: int) -> np.ndarray:
+        src = (
+            self._prev
+            if (self._inflight and self._prev is not None)
+            else self._boards
+        )
+        return packed_mod.unpack_board(
+            np.asarray(src[slot]), self.key.shape[1]
+        )
+
+
+def make_mc_engine(
+    key: CompileKey, capacity: int, chunk_steps: int, *, packed: bool | None = None
+) -> EngineBase:
     """Engine factory for stochastic CompileKeys (typed rejection for
     executors without the key schedule — slot-loop backends would run a
-    different, irreproducible trajectory)."""
+    different, irreproducible trajectory).
+
+    ``packed=None`` (auto) runs ising batches on the bitplane-packed
+    device engine — bit-identical to the roll engines, multiple-x fewer
+    bytes per sweep; ``packed=False`` (``--no-bitpack``) pins the roll
+    engines.  The numpy executor stays the roll ground truth either way,
+    so the serve equivalence oracle never silently moves with the fast
+    path it is checking.
+    """
     require_key_schedule(key.rule, key.backend)
-    validate_board_shape(key.rule, key.shape)
+    use_packed = (packed is None or packed) and packed_supports(key.rule)
+    validate_board_shape(
+        key.rule, key.shape, wide_counter=use_packed and key.backend == "jax"
+    )
     if key.backend == "jax":
+        if use_packed:
+            return MCPackedVmapEngine(key, capacity, chunk_steps)
         return MCVmapEngine(key, capacity, chunk_steps)
     return MCHostEngine(key, capacity, chunk_steps)

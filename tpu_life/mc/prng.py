@@ -44,6 +44,18 @@ SUB_NOISE = 2  # noisy-Life flip mask
 SUB_BOARD = 3  # seeded initial-board staging
 NSUB = 4
 
+#: Cells addressable by the narrow (one-word) schedule: flat indices
+#: 0 .. 2^32 - 1 fit a single uint32 counter word.  Bigger boards MUST go
+#: through the wide (two-word) cell index below — on the narrow schedule
+#: their indices would wrap mod 2^32 and silently reuse draws.
+MAX_NARROW_CELLS = 1 << 32
+
+#: The c1 word of the wide-index key-derivation hash.  Simulation draws
+#: use c1 = step * NSUB + substream, which only reaches this value at
+#: step ~(2^32 - 1) / NSUB ≈ 1.07e9 — far past any realistic trajectory,
+#: so the derivation counter space never collides with a draw's.
+WIDE_KEY_TAG = 0xFFFFFFFF
+
 _ROT_A = (13, 15, 26, 6)
 _ROT_B = (17, 29, 16, 24)
 
@@ -91,19 +103,94 @@ def key_halves(seed: int) -> tuple[int, int]:
     return seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF
 
 
-def cell_uniforms(xp, shape: tuple[int, int], k0, k1, step, substream: int):
+def split_cell_index(idx) -> tuple[np.ndarray, np.ndarray]:
+    """64-bit flat cell indices -> ``(lo, hi)`` uint32 word arrays.
+
+    Host-side (numpy) split of the two-word cell coordinate; ``hi`` is
+    zero everywhere for indices below 2^32, which is exactly the
+    condition under which the wide schedule reproduces the narrow one.
+    """
+    idx = np.asarray(idx, np.int64)
+    if idx.size and int(idx.min()) < 0:
+        raise ValueError("cell indices must be >= 0")
+    return (idx & 0xFFFFFFFF).astype(np.uint32), (idx >> 32).astype(np.uint32)
+
+
+def derive_wide_keys(xp, k0, k1, hi):
+    """Per-cell ``(k0', k1')`` for the two-word cell index.
+
+    Block 0 (``hi == 0``) keeps the run key VERBATIM — so every board
+    whose indices fit one word draws the byte-identical narrow stream,
+    which is the wide-index KAT contract (tests/test_mc_packed.py).
+    Blocks ``hi > 0`` re-key through one extra Threefry evaluation on
+    counter ``(hi, WIDE_KEY_TAG)``: each 2^32-cell block owns a derived
+    subkey, so the (lo, step) counter space never collides across blocks.
+    Same integer ops under numpy and XLA, like every draw here.
+    """
+    d0, d1 = threefry2x32(xp, k0, k1, hi, xp.uint32(WIDE_KEY_TAG))
+    narrow = xp.asarray(hi, dtype=xp.uint32) == xp.uint32(0)
+    return (
+        xp.where(narrow, xp.uint32(k0), d0),
+        xp.where(narrow, xp.uint32(k1), d1),
+    )
+
+
+def cell_uniforms_at(xp, lo, hi, k0, k1, step, substream: int):
+    """uint32 draws at explicit two-word cell coordinates ``(hi, lo)``.
+
+    ``hi = None`` selects the narrow schedule outright (a *static*,
+    host-side decision — callers know their board's index range at build
+    time), skipping the key-derivation hash entirely; an all-zero ``hi``
+    array produces the identical stream through the wide machinery.
+    """
+    c1 = xp.uint32(step) * xp.uint32(NSUB) + xp.uint32(substream)
+    if hi is None:
+        u, _ = threefry2x32(xp, k0, k1, lo, c1)
+        return u
+    wk0, wk1 = derive_wide_keys(xp, k0, k1, hi)
+    u, _ = threefry2x32(xp, wk0, wk1, lo, c1)
+    return u
+
+
+def cell_uniforms(
+    xp, shape: tuple[int, int], k0, k1, step, substream: int, *, origin: int = 0
+):
     """uint32[h, w] of i.i.d. draws for every cell at ``step``/``substream``.
 
     ``k0``/``k1``/``step`` may be traced scalars (per-slot under vmap);
-    ``shape`` and ``substream`` are static.  Cell index wraps mod 2^32 —
-    boards at or beyond 65536^2 cells would reuse counters and must move
-    to a 2-word cell index first.
+    ``shape``, ``substream`` and ``origin`` are static.  ``origin`` is the
+    absolute flat index of element (0, 0) — a shard of a mega-board (or a
+    test) addresses the wide two-word index space with it.  Indices that
+    fit one word (``origin + h*w <= 2^32``) take the narrow schedule
+    verbatim, so every pre-wide trajectory reproduces byte-for-byte; past
+    that the two-word split kicks in (``derive_wide_keys``).  The 64-bit
+    coordinate arithmetic is done in uint32 pairs — identical numpy/jax
+    (JAX runs with x64 disabled).
     """
     h, w = shape
-    c0 = xp.arange(h * w, dtype=xp.uint32).reshape(h, w)
+    n = h * w
+    origin = int(origin)
+    if origin < 0:
+        raise ValueError(f"origin must be >= 0, got {origin}")
+    if n > MAX_NARROW_CELLS:
+        raise ValueError(
+            f"cannot materialize draws for {n} cells in one array; "
+            f"address a mega-board shard-wise via origin"
+        )
     c1 = xp.uint32(step) * xp.uint32(NSUB) + xp.uint32(substream)
-    u, _ = threefry2x32(xp, k0, k1, c0, c1)
-    return u
+    if origin == 0:  # n <= MAX_NARROW_CELLS is guaranteed above
+        c0 = xp.arange(n, dtype=xp.uint32).reshape(h, w)
+        u, _ = threefry2x32(xp, k0, k1, c0, c1)
+        return u
+    base_lo = xp.uint32(origin & 0xFFFFFFFF)
+    base_hi = xp.uint32((origin >> 32) & 0xFFFFFFFF)
+    off = xp.arange(n, dtype=xp.uint32).reshape(h, w)
+    lo = base_lo + off  # wraps mod 2^32
+    # off < 2^32, so at most one carry: it happened iff the sum wrapped
+    hi = base_hi + (lo < base_lo).astype(xp.uint32)
+    if origin + n <= MAX_NARROW_CELLS:
+        hi = None  # still inside block 0: narrow schedule, statically
+    return cell_uniforms_at(xp, lo, hi, k0, k1, step, substream)
 
 
 def threshold_u32(p: float) -> int:

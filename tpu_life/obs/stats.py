@@ -147,6 +147,14 @@ def _serve_summary(rounds: list[dict]) -> dict:
         out["spilled_sessions_max"] = max(
             r.get("spilled_sessions", 0) for r in rounds
         )
+    # storage-path attribution (ISSUE 12): the slice of stepped work run
+    # by bitplane-packed stochastic engines — only when the sink carries
+    # the stamp, so pre-packed sinks summarize byte-stable
+    if any("steps_advanced_packed" in r for r in rounds):
+        packed = sum(r.get("steps_advanced_packed", 0) for r in rounds)
+        out["steps_advanced_packed"] = packed
+        total = out["steps_advanced"]
+        out["packed_steps_fraction"] = packed / total if total else 0.0
     return out
 
 
@@ -206,6 +214,18 @@ def _merge_serve(per_run: dict) -> dict:
         merged["snapshot_seconds"] = sum(snaps)
         merged["spilled_sessions_max"] = max(
             s.get("spilled_sessions_max", 0) for s in summaries
+        )
+    # packed attribution sums like the step counts it slices
+    packed = [
+        s["steps_advanced_packed"] for s in summaries
+        if "steps_advanced_packed" in s
+    ]
+    if packed:
+        merged["steps_advanced_packed"] = sum(packed)
+        merged["packed_steps_fraction"] = (
+            sum(packed) / merged["steps_advanced"]
+            if merged["steps_advanced"]
+            else 0.0
         )
     return merged
 
@@ -376,6 +396,11 @@ def render(summary: dict) -> str:
             lines.append(
                 f"  snapshot_s={_fmt(serve['snapshot_seconds'])}  "
                 f"spilled_sessions_max={_fmt(serve.get('spilled_sessions_max'))}"
+            )
+        if "steps_advanced_packed" in serve:
+            lines.append(
+                f"  packed_steps={_fmt(serve['steps_advanced_packed'])}  "
+                f"packed_fraction={_fmt(serve.get('packed_steps_fraction'))}"
             )
         if "rejection_rate" in serve:
             lines.append(f"  rejection_rate={_fmt(serve['rejection_rate'])}")

@@ -114,7 +114,16 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
 
         mc.ensure_backend_supported(rule, cfg.backend)
         mc.validate_params(rule, cfg.temperature)
-        mc.validate_board_shape(rule, (height, width))
+        # board area vs PRNG counter width: the packed path (jax default,
+        # --bitpack) carries the wide two-word cell index, so over-2^32-
+        # cell lattices route there; the roll path rejects them typed
+        mc.validate_board_shape(
+            rule,
+            (height, width),
+            wide_counter=mc.wide_counter_capable(
+                rule, cfg.backend, bitpack=cfg.bitpack
+            ),
+        )
 
     timer = Timer()  # spans I/O too, like the reference's Wtime bracket
 

@@ -98,6 +98,16 @@ class EngineBase:
     #: deferred *host* compute that would otherwise be silently dropped.
     ASYNC_ROLL = False
 
+    #: Observability stamps (docs/OBSERVABILITY.md): which storage path
+    #: this executor steps — ``packed`` True/False on the stochastic
+    #: engines (None on deterministic ones, whose packing is a backend
+    #: knob below this layer), ``lanes`` the spins-per-word of a packed
+    #: engine.  The scheduler copies them onto each admitted session so
+    #: round records and session views attribute throughput to the path
+    #: that produced it.
+    packed: bool | None = None
+    lanes: int | None = None
+
     def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -500,7 +510,13 @@ class SlotLoopEngine(EngineBase):
         return np.asarray(self._runners[slot].fetch())
 
 
-def make_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
+def make_engine(
+    key: CompileKey,
+    capacity: int,
+    chunk_steps: int,
+    *,
+    mc_packed: bool | None = None,
+) -> EngineBase:
     """Engine factory, dispatched on the key's executor family.
 
     ``backend == "tuned"`` resolves the executor through the autotune
@@ -508,6 +524,10 @@ def make_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
     model): serving latency must never pay measurement cost, so an
     untuned key degrades to the cost-model pick, it does not trigger a
     trial sweep.  Run ``tpu-life tune`` offline to populate the cache.
+
+    ``mc_packed`` is the stochastic tier's bitplane knob
+    (``ServeConfig.mc_packed`` / ``--no-bitpack``); deterministic keys
+    ignore it.
     """
     if getattr(key.rule, "stochastic", False):
         # stochastic keys dispatch to the MC executors (per-slot seed /
@@ -515,7 +535,7 @@ def make_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
         # schedule are a typed rejection, never a silent fallback
         from tpu_life.mc.engine import make_mc_engine
 
-        return make_mc_engine(key, capacity, chunk_steps)
+        return make_mc_engine(key, capacity, chunk_steps, packed=mc_packed)
     backend_name = key.backend
     backend_kwargs: dict = {}
     if backend_name == "tuned":

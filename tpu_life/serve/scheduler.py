@@ -67,6 +67,9 @@ class RoundStats:
     failed: int = 0
     evicted: int = 0
     steps_advanced: int = 0
+    # the slice of steps_advanced run by bitplane-packed engines — the
+    # per-round attribution `tpu-life stats` splits throughput on
+    steps_advanced_packed: int = 0
     queue_depth: int = 0
     occupancy: int = 0  # occupied slots across engines after the round
     slots: int = 0  # total allocated slots across engines
@@ -77,6 +80,9 @@ class Scheduler:
     capacity: int = 8  # batch slots per engine (per compile key)
     chunk_steps: int = 16  # device steps per host-sync scheduling round
     max_queue: int = 64  # bounded admission queue (backpressure)
+    # the stochastic tier's bitplane knob (ServeConfig.mc_packed): ising
+    # batches run on the packed device engine unless pinned off
+    mc_packed: bool = True
     clock: object = time.monotonic
 
     queue: deque = field(default_factory=deque)
@@ -209,7 +215,8 @@ class Scheduler:
             engine = self.engines.get(key)
             if engine is None:
                 engine = self.engines[key] = make_engine(
-                    key, self.capacity, self.chunk_steps
+                    key, self.capacity, self.chunk_steps,
+                    mc_packed=self.mc_packed,
                 )
                 self.running[key] = {}
             slot = engine.acquire()
@@ -241,6 +248,10 @@ class Scheduler:
                 continue
             s.state = SessionState.RUNNING
             s.slot = slot
+            # the path stamp (docs/OBSERVABILITY.md): which storage layout
+            # steps this session — echoed in views and round attribution
+            s.packed = engine.packed
+            s.lanes = engine.lanes
             s.admitted_at = self.clock()
             if self.observer is not None:
                 self.observer.session_admitted(
@@ -367,6 +378,8 @@ class Scheduler:
                         continue  # slot freed above; engine already ignores it
                     s.steps_done += n
                     stats.steps_advanced += n
+                    if engine.packed:
+                        stats.steps_advanced_packed += n
                     if s.steps_remaining == 0:
                         self._retire_slot(engine, slots, slot, s, stats)
 
@@ -447,6 +460,8 @@ class Scheduler:
                 continue  # slot freed above; the chunk steps dead weight
             s.steps_done += n
             stats.steps_advanced += n
+            if engine.packed:
+                stats.steps_advanced_packed += n
             if s.steps_remaining == 0:
                 fresh.append((slot, s))
         if fresh:

@@ -116,6 +116,12 @@ class ServeConfig:
     # ``spill_dir`` (typed error at construction).
     spill_url: str | None = None
     spill_namespace: str | None = None  # default: this service's run_id
+    # the stochastic tier's bitplane knob (docs/STOCHASTIC.md packed
+    # tier): ising batches run on the bitplane-packed device engine (32
+    # spins per uint32 lane, bit-identical to the roll path).  False
+    # (--no-bitpack) pins the int8 roll engines — the oracle
+    # configuration the packed path is byte-compared against in CI.
+    mc_packed: bool = True
 
 
 class SimulationService:
@@ -149,6 +155,7 @@ class SimulationService:
             capacity=self.config.capacity,
             chunk_steps=self.config.chunk_steps,
             max_queue=self.config.max_queue,
+            mc_packed=self.config.mc_packed,
             clock=clock,
             observer=self,
         )
@@ -291,6 +298,10 @@ class SimulationService:
         self._completed = 0
         self._rounds = 0
         self._occupancy_sum = 0.0  # for mean batch occupancy in stats()
+        # cumulative step attribution by storage path (obs): total steps
+        # advanced, and the slice run by bitplane-packed engines
+        self._steps_total = 0
+        self._steps_packed_total = 0
         # the thread-safe seam: every verb and the pump serialize on this
         # (reentrant: cancel/pump call observer hooks while holding it)
         self._lock = threading.RLock()
@@ -371,7 +382,17 @@ class SimulationService:
                 f"0..{rule.states - 1}"
             )
         board = board.astype(np.int8)
-        mc.validate_board_shape(rule, board.shape)
+        # board-area admission check against the PRNG counter width: the
+        # packed engine carries the wide two-word cell index; the roll
+        # engines are pinned narrow, so over-2^32-cell boards on them are
+        # a typed rejection here, never a silent counter wraparound
+        mc.validate_board_shape(
+            rule,
+            board.shape,
+            wide_counter=mc.wide_counter_capable(
+                rule, self.config.backend, bitpack=self.config.mc_packed
+            ),
+        )
         if steps < 0:
             raise ValueError(f"steps must be >= 0, got {steps}")
         start_step = int(start_step)
@@ -802,6 +823,8 @@ class SimulationService:
         gauges, the per-round metrics record, the live prom snapshot."""
         self._completed += stats.completed
         self._rounds += 1
+        self._steps_total += stats.steps_advanced
+        self._steps_packed_total += stats.steps_advanced_packed
         self._c_rounds.inc()
         occ = stats.occupancy / stats.slots if stats.slots else 0.0
         self._occupancy_sum += occ
@@ -829,6 +852,10 @@ class SimulationService:
                 "completed": stats.completed,
                 "failed": stats.failed,
                 "steps_advanced": stats.steps_advanced,
+                # path attribution (docs/OBSERVABILITY.md): the slice of
+                # this round's steps run by bitplane-packed engines, so
+                # `tpu-life stats` splits throughput by storage path
+                "steps_advanced_packed": stats.steps_advanced_packed,
                 "sessions_done": self._completed,
                 "sessions_per_sec": self._completed / elapsed
                 if elapsed > 0
@@ -942,6 +969,8 @@ class SimulationService:
             "failed": self.store.count(SessionState.FAILED),
             "cancelled": self.store.count(SessionState.CANCELLED),
             "rounds": self._rounds,
+            "steps_advanced": self._steps_total,
+            "steps_advanced_packed": self._steps_packed_total,
             "elapsed_s": elapsed,
             "sessions_per_sec": self._completed / elapsed if elapsed > 0 else 0.0,
             "batch_occupancy_mean": self._occupancy_sum / self._rounds

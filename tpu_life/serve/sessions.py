@@ -66,6 +66,13 @@ class Session:
     # is a full replay record.
     seed: int | None = None
     temperature: float | None = None
+    # the execution-path stamp (docs/OBSERVABILITY.md): set at admission
+    # from the engine that took the session — True on the bitplane-packed
+    # stochastic engines (lanes = spins per uint32 word), False on the
+    # int8 roll engines, None for deterministic engines (their packing is
+    # a backend knob below the serve layer)
+    packed: bool | None = None
+    lanes: int | None = None
     # failover resume (docs/FLEET.md): absolute steps already completed by
     # a previous life of this trajectory before this service admitted it.
     # ``steps`` stays the REMAINING budget this service must run; views
@@ -123,6 +130,11 @@ class SessionView:
     # and the ising temperature; None where not applicable
     seed: int | None = None
     temperature: float | None = None
+    # execution-path attribution: whether a stochastic session is being
+    # stepped by a bitplane-packed engine (and its lane width) — None
+    # until admission, and always None for deterministic sessions
+    packed: bool | None = None
+    lanes: int | None = None
 
     @property
     def finished(self) -> bool:
@@ -169,6 +181,8 @@ class SessionStore:
             rule=s.rule.name,
             seed=s.seed,
             temperature=s.temperature,
+            packed=s.packed,
+            lanes=s.lanes,
         )
 
     def result(self, sid: str) -> np.ndarray:
