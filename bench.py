@@ -906,6 +906,82 @@ def run_chaos_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def run_governor_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_governor capture (docs/SERVING.md "Resource
+    governance"): the governor drill — engine OOMs masked by the
+    in-place recovery ladder, one wedged settle rescued through the
+    watchdog -> readyz-500 -> unready-recycle -> migration path — next
+    to a fault-free twin of the same workload.  Recovery percentiles
+    come from the observed wedge-recycles (kill-free: the only worker
+    deaths allowed are the wedge's own).  Replayable: the record stamps
+    the seed and plan digest.
+    """
+    import tempfile
+
+    from tpu_life.chaos.drill import DrillConfig, run_drill
+
+    def leg(points, governor, tag):
+        workdir = tempfile.mkdtemp(prefix=f"tpu-life-bench-governor-{tag}-")
+        try:
+            summary = run_drill(
+                DrillConfig(
+                    seed=args.chaos_seed,
+                    workers=args.chaos_workers,
+                    det_sessions=6,
+                    ising_sessions=2,
+                    steps=args.serve_steps * 20,
+                    kills=0,
+                    points=points,
+                    governor=governor,
+                    workdir=workdir,
+                )
+            )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return {
+            "ok": summary["ok"],
+            "plan_digest": summary["plan_digest"],
+            "sessions": summary["sessions"],
+            "delivered": summary["delivered"],
+            "resubmits": summary["resubmits"],
+            "outcomes": summary["outcomes"],
+            "injections": summary["injections"],
+            "recycles": summary.get("recycles", []),
+            "elapsed_s": summary["elapsed_s"],
+            "sessions_per_sec": summary["sessions_per_sec"],
+        }
+
+    fault_free = leg({}, False, "clean")
+    governed = leg(None, True, "governor")  # None = GOVERNOR_POINTS
+    recoveries = sorted(
+        r["recovery_s"]
+        for r in governed["recycles"]
+        if r.get("recovery_s") is not None
+    )
+    return {
+        "metric": "governor_sessions_per_sec",
+        "value": governed["sessions_per_sec"],
+        "unit": "sessions/s",
+        "platform": platform,
+        "backend": "numpy",
+        "workers": args.chaos_workers,
+        # the replay stamp: every robustness number names its adversity
+        "chaos_seed": args.chaos_seed,
+        "plan_digest": governed["plan_digest"],
+        "fault_free": fault_free,
+        "governor": governed,
+        "throughput_under_faults_frac": (
+            governed["sessions_per_sec"] / fault_free["sessions_per_sec"]
+            if fault_free["sessions_per_sec"] > 0
+            else 0.0
+        ),
+        "recovery_s_p50": recoveries[len(recoveries) // 2] if recoveries else None,
+        "recovery_s_max": recoveries[-1] if recoveries else None,
+        "invariants_ok": fault_free["ok"] and governed["ok"],
+        "degraded": degraded,
+    }
+
+
 def run_cross_host_bench(args, platform: str, degraded: bool) -> dict:
     """The BENCH_cross_host capture (docs/FLEET.md "Cross-host
     topology"): the two-control-plane drill — wire registration, a lease
@@ -1307,6 +1383,15 @@ def main() -> None:
     p.add_argument("--chaos-seed", type=int, default=0)
     p.add_argument("--chaos-workers", type=int, default=2)
     p.add_argument("--chaos-kills", type=int, default=1)
+    # the BENCH_governor capture (docs/SERVING.md "Resource governance"):
+    # the governor drill — masked OOMs, a wedge-recycle rescue — vs its
+    # fault-free twin; reuses the --chaos-* knobs (seed / workers)
+    p.add_argument("--governor", action="store_true",
+                   help="robustness bench: the resource-governor drill "
+                   "(masked engine OOMs through the recovery ladder, a "
+                   "wedged settle rescued via unready-recycle + "
+                   "migration) vs a fault-free twin — emits "
+                   "governor_sessions_per_sec")
     # the BENCH_cross_host capture (docs/FLEET.md "Cross-host topology"):
     # the two-control-plane drill as one record — reuses the --chaos-*
     # knobs (seed / workers / kills) for its shape
@@ -1483,6 +1568,8 @@ def main() -> None:
             result = run_fleet_bench(args, platform, degraded)
         elif args.chaos:
             result = run_chaos_bench(args, platform, degraded)
+        elif args.governor:
+            result = run_governor_bench(args, platform, degraded)
         elif args.cross_host:
             result = run_cross_host_bench(args, platform, degraded)
         elif args.serve:
@@ -1533,10 +1620,12 @@ def main() -> None:
                     )
                 cmd += ["--serve-capacity", str(args.serve_capacity)]
                 cmd += ["--serve-chunk-steps", str(args.serve_chunk_steps)]
-            if args.chaos or args.cross_host:
+            if args.chaos or args.cross_host or args.governor:
                 # the retry must re-run the SAME seeded drill: seed and
                 # shape ride along so the replay contract holds
-                cmd += ["--cross-host" if args.cross_host else "--chaos",
+                mode = ("--cross-host" if args.cross_host
+                        else "--governor" if args.governor else "--chaos")
+                cmd += [mode,
                         "--chaos-seed", str(args.chaos_seed),
                         "--chaos-workers", str(args.chaos_workers),
                         "--chaos-kills", str(args.chaos_kills)]
@@ -1566,6 +1655,9 @@ def main() -> None:
             size, steps = args.serve_size, args.serve_steps
         elif args.chaos:
             metric, unit = "chaos_sessions_per_sec", "sessions/s"
+            size, steps = args.serve_size, args.serve_steps
+        elif args.governor:
+            metric, unit = "governor_sessions_per_sec", "sessions/s"
             size, steps = args.serve_size, args.serve_steps
         elif args.cross_host:
             metric, unit = "cross_host_sessions_per_sec", "sessions/s"
@@ -1599,7 +1691,7 @@ def main() -> None:
             failure["batch_capacity"] = args.serve_capacity
             if args.fleet:
                 failure["workers"] = args.fleet_workers
-        elif args.chaos or args.cross_host:
+        elif args.chaos or args.cross_host or args.governor:
             # the replay stamp survives even a failed capture
             failure["chaos_seed"] = args.chaos_seed
             failure["workers"] = args.chaos_workers

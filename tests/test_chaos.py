@@ -258,10 +258,16 @@ def test_spill_read_fault_lands_in_corrupt(tmp_path):
 @pytest.mark.parametrize("pipeline", [True, False])
 def test_engine_chunk_fault_fails_only_that_key(tmp_path, point, pipeline):
     """A chunk-level device fault costs one CompileKey's tenants, typed —
-    the other key keeps stepping bit-exactly and the pump survives."""
+    the other key keeps stepping bit-exactly and the pump survives.
+
+    This pins the TYPED-FAILURE rung of the governor (docs/SERVING.md
+    "Resource governance"): with ``engine_max_restarts=0`` the in-place
+    recovery ladder is off and the PR 10 failure-isolation contract is
+    exactly what must hold.  The default (recovery ON) is covered in
+    tests/test_governor.py."""
     svc = SimulationService(
         ServeConfig(capacity=4, chunk_steps=4, backend="numpy",
-                    pipeline=pipeline)
+                    pipeline=pipeline, engine_max_restarts=0)
     )
     conway = random_board(12, 12, seed=1)
     bb = random_board(12, 12, seed=2, states=3)
@@ -302,7 +308,8 @@ def test_chunk_fault_never_rewrites_a_finished_outcome():
         if chaos.ChaosPlan(s, pts).preview("engine.dispatch", 2) == [False, True]
     )
     svc = SimulationService(
-        ServeConfig(capacity=2, chunk_steps=4, backend="numpy", pipeline=True)
+        ServeConfig(capacity=2, chunk_steps=4, backend="numpy",
+                    pipeline=True, engine_max_restarts=0)
     )
     board = random_board(12, 12, seed=9)
     oracle = run_np(board, get_rule("conway"), 4)

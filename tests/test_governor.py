@@ -1,0 +1,785 @@
+"""The serve-tier resource governor (docs/SERVING.md "Resource
+governance"): memory-budgeted admission, in-place engine recovery from
+chunk-level RECOVERABLE faults (the OOM halve-chunk -> host-demotion
+ladder), and the wedge watchdog.
+
+Bit-identity is the spine of every recovery assertion: a masked fault
+may cost throughput (a replay, a halved chunk, the host executor) but
+never a byte — each recovered session is compared against its solo
+oracle (``run_np`` / ``MCHostRunner``)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_life import chaos, mc
+from tpu_life.gateway.errors import from_serve_error
+from tpu_life.mc.engine import MCHostRunner
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.serve import (
+    InsufficientMemory,
+    ServeConfig,
+    SessionState,
+    SimulationService,
+)
+from tpu_life.serve import governor
+from tpu_life.serve.engine import HostBatchEngine, compile_key_for
+
+
+def _key(rule_name, h, w, backend):
+    board = np.zeros((h, w), np.int8)
+    return compile_key_for(get_rule(rule_name), board, backend)
+
+
+# -- the estimator -----------------------------------------------------------
+def test_estimate_deterministic_device_doubles_boards():
+    key = _key("conway", 64, 48, "jax")
+    # boards x double buffer + the int32 remaining vector
+    assert governor.estimate_engine_bytes(key, 8) == 8 * 64 * 48 * 2 + 8 * 4
+
+
+def test_estimate_host_engine_single_copy():
+    key = _key("conway", 64, 48, "numpy")
+    assert governor.estimate_engine_bytes(key, 8) == 8 * 64 * 48 + 8 * 4
+
+
+def test_estimate_mc_roll_carries():
+    key = _key("ising", 32, 32, "jax")
+    base = 8 * 32 * 32 * 2 + 8 * 4
+    carries = 8 * 4 * 3 + 8 * 4 * 5  # keys + counter, acceptance table
+    assert (
+        governor.estimate_engine_bytes(key, 8, mc_packed=False)
+        == base + carries
+    )
+
+
+def test_estimate_packed_lanes_shrink_boards():
+    from tpu_life.mc.packed import packed_width
+
+    key = _key("ising", 32, 70, "jax")
+    packed = governor.estimate_engine_bytes(key, 8, mc_packed=True)
+    rolled = governor.estimate_engine_bytes(key, 8, mc_packed=False)
+    board_packed = 8 * 32 * packed_width(70) * 4 * 2  # uint32 lanes, x2
+    board_rolled = 8 * 32 * 70 * 2
+    assert packed - board_packed == rolled - board_rolled  # same carries
+    assert packed < rolled  # 70 cols -> 3 words = 12 bytes vs 70
+
+
+def test_resolve_budget_explicit_and_disabled():
+    assert governor.resolve_budget(12345) == 12345
+    assert governor.resolve_budget(0) is None
+    assert governor.resolve_budget(-1) is None
+    # the derived default exists and is per-device-positive (memoized)
+    assert governor.resolve_budget(None) >= min(
+        governor.DEFAULT_BYTES_PER_DEVICE.values()
+    )
+
+
+# -- budget admission --------------------------------------------------------
+def _svc(budget, **kw):
+    kw.setdefault("capacity", 4)
+    kw.setdefault("backend", "numpy")
+    return SimulationService(ServeConfig(memory_budget_bytes=budget, **kw))
+
+
+def test_admission_existing_key_is_free_new_key_rejected_transient():
+    b16 = random_board(16, 16, seed=1)
+    b32 = random_board(32, 32, seed=2)
+    need16 = governor.estimate_engine_bytes(_key("conway", 16, 16, "numpy"), 4)
+    need32 = governor.estimate_engine_bytes(_key("conway", 32, 32, "numpy"), 4)
+    svc = _svc(need16 + need32 - 1)  # each alone fits; both never
+    sid = svc.submit(b16, "conway", 4)
+    svc.submit(b16, "conway", 4)  # same key: no new bytes, admits
+    with pytest.raises(InsufficientMemory) as ei:
+        svc.submit(b32, "conway", 4)
+    assert ei.value.transient
+    assert ei.value.estimated_bytes == need32
+    assert ei.value.budget_bytes == need16 + need32 - 1
+    svc.drain()
+    assert svc.poll(sid).state is SessionState.DONE
+    # the typed rejections are counted by reason
+    assert svc.stats()["memory_budget_bytes"] == need16 + need32 - 1
+    fam = svc.registry.counter(
+        "serve_admission_rejected_total", labels=("reason",)
+    )
+    assert fam.labels(reason="insufficient_memory").value == 1
+    svc.close()
+
+
+def test_admission_never_fits_is_permanent():
+    svc = _svc(512)
+    with pytest.raises(InsufficientMemory) as ei:
+        svc.submit(random_board(64, 64, seed=3), "conway", 4)
+    assert not ei.value.transient
+    fam = svc.registry.counter(
+        "serve_admission_rejected_total", labels=("reason",)
+    )
+    assert fam.labels(reason="session_too_large").value == 1
+    svc.close()
+
+
+def test_admission_counts_queued_keys_as_reserved():
+    """A key waiting in the queue has its engine coming: a second new key
+    must be charged against BOTH, not sneak in before the first admits."""
+    b16 = random_board(16, 16, seed=1)
+    b24 = random_board(24, 24, seed=2)
+    need16 = governor.estimate_engine_bytes(_key("conway", 16, 16, "numpy"), 4)
+    need24 = governor.estimate_engine_bytes(_key("conway", 24, 24, "numpy"), 4)
+    svc = _svc(max(need16, need24) + 1)
+    svc.submit(b16, "conway", 4)  # queued; engine not yet built
+    with pytest.raises(InsufficientMemory):
+        svc.submit(b24, "conway", 4)
+    svc.drain()
+    svc.close()
+
+
+def test_zero_budget_disables_accounting():
+    svc = _svc(0)
+    sid = svc.submit(random_board(64, 64, seed=4), "conway", 2)
+    svc.drain()
+    assert svc.poll(sid).state is SessionState.DONE
+    assert svc.stats()["memory_budget_bytes"] == 0
+    svc.close()
+
+
+def test_gateway_maps_transient_503_and_permanent_413():
+    transient = InsufficientMemory(
+        "t", transient=True, estimated_bytes=10, budget_bytes=5
+    )
+    api = from_serve_error(transient)
+    assert api.status == 503 and api.code == "insufficient_memory"
+    assert api.retry_after is not None
+    assert api.body()["error"]["transient"] is True
+    permanent = InsufficientMemory(
+        "p", transient=False, estimated_bytes=10, budget_bytes=5
+    )
+    api = from_serve_error(permanent)
+    assert api.status == 413 and api.code == "insufficient_memory"
+    assert api.retry_after is None
+    assert api.body()["error"]["estimated_bytes"] == 10
+
+
+def test_gateway_http_budget_rejections(tmp_path):
+    """The wire shape of both rungs: 503 + Retry-After for transient
+    pressure, 413 for a session that can never fit."""
+    from tpu_life.gateway import Gateway, GatewayConfig
+
+    need16 = governor.estimate_engine_bytes(_key("conway", 16, 16, "numpy"), 4)
+    need20 = governor.estimate_engine_bytes(_key("conway", 20, 20, "numpy"), 4)
+    svc = _svc(need16 + need20 - 1)  # each alone fits; both never
+    gw = Gateway(svc, GatewayConfig(port=0))
+    gw.start()
+    try:
+        url = f"http://{gw.host}:{gw.port}/v1/sessions"
+
+        def post(body):
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(), method="POST"
+            )
+            return urllib.request.urlopen(req, timeout=5)
+
+        with post({"size": 16, "steps": 2}) as resp:
+            assert resp.status == 201
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"size": 20, "steps": 2})  # second key: transient
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        doc = json.loads(ei.value.read())
+        assert doc["error"]["code"] == "insufficient_memory"
+        assert doc["error"]["transient"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"size": 512, "steps": 2})  # never fits: permanent
+        assert ei.value.code == 413
+        doc = json.loads(ei.value.read())
+        assert doc["error"]["code"] == "insufficient_memory"
+        assert doc["error"]["transient"] is False
+    finally:
+        gw.begin_drain()
+        gw.wait(timeout=20)
+        gw.close()
+
+
+def test_sweep_cli_budget_flag(tmp_path, monkeypatch, capsys):
+    """The sweep front: the grid shares ONE CompileKey, so a budget it
+    cannot fit is a typed exit-2 refusal before any work runs — and a
+    budget that fits runs the sweep untouched."""
+    from tpu_life.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main([
+        "sweep", "--size", "8", "--steps", "2", "--temps", "2.0,2.2",
+        "--serve-backend", "numpy", "--memory-budget-bytes", "64",
+    ])
+    out = capsys.readouterr()
+    assert rc == 2
+    assert "memory budget" in out.err
+    rc = main([
+        "sweep", "--size", "8", "--steps", "2", "--temps", "2.0,2.2",
+        "--serve-backend", "numpy", "--memory-budget-bytes", "1000000",
+    ])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert json.loads(out.out.strip().splitlines()[-1])["done"] == 2
+
+
+def test_serve_cli_budget_rejects_one_request_serves_the_rest(
+    tmp_path, monkeypatch, capsys
+):
+    """The spool front: requests are independent — a request whose
+    CompileKey cannot fit is recorded 'rejected' in the summary while
+    the rest complete."""
+    from tpu_life.cli import main
+    from tpu_life.io.codec import write_board
+
+    monkeypatch.chdir(tmp_path)
+    small = random_board(8, 8, seed=1)
+    big = random_board(48, 48, seed=2)
+    write_board(tmp_path / "small.txt", small)
+    write_board(tmp_path / "big.txt", big)
+    assert main(["submit", "--input-file", "small.txt", "--steps", "3",
+                 "--height", "8", "--width", "8"]) == 0
+    assert main(["submit", "--input-file", "big.txt", "--steps", "3",
+                 "--height", "48", "--width", "48", "--id", "too-big"]) == 0
+    capsys.readouterr()
+    need_small = governor.estimate_engine_bytes(
+        _key("conway", 8, 8, "numpy"), 2
+    )
+    rc = main([
+        "serve", "--capacity", "2", "--serve-backend", "numpy",
+        "--memory-budget-bytes", str(need_small + 1),
+    ])
+    out = capsys.readouterr()
+    assert rc == 1
+    summary = json.loads(out.out.strip().splitlines()[-1])
+    assert summary["done"] == 1 and summary["written"] == 1
+    rejected = [f for f in summary["failures"] if f["state"] == "rejected"]
+    assert len(rejected) == 1 and rejected[0]["id"] == "too-big"
+    assert "InsufficientMemory" in rejected[0]["error"]
+
+
+# -- the in-place recovery ladder --------------------------------------------
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", ["engine.dispatch", "engine.collect"])
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_recovery_masks_chunk_fault_byte_identical(point, pipeline):
+    """The default contract is failure-MASKING: a chunk-level fault is
+    recovered by rebuild-and-replay, the session finishes DONE and
+    byte-identical to its solo oracle, and the recovery is counted."""
+    svc = SimulationService(
+        ServeConfig(capacity=4, chunk_steps=4, backend="numpy",
+                    pipeline=pipeline)
+    )
+    board = random_board(12, 12, seed=1)
+    steps = 12
+    with chaos.armed_plan(
+        {"seed": 4, "points": {point: {"mode": "fault", "times": 1}}}
+    ):
+        sid = svc.submit(board, "conway", steps)
+        svc.drain(max_rounds=80)
+    v = svc.poll(sid)
+    assert v.state is SessionState.DONE, v.error
+    expect = run_np(board, get_rule("conway"), steps)
+    assert svc.result(sid).tobytes() == expect.tobytes()
+    assert v.degraded_reason is None  # a plain replay does not degrade
+    assert svc.stats()["engine_recoveries"].get("replayed") == 1
+    svc.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_recovery_isolation_other_key_untouched(pipeline):
+    """Recovery stays per-key: the other CompileKey's batch is neither
+    rewound nor replayed while its neighbor rebuilds."""
+    svc = SimulationService(
+        ServeConfig(capacity=4, chunk_steps=4, backend="numpy",
+                    pipeline=pipeline)
+    )
+    conway = random_board(12, 12, seed=1)
+    bb = random_board(12, 12, seed=2, states=3)
+    with chaos.armed_plan(
+        {"seed": 4, "points": {"engine.dispatch": {"mode": "fault", "times": 1}}}
+    ):
+        a = svc.submit(conway, "conway", 8)
+        b = svc.submit(bb, "brians_brain", 8)
+        svc.drain(max_rounds=80)
+    for sid, board, rule in ((a, conway, "conway"), (b, bb, "brians_brain")):
+        assert svc.poll(sid).state is SessionState.DONE
+        expect = run_np(board, get_rule(rule), 8)
+        assert svc.result(sid).tobytes() == expect.tobytes()
+    svc.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_oom_ladder_halves_then_demotes_stamped(pipeline):
+    """Two OOMs on one key walk the full ladder: halved chunk (still the
+    device engine), then host demotion — each stamped, each
+    byte-identical to the solo oracle."""
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="jax",
+                    pipeline=pipeline)
+    )
+    board = random_board(12, 12, seed=2)
+    steps = 12
+    with chaos.armed_plan(
+        {"seed": 1, "points": {"engine.oom": {"mode": "oom", "times": 2}}}
+    ):
+        sid = svc.submit(board, "conway", steps)
+        svc.drain(max_rounds=120)
+    v = svc.poll(sid)
+    assert v.state is SessionState.DONE, v.error
+    assert svc.result(sid).tobytes() == run_np(
+        board, get_rule("conway"), steps
+    ).tobytes()
+    assert v.degraded_reason == "oom_host_demoted"
+    key = next(iter(svc.scheduler.engines))
+    engine = svc.scheduler.engines[key]
+    assert isinstance(engine, HostBatchEngine)
+    assert engine.chunk_steps == 2  # the halved chunk survives demotion
+    rec = svc.stats()["engine_recoveries"]
+    assert rec.get("oom_halved_chunk") == 1
+    assert rec.get("oom_host_demoted") == 1
+    # a LATER session on the degraded key is stamped too, and the view
+    # carries the stamp over the wire shape
+    sid2 = svc.submit(board, "conway", 4)
+    svc.drain(max_rounds=40)
+    v2 = svc.poll(sid2)
+    assert v2.state is SessionState.DONE
+    assert v2.degraded_reason == "oom_host_demoted"
+    from tpu_life.gateway.protocol import render_view
+
+    assert render_view(v2)["degraded_reason"] == "oom_host_demoted"
+    svc.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_oom_ladder_ising_bit_identical(pipeline):
+    """The stochastic tier rides the same ladder: the absolute MC
+    counters re-enter the stream exactly, so halved-chunk and
+    host-demoted replays stay byte-identical (packed jax engine ->
+    MCHostEngine demotion included)."""
+    board = mc.seeded_board(16, 16, 0.5, seed=9)
+    steps = 12
+    oracle = MCHostRunner(board, get_rule("ising"), seed=9, temperature=2.3)
+    oracle.advance(steps)
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="jax",
+                    pipeline=pipeline)
+    )
+    with chaos.armed_plan(
+        {"seed": 2, "points": {"engine.oom": {"mode": "oom", "times": 2}}}
+    ):
+        sid = svc.submit(board, "ising", steps, seed=9, temperature=2.3)
+        svc.drain(max_rounds=120)
+    v = svc.poll(sid)
+    assert v.state is SessionState.DONE, v.error
+    assert svc.result(sid).tobytes() == oracle.fetch().tobytes()
+    assert v.degraded_reason == "oom_host_demoted"
+    assert v.packed is False  # the host twin is the roll executor
+    svc.close()
+
+
+@pytest.mark.chaos
+def test_restart_budget_exhaustion_falls_back_typed():
+    """Past engine_max_restarts the fault is today's typed failure — and
+    the exhaustion is counted as its own outcome."""
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="numpy",
+                    engine_max_restarts=1)
+    )
+    board = random_board(12, 12, seed=3)
+    with chaos.armed_plan(
+        {"seed": 4,
+         "points": {"engine.dispatch": {"mode": "fault", "times": 3}}}
+    ):
+        sid = svc.submit(board, "conway", 30)
+        svc.drain(max_rounds=80)
+    v = svc.poll(sid)
+    assert v.state is SessionState.FAILED and "InjectedFault" in v.error
+    rec = svc.stats()["engine_recoveries"]
+    assert rec.get("replayed") == 1
+    assert rec.get("budget_exhausted") == 1
+    svc.close()
+
+
+@pytest.mark.chaos
+def test_first_compile_oom_in_locked_begin_does_not_escape_pump():
+    """The regression the governor exists for: a RECOVERABLE raised by
+    the very FIRST dispatch of a new key (first-compile OOM) inside the
+    locked round_begin must cost only that key's round — never the pump.
+    engine.oom is scheduled on call 1."""
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="jax", pipeline=True)
+    )
+    board = random_board(12, 12, seed=5)
+    other = random_board(10, 10, seed=6)
+    with chaos.armed_plan(
+        {"seed": 0,
+         "points": {"engine.oom": {"mode": "oom", "rate": 1.0, "times": 1}}}
+    ):
+        sid = svc.submit(board, "conway", 8)
+        sid2 = svc.submit(other, "conway", 8)  # a second key, same round
+        svc.drain(max_rounds=80)  # a pump escape would raise right here
+    for s, b in ((sid, board), (sid2, other)):
+        v = svc.poll(s)
+        assert v.state is SessionState.DONE, v.error
+        assert svc.result(s).tobytes() == run_np(
+            b, get_rule("conway"), 8
+        ).tobytes()
+    assert svc.stats()["engine_recoveries"].get("oom_halved_chunk") == 1
+    svc.close()
+
+
+@pytest.mark.chaos
+def test_engine_build_oom_at_admit_fails_only_that_session(monkeypatch):
+    """An engine CONSTRUCTION that raises RECOVERABLE (the batch
+    allocation OOMs before any dispatch exists) fails that session's
+    admit typed; the pump and other keys survive."""
+    import tpu_life.serve.scheduler as sched_mod
+
+    real = sched_mod.make_engine
+    board = random_board(12, 12, seed=7)
+    other = random_board(10, 10, seed=8)
+
+    def boom(key, capacity, chunk_steps, **kw):
+        if key.shape == (12, 12):
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected build OOM")
+        return real(key, capacity, chunk_steps, **kw)
+
+    monkeypatch.setattr(sched_mod, "make_engine", boom)
+    svc = SimulationService(ServeConfig(capacity=2, backend="numpy"))
+    sid = svc.submit(board, "conway", 4)
+    sid2 = svc.submit(other, "conway", 4)
+    svc.drain(max_rounds=40)
+    v = svc.poll(sid)
+    assert v.state is SessionState.FAILED and "engine build failed" in v.error
+    assert svc.poll(sid2).state is SessionState.DONE
+    assert svc.result(sid2).tobytes() == run_np(
+        other, get_rule("conway"), 4
+    ).tobytes()
+    svc.close()
+
+
+# -- the wedge watchdog ------------------------------------------------------
+@pytest.mark.chaos
+def test_wedge_watchdog_marks_and_salvages():
+    """A settle blocked past the deadline: the watchdog (not the stuck
+    pump) marks the service wedged, and finishers of engines that
+    settled BEFORE the wedge retire DONE — their results leave the
+    worker before any recycle."""
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=2, backend="numpy",
+                    settle_deadline_s=0.1)
+    )
+    board = random_board(10, 10, seed=4)
+    sid = svc.submit(board, "conway", 40)
+    done = threading.Event()
+
+    def pump_until_wedged():
+        try:
+            while svc.wedged is None and not done.is_set():
+                svc.pump()
+        finally:
+            done.set()
+
+    with chaos.armed_plan(
+        {"seed": 1,
+         "points": {"engine.wedge": {"mode": "sleep", "seconds": 1.5,
+                                     "times": 1}}}
+    ):
+        t = threading.Thread(target=pump_until_wedged, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while svc.wedged is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        wedged = svc.wedged
+        assert wedged is not None, "watchdog never fired"
+        assert wedged["reason"] == "settle_deadline"
+        assert wedged["compile_key"] is not None
+        assert svc.stats()["engine_recoveries"].get("wedged") == 1
+        done.set()
+        t.join(timeout=10)
+    # the wedge is sticky: the deadline contract was broken once
+    assert svc.wedged is not None
+    svc.cancel(sid)
+    svc.close()
+
+
+def test_wedge_salvage_retires_settled_finishers():
+    """The salvage the watchdog runs on a wedge: a pending finisher of
+    an engine that SETTLED before the wedge retires DONE, byte-identical
+    — its result leaves the worker before the supervisor recycles it.
+    Driven directly (the wedged-pump e2e shape is covered by the
+    watchdog and readyz tests; WHICH engine wedges there depends on the
+    rotation, so the salvage contract is pinned deterministically
+    here)."""
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="numpy",
+                    settle_deadline_s=5.0)
+    )
+    board = random_board(10, 10, seed=5)
+    oracle = run_np(board, get_rule("conway"), 4)
+    fast = svc.submit(board, "conway", 4)
+    svc.pump()  # round 1: fast finishes inside its chunk -> pending
+    sched = svc.scheduler
+    key = next(iter(sched.engines))
+    assert sched.pending.get(key), "precondition: a pending finisher"
+    assert svc.poll(fast).state is SessionState.RUNNING
+    plan = [(key, sched.engines[key], True)]
+    with svc._lock:
+        salvaged = svc._salvage_wedged_locked(plan, settled={key})
+    assert salvaged == 1
+    v = svc.poll(fast)
+    assert v.state is SessionState.DONE
+    assert svc.result(fast).tobytes() == oracle.tobytes()
+    # idempotent against the pump resuming: the next rounds re-retire
+    # nothing and the service drains clean
+    svc.drain(max_rounds=10)
+    svc.close()
+
+
+@pytest.mark.chaos
+def test_recovery_rebuild_failure_falls_back_typed(monkeypatch):
+    """If the REBUILD itself raises RECOVERABLE (the replacement batch
+    allocation OOMs while the condemned engine's buffers still live),
+    the salvaged sessions fail typed and the pump survives — the
+    recovery path must never kill the worker it exists to keep alive."""
+    import tpu_life.serve.scheduler as sched_mod
+
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="numpy")
+    )
+    board = random_board(12, 12, seed=3)
+    real = sched_mod.make_engine
+    calls = {"n": 0}
+
+    def flaky(key, capacity, chunk_steps, **kw):
+        calls["n"] += 1
+        if calls["n"] > 1:  # call 1 built the original; 2+ is the rebuild
+            raise RuntimeError("RESOURCE_EXHAUSTED: rebuild allocation OOM")
+        return real(key, capacity, chunk_steps, **kw)
+
+    monkeypatch.setattr(sched_mod, "make_engine", flaky)
+    with chaos.armed_plan(
+        {"seed": 4, "points": {"engine.dispatch": {"mode": "fault", "times": 1}}}
+    ):
+        sid = svc.submit(board, "conway", 8)
+        svc.drain(max_rounds=60)  # a pump escape would raise right here
+    v = svc.poll(sid)
+    assert v.state is SessionState.FAILED
+    assert "recovery rebuild failed" in v.error
+    assert svc.stats()["engine_recoveries"].get("rebuild_failed") == 1
+    # the key stays serviceable: the old engine is still registered with
+    # every slot free, so fresh sessions admit and complete
+    monkeypatch.setattr(sched_mod, "make_engine", real)
+    sid2 = svc.submit(board, "conway", 4)
+    svc.drain(max_rounds=40)
+    assert svc.poll(sid2).state is SessionState.DONE
+    assert svc.result(sid2).tobytes() == run_np(
+        board, get_rule("conway"), 4
+    ).tobytes()
+    svc.close()
+
+
+def test_watchdog_deadline_is_per_engine_progress():
+    """The deadline applies to ONE engine's wait: many keys settling in
+    sequence (each under the deadline, cumulatively far over it) never
+    trip the watchdog, and when the tail engine really blocks, the
+    verdict names IT — skipping settled AND faulted keys."""
+    svc = SimulationService(
+        ServeConfig(capacity=2, backend="numpy", settle_deadline_s=0.25)
+    )
+    from tpu_life.serve.service import _key_bucket
+
+    keys = [_key("conway", n, n, "numpy") for n in (8, 10, 12)]
+    plan = [(k, None, True) for k in keys]
+    settled: list = []
+    faulted: list = []
+    svc._settle_state = (time.monotonic(), plan, settled, faulted)
+    try:
+        # progress every 0.15s — under the 0.25s deadline each time,
+        # 0.45s cumulative (over it): no wedge
+        time.sleep(0.15)
+        settled.append(keys[0])
+        time.sleep(0.15)
+        faulted.append(keys[1])  # a fault is progress too (recovery owns it)
+        time.sleep(0.15)
+        assert svc.wedged is None
+        # now the tail engine stalls past the deadline: wedged, and the
+        # verdict names the BLOCKED key, not the settled/faulted ones
+        deadline = time.monotonic() + 5
+        while svc.wedged is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert svc.wedged is not None, "watchdog never fired"
+        assert svc.wedged["compile_key"] == _key_bucket(keys[2])
+    finally:
+        svc._settle_state = None
+        svc.close()
+
+
+def test_slow_spill_does_not_wedge(tmp_path, monkeypatch):
+    """The watchdog guards DEVICE waits, not disk: a spill pass slower
+    than the settle deadline (slow storage) must never mark a healthy
+    worker wedged — the window closes before the spill phase."""
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=2, backend="numpy",
+                    settle_deadline_s=0.1, spill_dir=str(tmp_path),
+                    spill_every=1)
+    )
+    board = random_board(10, 10, seed=1)
+    sid = svc.submit(board, "conway", 8)
+    real = svc._run_spill
+
+    def slow_spill(plan):
+        time.sleep(0.4)  # 4x the deadline, pure disk-phase time
+        return real(plan)
+
+    monkeypatch.setattr(svc, "_run_spill", slow_spill)
+    svc.drain(max_rounds=40)
+    assert svc.wedged is None
+    assert svc.poll(sid).state is SessionState.DONE
+    svc.close()
+
+
+@pytest.mark.chaos
+def test_wedged_readyz_answers_500_with_reason():
+    from tpu_life.gateway import Gateway, GatewayConfig
+
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=2, backend="numpy",
+                    settle_deadline_s=0.1)
+    )
+    gw = Gateway(svc, GatewayConfig(port=0))
+    gw.start()
+    try:
+        url = f"http://{gw.host}:{gw.port}"
+        with chaos.armed_plan(
+            {"seed": 1,
+             "points": {"engine.wedge": {"mode": "sleep", "seconds": 1.5,
+                                         "times": 1}}}
+        ):
+            req = urllib.request.Request(
+                url + "/v1/sessions",
+                data=json.dumps({"size": 10, "steps": 40}).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+            got = None
+            deadline = time.monotonic() + 10
+            while got is None and time.monotonic() < deadline:
+                try:
+                    urllib.request.urlopen(url + "/readyz", timeout=2)
+                except urllib.error.HTTPError as e:
+                    if e.code == 500:
+                        got = json.loads(e.read())
+                time.sleep(0.02)
+        assert got is not None, "readyz never flipped to 500"
+        err = got["error"]
+        assert err["code"] == "engine_wedged"
+        assert err["reason"] == "settle_deadline"
+        assert err["compile_key"]
+    finally:
+        gw.begin_drain()
+        gw.wait(timeout=20)
+        gw.close()
+
+
+def test_supervisor_probe_carries_unready_reason():
+    """The fleet half of the wedge story: a 500 /readyz with a typed
+    body surfaces as the worker's unready_reason (still 'unreachable'
+    for recycle purposes)."""
+    from tpu_life.fleet.supervisor import _unready_reason
+
+    class FakeErr:
+        def read(self):
+            return json.dumps(
+                {"error": {"code": "engine_wedged",
+                           "reason": "settle_deadline"}}
+            ).encode()
+
+    assert _unready_reason(FakeErr()) == "engine_wedged:settle_deadline"
+
+    class Untyped:
+        def read(self):
+            return b"not json"
+
+    assert _unready_reason(Untyped()) is None
+
+
+# -- stats read-back ---------------------------------------------------------
+def test_stats_summarize_governor_families(tmp_path):
+    from tpu_life.obs.stats import summarize
+
+    records = [
+        {"kind": "serve", "run_id": "a", "elapsed_s": 1.0, "queue_depth": 0,
+         "batch_occupancy": 0.5, "admitted": 2, "completed": 2, "failed": 0,
+         "steps_advanced": 10, "engine_recoveries": 1,
+         "sessions_done": 2, "sessions_per_sec": 2.0},
+        {"kind": "serve", "run_id": "b", "elapsed_s": 1.0, "queue_depth": 0,
+         "batch_occupancy": 0.5, "admitted": 1, "completed": 1, "failed": 0,
+         "steps_advanced": 5, "engine_recoveries": 2,
+         "sessions_done": 1, "sessions_per_sec": 1.0},
+        {"kind": "metric", "run_id": "a", "metric":
+         "serve_engine_recoveries_total", "type": "counter",
+         "labels": {"outcome": "replayed"}, "value": 1.0},
+        {"kind": "metric", "run_id": "b", "metric":
+         "serve_engine_recoveries_total", "type": "counter",
+         "labels": {"outcome": "oom_host_demoted"}, "value": 2.0},
+        {"kind": "metric", "run_id": "a", "metric":
+         "serve_admission_rejected_total", "type": "counter",
+         "labels": {"reason": "insufficient_memory"}, "value": 3.0},
+        {"kind": "metric", "run_id": "a", "metric":
+         "serve_memory_budget_bytes", "type": "gauge", "labels": {},
+         "value": 1000.0},
+        {"kind": "metric", "run_id": "b", "metric":
+         "serve_memory_budget_bytes", "type": "gauge", "labels": {},
+         "value": 2000.0},
+    ]
+    s = summarize(records)
+    assert s["serve"]["engine_recoveries"] == 3  # fleet merge sums rounds
+    assert s["serve"]["engine_recoveries_by_outcome"] == {
+        "replayed": 1.0, "oom_host_demoted": 2.0
+    }
+    assert s["serve"]["admission_rejected_by_reason"] == {
+        "insufficient_memory": 3.0
+    }
+    assert s["serve"]["memory_budget_bytes"] == 3000  # per-worker budgets sum
+
+
+# -- the governor drill (e2e) ------------------------------------------------
+@pytest.mark.chaos
+def test_governor_drill_end_to_end(tmp_path):
+    """The acceptance drill in miniature: a real 2-worker fleet with the
+    wedge watchdog armed, engine.oom MASKED (no worker dies of it),
+    engine.wedge rescued via unready-recycle + migration, every session
+    byte-identical to its solo oracle — seed-replayable."""
+    from tpu_life.chaos.drill import DrillConfig, run_drill
+
+    summary = run_drill(
+        DrillConfig(
+            seed=7,
+            workers=2,
+            det_sessions=4,
+            ising_sessions=1,
+            steps=900,
+            kills=0,
+            governor=True,
+            workdir=str(tmp_path),
+        )
+    )
+    assert summary["ok"], summary["invariants"]
+    assert summary["kind"] == "governor_drill"
+    assert summary["injections"].get("engine.oom", 0) >= 1
+    assert summary["injections"].get("engine.wedge", 0) >= 1
+    assert summary["recycles"], summary
+    assert summary["delivered"] == summary["sessions"]
+    assert summary["invariants"]["governor"]["ok"]

@@ -74,6 +74,9 @@ POINTS: dict[str, tuple[str, ...]] = {
     # serve engines (per-key chunk faults)
     "engine.dispatch": ("fault",),  # recovery.InjectedFault at dispatch
     "engine.collect": ("fault",),  # recovery.InjectedFault at collect
+    # serve-tier resource governor (docs/SERVING.md "Resource governance")
+    "engine.oom": ("oom",),  # RESOURCE_EXHAUSTED InjectedFault at dispatch
+    "engine.wedge": ("sleep",),  # collect/settle stalls `seconds` (watchdog drill)
     # gateway worker lifecycle
     "worker.crash": ("exit",),  # os._exit from the pump loop
     "worker.hang": ("sleep",),  # pump loop stalls `seconds`
@@ -439,6 +442,16 @@ def inject(point: str) -> None:
 
         raise recovery.InjectedFault(
             f"chaos: injected device fault at {point} (seed {plan.seed})"
+        )
+    if mode == "oom":
+        from tpu_life.runtime import recovery
+
+        # the message carries the real XLA OOM marker so the production
+        # classifier (recovery.is_oom) — and therefore the OOM-specific
+        # recovery ladder — is what an injection exercises
+        raise recovery.InjectedFault(
+            f"RESOURCE_EXHAUSTED: chaos: injected device OOM at {point} "
+            f"(seed {plan.seed})"
         )
     raise ChaosError(f"point {point} cannot inject mode {mode}")  # pragma: no cover
 

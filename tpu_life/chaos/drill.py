@@ -80,6 +80,20 @@ DEFAULT_POINTS: dict[str, dict] = {
     "engine.dispatch": {"rate": 0.02, "mode": "fault", "times": 1},
 }
 
+#: The governor drill's fault mix (``tpu-life chaos --governor``,
+#: docs/SERVING.md "Resource governance"): device OOMs that the
+#: in-place recovery ladder must MASK (the worker survives, sessions
+#: finish byte-identical on halved-chunk/host-demotion), and a wedged
+#: settle that only the watchdog -> readyz-500 -> supervisor-recycle ->
+#: migration path can rescue.  Low rates land the faults mid-flight;
+#: ``seconds`` is far past any sane settle deadline so the wedge can
+#: never clear itself by luck.
+GOVERNOR_POINTS: dict[str, dict] = {
+    "engine.oom": {"rate": 0.04, "mode": "oom", "times": 2},
+    "engine.wedge": {"rate": 0.015, "mode": "sleep", "times": 1,
+                     "seconds": 30.0},
+}
+
 
 @dataclass
 class DrillConfig:
@@ -102,6 +116,13 @@ class DrillConfig:
     migrate_stuck_after_s: float = 60.0
     workdir: str = "."  # spill/ and logs/ land under here
     summary_file: str | None = None  # append the summary as one JSONL line
+    # the governor drill (docs/SERVING.md "Resource governance"): arm
+    # GOVERNOR_POINTS by default, run every worker with the wedge
+    # watchdog at ``settle_deadline_s``, track supervisor recycles, and
+    # verify the extra ``governor`` invariant (OOM masked — no worker
+    # death outside the wedge-recycle/kill schedule; both points fired)
+    governor: bool = False
+    settle_deadline_s: float = 1.0
 
 
 @dataclass
@@ -190,11 +211,15 @@ class _Driller:
     def __init__(self, cfg: DrillConfig):
         self.cfg = cfg
         self.items = _build_items(cfg)
-        self.plan = chaos.ChaosPlan(
-            cfg.seed, DEFAULT_POINTS if cfg.points is None else cfg.points
-        )
+        if cfg.points is not None:
+            points = cfg.points
+        else:
+            points = GOVERNOR_POINTS if cfg.governor else DEFAULT_POINTS
+        self.plan = chaos.ChaosPlan(cfg.seed, points)
         self.accepted = 0  # 201s the clients received (== routed, invariant)
         self.kills: list[dict] = []
+        self.recycles: list[dict] = []  # supervisor unready-recycles observed
+        self.extra_invariants: list[str] = []
         self.violations: dict[str, list[str]] = {}
         self.injection_scrapes: dict[str, dict[str, float]] = {}
         self.fleet = None
@@ -509,17 +534,125 @@ class _Driller:
 
     def verdicts(self) -> dict[str, dict]:
         out = {}
-        for name in (
+        names = (
             "all_terminal",
             "bit_identity",
             "legal_410",
             "no_lost_work",
             "recovery_bounded",
             "metrics_consistent",
-        ):
+            *self.extra_invariants,
+        )
+        for name in names:
             probs = self.violations.get(name, [])
             out[name] = {"ok": not probs, "violations": probs}
         return out
+
+
+def _check_governor(d: "_Driller", fleet) -> None:
+    """The governor invariant (docs/SERVING.md "Resource governance"),
+    appended to the standard six when ``--governor`` is armed:
+
+    - both governor points actually fired (a drill that never reached
+      its seams must not certify anything);
+    - every worker restart is accounted for by a wedge-recycle or a
+      drill-driven SIGKILL — i.e. an OOM (or any other masked fault)
+      never killed a worker.  Sessions' byte-identity and delivery are
+      already covered by bit_identity / no_lost_work.
+    """
+    d.extra_invariants.append("governor")
+    inj = d.injections_by_point()
+    ooms = inj.get("engine.oom", 0)
+    wedges = inj.get("engine.wedge", 0)
+    if ooms < 1:
+        d.violate(
+            "governor",
+            f"engine.oom never fired (injections: {inj}) — the OOM "
+            f"masking path was not exercised; pick a seed that reaches it",
+        )
+    if wedges < 1:
+        d.violate(
+            "governor",
+            f"engine.wedge never fired (injections: {inj}) — the wedge "
+            f"watchdog path was not exercised; pick a seed that reaches it",
+        )
+    restarts = fleet.supervisor.restarts()
+    sigkills = sum(1 for k in d.kills if k.get("worker"))
+    allowed = wedges + sigkills
+    if restarts > allowed:
+        d.violate(
+            "governor",
+            f"{restarts:g} worker restart(s) but only {allowed:g} are "
+            f"accounted for ({wedges:g} wedge fire(s) + {sigkills} "
+            f"SIGKILL(s)) — a fault the governor must MASK killed a worker",
+        )
+
+
+class _RecycleWatch:
+    """Background sampler of supervisor state: records every observed
+    unready-recycle — a worker leaving READY and coming back under a
+    BUMPED generation — with its wall-clock recovery time.  The governor
+    drill's wedge evidence: the watchdog flipped readyz, the supervisor
+    recycled, and how long the round trip took."""
+
+    def __init__(self, supervisor, on_down=None):
+        import threading
+
+        self.sup = supervisor
+        self.recycles: list[dict] = []
+        # fired ONCE, on the first ready->down transition observed: the
+        # governor drill disarms the wedge point in the inherited env
+        # spec here, so RESPAWNED workers come up clean — without it
+        # every fresh generation draws a fresh per-process schedule and
+        # the wedge refires forever (an unbounded recycle storm instead
+        # of one rescued wedge)
+        self.on_down = on_down
+        self._down_seen = False
+        self._stop = threading.Event()
+        self._t = threading.Thread(
+            target=self._run, name="drill-recycle-watch", daemon=True
+        )
+
+    def start(self):
+        self._t.start()
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=5)
+
+    def _run(self):
+        ready_gen: dict[str, int] = {}  # last generation observed READY
+        down: dict[str, tuple[float, int]] = {}  # name -> (since, gen then)
+        while not self._stop.wait(0.05):
+            try:
+                states = self.sup.states()
+                gens = {w.name: w.generation for w in self.sup.workers}
+            except Exception:  # noqa: BLE001 - sampling must not die
+                continue
+            now = time.monotonic()
+            for name, state in states.items():
+                gen = gens.get(name, 0)
+                if state == "ready":
+                    if name in down:
+                        since, gen0 = down.pop(name)
+                        if gen > gen0:  # came back as a NEW incarnation
+                            self.recycles.append(
+                                {
+                                    "worker": name,
+                                    "generation": gen,
+                                    "recovery_s": now - since,
+                                }
+                            )
+                    ready_gen[name] = gen
+                elif name in ready_gen and name not in down:
+                    down[name] = (now, ready_gen[name])
+                    if not self._down_seen:
+                        self._down_seen = True
+                        if self.on_down is not None:
+                            try:
+                                self.on_down()
+                            except Exception:  # noqa: BLE001
+                                log.exception("drill: on_down hook failed")
 
 
 def run_drill(cfg: DrillConfig) -> dict:
@@ -536,16 +669,22 @@ def run_drill(cfg: DrillConfig) -> dict:
     os.environ[chaos.ENV_VAR] = json.dumps(spec)  # workers inherit this
     chaos.arm(d.plan)  # this process: router/supervisor/migrator seams
     workdir = cfg.workdir
+    worker_args = [
+        "--serve-backend", cfg.backend,
+        "--capacity", str(cfg.capacity),
+        "--chunk-steps", str(cfg.chunk_steps),
+        "--max-queue", str(4 * (cfg.det_sessions + cfg.ising_sessions)),
+    ]
+    if cfg.governor:
+        # every worker runs the wedge watchdog: a wedged settle flips its
+        # /readyz to 500 engine_wedged, and the supervisor's existing
+        # unready-recycle + migration path is what the drill verifies
+        worker_args += ["--settle-deadline", str(cfg.settle_deadline_s)]
     fleet = Fleet(
         FleetConfig(
             workers=cfg.workers,
             port=0,
-            worker_args=(
-                "--serve-backend", cfg.backend,
-                "--capacity", str(cfg.capacity),
-                "--chunk-steps", str(cfg.chunk_steps),
-                "--max-queue", str(4 * (cfg.det_sessions + cfg.ising_sessions)),
-            ),
+            worker_args=tuple(worker_args),
             log_dir=os.path.join(workdir, "logs"),
             spill_dir=os.path.join(workdir, "spill"),
             spill_every=cfg.spill_every,
@@ -555,12 +694,37 @@ def run_drill(cfg: DrillConfig) -> dict:
         )
     )
     d.fleet = fleet
+
+    def _disarm_wedge_for_respawns() -> None:
+        # the wedge did its damage (a worker just left READY): strip
+        # engine.wedge from the INHERITED spec so respawned generations
+        # come up clean — each fresh process draws a fresh per-process
+        # schedule, and without this the wedge refires every generation
+        # (an unbounded recycle storm, not one rescued wedge).  The live
+        # processes' plans are untouched; only future spawns change.
+        healed = {
+            k: v
+            for k, v in spec.get("points", {}).items()
+            if k != "engine.wedge"
+        }
+        os.environ[chaos.ENV_VAR] = json.dumps(
+            {"seed": spec["seed"], "points": healed}
+        )
+        log.info("chaos drill: engine.wedge disarmed for respawns")
+
+    watch = (
+        _RecycleWatch(fleet.supervisor, on_down=_disarm_wedge_for_respawns)
+        if cfg.governor
+        else None
+    )
     try:
         fleet.start()
         if not fleet.wait_ready(timeout=120, min_workers=cfg.workers):
             raise RuntimeError(
                 f"fleet never became ready: {fleet.supervisor.states()}"
             )
+        if watch is not None:
+            watch.start()
         d.base_url = f"http://127.0.0.1:{fleet.port}"
         client = GatewayClient(d.base_url, retries=8)
         for item in d.items:
@@ -592,7 +756,12 @@ def run_drill(cfg: DrillConfig) -> dict:
                 )
         d._scrape_injections()
         d.check_metrics()
+        if cfg.governor:
+            d.recycles = list(watch.recycles)
+            _check_governor(d, fleet)
     finally:
+        if watch is not None:
+            watch.stop()
         try:
             fleet.begin_drain()
             fleet.wait(timeout=60)
@@ -613,7 +782,7 @@ def run_drill(cfg: DrillConfig) -> dict:
     ]
     done = outcomes.get("done", 0)
     summary = {
-        "kind": "chaos_drill",
+        "kind": "governor_drill" if cfg.governor else "chaos_drill",
         # the replay stamp (docs/CHAOS.md): seed + canonical plan + its
         # digest — a failed CI drill is rerun locally from exactly these
         "seed": cfg.seed,
@@ -621,6 +790,9 @@ def run_drill(cfg: DrillConfig) -> dict:
         "plan_digest": d.plan.digest(),
         "workers": cfg.workers,
         "kills": d.kills,
+        # governor mode: the wedge-recycle evidence (worker, successor
+        # generation, readyz-500 -> ready-again wall seconds)
+        **({"recycles": d.recycles} if cfg.governor else {}),
         "sessions": len(d.items),
         "accepted": d.accepted,
         "outcomes": outcomes,

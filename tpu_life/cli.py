@@ -15,6 +15,30 @@ import sys
 from tpu_life.config import RunConfig
 
 
+def _add_governor_args(p) -> None:
+    """The serve-tier resource-governor knobs (docs/SERVING.md "Resource
+    governance") — shared by every front that constructs a ServeConfig
+    (serve / sweep / gateway) and forwarded per worker by the fleet."""
+    p.add_argument(
+        "--memory-budget-bytes", type=int, default=None, metavar="BYTES",
+        help="admission memory budget for estimated engine footprints; a "
+             "CompileKey that would overflow it is a typed rejection "
+             "instead of a mid-round XLA OOM (default: devices x "
+             "per-kind default from device_info(); 0 disables)")
+    p.add_argument(
+        "--engine-max-restarts", type=int, default=3, metavar="N",
+        help="in-place engine recoveries per CompileKey (rebuild+replay, "
+             "OOM halve-chunk -> host-demotion ladder) before a chunk "
+             "fault falls back to the typed per-key failure (0 = pure "
+             "failure isolation)")
+    p.add_argument(
+        "--settle-deadline", type=float, default=None, metavar="SECONDS",
+        help="wedge watchdog: a pipelined settle window still blocked "
+             "after this many seconds marks the service wedged — "
+             "finishers salvaged, /readyz answers 500 engine_wedged so a "
+             "supervisor recycles the worker (default: off)")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu_life", description="TPU-native cellular-automaton framework"
@@ -174,6 +198,7 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--prom-file", default=None, metavar="FILE",
                      help="write a Prometheus text-exposition snapshot of "
                      "the serve metrics registry at shutdown")
+    _add_governor_args(srv)
     srv.add_argument("--platform", default=None,
                      help="force a JAX platform (cpu/tpu), like `run --platform`")
     srv.add_argument("--profile", default=None, metavar="TRACE_DIR")
@@ -225,6 +250,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="sweep on the int8 roll engines instead of the "
                     "default bitplane-packed Metropolis path — "
                     "bit-identical, the packed path's oracle")
+    _add_governor_args(sw)
     sw.add_argument("--output-dir", default=None, metavar="DIR",
                     help="also write each final lattice to "
                     "DIR/<session-id>.txt (contract board format)")
@@ -289,6 +315,7 @@ def build_parser() -> argparse.ArgumentParser:
                     "renewed lease, rebind the spill namespace per grant, "
                     "and on a lease_expired fence drop the re-homed "
                     "sessions and re-register fresh")
+    _add_governor_args(gw)
     gw.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
                     help="per-API-key token-bucket refill rate; 0 disables "
                     "rate limiting (the X-API-Key header names the key)")
@@ -373,6 +400,7 @@ def build_parser() -> argparse.ArgumentParser:
                     "--register); an un-renewed lease fires the same "
                     "migration a worker death does, then fences the "
                     "generation")
+    _add_governor_args(fl)
     fl.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline (per worker)")
     fl.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
@@ -481,6 +509,17 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--workdir", default=None, metavar="DIR",
                     help="where spill/ and logs/ land (default: a fresh "
                     "temp dir)")
+    ch.add_argument("--governor", action="store_true",
+                    help="the resource-governor drill (docs/SERVING.md "
+                         "'Resource governance'): arm engine.oom + "
+                         "engine.wedge, run workers with the wedge "
+                         "watchdog, and verify OOMs are MASKED (no worker "
+                         "death) while wedges are rescued via the "
+                         "unready-recycle + migration path")
+    ch.add_argument("--settle-deadline", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="worker wedge-watchdog deadline for --governor "
+                         "(forwarded as each worker's --settle-deadline)")
     ch.add_argument("--cross-host", action="store_true",
                     help="the two-control-plane drill (docs/FLEET.md "
                     "cross-host topology): two supervisors with disjoint "
@@ -1248,17 +1287,21 @@ def _serve(args) -> int:
             spill_dir=args.spill_dir,
             spill_every=args.spill_every,
             mc_packed=not args.no_bitpack,
+            memory_budget_bytes=args.memory_budget_bytes,
+            engine_max_restarts=args.engine_max_restarts,
+            settle_deadline_s=args.settle_deadline,
         )
     )
     # admit respecting backpressure: when the bounded queue fills, pump
     # until it drains enough to take the next request — the CLI is a
     # well-behaved client of its own service
-    from tpu_life.serve import QueueFull
+    from tpu_life.serve import InsufficientMemory, QueueFull
 
     from tpu_life import mc
     from tpu_life.models.rules import get_rule
 
     submitted: list[tuple[str, dict]] = []
+    rejected: list[dict] = []
     try:
         for i, req in enumerate(requests):
             if "input_file" in req:
@@ -1274,6 +1317,7 @@ def _serve(args) -> int:
                     states=get_rule(req.get("rule", "conway")).states,
                     seed=int(req.get("seed", 0)),
                 )
+            sid = None
             while True:
                 try:
                     sid = svc.submit(
@@ -1287,7 +1331,22 @@ def _serve(args) -> int:
                     break
                 except QueueFull:
                     svc.pump()
-            submitted.append((sid, req))
+                except InsufficientMemory as e:
+                    # the memory governor's typed rejection (docs/
+                    # SERVING.md "Resource governance"): requests are
+                    # independent — record this one's refusal in the
+                    # summary and keep serving the rest
+                    rejected.append(
+                        {
+                            "session": None,
+                            "id": req.get("id"),
+                            "state": "rejected",
+                            "error": f"{type(e).__name__}: {e}",
+                        }
+                    )
+                    break
+            if sid is not None:
+                submitted.append((sid, req))
         svc.drain()
     finally:
         # a failed serve still flushes its telemetry — trace buffer, prom
@@ -1296,7 +1355,7 @@ def _serve(args) -> int:
         svc.close()
 
     out_dir = Path(args.output_dir)
-    failures = []
+    failures = list(rejected)
     written = 0
     for sid, req in submitted:
         view = svc.poll(sid)
@@ -1379,7 +1438,13 @@ def _sweep(parser, args) -> int:
     from tpu_life import mc
     from tpu_life.models.rules import get_rule
     from tpu_life.runtime.metrics import configure_logging
-    from tpu_life.serve import QueueFull, ServeConfig, SessionState, SimulationService
+    from tpu_life.serve import (
+        InsufficientMemory,
+        QueueFull,
+        ServeConfig,
+        SessionState,
+        SimulationService,
+    )
 
     configure_logging(args.verbose)
     height = args.height if args.height is not None else args.size
@@ -1416,6 +1481,9 @@ def _sweep(parser, args) -> int:
             metrics=bool(args.metrics_file),
             metrics_file=args.metrics_file,
             mc_packed=not args.no_bitpack,
+            memory_budget_bytes=args.memory_budget_bytes,
+            engine_max_restarts=args.engine_max_restarts,
+            settle_deadline_s=args.settle_deadline,
         )
     )
     try:
@@ -1435,6 +1503,13 @@ def _sweep(parser, args) -> int:
                     break
                 except QueueFull:
                     svc.pump()
+                except InsufficientMemory as e:
+                    # the whole grid shares ONE CompileKey: if it cannot
+                    # fit the budget, no session of this sweep ever can —
+                    # a typed config refusal, before any work runs (the
+                    # finally below closes the service)
+                    print(f"sweep: {e}", file=sys.stderr)
+                    return 2
         svc.drain()
         # snapshot BEFORE close: close() releases idle engines, and the
         # summary's compile_counts (the one-compile sweep invariant CI
@@ -1523,6 +1598,9 @@ def _gateway(args) -> int:
                 spill_url=args.spill_url,
                 spill_namespace=args.spill_namespace,
                 mc_packed=not args.no_bitpack,
+                memory_budget_bytes=args.memory_budget_bytes,
+                engine_max_restarts=args.engine_max_restarts,
+                settle_deadline_s=args.settle_deadline,
             )
         )
     except ValueError as e:
@@ -1678,6 +1756,14 @@ def _fleet(args) -> int:
     ]
     if args.sync_pump:
         worker_args += ["--sync-pump"]
+    # the per-worker resource governor (docs/SERVING.md): each gateway
+    # worker enforces its own budget/restart/watchdog knobs
+    if args.memory_budget_bytes is not None:
+        worker_args += ["--memory-budget-bytes", str(args.memory_budget_bytes)]
+    if args.engine_max_restarts != 3:
+        worker_args += ["--engine-max-restarts", str(args.engine_max_restarts)]
+    if args.settle_deadline is not None:
+        worker_args += ["--settle-deadline", str(args.settle_deadline)]
     if args.timeout is not None:
         worker_args += ["--timeout", str(args.timeout)]
     if args.platform is not None:
@@ -1867,6 +1953,13 @@ def _chaos_drill(args) -> int:
             print(f"chaos: bad --plan: {e}", file=sys.stderr)
             return 2
     if args.cross_host:
+        if args.governor:
+            print(
+                "chaos: --governor and --cross-host are separate drills; "
+                "pick one",
+                file=sys.stderr,
+            )
+            return 2
         return _chaos_cross_host(args, points)
     cfg = DrillConfig(
         seed=args.seed,
@@ -1885,11 +1978,14 @@ def _chaos_drill(args) -> int:
         wait_timeout_s=args.wait_timeout,
         workdir=args.workdir or tempfile.mkdtemp(prefix="tpu-life-chaos-"),
         summary_file=args.summary_file,
+        governor=args.governor,
+        settle_deadline_s=args.settle_deadline,
     )
     print(
         json.dumps(
             {
                 "mode": "chaos",
+                "governor": cfg.governor,
                 "seed": cfg.seed,
                 "workers": cfg.workers,
                 "sessions": cfg.det_sessions + cfg.ising_sessions,
@@ -1902,9 +1998,10 @@ def _chaos_drill(args) -> int:
     summary = run_drill(cfg)
     print(json.dumps(summary), flush=True)
     if not summary["ok"]:
+        flag = " --governor" if cfg.governor else ""
         print(
             f"chaos: INVARIANT FAILURE — replay verbatim with: "
-            f"tpu-life chaos --seed {cfg.seed} "
+            f"tpu-life chaos{flag} --seed {cfg.seed} "
             f"(plan digest {summary['plan_digest']})",
             file=sys.stderr,
         )

@@ -159,6 +159,9 @@ class Fleet:
             # count (sums only when placement makes slices disjoint)
             "capacity": capacity,
             "devices_total": self.supervisor.devices_total(),
+            # typed probe-refusal reasons (docs/FLEET.md): why any
+            # in-flight unready-recycle fired (e.g. engine_wedged)
+            "unready_reasons": self.supervisor.unready_reasons(),
         }
         if self.migrator is not None:
             out["migrations"] = {

@@ -591,6 +591,10 @@ class _Handler(JsonHandler):
                     # device set and report its size, once)
                     "capacity": capacity,
                     "devices_total": rt.supervisor.devices_total(),
+                    # workers refusing their probe with a TYPED reason
+                    # (e.g. the serve wedge watchdog's engine_wedged) —
+                    # why an unready-recycle is in flight, not just that
+                    "unready_reasons": rt.supervisor.unready_reasons(),
                 },
             )
             return

@@ -167,6 +167,12 @@ class Worker:
     restart_at: float = 0.0
     failures: int = 0  # consecutive fast failures (breaker input)
     unready: int = 0  # consecutive failed probes while alive
+    #: machine-readable reason from the last 500 /readyz answer (e.g.
+    #: ``engine_wedged:settle_deadline`` from the serve wedge watchdog,
+    #: docs/SERVING.md "Resource governance") — surfaced in /healthz and
+    #: the fleet summary so an unready-recycle names WHY it fired; None
+    #: for plain unreachability, cleared on the next ready/draining probe
+    unready_reason: str | None = None
     log_offset: int = 0  # startup line scan starts here (per generation)
     exit_codes: list[int] = field(default_factory=list)
     #: placement env overlay applied at every spawn of this worker —
@@ -479,6 +485,17 @@ class Supervisor:
                 out[w.name] = st.value
             return out
 
+    def unready_reasons(self) -> dict[str, str]:
+        """Workers currently refusing their probe WITH a typed reason
+        (``code[:reason]``, e.g. ``engine_wedged:settle_deadline``) — the
+        why behind an in-flight unready-recycle (docs/FLEET.md)."""
+        with self._lock:
+            return {
+                w.name: w.unready_reason
+                for w in self.workers
+                if w.unready_reason is not None
+            }
+
     def capacities(self) -> dict:
         """Per-worker capacity view for ``/healthz`` / ``stats``: resolved
         (or planned) device count + kind, and the routing weight the
@@ -654,6 +671,7 @@ class Supervisor:
             w.state = WorkerState.READY
             w.ever_ready = True
             w.unready = 0
+            w.unready_reason = None
             if isinstance(info, dict) and info.get("devices"):
                 w.devices = int(info["devices"])
                 w.device_kind = info.get("device_kind") or w.device_kind
@@ -662,7 +680,15 @@ class Supervisor:
         elif status == "draining":
             w.state = WorkerState.DRAINING
             w.unready = 0
+            w.unready_reason = None
         else:  # unreachable
+            # a reasoned refusal (the worker answered 500 with a typed
+            # body — e.g. the serve wedge watchdog's engine_wedged) is
+            # still UNREACHABLE for recycle purposes, but the reason is
+            # retained so /healthz and the summary name why the recycle
+            # fired instead of showing an anonymous probe failure
+            if isinstance(info, dict) and info.get("unready_reason"):
+                w.unready_reason = str(info["unready_reason"])
             if w.state is WorkerState.STARTING:
                 if now - w.started_at > self.config.startup_timeout_s:
                     log.warning("fleet: %s never became ready; killing", w.name)
@@ -1109,9 +1135,33 @@ class Supervisor:
             # once the worker's async device resolution lands
             return ("ready", doc)
         except urllib.error.HTTPError as e:
-            return "draining" if e.code == 503 else "unreachable"
+            if e.code == 503:
+                return "draining"
+            reason = _unready_reason(e)
+            if reason:
+                # a TYPED refusal (the serve wedge watchdog's 500
+                # engine_wedged): unreachable for recycle purposes, but
+                # the machine-readable reason rides along
+                return ("unreachable", {"unready_reason": reason})
+            return "unreachable"
         except Exception:
             return "unreachable"
+
+
+def _unready_reason(e) -> str | None:
+    """``code[:reason]`` from a refused probe's JSON error envelope, or
+    None when the body is unreadable/untyped — reason extraction must
+    never turn a readable refusal into a probe crash."""
+    try:
+        doc = json.loads(e.read() or b"{}")
+        err = doc.get("error") or {}
+        code = err.get("code")
+        if not code:
+            return None
+        reason = err.get("reason")
+        return f"{code}:{reason}" if reason else str(code)
+    except Exception:
+        return None
 
 
 def _scrape_injection_series(url: str) -> dict[str, float] | None:

@@ -139,11 +139,30 @@ def from_serve_error(e: Exception) -> ApiError:
     """Serving-layer exception -> HTTP semantics (the one mapping table)."""
     from tpu_life.serve.errors import (
         Draining,
+        InsufficientMemory,
         QueueFull,
         SessionFailed,
         UnknownSession,
     )
 
+    if isinstance(e, InsufficientMemory):
+        # the memory governor (docs/SERVING.md "Resource governance"):
+        # transient pressure is a retryable 503 (other keys hold the
+        # budget — come back after they drain); a session whose engine
+        # can NEVER fit is a 413, not worth retrying.  One stable code
+        # either way; the status and the `transient` flag carry the
+        # retry semantics, the byte arithmetic rides in the extra.
+        extra = {
+            "transient": e.transient,
+            "estimated_bytes": e.estimated_bytes,
+            "budget_bytes": e.budget_bytes,
+        }
+        if e.transient:
+            return ApiError(
+                503, "insufficient_memory", str(e),
+                retry_after=1.0, extra=extra,
+            )
+        return ApiError(413, "insufficient_memory", str(e), extra=extra)
     if isinstance(e, QueueFull):
         # backpressure: the bounded admission queue is the hard backstop
         # behind the shed threshold — same retry contract, same status

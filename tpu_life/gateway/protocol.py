@@ -345,6 +345,11 @@ def render_view(view: SessionView) -> dict:
         out["packed"] = view.packed
         if view.lanes is not None:
             out["lanes"] = view.lanes
+    # the OOM fallback ladder's stamp (docs/SERVING.md "Resource
+    # governance") — present only when the session's CompileKey degraded
+    # to keep serving, so untouched sessions keep their exact prior shape
+    if view.degraded_reason is not None:
+        out["degraded_reason"] = view.degraded_reason
     return out
 
 

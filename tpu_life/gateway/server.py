@@ -461,6 +461,21 @@ class _Handler(JsonHandler):
             chaos.record_fire("worker.unready", "refuse")
             raise ApiError(500, "chaos_unready", "chaos: injected unready probe")
         svc = self.gw.service
+        wedged = getattr(svc, "wedged", None)
+        if wedged is not None:
+            # the wedge watchdog tripped (docs/SERVING.md "Resource
+            # governance"): a settle window outlived its deadline.  500
+            # with the machine-readable verdict — a supervisor probe
+            # reads "unreachable" (never the graceful "draining") and
+            # its unready-recycle + migration path rescues the sessions.
+            raise ApiError(
+                500,
+                "engine_wedged",
+                f"a device settle blocked past "
+                f"{wedged.get('deadline_s')}s; this worker must be "
+                f"recycled",
+                extra=wedged,
+            )
         if svc.draining:
             self._send_json(
                 503,

@@ -490,6 +490,7 @@ class MCVmapEngine(EngineBase):
 
     def settle(self) -> None:
         # wait for everything but the newest chunk (see VmapEngine.settle)
+        self._chaos_wedge()
         if self._prev is not None:
             import jax
 
@@ -500,8 +501,10 @@ class MCVmapEngine(EngineBase):
         # slot's board AND step counter are provably unchanged by the
         # in-flight chunk (fetch), and a stepped slot's pre-chunk state
         # pairs with peek_slot's lag — the stream position either implies
-        # is exact because the counter is a pure function of progress
-        if self._inflight and self._prev is not None:
+        # is exact because the counter is a pure function of progress.
+        # A LOST chunk (collect raised) reads _prev too: its output in
+        # _boards is unreachable, and salvage pairs _prev with the lag.
+        if (self._inflight or self._lost) and self._prev is not None:
             return np.asarray(self._prev[slot])
         return np.asarray(self._boards[slot])
 
@@ -640,7 +643,7 @@ class MCPackedVmapEngine(MCVmapEngine):
     def _peek_board(self, slot: int) -> np.ndarray:
         src = (
             self._prev
-            if (self._inflight and self._prev is not None)
+            if ((self._inflight or self._lost) and self._prev is not None)
             else self._boards
         )
         return packed_mod.unpack_board(

@@ -155,6 +155,12 @@ def _serve_summary(rounds: list[dict]) -> dict:
         out["steps_advanced_packed"] = packed
         total = out["steps_advanced"]
         out["packed_steps_fraction"] = packed / total if total else 0.0
+    # the governor stamp (ISSUE 13): chunk faults masked by in-place
+    # engine recovery — only when the sink carries it (newer runtimes)
+    if any("engine_recoveries" in r for r in rounds):
+        out["engine_recoveries"] = sum(
+            r.get("engine_recoveries", 0) for r in rounds
+        )
     return out
 
 
@@ -215,6 +221,13 @@ def _merge_serve(per_run: dict) -> dict:
         merged["spilled_sessions_max"] = max(
             s.get("spilled_sessions_max", 0) for s in summaries
         )
+    # masked chunk faults sum like the counts they are
+    recoveries = [
+        s["engine_recoveries"] for s in summaries
+        if "engine_recoveries" in s
+    ]
+    if recoveries:
+        merged["engine_recoveries"] = sum(recoveries)
     # packed attribution sums like the step counts it slices
     packed = [
         s["steps_advanced_packed"] for s in summaries
@@ -272,7 +285,15 @@ def summarize(records: list[dict]) -> dict:
         summary["metrics"] = []
         counters = {}
         devices_by_worker: dict = {}
+        budget_by_worker: dict = {}
         for rec in metrics:
+            if rec["metric"] == "serve_memory_budget_bytes" and rec.get("value"):
+                # same keying rule as serve_devices below: per sink (a
+                # worker's file spans its restarts — per-run_id summing
+                # would double-count dead generations), last snapshot wins
+                budget_by_worker[
+                    rec.get("_sink", rec.get("run_id"))
+                ] = rec["value"]
             if rec["metric"] == "serve_devices" and rec.get("value"):
                 # keyed by SINK when the loader stamped one (a fleet
                 # worker's file spans its restarts, each generation a
@@ -324,6 +345,27 @@ def summarize(records: list[dict]) -> dict:
         if submitted or rejected:
             summary.setdefault("serve", {})["rejection_rate"] = (
                 rejected / (submitted + rejected) if (submitted + rejected) else 0.0
+            )
+        # the governor families (ISSUE 13): in-place recoveries by ladder
+        # outcome and typed admission rejections by reason — summed across
+        # workers (fleet sinks), keyed by their one label
+        for family, out_key in (
+            ("serve_engine_recoveries_total", "engine_recoveries_by_outcome"),
+            ("serve_admission_rejected_total", "admission_rejected_by_reason"),
+        ):
+            by_label: dict = {}
+            for (name, labels_id, _), v in counters.items():
+                if name != family or not v:
+                    continue
+                label = labels_id.partition("=")[2] or "<none>"
+                by_label[label] = by_label.get(label, 0.0) + v
+            if by_label:
+                summary.setdefault("serve", {})[out_key] = by_label
+        if budget_by_worker:
+            # fleet budget = sum of the workers' budgets (each governs
+            # its own engines); a single sink reports its own value
+            summary.setdefault("serve", {})["memory_budget_bytes"] = int(
+                sum(budget_by_worker.values())
             )
         if devices_by_worker:
             # the fleet's aggregate device count: each worker snapshot
@@ -404,6 +446,23 @@ def render(summary: dict) -> str:
             )
         if "rejection_rate" in serve:
             lines.append(f"  rejection_rate={_fmt(serve['rejection_rate'])}")
+        if "engine_recoveries" in serve or "engine_recoveries_by_outcome" in serve:
+            by = serve.get("engine_recoveries_by_outcome") or {}
+            detail = " ".join(f"{k}={_fmt(v)}" for k, v in sorted(by.items()))
+            lines.append(
+                f"  engine_recoveries={_fmt(serve.get('engine_recoveries', sum(by.values())))}"
+                + (f"  ({detail})" if detail else "")
+            )
+        if "admission_rejected_by_reason" in serve:
+            detail = " ".join(
+                f"{k}={_fmt(v)}"
+                for k, v in sorted(serve["admission_rejected_by_reason"].items())
+            )
+            lines.append(f"  admission_rejected: {detail}")
+        if "memory_budget_bytes" in serve:
+            lines.append(
+                f"  memory_budget_bytes={_fmt(serve['memory_budget_bytes'])}"
+            )
         if "devices_total" in serve:
             lines.append(f"  devices_total={_fmt(serve['devices_total'])}")
     runs = summary.get("runs")

@@ -44,6 +44,22 @@ class InjectedFault(RuntimeError):
 RECOVERABLE: tuple[type[BaseException], ...] = (RuntimeError,)
 
 
+#: Message markers that identify a device out-of-memory among the
+#: RECOVERABLE family.  XLA surfaces OOM as an XlaRuntimeError whose
+#: message leads with the RESOURCE_EXHAUSTED status (TPU and GPU alike);
+#: the chaos ``engine.oom`` drill injects the same marker so the
+#: classifier exercised in tests is the one production runs.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory")
+
+
+def is_oom(e: BaseException) -> bool:
+    """True when a RECOVERABLE error is a device out-of-memory — the one
+    failure shape with its own recovery ladder (halve the chunk, then
+    demote to the host engine) instead of a plain rebuild-and-replay."""
+    msg = str(e)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
 def unwrap(runner):
     """The backend's own Runner behind a possible ``FaultingRunner`` proxy —
     for backend APIs that take their runner back (``write_runner_to_file``)."""

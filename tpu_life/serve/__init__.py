@@ -25,6 +25,7 @@ rules, and ``tpu-life serve`` / ``tpu-life submit`` for the CLI front-end.
 from tpu_life.serve.engine import CompileKey, compile_key_for, make_engine
 from tpu_life.serve.errors import (
     Draining,
+    InsufficientMemory,
     QueueFull,
     ServeError,
     SessionFailed,
@@ -38,6 +39,7 @@ from tpu_life.serve.sessions import Session, SessionState, SessionStore, Session
 __all__ = [
     "CompileKey",
     "Draining",
+    "InsufficientMemory",
     "QueueFull",
     "RoundStats",
     "Scheduler",

@@ -57,6 +57,7 @@ import numpy as np
 
 from tpu_life import chaos
 from tpu_life.models.rules import Rule
+from tpu_life.runtime.metrics import log
 
 
 @dataclass(frozen=True)
@@ -121,6 +122,13 @@ class EngineBase:
         self._remaining = np.zeros(capacity, dtype=np.int64)
         # the in-flight chunk's {slot: steps} accounting (empty = none)
         self._inflight: dict[int, int] = {}
+        # a LOST chunk's accounting: collect raised after the in-flight
+        # map was already cleared, so these steps are accounted to the
+        # sessions but their results are unreachable — the in-place
+        # recovery (scheduler.recover_engine) reads this to rewind each
+        # session to its newest materialized state.  Empty outside the
+        # window between a collect fault and the engine's replacement.
+        self._lost: dict[int, int] = {}
         # set by the service while this engine settles OUTSIDE the lock:
         # verb-triggered slot releases must defer to the pump meanwhile
         self.busy = False
@@ -145,6 +153,7 @@ class EngineBase:
         session is about to be (or already was) loaded into."""
         self._remaining[slot] = 0
         self._inflight.pop(slot, None)
+        self._lost.pop(slot, None)
         self._clear_slot(slot)
         self._free.append(slot)
 
@@ -203,12 +212,15 @@ class EngineBase:
             if r > 0
         }
         if advanced:
-            # chaos seam: a launch-time device fault.  Raised BEFORE any
-            # state moves, so the engine stays consistent (nothing in
-            # flight, remaining untouched) and the scheduler's
-            # RECOVERABLE handling fails this key's sessions while every
-            # other key keeps stepping (per-key isolation).
+            # chaos seams: a launch-time device fault, and a launch-time
+            # RESOURCE_EXHAUSTED (the OOM drill: first-compile of a new
+            # key, or a neighbor key ballooning the heap).  Both raised
+            # BEFORE any state moves, so the engine stays consistent
+            # (nothing new in flight, remaining untouched) and the
+            # scheduler's RECOVERABLE handling recovers this key in
+            # place while every other key keeps stepping.
             chaos.inject("engine.dispatch")
+            chaos.inject("engine.oom")
             now = time.monotonic()
             if self._idle_since is not None:
                 self.idle_seconds += now - self._idle_since
@@ -224,15 +236,39 @@ class EngineBase:
         any slot reflects the chunk."""
         adv, self._inflight = self._inflight, {}
         if adv:
-            # chaos seam: the chunk's materialization fails (a device
-            # reset mid-chunk).  The in-flight accounting is already
-            # cleared, so the handler's slot releases leave the engine
-            # re-dispatchable; the chunk's results are simply lost and
-            # its sessions fail typed (per-key isolation again).
-            chaos.inject("engine.collect")
-            self._collect_impl(adv)
+            self._chaos_wedge()
+            try:
+                # chaos seam: the chunk's materialization fails (a device
+                # reset mid-chunk).  The in-flight accounting is already
+                # cleared, so the handler's slot releases leave the
+                # engine re-dispatchable; the chunk's accounting lands in
+                # ``_lost`` so in-place recovery can rewind its sessions
+                # to their newest materialized state (per-key isolation).
+                chaos.inject("engine.collect")
+                self._collect_impl(adv)
+            except BaseException:
+                for slot, n in adv.items():
+                    self._lost[slot] = self._lost.get(slot, 0) + n
+                raise
             self._idle_since = time.monotonic()
         return adv
+
+    def clear_lost(self) -> None:
+        """Forget a lost chunk's accounting — the typed-failure path has
+        released (or retired) its sessions, and a stale entry would
+        misroute later peeks to the double buffer."""
+        self._lost.clear()
+
+    def _chaos_wedge(self) -> None:
+        # chaos seam: a wedged grant — the chunk wait stalls instead of
+        # raising (the real-TPU probe-hang mode, docs/CHAOS.md).  Fired
+        # from collect AND the device settle paths, i.e. wherever the
+        # pipelined pump's unlocked window actually blocks — which is
+        # what the service's settle-deadline watchdog exists to catch.
+        hang = chaos.delay("engine.wedge")
+        if hang > 0:
+            log.warning("chaos: engine wedging %.1fs (engine.wedge)", hang)
+            time.sleep(hang)
 
     def settle(self) -> None:
         """Finish enough in-flight work that ``fetch()`` of *frozen*
@@ -281,6 +317,21 @@ class EngineBase:
         board with the returned lag instead of requiring lag zero.
         """
         return self._peek_board(slot), self._inflight.get(slot, 0)
+
+    def salvage_slot(self, slot: int) -> tuple[np.ndarray, int]:
+        """After a chunk-level fault: the newest *trustworthy* board for
+        a resident slot, plus how many already-accounted steps it lags
+        the session bookkeeping — the in-flight chunk's steps (if any is
+        still flying) plus a LOST chunk's (collect raised after clearing
+        the in-flight map).  The in-place recovery path
+        (``scheduler.recover_engine``) rewinds each session by this lag
+        and replays the difference on a rebuilt engine, so a device
+        fault costs a re-run of at most two chunks — never a session.
+        Materializing the board may itself raise RECOVERABLE (a poisoned
+        device buffer): that session is genuinely unrecoverable and the
+        caller fails it typed."""
+        lag = self._inflight.get(slot, 0) + self._lost.get(slot, 0)
+        return self._peek_board(slot), lag
 
     def _peek_board(self, slot: int) -> np.ndarray:
         raise NotImplementedError
@@ -421,6 +472,7 @@ class VmapEngine(EngineBase):
         # input, i.e. the previous chunk's output — once it is ready, every
         # frozen slot fetches without blocking, and the host can never run
         # more than one chunk ahead of the device
+        self._chaos_wedge()
         if self._prev is not None:
             import jax
 
@@ -432,8 +484,11 @@ class VmapEngine(EngineBase):
         # mask provably leaves it untouched) has the same value in the
         # chunk INPUT as in its output, so fetch reads here instead of
         # blocking on the newest chunk; a slot the chunk IS stepping reads
-        # its pre-chunk state — peek_slot's lag accounts for it
-        if self._inflight and self._prev is not None:
+        # its pre-chunk state — peek_slot's lag accounts for it.  A LOST
+        # chunk (collect raised) reads the same way: _prev is the dead
+        # chunk's input and _boards its unreachable output, so salvage
+        # must read _prev too.
+        if (self._inflight or self._lost) and self._prev is not None:
             return np.asarray(self._prev[slot])
         return np.asarray(self._boards[slot])
 
@@ -508,6 +563,22 @@ class SlotLoopEngine(EngineBase):
 
     def _peek_board(self, slot: int) -> np.ndarray:
         return np.asarray(self._runners[slot].fetch())
+
+
+def make_host_engine(key: CompileKey, capacity: int, chunk_steps: int) -> EngineBase:
+    """The key's host-executor twin — the bottom rung of the OOM
+    recovery ladder (docs/SERVING.md "Resource governance"): when a
+    device engine OOMs even at a halved chunk, the scheduler demotes
+    the key to the bit-identical host executor (``HostBatchEngine`` /
+    ``MCHostEngine``) so its sessions *finish*, slower, instead of
+    failing typed.  Bit-identity is the ground-truth contract these
+    executors already carry — the equivalence suites pin the device
+    engines against exactly them."""
+    if getattr(key.rule, "stochastic", False):
+        from tpu_life.mc.engine import MCHostEngine
+
+        return MCHostEngine(key, capacity, chunk_steps)
+    return HostBatchEngine(key, capacity, chunk_steps)
 
 
 def make_engine(

@@ -34,6 +34,36 @@ class Draining(ServeError):
     """
 
 
+class InsufficientMemory(ServeError):
+    """Admission-time memory governance (docs/SERVING.md "Resource
+    governance"): admitting this session's CompileKey would push the
+    estimated engine footprint past ``ServeConfig.memory_budget_bytes``.
+
+    Raised by ``submit`` *synchronously* — nothing is stored, so an XLA
+    ``RESOURCE_EXHAUSTED`` at engine build time becomes a rejected
+    request instead of a dead worker.  ``transient`` is the retry
+    contract: True means the key would fit on an otherwise-idle service
+    (other keys' engines are holding the budget — retry after they
+    drain, HTTP 503 + Retry-After); False means this single session's
+    engine alone can never fit the budget (HTTP 413, never retried).
+    ``estimated_bytes`` / ``budget_bytes`` carry the arithmetic so
+    clients and tests can see exactly what was refused.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        transient: bool,
+        estimated_bytes: int,
+        budget_bytes: int,
+    ):
+        super().__init__(message)
+        self.transient = transient
+        self.estimated_bytes = estimated_bytes
+        self.budget_bytes = budget_bytes
+
+
 class SessionTimeout(ServeError):
     """A session exceeded its per-request deadline.
 

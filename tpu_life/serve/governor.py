@@ -1,0 +1,198 @@
+"""Admission-time memory governance: the serve tier's byte accountant.
+
+The realistic first OOM in a multi-tenant batched service is not a slot
+— it is a **CompileKey**: every new (rule, shape, dtype, backend) mints
+a fresh engine holding a ``(capacity, h, w)`` board batch, its double
+buffer (the device executors retain the in-flight chunk's input batch),
+and the stochastic tier's per-slot carry words.  Nothing bounded that
+sum: a client fanning out varied geometries would grow device memory
+until XLA raised ``RESOURCE_EXHAUSTED`` mid-round and killed the whole
+worker.  This module makes the footprint a *number checked at submit*:
+
+- :func:`estimate_engine_bytes` — the per-CompileKey estimator, pure
+  arithmetic over the engine layouts (``serve.engine`` /
+  ``mc.engine``): board batch x double buffer on the device executors,
+  the MC key/counter/threshold carries, and the bitplane-packed lane
+  layout (uint32 words of 32 spins) when the key would take the packed
+  engine;
+- :func:`resolve_budget` — ``ServeConfig.memory_budget_bytes`` or, when
+  unset, a per-device default derived from ``utils.platform.
+  device_info()`` (memoized; the probe is bounded so a wedged
+  accelerator degrades the default, never hangs construction);
+- :func:`check_admission` — the submit-time verdict: an existing key
+  admits for free (its slots are preallocated), a new key must fit next
+  to every *reserved* key (live engines plus the keys of queued
+  sessions), and the failure is the typed
+  :class:`~tpu_life.serve.errors.InsufficientMemory` — ``transient``
+  when the key would fit alone (503 + Retry-After at the gateway),
+  permanent when it can never fit (413).
+
+The estimate is deliberately a **floor with the dominant terms only**
+(boards dominate: the per-slot aux vectors are O(capacity) words).  It
+exists to turn "the worker died mid-round" into "the request was
+refused typed"; the in-place recovery ladder (``scheduler.
+recover_engine``) catches whatever slips past the estimate.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from tpu_life.serve.errors import InsufficientMemory
+
+#: Default budget per resolved device, by platform kind.  Deliberately
+#: conservative for accelerators (the smallest deployed HBM of the
+#: family) and generous-but-bounded for hosts; override with
+#: ``ServeConfig.memory_budget_bytes`` (or the CLI flags) when the real
+#: capacity is known.  ``<= 0`` disables accounting entirely.
+GIB = 1 << 30
+DEFAULT_BYTES_PER_DEVICE: dict[str, int] = {
+    "tpu": 8 * GIB,
+    "gpu": 8 * GIB,
+    "cuda": 8 * GIB,
+    "rocm": 8 * GIB,
+    "cpu": 2 * GIB,
+    "host": 2 * GIB,
+}
+
+#: Bound on the one-time device probe the default-budget path runs: a
+#: wedged accelerator plugin must degrade the default (1 device, host
+#: rate), never stall service construction toward a supervisor timeout.
+BUDGET_PROBE_TIMEOUT_S = float(os.environ.get("TPU_LIFE_BUDGET_PROBE_S", 10.0))
+
+_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_BUDGET: int | None = None
+
+
+def estimate_engine_bytes(key, capacity: int, *, mc_packed: bool = True) -> int:
+    """Estimated resident bytes of the engine ``key`` would mint.
+
+    Pure arithmetic — no engine is built, no device touched — so the
+    admission check costs nanoseconds.  Terms, matching the executor
+    layouts in ``serve.engine`` / ``mc.engine``:
+
+    - board batch: ``capacity x h x w`` int8, or ``capacity x h x
+      packed_width(w)`` uint32 on the bitplane-packed stochastic path
+      (32 spins per lane — 8x fewer bytes, the packed tier's whole
+      point);
+    - double buffer: the device (jax) executors retain the in-flight
+      chunk's input batch (``_prev``), so the board term doubles there;
+      host executors hold one copy;
+    - MC carries: per-slot key halves + absolute step counter (3 x
+      uint32) and the uint32[5] acceptance table, plus the shared int32
+      remaining vector.
+    """
+    h, w = key.shape
+    stochastic = bool(getattr(key.rule, "stochastic", False))
+    packed = False
+    if stochastic and key.backend == "jax" and mc_packed:
+        from tpu_life.mc import packed_supports
+
+        packed = packed_supports(key.rule)
+    if packed:
+        from tpu_life.mc.packed import packed_width
+
+        board_bytes = capacity * h * packed_width(w) * 4
+    else:
+        board_bytes = capacity * h * w  # int8
+    copies = 2 if key.backend == "jax" else 1  # the double buffer
+    total = board_bytes * copies
+    total += capacity * 4  # the remaining-steps vector (int32)
+    if stochastic:
+        total += capacity * 4 * 3  # k0 / k1 / absolute step counter
+        total += capacity * 4 * 5  # the uint32[5] acceptance table
+    return total
+
+
+def default_budget() -> int:
+    """The derived budget: ``devices x DEFAULT_BYTES_PER_DEVICE[kind]``,
+    resolved once per process through the watchdogged device probe
+    (``utils.platform.device_info``) and memoized — a wedged plugin
+    costs one bounded wait, then every later service construction is
+    free."""
+    global _DEFAULT_BUDGET
+    with _DEFAULT_LOCK:
+        if _DEFAULT_BUDGET is None:
+            from tpu_life.utils.platform import device_info
+
+            devices, kind = device_info(timeout_s=BUDGET_PROBE_TIMEOUT_S)
+            per = DEFAULT_BYTES_PER_DEVICE.get(kind, DEFAULT_BYTES_PER_DEVICE["host"])
+            _DEFAULT_BUDGET = max(1, devices) * per
+        return _DEFAULT_BUDGET
+
+
+def resolve_budget(configured: int | None) -> int | None:
+    """``ServeConfig.memory_budget_bytes`` -> the effective budget.
+
+    ``None`` derives the per-device default; ``<= 0`` is the explicit
+    opt-out (accounting disabled, returned as None)."""
+    if configured is None:
+        return default_budget()
+    configured = int(configured)
+    return configured if configured > 0 else None
+
+
+def check_admission(
+    key,
+    reserved: dict,
+    budget: int | None,
+    capacity: int,
+    *,
+    mc_packed: bool = True,
+) -> None:
+    """Raise :class:`InsufficientMemory` when admitting a session of
+    ``key`` would overflow ``budget``.
+
+    ``reserved`` maps every key already holding (or about to hold) an
+    engine — live engines plus the distinct keys of queued sessions —
+    to its estimated bytes.  A key already reserved admits for free:
+    its batch is preallocated and a new session only occupies an
+    existing slot.
+    """
+    if budget is None or key in reserved:
+        return
+    need = estimate_engine_bytes(key, capacity, mc_packed=mc_packed)
+    if need > budget:
+        raise InsufficientMemory(
+            f"session's engine needs ~{need} bytes "
+            f"(capacity {capacity}, shape {key.shape[0]}x{key.shape[1]}, "
+            f"backend {key.backend}) but the memory budget is {budget} "
+            f"bytes — it can never fit; shrink the board or raise "
+            f"--memory-budget-bytes",
+            transient=False,
+            estimated_bytes=need,
+            budget_bytes=budget,
+        )
+    held = sum(reserved.values())
+    if held + need > budget:
+        raise InsufficientMemory(
+            f"admitting this CompileKey needs ~{need} bytes but "
+            f"{held} of the {budget}-byte budget is held by "
+            f"{len(reserved)} resident key(s); retry after they drain "
+            f"(or release_idle_engines)",
+            transient=True,
+            estimated_bytes=need,
+            budget_bytes=budget,
+        )
+
+
+def reserved_bytes(
+    engines: dict, queued_keys, capacity: int, *, mc_packed: bool = True
+) -> dict:
+    """The reserved-key map :func:`check_admission` consumes: every live
+    engine's key plus every distinct CompileKey waiting in the queue
+    (its engine will be minted at admit), each at its estimate."""
+    out = {}
+    for key in engines:
+        out[key] = estimate_engine_bytes(key, capacity, mc_packed=mc_packed)
+    for key in queued_keys:
+        if key not in out:
+            out[key] = estimate_engine_bytes(key, capacity, mc_packed=mc_packed)
+    return out
+
+
+def _reset_default_budget_for_tests() -> None:
+    global _DEFAULT_BUDGET
+    with _DEFAULT_LOCK:
+        _DEFAULT_BUDGET = None

@@ -53,7 +53,12 @@ from dataclasses import dataclass, field
 from tpu_life import obs
 from tpu_life.runtime import recovery
 from tpu_life.runtime.metrics import log
-from tpu_life.serve.engine import CompileKey, EngineBase, make_engine
+from tpu_life.serve.engine import (
+    CompileKey,
+    EngineBase,
+    make_engine,
+    make_host_engine,
+)
 from tpu_life.serve.errors import QueueFull, SessionTimeout
 from tpu_life.serve.sessions import Session, SessionState
 
@@ -66,6 +71,9 @@ class RoundStats:
     completed: int = 0
     failed: int = 0
     evicted: int = 0
+    # in-place engine recoveries this round (docs/SERVING.md "Resource
+    # governance"): a chunk fault that was masked instead of failing a key
+    engine_recoveries: int = 0
     steps_advanced: int = 0
     # the slice of steps_advanced run by bitplane-packed engines — the
     # per-round attribution `tpu-life stats` splits throughput on
@@ -83,6 +91,11 @@ class Scheduler:
     # the stochastic tier's bitplane knob (ServeConfig.mc_packed): ising
     # batches run on the packed device engine unless pinned off
     mc_packed: bool = True
+    # in-place recovery budget (docs/SERVING.md "Resource governance"):
+    # how many chunk-level RECOVERABLE faults per CompileKey are masked
+    # by rebuild-and-replay before falling back to the typed failure.
+    # 0 restores the pure failure-isolating behavior.
+    engine_max_restarts: int = 3
     clock: object = time.monotonic
 
     queue: deque = field(default_factory=deque)
@@ -103,6 +116,14 @@ class Scheduler:
     # the key-rotation cursor for round-robin dispatch order
     deferred: list = field(default_factory=list)
     _rotation: int = 0
+    # the in-place recovery ladder's per-key state (docs/SERVING.md
+    # "Resource governance"): recoveries consumed, a halved chunk size
+    # (the first OOM rung), keys demoted to the host executor (the
+    # second), and the degraded_reason stamped onto their sessions
+    restarts: dict = field(default_factory=dict)
+    chunk_override: dict = field(default_factory=dict)
+    demoted: set = field(default_factory=set)
+    degraded: dict = field(default_factory=dict)
 
     # -- ingestion ---------------------------------------------------------
     def ensure_admission(self) -> None:
@@ -214,10 +235,23 @@ class Scheduler:
             key = keyer(s)
             engine = self.engines.get(key)
             if engine is None:
-                engine = self.engines[key] = make_engine(
-                    key, self.capacity, self.chunk_steps,
-                    mc_packed=self.mc_packed,
-                )
+                try:
+                    engine = self._build_engine(key)
+                except recovery.RECOVERABLE as e:
+                    # an engine build that OOMs (device_put of the batch,
+                    # a first allocation) must fail only this key's
+                    # admit, typed — never escape into the pump.  Later
+                    # queued sessions of the same key each retry (and
+                    # fail) their own admit.
+                    s.fail(f"engine build failed: {type(e).__name__}: {e}")
+                    self._notify_finished(s)
+                    stats.failed += 1
+                    log.warning(
+                        "serve: engine build for %r failed at admit: %s",
+                        key, e,
+                    )
+                    continue
+                self.engines[key] = engine
                 self.running[key] = {}
             slot = engine.acquire()
             if slot is None:
@@ -252,6 +286,11 @@ class Scheduler:
             # steps this session — echoed in views and round attribution
             s.packed = engine.packed
             s.lanes = engine.lanes
+            # a key degraded by the OOM ladder stamps every later tenant
+            # too: the operator sees WHICH sessions ran on the fallback
+            reason = self.degraded.get(key)
+            if reason is not None:
+                s.degraded_reason = reason
             s.admitted_at = self.clock()
             if self.observer is not None:
                 self.observer.session_admitted(
@@ -279,6 +318,166 @@ class Scheduler:
             self._notify_finished(s)
             stats.failed += 1
             log.info("serve: session %s failed in slot %d: %s", s.sid, slot, e)
+
+    def _build_engine(self, key) -> EngineBase:
+        """The key's engine, honoring the recovery ladder's per-key state:
+        a halved chunk after the first OOM, the host executor after the
+        second — so a rebuilt (or re-minted, after release_idle_engines)
+        engine for a degraded key stays degraded instead of re-OOMing."""
+        chunk = self.chunk_override.get(key, self.chunk_steps)
+        if key in self.demoted:
+            return make_host_engine(key, self.capacity, chunk)
+        return make_engine(key, self.capacity, chunk, mc_packed=self.mc_packed)
+
+    def _notify_recovery(self, key, outcome: str) -> None:
+        hook = getattr(self.observer, "engine_recovered", None)
+        if hook is not None:
+            hook(key, outcome)
+
+    def recover_engine(self, key, exc, stats: RoundStats | None = None) -> bool:
+        """In-place engine recovery after a chunk-level RECOVERABLE fault
+        (docs/SERVING.md "Resource governance"): instead of failing the
+        key's tenants typed, rebuild the engine and replay.
+
+        Every resident session's newest *materialized* state is salvaged
+        (``engine.salvage_slot``: the double buffer plus the in-flight /
+        lost chunk's lag), its bookkeeping rewound by the lag, and the
+        session reloaded into a fresh engine at the exact absolute
+        position (``start_step + steps_done`` — the counter-based MC
+        streams re-enter bit-identically, deterministic rules are pure
+        functions of the board, and chunk invariance is already proven).
+        A session whose compute is both finished AND materialized
+        retires DONE right here.  A device OOM takes the **fallback
+        ladder**: the first OOM halves the key's chunk (smaller scan
+        footprint, same trajectory), a second demotes the key to the
+        bit-identical host executor; both stamp ``degraded_reason`` on
+        the key's sessions.  ``engine_max_restarts`` bounds recoveries
+        per key — past it (or with the budget set to 0) the fault falls
+        back to today's typed failure.  Returns True when the key was
+        recovered in place."""
+        stats = stats if stats is not None else RoundStats()
+        error = f"{type(exc).__name__}: {exc}"
+        engine = self.engines.get(key)
+        slots = self.running.get(key)
+        if engine is None or slots is None:
+            return False
+        used = self.restarts.get(key, 0) + 1
+        self.restarts[key] = used
+        if used > self.engine_max_restarts:
+            self._notify_recovery(key, "budget_exhausted")
+            self.fail_engine_sessions(key, error, stats)
+            return False
+        outcome = "replayed"
+        if recovery.is_oom(exc) and key not in self.demoted:
+            if key in self.chunk_override:
+                # the halved chunk still OOMed: demote to the host twin —
+                # sessions finish (slower) instead of failing typed
+                self.demoted.add(key)
+                outcome = "oom_host_demoted"
+            else:
+                self.chunk_override[key] = max(1, engine.chunk_steps // 2)
+                outcome = "oom_halved_chunk"
+            self.degraded[key] = outcome
+        # salvage each resident session's newest trustworthy state; a
+        # slot whose board cannot materialize (poisoned device buffer)
+        # is genuinely lost and fails typed like before
+        salvaged: list = []
+        lost = 0
+        for slot, s in list(slots.items()):
+            del slots[slot]
+            try:
+                board, lag = engine.salvage_slot(slot)
+            except recovery.RECOVERABLE as e2:
+                s.fail(
+                    f"salvage failed: {error} "
+                    f"(then {type(e2).__name__}: {e2})"
+                )
+                self._notify_finished(s)
+                stats.failed += 1
+                lost += 1
+                continue
+            salvaged.append((s, board, lag))
+        # condemn the old engine with its per-key transient state; parked
+        # releases for this key are for already-evicted sessions — moot
+        # against a fresh engine's clean slot pool
+        self.pending.pop(key, None)
+        self._fresh.pop(key, None)
+        self.deferred = [(k, sl) for (k, sl) in self.deferred if k != key]
+        try:
+            new_engine = self._build_engine(key)
+        except recovery.RECOVERABLE as e2:
+            # the rebuild itself failed — e.g. the replacement batch
+            # allocation OOMs while the condemned engine's buffers are
+            # still alive.  The recovery path must NEVER let that escape
+            # into the pump (it would kill the worker the governor
+            # exists to keep alive): the salvaged sessions fall back to
+            # the typed failure, the old engine stays registered for
+            # future admissions (its slots are all free), and its lost
+            # accounting is cleared like any typed-failure path.
+            for s, _board, _lag in salvaged:
+                s.fail(
+                    f"recovery rebuild failed: {error} "
+                    f"(then {type(e2).__name__}: {e2})"
+                )
+                self._notify_finished(s)
+                stats.failed += 1
+            engine.clear_lost()
+            self._notify_recovery(key, "rebuild_failed")
+            log.error(
+                "serve: engine %r recovery REBUILD failed (%s after %s); "
+                "%d session(s) failed typed",
+                key, e2, error, len(salvaged),
+            )
+            return False
+        self.engines[key] = new_engine
+        reason = self.degraded.get(key)
+        reloaded = retired = 0
+        for s, board, lag in salvaged:
+            # rewind to the materialized step: the lag steps were
+            # accounted at dispatch but never materialized — the rebuilt
+            # engine re-runs exactly them
+            s.steps_done -= lag
+            if reason is not None:
+                s.degraded_reason = reason
+            if s.steps_remaining == 0:
+                # finished AND materialized (a pending finisher with zero
+                # lag): its board is final — retire it DONE, the outcome
+                # the sync pump already settled a round earlier
+                s.finish(board)
+                self._notify_finished(s)
+                stats.completed += 1
+                retired += 1
+                continue
+            slot = new_engine.acquire()
+            try:
+                new_engine.load(
+                    slot,
+                    board,
+                    s.steps_remaining,
+                    seed=s.seed,
+                    temperature=s.temperature,
+                    start_step=s.start_step + s.steps_done,
+                )
+            except recovery.RECOVERABLE as e2:
+                new_engine.release(slot)
+                s.fail(f"recovery reload failed: {type(e2).__name__}: {e2}")
+                self._notify_finished(s)
+                stats.failed += 1
+                continue
+            s.slot = slot
+            s.packed = new_engine.packed
+            s.lanes = new_engine.lanes
+            slots[slot] = s
+            reloaded += 1
+        stats.engine_recoveries += 1
+        self._notify_recovery(key, outcome)
+        log.warning(
+            "serve: engine %r recovered in place (%s, attempt %d/%d): "
+            "%d session(s) replaying, %d retired, %d unsalvageable — %s",
+            key, outcome, used, self.engine_max_restarts,
+            reloaded, retired, lost, error,
+        )
+        return True
 
     def fail_engine_sessions(
         self, key, error: str, stats: RoundStats | None = None
@@ -323,6 +522,10 @@ class Scheduler:
             failed += 1
         self.pending.pop(key, None)
         self._fresh.pop(key, None)
+        if engine is not None:
+            # a lost chunk's accounting dies with its sessions: a stale
+            # entry would misroute later peeks to the double buffer
+            engine.clear_lost()
         stats.failed += failed
         if failed or salvage:
             log.warning(
@@ -362,16 +565,20 @@ class Scheduler:
                 "serve.step-chunk", occupied=len(slots), steps=engine.chunk_steps
             ):
                 try:
-                    advanced = engine.advance_chunk()
+                    advanced = engine.dispatch_chunk()
                 except recovery.RECOVERABLE as e:
                     # a chunk-level device fault (the chaos engine.* drill,
-                    # or any real launch/materialize failure): this key's
-                    # tenants fail typed, the other keys' batches continue
-                    self.fail_engine_sessions(
-                        key, f"{type(e).__name__}: {e}", stats
-                    )
+                    # or any real launch/materialize failure): recovered
+                    # IN PLACE — rebuild + replay under the restart
+                    # budget, the OOM ladder when applicable — while the
+                    # other keys' batches continue untouched; only an
+                    # exhausted budget falls back to the typed failure
+                    self.recover_engine(key, e, stats)
                     continue
-            with obs.span("serve.retire"):
+                # account the dispatched steps BEFORE the collect — the
+                # same order the pipelined pump uses — so a collect
+                # fault's lost-chunk lag (engine.salvage_slot) rewinds
+                # exactly what was accounted, under either pump
                 for slot, n in advanced.items():
                     s = slots.get(slot)
                     if s is None:
@@ -380,6 +587,13 @@ class Scheduler:
                     stats.steps_advanced += n
                     if engine.packed:
                         stats.steps_advanced_packed += n
+                try:
+                    engine.collect_chunk()
+                except recovery.RECOVERABLE as e:
+                    self.recover_engine(key, e, stats)
+                    continue
+            with obs.span("serve.retire"):
+                for slot, s in list(slots.items()):
                     if s.steps_remaining == 0:
                         self._retire_slot(engine, slots, slot, s, stats)
 
@@ -424,6 +638,11 @@ class Scheduler:
                 rolled = self._dispatch_engine(
                     key, engine, slots, stats, self._fresh
                 )
+                # a dispatch fault recovered in place replaces the key's
+                # engine: the settle plan must carry the LIVE engine, not
+                # the condemned one (settling a condemned engine would
+                # re-raise and burn another recovery)
+                engine = self.engines[key]
             plan.append((key, engine, rolled))
         stats.queue_depth = len(self.queue)
         return plan
@@ -446,10 +665,12 @@ class Scheduler:
             try:
                 advanced = engine.dispatch_chunk()
             except recovery.RECOVERABLE as e:
-                # launch-time fault: nothing is in flight (the engine
-                # raises before any state moves), so failing this key's
-                # residents leaves the engine clean for new admissions
-                self.fail_engine_sessions(key, f"{type(e).__name__}: {e}", stats)
+                # launch-time fault — including the realistic first-
+                # compile OOM of a brand-new key, raised HERE inside the
+                # locked begin phase: recovered in place (rebuild +
+                # replay, OOM ladder), never escaping into the pump; an
+                # exhausted budget falls back to the typed failure
+                self.recover_engine(key, e, stats)
                 return False
         if not advanced:
             return False

@@ -71,7 +71,7 @@ from tpu_life.runtime.checkpoint import atomic_publish as ckpt_atomic_publish
 from tpu_life.runtime.metrics import MetricsRecorder, log
 from tpu_life.runtime.profiling import maybe_profile
 from tpu_life.serve.engine import CompileKey, compile_key_for
-from tpu_life.serve.errors import Draining, QueueFull
+from tpu_life.serve.errors import Draining, InsufficientMemory, QueueFull
 from tpu_life.serve.scheduler import RoundStats, Scheduler
 from tpu_life.serve.sessions import (
     SessionState,
@@ -122,6 +122,24 @@ class ServeConfig:
     # (--no-bitpack) pins the int8 roll engines — the oracle
     # configuration the packed path is byte-compared against in CI.
     mc_packed: bool = True
+    # the resource governor (docs/SERVING.md "Resource governance"):
+    # admission-time memory budget for the estimated engine footprint.
+    # None derives devices x per-kind default from device_info(); <= 0
+    # disables accounting.  A submit whose CompileKey would overflow it
+    # raises the typed InsufficientMemory instead of letting XLA OOM
+    # kill the worker mid-round.
+    memory_budget_bytes: int | None = None
+    # in-place recovery budget per CompileKey: chunk-level RECOVERABLE
+    # faults are masked by rebuild-and-replay (OOM takes the halve-chunk
+    # -> host-demotion ladder) this many times before falling back to
+    # the typed per-key failure.  0 = pure failure isolation (PR 10).
+    engine_max_restarts: int = 3
+    # the wedge watchdog: a pipelined settle window still blocked after
+    # this many seconds marks the service WEDGED — finishers of already-
+    # settled engines are salvaged and /readyz flips to 500 with a
+    # machine-readable reason, so a fleet supervisor's unready-recycle +
+    # migration path rescues the sessions.  None disables the watchdog.
+    settle_deadline_s: float | None = None
 
 
 class SimulationService:
@@ -148,6 +166,19 @@ class SimulationService:
             raise ValueError(
                 f"spill_every must be >= 1, got {self.config.spill_every}"
             )
+        if self.config.engine_max_restarts < 0:
+            raise ValueError(
+                f"engine_max_restarts must be >= 0, "
+                f"got {self.config.engine_max_restarts}"
+            )
+        if (
+            self.config.settle_deadline_s is not None
+            and self.config.settle_deadline_s <= 0
+        ):
+            raise ValueError(
+                f"settle_deadline_s must be > 0, "
+                f"got {self.config.settle_deadline_s}"
+            )
         self.clock = clock
         self.run_id = self.config.run_id or obs.new_run_id()
         self.store = SessionStore()
@@ -156,8 +187,19 @@ class SimulationService:
             chunk_steps=self.config.chunk_steps,
             max_queue=self.config.max_queue,
             mc_packed=self.config.mc_packed,
+            engine_max_restarts=self.config.engine_max_restarts,
             clock=clock,
             observer=self,
+        )
+        # the resource governor (docs/SERVING.md "Resource governance"):
+        # the effective budget is resolved ONCE — the derived default
+        # runs a bounded device probe, memoized process-wide — so submit
+        # pays pure arithmetic
+        from tpu_life.serve import governor
+
+        self._governor = governor
+        self._memory_budget = governor.resolve_budget(
+            self.config.memory_budget_bytes
         )
         self.registry = obs.MetricsRegistry()
         self.recorder = MetricsRecorder(
@@ -181,7 +223,8 @@ class SimulationService:
         )
         self._c_rejections = self.registry.counter(
             "serve_admission_rejections_total",
-            "submissions bounced by queue backpressure (QueueFull)",
+            "submissions bounced by backpressure (queue full, or transient "
+            "memory pressure from the governor)",
         )
         # liveness for file scrapers: a stalled pump shows as a frozen
         # round counter even while every gauge legitimately sits still
@@ -236,6 +279,37 @@ class SimulationService:
             "compiled batch programs per engine",
             labels=("compile_key",),
         )
+        # the resource-governor instruments (docs/SERVING.md "Resource
+        # governance"): the admission budget, the per-key estimated
+        # engine footprint it is charged against, every typed admission
+        # rejection by reason, and every in-place engine recovery by
+        # ladder outcome
+        self._g_mem_budget = self.registry.gauge(
+            "serve_memory_budget_bytes",
+            "admission-time memory budget for estimated engine footprints "
+            "(0 = accounting disabled)",
+        )
+        self._g_est_bytes = self.registry.gauge(
+            "serve_estimated_bytes",
+            "estimated resident bytes per live engine",
+            labels=("key",),
+        )
+        self._c_adm_rejected = self.registry.counter(
+            "serve_admission_rejected_total",
+            "typed admission rejections by reason",
+            labels=("reason",),
+        )
+        self._c_recoveries = self.registry.counter(
+            "serve_engine_recoveries_total",
+            "in-place engine recoveries by outcome (replayed / "
+            "oom_halved_chunk / oom_host_demoted / budget_exhausted / "
+            "rebuild_failed / wedged)",
+            labels=("outcome",),
+        )
+        self._g_mem_budget.set(float(self._memory_budget or 0))
+        # key buckets whose estimated-bytes gauge was last set (released
+        # engines' buckets zero out in the next round's sweep)
+        self._est_buckets: set[str] = set()
         # prime the unlabeled series so a snapshot taken before the first
         # event still shows them (a zero rejection counter is information;
         # an absent one is a question)
@@ -310,6 +384,27 @@ class SimulationService:
         # the round boundary, never interleave phases
         self._pump_mutex = threading.Lock()
         self._draining = False
+        # the wedge watchdog (docs/SERVING.md "Resource governance"): a
+        # settle window that blocks past settle_deadline_s is the hang
+        # mode recovery cannot catch in-process (nothing raises).  The
+        # watchdog thread detects it FROM OUTSIDE the pump: the pump
+        # publishes (start time, plan, settled-so-far) around every
+        # unlocked settle window; on deadline the watchdog — under the
+        # service lock, which the stuck pump does NOT hold — marks the
+        # service wedged, salvages the already-settled engines' pending
+        # finishers, and /readyz answers 500 with the machine-readable
+        # reason so a supervisor's unready-recycle + migration rescues
+        # the rest.  Sticky by design: a declared wedge means the
+        # deadline contract was broken; the recycle path owns recovery.
+        self._wedged: dict | None = None
+        self._settle_state: tuple | None = None
+        self._watchdog_stop = threading.Event()
+        self._watchdog: threading.Thread | None = None
+        if self.config.settle_deadline_s is not None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="serve-watchdog", daemon=True
+            )
+            self._watchdog.start()
 
     # -- the four verbs ----------------------------------------------------
     def submit(
@@ -407,6 +502,43 @@ class SimulationService:
                 raise Draining(
                     "service is draining: no new sessions are admitted"
                 )
+            # the memory governor (docs/SERVING.md "Resource governance"):
+            # would this session's CompileKey overflow the budget?  An
+            # existing (or already-queued) key admits for free; a new key
+            # must fit next to every reserved one.  Checked BEFORE the
+            # session exists anywhere, so an XLA RESOURCE_EXHAUSTED
+            # becomes a typed rejection instead of a dead worker.
+            if self._memory_budget is not None:
+                key = compile_key_for(rule, board, self.config.backend)
+                sched = self.scheduler
+                reserved = self._governor.reserved_bytes(
+                    sched.engines,
+                    (self._keyer()(s) for s in sched.queue),
+                    self.config.capacity,
+                    mc_packed=self.config.mc_packed,
+                )
+                try:
+                    self._governor.check_admission(
+                        key,
+                        reserved,
+                        self._memory_budget,
+                        self.config.capacity,
+                        mc_packed=self.config.mc_packed,
+                    )
+                except InsufficientMemory as e:
+                    if e.transient:
+                        # transient pressure IS backpressure: it joins
+                        # the classic rejection counter so the stats
+                        # rejection_rate (the first overload signal)
+                        # covers it; a never-fits session is a client
+                        # error, not overload, and stays out
+                        self._c_rejections.inc()
+                    self._c_adm_rejected.labels(
+                        reason="insufficient_memory"
+                        if e.transient
+                        else "session_too_large"
+                    ).inc()
+                    raise
             # backpressure check BEFORE the session exists anywhere; a bounce
             # is an admission outcome worth counting (rejection rate is the
             # first overload signal), so the counter ticks before the raise
@@ -414,6 +546,7 @@ class SimulationService:
                 self.scheduler.ensure_admission()
             except QueueFull:
                 self._c_rejections.inc()
+                self._c_adm_rejected.labels(reason="queue_full").inc()
                 raise
             now = self.clock()
             if timeout_s is None:
@@ -536,6 +669,12 @@ class SimulationService:
         if session.admitted_at is None:
             # it died waiting: close the still-open queue-wait interval
             obs.async_end("queue-wait", session.sid, outcome=session.state.value)
+
+    def engine_recovered(self, key, outcome: str) -> None:
+        """Scheduler hook: a chunk-level fault on ``key`` was handled —
+        masked in place (``replayed`` / the OOM ladder rungs) or, past
+        the restart budget, failed typed (``budget_exhausted``)."""
+        self._c_recoveries.labels(outcome=outcome).inc()
 
     def drain(self, max_rounds: int | None = None) -> int:
         """Pump until every admitted session reaches a terminal state;
@@ -661,6 +800,14 @@ class SimulationService:
         # serviceable; verb-triggered slot releases defer to the next begin.
         spill_failures: list = []
         chunk_faults: list = []
+        settled: list = []
+        faulted: list = []
+        # publish the settle window for the wedge watchdog: it reads
+        # (start, plan, settled-so-far, faulted-so-far) from outside the
+        # pump and fires once an engine blocks past settle_deadline_s
+        # WITHOUT progress (each settled engine restarts the clock —
+        # many keys legitimately settling in sequence is not a wedge)
+        self._settle_state = (time.monotonic(), plan, settled, faulted)
         try:
             with obs.activate(self._tracer), obs.span(
                 "serve.collect", engines=len(plan)
@@ -674,9 +821,19 @@ class SimulationService:
                     except recovery.RECOVERABLE as e:
                         # a chunk-level fault while settling (the chaos
                         # engine.collect drill, or a real device reset):
-                        # recorded here, handled under the lock below —
-                        # this key's sessions fail typed, the pump lives
-                        chunk_faults.append((key, f"{type(e).__name__}: {e}"))
+                        # recorded here, RECOVERED under the lock below —
+                        # rebuild + replay, this pump round survives.
+                        # NOT marked settled: the wedge salvage must
+                        # never fetch from an engine whose chunk just
+                        # died (recover_engine owns its sessions).
+                        chunk_faults.append((key, e))
+                        faulted.append(key)
+                    else:
+                        settled.append(key)
+            # the watchdog window closes HERE: every device wait is done.
+            # The spill pass below is disk I/O — slow storage must never
+            # read as a wedged device grant
+            self._settle_state = None
             if spill_plan:
                 # engines are settled (double buffers materialized) and
                 # still marked busy, so verb releases stay deferred and
@@ -688,12 +845,13 @@ class SimulationService:
                 with obs.activate(self._tracer):
                     spill_failures = self._run_spill(spill_plan)
         finally:
+            self._settle_state = None
             with self._lock:
                 for _, engine, _ in plan:
                     engine.busy = False
         with self._lock:
-            for key, msg in chunk_faults:
-                self.scheduler.fail_engine_sessions(key, msg, stats)
+            for key, exc in chunk_faults:
+                self.scheduler.recover_engine(key, exc, stats)
             with obs.activate(self._tracer):
                 self.scheduler.round_end(keyer, stats, rolled)
             if spill_plan:
@@ -701,6 +859,103 @@ class SimulationService:
                 self._sweep_spills(spill_plan)
             self._finish_round(stats)
         return stats
+
+    # -- the wedge watchdog (docs/SERVING.md "Resource governance") ---------
+    @property
+    def wedged(self) -> dict | None:
+        """The wedge verdict: None while healthy, else a machine-readable
+        dict (``reason`` / ``compile_key`` / ``deadline_s`` /
+        ``waited_s``) — what ``/readyz`` serializes into its 500 body.
+        Sticky by design: a declared wedge means the settle-deadline
+        contract was broken, and the supervisor recycle path owns the
+        recovery from here."""
+        return self._wedged
+
+    def _watchdog_loop(self) -> None:
+        deadline = float(self.config.settle_deadline_s)
+        poll = max(0.01, min(0.25, deadline / 4))
+        # progress tracking: the deadline applies to ONE engine's wait,
+        # not the cumulative multi-engine window — every engine that
+        # settles (or faults into the recovery path) restarts the clock,
+        # so N keys legitimately settling in sequence never trip it
+        last_state: tuple | None = None
+        last_progress = -1
+        baseline = 0.0
+        while not self._watchdog_stop.wait(poll):
+            state = self._settle_state
+            if state is None or self._wedged is not None:
+                last_state = None
+                continue
+            started, plan, settled, faulted = state
+            progress = len(settled) + len(faulted)
+            now = time.monotonic()
+            if state is not last_state:
+                last_state, last_progress, baseline = state, progress, started
+            elif progress != last_progress:
+                last_progress, baseline = progress, now
+            if now - baseline <= deadline:
+                continue
+            waited = now - baseline
+            # the stuck pump does NOT hold the service lock during the
+            # settle window — that is the whole design of the pipelined
+            # pump — so the watchdog can take it and act
+            with self._lock:
+                if self._settle_state is not state or self._wedged is not None:
+                    continue  # the window closed while we queued
+                skip = set(settled) | set(faulted)
+                # the engine actually blocked: the first plan entry that
+                # neither settled nor faulted (a faulted key already
+                # failed over to recover_engine — blaming it would put
+                # the wrong compile_key in the operator-facing verdict)
+                stuck = next((k for k, _, _ in plan if k not in skip), None)
+                if stuck is None:
+                    # every engine settled or faulted: the window is
+                    # logically over even if the pump has not cleared the
+                    # state yet — nothing is wedged on a device
+                    continue
+                self._wedged = {
+                    "reason": "settle_deadline",
+                    "compile_key": (
+                        _key_bucket(stuck) if stuck is not None else None
+                    ),
+                    "deadline_s": deadline,
+                    "waited_s": waited,
+                }
+                self._c_recoveries.labels(outcome="wedged").inc()
+                # salvage only from SETTLED engines — a faulted engine's
+                # chunk died and recover_engine owns its sessions
+                salvaged = self._salvage_wedged_locked(plan, set(settled))
+            log.error(
+                "serve: WEDGED — settle window blocked %.1fs (deadline "
+                "%.1fs) on %s; %d finisher(s) salvaged, /readyz now "
+                "answers 500 engine_wedged so the supervisor's "
+                "unready-recycle + migration path rescues the sessions",
+                waited,
+                deadline,
+                self._wedged["compile_key"],
+                salvaged,
+            )
+
+    def _salvage_wedged_locked(self, plan, settled: set) -> int:
+        """Retire the pending finishers of engines that SETTLED before
+        the wedge: their double buffers are materialized and the stuck
+        pump is blocked in a different engine, so fetching them here
+        (under the service lock) is safe — those results leave the
+        worker before the supervisor recycles it."""
+        sched = self.scheduler
+        stats = RoundStats()
+        for key, engine, _ in plan:
+            if key not in settled:
+                continue
+            entries = sched.pending.get(key) or []
+            slots = sched.running.get(key, {})
+            for slot, s in list(entries):
+                if slots.get(slot) is not s:
+                    continue  # cancelled/expired meanwhile
+                sched._retire_slot(engine, slots, slot, s, stats)
+            sched.pending.pop(key, None)
+        self._completed += stats.completed
+        return stats.completed
 
     # -- durable sessions: the spill pass (docs/SERVING.md) -----------------
     def _spill_plan(self) -> list | None:
@@ -839,6 +1094,26 @@ class SimulationService:
             self._g_spilled.set(float(self._spill.spilled_count()))
         for key, count in self.scheduler.compile_counts().items():
             self._g_compiles.labels(compile_key=_key_bucket(key)).set(count)
+        # the governor's footprint view: what each live engine is charged
+        # against the budget (same bounded key buckets as compile counts).
+        # Unlike compile counts this is a LIVE footprint, so buckets of
+        # released engines zero out instead of showing a stale charge.
+        live_buckets = set()
+        for key in self.scheduler.engines:
+            bucket = _key_bucket(key)
+            live_buckets.add(bucket)
+            self._g_est_bytes.labels(key=bucket).set(
+                float(
+                    self._governor.estimate_engine_bytes(
+                        key,
+                        self.config.capacity,
+                        mc_packed=self.config.mc_packed,
+                    )
+                )
+            )
+        for bucket in self._est_buckets - live_buckets:
+            self._g_est_bytes.labels(key=bucket).set(0.0)
+        self._est_buckets = live_buckets
         elapsed = self.clock() - self._t0
         qw, lat = self._h_queue_wait, self._h_latency
         self.recorder.record(
@@ -864,6 +1139,10 @@ class SimulationService:
                 # dispatches, and cumulative engine-idle wall seconds
                 "pipeline_depth": depth,
                 "device_idle_s": self._c_device_idle.value,
+                # the governor stamps (docs/SERVING.md "Resource
+                # governance"): in-place recoveries this round, and the
+                # cumulative ladder counter
+                "engine_recoveries": stats.engine_recoveries,
                 # the durability stamps (present only with a spill dir):
                 # sessions currently resumable from disk, and cumulative
                 # wall seconds spent writing spills
@@ -924,6 +1203,7 @@ class SimulationService:
         snapshot lands in the JSONL sink, the Prometheus snapshot in
         ``prom_file``, the trace file is written, in-flight chunks
         collected, idle engines freed."""
+        self._watchdog_stop.set()
         with self._lock:
             self.scheduler.flush_inflight()
             self.recorder.close()
@@ -948,6 +1228,12 @@ class SimulationService:
         return {
             "run_id": self.run_id,
             "draining": self._draining,
+            "wedged": self._wedged,
+            "memory_budget_bytes": self._memory_budget or 0,
+            "engine_recoveries": {
+                labels["outcome"]: inst.value
+                for labels, inst in self._c_recoveries.series()
+            },
             "pump": "pipelined" if self.config.pipeline else "sync",
             "pipeline_depth": self._g_pipeline_depth.value,
             "device_idle_seconds": self._c_device_idle.value,

@@ -91,6 +91,12 @@ class Session:
     # back-to-back kill degrades to one extra rescue instead of a 410
     # ``never_snapshotted``.  Cleared after its first successful spill.
     spill_urgent: bool = False
+    # the OOM fallback ladder's stamp (docs/SERVING.md "Resource
+    # governance"): set when this session's CompileKey was degraded to
+    # keep serving through device OOM — ``oom_halved_chunk`` (smaller
+    # compiled scan) or ``oom_host_demoted`` (the bit-identical host
+    # executor).  Results stay byte-identical; only throughput degrades.
+    degraded_reason: str | None = None
 
     @property
     def steps_remaining(self) -> int:
@@ -135,6 +141,8 @@ class SessionView:
     # until admission, and always None for deterministic sessions
     packed: bool | None = None
     lanes: int | None = None
+    # the OOM fallback ladder's stamp (None when the key never degraded)
+    degraded_reason: str | None = None
 
     @property
     def finished(self) -> bool:
@@ -183,6 +191,7 @@ class SessionStore:
             temperature=s.temperature,
             packed=s.packed,
             lanes=s.lanes,
+            degraded_reason=s.degraded_reason,
         )
 
     def result(self, sid: str) -> np.ndarray:
