@@ -1,0 +1,762 @@
+"""Distributed tracing units (docs/OBSERVABILITY.md "Distributed
+tracing"): the bounded span ring, trace-id propagation through submit /
+views / the wire / the spill manifest, the flight recorder, the gateway
+drain verb, and the merge + doctor read-back on synthetic captures.
+
+The end-to-end journey-continuity drill (a real 2-worker fleet, one
+SIGKILL, one contiguous trace across generations) lives in
+tests/test_trace_journey.py.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_life import obs
+from tpu_life.gateway import Gateway, GatewayConfig
+from tpu_life.gateway.errors import ApiError
+from tpu_life.gateway.protocol import parse_submit, parse_trace_id, render_view
+from tpu_life.models.patterns import random_board
+from tpu_life.obs import journey
+from tpu_life.obs.flight import FlightRecorder
+from tpu_life.serve import ServeConfig, SimulationService
+from tpu_life.serve.spill import SpillStore, read_spill_sessions
+
+
+# ---------------------------------------------------------------------------
+# the bounded span ring
+# ---------------------------------------------------------------------------
+def test_tracer_ring_bounds_and_counts_drops(tmp_path):
+    t = obs.Tracer(str(tmp_path / "t.json"), max_events=8)
+    for i in range(20):
+        t.instant("tick", i=i)
+    assert len(t._events) == 8
+    assert t.dropped == 12
+    # the survivors are the NEWEST events (flight-recorder semantics)
+    assert [e["args"]["i"] for e in t._events] == list(range(12, 20))
+
+
+def test_tracer_drain_is_incremental(tmp_path):
+    t = obs.Tracer(str(tmp_path / "t.json"), run_id="abc123abc123")
+    t.instant("a")
+    t.instant("b")
+    first = t.drain()
+    assert [e["name"] for e in first] == ["a", "b"]
+    assert t.drain() == []
+    t.instant("c")
+    # write() emits only what was never drained, plus the ring anchors
+    path = t.write()
+    doc = json.loads(open(path).read())
+    assert [e["name"] for e in doc["traceEvents"]] == ["c"]
+    assert doc["otherData"]["run_id"] == "abc123abc123"
+    assert doc["otherData"]["dropped"] == 0
+    assert doc["otherData"]["wall_t0"] == pytest.approx(t.wall_t0)
+
+
+def test_tracer_rejects_degenerate_cap(tmp_path):
+    with pytest.raises(ValueError, match="max_events"):
+        obs.Tracer(str(tmp_path / "t.json"), max_events=0)
+
+
+def test_trace_id_vocabulary():
+    tid = obs.new_trace_id()
+    assert len(tid) == 16 and obs.valid_trace_id(tid)
+    assert obs.valid_trace_id("client-abc.123:x")
+    assert not obs.valid_trace_id("")
+    assert not obs.valid_trace_id("-leading-dash")
+    assert not obs.valid_trace_id("x" * 65)
+    assert not obs.valid_trace_id("sp ace")
+    assert not obs.valid_trace_id(42)
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder
+# ---------------------------------------------------------------------------
+def test_flight_ring_bounds_and_drains():
+    fr = FlightRecorder(max_events=4)
+    for i in range(6):
+        fr.record("k", i=i)
+    assert fr.dropped == 2 and fr.recorded == 6
+    snap = fr.snapshot()
+    assert [e["i"] for e in snap] == [2, 3, 4, 5]
+    assert all(e["kind"] == "k" and "t" in e for e in snap)
+    assert [e["i"] for e in fr.drain()] == [2, 3, 4, 5]
+    assert fr.drain() == [] and fr.snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# trace-id propagation: service, views, spans
+# ---------------------------------------------------------------------------
+def test_submit_carries_trace_id_through_view_and_spans(tmp_path):
+    obs.flight.reset()  # the ring is process-global: shed other tests' events
+    trace_file = tmp_path / "serve.trace.json"
+    svc = SimulationService(
+        ServeConfig(
+            backend="numpy", capacity=2, chunk_steps=4,
+            trace_events=str(trace_file),
+        )
+    )
+    sid = svc.submit(
+        random_board(8, 8, seed=1), "conway", 8, trace_id="trace-xyz"
+    )
+    assert svc.poll(sid).trace_id == "trace-xyz"
+    svc.drain(max_rounds=50)
+    assert svc.poll(sid).finished
+    svc.close()
+    doc = json.loads(trace_file.read_text())
+    by_name: dict = {}
+    for ev in doc["traceEvents"]:
+        by_name.setdefault(ev["name"], []).append(ev)
+    # the queue-wait interval and the execution interval both carry the
+    # trace context; the exec end stamps the outcome
+    qw = [e for e in by_name["queue-wait"] if e["ph"] == "b"]
+    assert qw and qw[0]["args"]["trace_id"] == "trace-xyz"
+    execs = by_name["serve.exec"]
+    begins = [e for e in execs if e["ph"] == "b"]
+    ends = [e for e in execs if e["ph"] == "e"]
+    assert begins and begins[0]["id"] == sid
+    assert begins[0]["args"]["trace_id"] == "trace-xyz"
+    assert ends and ends[-1]["args"]["outcome"] == "done"
+    # dispatch spans carry the per-slot attribution (guarded attrs)
+    dispatches = [
+        e
+        for name in ("serve.dispatch", "serve.step-chunk")
+        for e in by_name.get(name, [])
+        if e["ph"] == "B"
+    ]
+    assert any(
+        "trace-xyz" in (e.get("args", {}).get("trace_ids") or [])
+        for e in dispatches
+    )
+    # flight events rode into the written file as instant markers
+    assert "flight.admission" in by_name
+    adm = by_name["flight.admission"][0]
+    assert adm["args"]["trace_id"] == "trace-xyz" and adm["args"]["sid"] == sid
+    assert "flight.terminal" in by_name
+
+
+def test_library_submit_without_trace_id_stays_naked():
+    svc = SimulationService(ServeConfig(backend="numpy", capacity=2))
+    sid = svc.submit(random_board(8, 8, seed=2), "conway", 4)
+    assert svc.poll(sid).trace_id is None
+    svc.drain(max_rounds=50)
+    svc.close()
+
+
+def test_drain_trace_payload_without_tracer():
+    obs.flight.reset()
+    svc = SimulationService(ServeConfig(backend="numpy", capacity=2))
+    sid = svc.submit(random_board(8, 8, seed=3), "conway", 4, trace_id="t-1")
+    payload = svc.drain_trace()
+    # no tracer: the span list is empty but the (always-on) flight ring
+    # still delivers the control-plane decisions
+    assert payload["events"] == [] and payload["wall_t0"] is None
+    kinds = [e["kind"] for e in payload["flight"]]
+    assert "admission" in kinds
+    adm = next(e for e in payload["flight"] if e["kind"] == "admission")
+    assert adm["sid"] == sid and adm["trace_id"] == "t-1"
+    # drains are increments
+    assert svc.drain_trace()["flight"] == []
+    svc.drain(max_rounds=50)
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# spill manifest + resume continuity
+# ---------------------------------------------------------------------------
+def test_spill_manifest_persists_trace_id(tmp_path):
+    store = SpillStore(tmp_path / "spill")
+    board = random_board(8, 8, seed=4)
+    store.save(
+        "s000001", board, 12, rule="conway", steps_total=64,
+        seed=None, temperature=None, timeout_s=None, trace_id="trace-77",
+    )
+    records, corrupt, disabled = read_spill_sessions(tmp_path / "spill")
+    assert not corrupt and not disabled
+    assert records[0].trace_id == "trace-77"
+    from tpu_life.fleet.migrate import resume_request
+
+    body = resume_request(records[0])
+    assert body["trace_id"] == "trace-77"
+    # a pre-trace manifest (no field) reads back as None, not a crash
+    store.save(
+        "s000002", board, 8, rule="conway", steps_total=64,
+        seed=None, temperature=None, timeout_s=None,
+    )
+    records, _, _ = read_spill_sessions(tmp_path / "spill")
+    by_sid = {r.sid: r for r in records}
+    assert by_sid["s000002"].trace_id is None
+    assert "trace_id" not in resume_request(by_sid["s000002"])
+
+
+# ---------------------------------------------------------------------------
+# the wire vocabulary
+# ---------------------------------------------------------------------------
+def test_parse_trace_id_typed_validation():
+    assert parse_trace_id(None) is None
+    assert parse_trace_id("ok-id.1:x") == "ok-id.1:x"
+    for bad in ("", "-x", "a b", "x" * 65, 7):
+        with pytest.raises(ApiError) as ei:
+            parse_trace_id(bad)
+        assert ei.value.code == "invalid_trace_id"
+
+
+def test_submit_spec_and_view_round_trip_trace_id():
+    spec = parse_submit({"size": 8, "steps": 4, "trace_id": "wire-1"})
+    assert spec.trace_id == "wire-1"
+    svc = SimulationService(ServeConfig(backend="numpy", capacity=2))
+    sid = svc.submit(spec.board, spec.rule, spec.steps, trace_id=spec.trace_id)
+    body = render_view(svc.poll(sid))
+    assert body["trace_id"] == "wire-1"
+    # no context -> no field (prior wire shape preserved exactly)
+    sid2 = svc.submit(random_board(8, 8, seed=5), "conway", 4)
+    assert "trace_id" not in render_view(svc.poll(sid2))
+    svc.drain(max_rounds=50)
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the gateway: X-Trace-Id + the drain verb
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def traced_gateway(tmp_path):
+    obs.flight.reset()
+    svc = SimulationService(
+        ServeConfig(
+            backend="numpy", capacity=2, chunk_steps=4,
+            trace_events=str(tmp_path / "gw.trace.json"),
+        )
+    )
+    gw = Gateway(svc, GatewayConfig(port=0))
+    gw.start()
+    yield gw
+    gw.begin_drain()
+    gw.wait(timeout=30)
+    gw.close()
+
+
+def _post(url, body, headers=None):
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method="POST")
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_gateway_honors_and_mints_trace_ids(traced_gateway):
+    gw = traced_gateway
+    base = f"http://127.0.0.1:{gw.port}"
+    # client-supplied header wins and echoes everywhere
+    status, doc = _post(
+        f"{base}/v1/sessions",
+        {"size": 8, "steps": 4},
+        headers={"X-Trace-Id": "client-supplied-1"},
+    )
+    assert status == 201 and doc["trace_id"] == "client-supplied-1"
+    poll = _get(f"{base}/v1/sessions/{doc['session']}")
+    assert poll["trace_id"] == "client-supplied-1"
+    # no header: the gateway mints one (every HTTP session has a journey)
+    status, doc2 = _post(f"{base}/v1/sessions", {"size": 8, "steps": 4})
+    assert status == 201 and obs.valid_trace_id(doc2["trace_id"])
+    # malformed header: typed 400, nothing stored
+    status, err = _post(
+        f"{base}/v1/sessions",
+        {"size": 8, "steps": 4},
+        headers={"X-Trace-Id": "bad id!"},
+    )
+    assert status == 400 and err["error"]["code"] == "invalid_trace_id"
+
+
+def test_gateway_debug_trace_drains_rings(traced_gateway):
+    gw = traced_gateway
+    base = f"http://127.0.0.1:{gw.port}"
+    status, doc = _post(
+        f"{base}/v1/sessions",
+        {"size": 8, "steps": 4},
+        headers={"X-Trace-Id": "drill-trace"},
+    )
+    assert status == 201
+    payload = _get(f"{base}/v1/debug/trace")
+    assert payload["run_id"] == gw.service.run_id
+    assert isinstance(payload["pid"], int) and payload["wall_t0"] is not None
+    kinds = [e["kind"] for e in payload["flight"]]
+    assert "admission" in kinds
+    qw = [e for e in payload["events"] if e["name"] == "queue-wait"]
+    assert any(e["args"].get("trace_id") == "drill-trace"
+               for e in qw if e.get("ph") == "b")
+    # the drain is destructive: an immediate re-scrape carries no repeats
+    again = _get(f"{base}/v1/debug/trace")
+    assert [e["kind"] for e in again["flight"]].count("admission") == 0
+
+
+# ---------------------------------------------------------------------------
+# merge + doctor on synthetic captures
+# ---------------------------------------------------------------------------
+def _capture_record(worker, gen, wall_t0, events=(), flight=(), offset=0.0):
+    return {
+        "worker": worker,
+        "generation": gen,
+        "pid": 1000 + gen,
+        "run_id": f"{worker}g{gen}rid",
+        "wall_t0": wall_t0,
+        "offset_s": offset,
+        "scraped_at": (wall_t0 or 0.0) + 60,
+        "dropped": 0,
+        "events": list(events),
+        "flight": list(flight),
+    }
+
+
+def _exec_pair(sid, tid, t_begin_us, t_end_us, outcome="done"):
+    begin = {
+        "name": "serve.exec", "cat": "serve.exec", "ph": "b", "id": sid,
+        "ts": t_begin_us, "pid": 1, "tid": 1,
+        "args": {"trace_id": tid, "step": 0},
+    }
+    end = {
+        "name": "serve.exec", "cat": "serve.exec", "ph": "e", "id": sid,
+        "ts": t_end_us, "pid": 1, "tid": 1,
+        "args": {"trace_id": tid, "outcome": outcome, "step": 64},
+    }
+    return begin, end
+
+
+def _write_capture(tmp_path, name, records):
+    with open(tmp_path / name, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+@pytest.fixture
+def killed_journey_capture(tmp_path):
+    """A synthetic capture of the canonical journey: submit -> rounds on
+    w0 g1 -> SIGKILL (no exec end) -> migration -> rounds on w1 g1 ->
+    done, all under one trace id.  Times are seconds offsets on a shared
+    epoch; w1's wall clock is skewed +5 s and its scrape records the
+    offset, so the merge must re-align it."""
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    t0 = 1_000_000.0
+    tid = "journey-1"
+    fsid = "w0g1-s000001"
+    # control plane: the routing pin, then the victim's exit
+    _write_capture(cap, "control.jsonl", [
+        _capture_record(
+            "control", 0, None,
+            flight=[
+                {"t": t0 + 0.5, "kind": "route.submit", "sid": fsid,
+                 "worker_sid": "s000001", "trace_id": tid,
+                 "worker": "w0", "generation": 1},
+                {"t": t0 + 3.0, "kind": "worker.exit", "worker": "w0",
+                 "generation": 1, "rc": -9, "draining": False,
+                 "recycling": False},
+                {"t": t0 + 3.2, "kind": "migrate.resumed", "sid": fsid,
+                 "trace_id": tid, "worker": "w1", "generation": 1,
+                 "worker_sid": "s000002"},
+            ],
+        ),
+    ])
+    # victim: exec began at +1.0, spilled at +2.0, killed at +3.0 (no end)
+    begin, _ = _exec_pair("s000001", tid, 1.0e6, None)
+    spill = {
+        "name": "serve.session.spill", "ph": "i", "s": "p",
+        "ts": 2.0e6, "pid": 7, "tid": 1,
+        "args": {"sid": "s000001", "trace_id": tid, "step": 32},
+    }
+    _write_capture(cap, "w0.jsonl", [
+        _capture_record("w0", 1, t0, events=[begin, spill]),
+    ])
+    # survivor: clock skewed +5 s, scrape measured it; resumes at +3.5
+    skew = 5.0
+    b2, e2 = _exec_pair("s000002", tid, 3.5e6, 6.0e6)
+    _write_capture(cap, "w1.jsonl", [
+        _capture_record("w1", 1, t0 + skew, events=[b2, e2], offset=skew),
+    ])
+    return cap, fsid, tid
+
+
+def test_merge_produces_one_aligned_perfetto_timeline(killed_journey_capture):
+    cap, fsid, tid = killed_journey_capture
+    doc = journey.merge_captures(cap)
+    assert doc["otherData"]["merged"] is True
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"process_name", "serve.exec", "serve.session.spill",
+            "flight.route.submit", "flight.worker.exit"} <= names
+    # one process track per incarnation, control first
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    labels = {e["args"]["name"] for e in meta}
+    assert labels == {"control", "w0 g1", "w1 g1"}
+    # timestamps are one ordered collector timeline starting at 0
+    data = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    ts = [e["ts"] for e in data]
+    assert ts == sorted(ts) and min(ts) == 0.0
+    # the +5 s wall-clock skew was absorbed by the handshake offset: the
+    # survivor's exec begin lands ~3.0 s after the victim's (3.5 vs 0.5
+    # on the route.submit-anchored timeline), NOT ~8 s
+    by = {(e["name"], e.get("ph")): e for e in data}
+    b_victim = next(e for e in data
+                    if e["name"] == "serve.exec" and e["ph"] == "b"
+                    and e["args"].get("step") == 0 and e["id"] == "s000001")
+    b_surv = next(e for e in data
+                  if e["name"] == "serve.exec" and e["ph"] == "b"
+                  and e["id"] == "s000002")
+    assert (b_surv["ts"] - b_victim["ts"]) / 1e6 == pytest.approx(2.5, abs=0.01)
+    # a migrated session's journey is ONE contiguous trace id across two
+    # worker tracks (the acceptance shape)
+    pids = {e["pid"] for e in data
+            if isinstance(e.get("args"), dict)
+            and e["args"].get("trace_id") == tid
+            and e["name"] == "serve.exec"}
+    assert len(pids) == 2
+
+
+def test_doctor_reconstructs_killed_journey(killed_journey_capture):
+    cap, fsid, tid = killed_journey_capture
+    doc = journey.merge_captures(cap)
+    report = journey.doctor(doc, sid=fsid)
+    assert report["trace_id"] == tid
+    assert report["ok"], report["anomalies"]
+    assert report["outcome"] == "done"
+    # the journey crosses exactly the two incarnations, in order
+    assert [i["worker"] for i in report["incarnations"]] == ["control", "w0", "w1"]
+    kinds = [f["kind"] for f in report["findings"]]
+    assert "migration" in kinds and "worker_exit" in kinds and "spill" in kinds
+    mig = next(f for f in report["findings"] if f["kind"] == "migration")
+    assert mig["from"] == "w0 g1" and mig["to"] == "w1 g1"
+    # the gap is the real kill -> resume distance (0.5 s), skew excluded
+    assert mig["gap_s"] == pytest.approx(0.5, abs=0.05)
+    # human rendering carries the verdict
+    text = journey.render_report(report)
+    assert "verdict: OK" in text and "migration" in text
+
+
+def test_doctor_flags_double_execution(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    tid = "dup-1"
+    t0 = 2_000_000.0
+    b1, e1 = _exec_pair("s000001", tid, 1.0e6, 4.0e6)
+    b2, e2 = _exec_pair("s000001", tid, 2.0e6, 5.0e6)
+    _write_capture(cap, "w0.jsonl", [_capture_record("w0", 1, t0, events=[b1, e1])])
+    _write_capture(cap, "w1.jsonl", [_capture_record("w1", 1, t0, events=[b2, e2])])
+    report = journey.doctor(journey.merge_captures(cap), trace_id=tid)
+    assert not report["ok"]
+    assert any(a["kind"] == "double_execution" for a in report["anomalies"])
+
+
+def test_doctor_flags_unbounded_gap_and_missing_terminal(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    tid = "gap-1"
+    t0 = 3_000_000.0
+    b1, e1 = _exec_pair("s000001", tid, 1.0e6, 2.0e6, outcome=None)
+    e1["args"].pop("outcome")
+    b2, _ = _exec_pair("s000002", tid, 200.0e6, None)
+    _write_capture(cap, "w0.jsonl", [_capture_record("w0", 1, t0, events=[b1, e1])])
+    _write_capture(cap, "w1.jsonl", [_capture_record("w1", 1, t0, events=[b2])])
+    report = journey.doctor(
+        journey.merge_captures(cap), trace_id=tid, max_gap_s=60.0
+    )
+    kinds = {a["kind"] for a in report["anomalies"]}
+    assert "migration_gap_exceeded" in kinds and "no_terminal" in kinds
+
+
+def test_doctor_unknown_sid_is_typed(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    _write_capture(cap, "w0.jsonl", [_capture_record("w0", 1, 1.0)])
+    report = journey.doctor(journey.merge_captures(cap), sid="w9g9-s999999")
+    assert not report["ok"]
+    assert report["anomalies"][0]["kind"] == "unknown_sid"
+
+
+def test_load_captures_tolerates_torn_final_line(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    _write_capture(cap, "w0.jsonl", [_capture_record("w0", 1, 1.0)])
+    with open(cap / "w0.jsonl", "a") as f:
+        f.write('{"worker": "w0", "torn')  # killed mid-append
+    assert len(journey.load_captures(cap)) == 1
+    # a torn MIDDLE line is corruption and raises
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    with open(bad / "w0.jsonl", "w") as f:
+        f.write('{"torn\n')
+        f.write(json.dumps(_capture_record("w0", 1, 1.0)) + "\n")
+    with pytest.raises(ValueError, match="corrupt capture line"):
+        journey.load_captures(bad)
+
+
+def test_load_captures_reads_written_trace_files(tmp_path):
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    t = obs.Tracer(str(cap / "w2g3.trace.json"), run_id="rid0rid0rid0")
+    t.instant("leftover", sid="s000009", trace_id="tail-1")
+    t.write()
+    records = journey.load_captures(cap)
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["worker"] == "w2" and rec["generation"] == 3
+    assert rec["wall_t0"] == pytest.approx(t.wall_t0)
+    assert rec["events"][0]["name"] == "leftover"
+    # and it merges onto the shared timeline
+    doc = journey.merge_records(records)
+    assert any(e["name"] == "leftover" for e in doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# chaos injections as trace instants (satellite)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_injection_fires_emit_trace_instants_and_flight_events(tmp_path):
+    from tpu_life import chaos
+
+    obs.flight.reset()
+    tracer = obs.start_tracing(str(tmp_path / "chaos.trace.json"))
+    try:
+        with chaos.armed_plan(
+            {"seed": 1, "points": {"spill.write": {"mode": "enospc", "times": 1}}}
+        ):
+            with pytest.raises(OSError):
+                chaos.inject("spill.write")
+    finally:
+        obs.stop_tracing(tracer)
+    doc = json.loads((tmp_path / "chaos.trace.json").read_text())
+    marks = [e for e in doc["traceEvents"] if e["name"] == "chaos.injection"]
+    assert marks and marks[0]["ph"] == "i"
+    assert marks[0]["args"] == {"point": "spill.write", "decision": "enospc"}
+    fl = [e for e in obs.flight.drain() if e["kind"] == "injection"]
+    assert fl and fl[0]["point"] == "spill.write" and fl[0]["decision"] == "enospc"
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+def test_remerge_ignores_previous_merged_output(tmp_path):
+    """The CLI's default output lands INSIDE the capture dir; a re-merge
+    (or doctor-on-directory after a merge) must not ingest it as a
+    phantom incarnation."""
+    from tpu_life.cli import main as cli_main
+
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    _write_capture(cap, "w0.jsonl", [
+        _capture_record("w0", 1, 1_000.0, flight=[
+            {"t": 1_001.0, "kind": "admission", "sid": "s000001",
+             "trace_id": "t-1"},
+        ]),
+    ])
+    assert cli_main(["trace", "merge", str(cap)]) == 0
+    assert (cap / "merged.trace.json").exists()
+    first = json.loads((cap / "merged.trace.json").read_text())
+    assert cli_main(["trace", "merge", str(cap)]) == 0
+    second = json.loads((cap / "merged.trace.json").read_text())
+    # identical shape: no "merged" worker track, no event inflation
+    workers = {m["worker"] for m in second["otherData"]["workers"].values()}
+    assert workers == {"w0"}
+    assert len(second["traceEvents"]) == len(first["traceEvents"])
+
+
+def _fake_supervisor(tmp_path, trace_dir):
+    from tpu_life.fleet.supervisor import FleetConfig, Supervisor
+
+    class FakeProc:
+        def __init__(self):
+            self.rc = None
+            self.kill_log = []
+
+        def poll(self):
+            return self.rc
+
+        def wait(self, timeout=None):
+            return self.rc
+
+        def kill(self):
+            self.kill_log.append("kill")
+            self.rc = -9
+
+        def terminate(self):
+            self.rc = 0
+
+    clock = [0.0]
+    procs, answers = {}, {}
+
+    def spawn(w):
+        procs[w.name] = w.proc = FakeProc()
+        w.url = f"http://127.0.0.1:1/{w.name}"  # unroutable: scrape no-ops
+        answers.setdefault(w.name, "ready")
+
+    def probe(w):
+        return answers.get(w.name, "unreachable")
+
+    cfg = FleetConfig(
+        workers=1, log_dir=str(tmp_path / "logs"),
+        unready_threshold=2, trace_dir=trace_dir,
+    )
+    s = Supervisor(
+        cfg, obs.MetricsRegistry(),
+        spawn=spawn, probe=probe, clock=lambda: clock[0],
+    )
+    with s._lock:
+        for w in s.workers:
+            s._spawn_worker(w, first=True)
+    s.tick()
+    return s, clock, procs, answers
+
+
+def test_traced_unready_recycle_scrapes_then_kills_outside_lock(tmp_path):
+    """The recycle victim's final scrape must not run HTTP under the
+    supervisor lock: with tracing on, the kill is deferred to the
+    tick's unlocked tail — scrape first, then the re-validated kill."""
+    s, clock, procs, answers = _fake_supervisor(
+        tmp_path, str(tmp_path / "trace")
+    )
+    order = []
+    real_reap = s._reap_doomed
+
+    def scrape_spy(w, gen, url):
+        assert not s._lock._is_owned(), "scrape ran under the supervisor lock"
+        order.append(("scrape", w.name, gen))
+
+    s._scrape_one = scrape_spy
+    procs["w0"].kill_log = order  # FakeProc.kill appends "kill"
+    answers["w0"] = "unreachable"
+    w = s.workers[0]
+    w.state = __import__("tpu_life.fleet.supervisor",
+                         fromlist=["WorkerState"]).WorkerState.READY
+    for _ in range(3):
+        clock[0] += 1.0
+        s.tick()
+        if "kill" in order:
+            break
+    assert order[0][0] == "scrape" and order[0][1] == "w0"
+    assert "kill" in order and order.index("kill") > 0
+    assert w.recycling and not s._doomed
+
+
+def test_untraced_unready_recycle_kills_inline(tmp_path):
+    """Without --trace-dir the prior behavior is byte-for-byte: the kill
+    is immediate, nothing is deferred, no scrape is attempted."""
+    s, clock, procs, answers = _fake_supervisor(tmp_path, None)
+    calls = []
+    s._scrape_one = lambda *a: calls.append(a)
+    answers["w0"] = "unreachable"
+    from tpu_life.fleet.supervisor import WorkerState
+
+    s.workers[0].state = WorkerState.READY
+    for _ in range(3):
+        clock[0] += 1.0
+        s.tick()
+        if procs["w0"].rc is not None:
+            break
+    assert procs["w0"].rc == -9 and not calls and not s._doomed
+
+
+def test_peer_rescue_forwards_trace_header(tmp_path):
+    """Cross-host continuity: the migrator's PEER resume must carry the
+    manifest trace id as X-Trace-Id — the peer ROUTER honors the header,
+    and without it would mint a fresh id (header beats body at the
+    worker), severing the journey on exactly the cross-host hop."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from tpu_life.fleet.migrate import Migrator, resume_request
+    from tpu_life.serve.spill import SpillStore, read_spill_sessions
+
+    seen = {}
+
+    class PeerStub(BaseHTTPRequestHandler):
+        def do_POST(self):
+            seen["trace_header"] = self.headers.get("X-Trace-Id")
+            body = b'{"session": "p0g1-s000001"}'
+            self.send_response(201)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), PeerStub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        store = SpillStore(tmp_path / "spill")
+        store.save(
+            "s000001", random_board(8, 8, seed=6), 12, rule="conway",
+            steps_total=64, seed=None, temperature=None, timeout_s=None,
+            trace_id="xhost-trace",
+        )
+        rec = read_spill_sessions(tmp_path / "spill")[0][0]
+        m = Migrator(
+            spill_root=str(tmp_path / "spill"), supervisor=None,
+            sessions=None, registry=obs.MetricsRegistry(), balancer=None,
+            forward=None, peers=(f"http://127.0.0.1:{srv.server_port}",),
+        )
+        body = json.dumps(resume_request(rec)).encode()
+        outcome, _ = m._try_peers("w0g1-s000001", body, rec.trace_id)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    assert outcome == "peer"
+    assert seen["trace_header"] == "xhost-trace"
+
+
+def test_doctor_uses_lease_expiry_as_remote_kill_edge(tmp_path):
+    """A wire-registered victim emits flight.lease.expired, never
+    flight.worker.exit: the doctor must anchor its open exec interval
+    (and the migration gap's left edge) on the lease expiry."""
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    tid = "lease-1"
+    t0 = 4_000_000.0
+    b1, _ = _exec_pair("s000001", tid, 1.0e6, None)
+    b2, e2 = _exec_pair("s000002", tid, 9.0e6, 11.0e6)
+    _write_capture(cap, "control.jsonl", [
+        _capture_record("control", 0, None, flight=[
+            {"t": t0 + 0.5, "kind": "route.submit", "sid": "w5g2-s000001",
+             "worker_sid": "s000001", "trace_id": tid,
+             "worker": "w5", "generation": 2},
+            # the remote worker's death marker: lease expiry, no process
+            {"t": t0 + 3.0, "kind": "lease.expired", "worker": "w5",
+             "generation": 2},
+        ]),
+    ])
+    _write_capture(cap, "w5.jsonl", [_capture_record("w5", 2, t0, events=[b1])])
+    _write_capture(cap, "w1.jsonl", [_capture_record("w1", 1, t0, events=[b2, e2])])
+    report = journey.doctor(journey.merge_captures(cap), sid="w5g2-s000001")
+    assert report["ok"], report["anomalies"]
+    mig = next(f for f in report["findings"] if f["kind"] == "migration")
+    # the gap runs lease-expiry (+3.0) -> survivor begin (+9.0) = 6.0 s,
+    # NOT last-scraped-event (+1.0) -> begin = 8.0 s
+    assert mig["gap_s"] == pytest.approx(6.0, abs=0.05)
+
+
+def test_zero_step_session_still_records_terminal_flight_event():
+    """A steps=0 submission completes inline at admission (no scheduler,
+    no session_finished hook) — the journey must still get its terminal
+    event, or the doctor would flag a cleanly-done session no_terminal."""
+    obs.flight.reset()
+    svc = SimulationService(ServeConfig(backend="numpy", capacity=2))
+    sid = svc.submit(
+        random_board(8, 8, seed=9), "conway", 0, trace_id="zero-step"
+    )
+    assert svc.poll(sid).finished
+    flights = svc.drain_trace()["flight"]
+    term = [e for e in flights if e["kind"] == "terminal"]
+    assert term and term[0]["sid"] == sid
+    assert term[0]["trace_id"] == "zero-step" and term[0]["outcome"] == "done"
+    svc.close()

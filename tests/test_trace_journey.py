@@ -1,0 +1,164 @@
+"""End-to-end journey continuity (docs/OBSERVABILITY.md "Distributed
+tracing"): a real 2-worker fleet with trace collection on, one SIGKILL
+mid-flight — the victim session's merged trace is one contiguous
+``trace_id`` across two worker generations (kill -> resume), and
+``tpu-life doctor`` reconstructs the journey machine-checkably: the
+migration finding is typed, the gap bounded, no double execution, and
+the healthy session's journey stays single-incarnation and anomaly-free.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from tpu_life import obs
+from tpu_life.fleet import Fleet, FleetConfig
+from tpu_life.gateway.client import GatewayClient
+from tpu_life.models.patterns import random_board
+from tpu_life.obs import journey
+
+
+@pytest.fixture
+def traced_fleet(tmp_path):
+    obs.flight.reset()  # the control-plane ring lives in THIS process
+    fleet = Fleet(
+        FleetConfig(
+            workers=2,
+            port=0,
+            worker_args=(
+                "--serve-backend", "numpy", "--capacity", "4",
+                "--chunk-steps", "2",
+            ),
+            log_dir=str(tmp_path / "logs"),
+            spill_dir=str(tmp_path / "spill"),
+            spill_every=1,
+            probe_interval_s=0.1,
+            backoff_base_s=0.2,
+            trace_dir=str(tmp_path / "trace"),
+        )
+    )
+    fleet.start()
+    assert fleet.wait_ready(timeout=90, min_workers=2), fleet.supervisor.states()
+    yield fleet
+    fleet.begin_drain()
+    if not fleet.wait(timeout=30):
+        for w in fleet.supervisor.workers:  # aid post-mortems
+            if w.log_path.exists():
+                print(f"--- {w.name} log tail ---")
+                print(w.log_path.read_text()[-2000:])
+    fleet.close()
+
+
+def test_sigkill_journey_is_one_contiguous_trace(traced_fleet, tmp_path):
+    fleet = traced_fleet
+    client = GatewayClient(f"http://127.0.0.1:{fleet.port}", retries=8)
+
+    boards = [random_board(24, 20, seed=900 + i, density=0.4) for i in range(3)]
+    steps = 1500
+    # the first session carries a CLIENT-supplied trace id (the router
+    # honors X-Trace-Id); the rest get router-minted ones
+    sids = [client.submit(board=boards[0], rule="conway", steps=steps,
+                          trace_id="client-supplied-journey")]
+    sids += [client.submit(board=b, rule="conway", steps=steps)
+             for b in boards[1:]]
+
+    views = {sid: client.poll(sid) for sid in sids}
+    by_worker: dict = {}
+    traces = {}
+    for sid, v in views.items():
+        by_worker.setdefault(v["worker"], []).append(sid)
+        # the router minted a trace id per submission and the worker
+        # echoes it on every poll — the journey key
+        assert obs.valid_trace_id(v["trace_id"]), v
+        traces[sid] = v["trace_id"]
+    assert len(set(traces.values())) == len(sids)
+    assert traces[sids[0]] == "client-supplied-journey"
+
+    # several rounds (and spill passes, spill_every=1) behind every
+    # session before the kill — same recovery-point discipline as the
+    # failover e2e — plus one monitor tick so the scrape collected the
+    # victims' admission spans
+    deadline = time.monotonic() + 60
+    while True:
+        views = {sid: client.poll(sid) for sid in sids}
+        if all(8 <= v["steps_done"] < v["steps"] for v in views.values()):
+            break
+        assert time.monotonic() < deadline, views
+        time.sleep(0.05)
+    time.sleep(0.3)
+
+    victim_name = max(by_worker, key=lambda k: len(by_worker[k]))
+    victim = fleet.supervisor.get(victim_name)
+    victim_gen = victim.generation
+    os.kill(victim.proc.pid, signal.SIGKILL)
+
+    for sid in sids:
+        view = client.wait(sid, timeout=180)
+        assert view["state"] == "done", (sid, view)
+        # the trace id RODE THROUGH the kill: the survivor's session
+        # answers under the same journey id the router minted
+        assert view["trace_id"] == traces[sid], (sid, view)
+
+    fleet.begin_drain()
+    assert fleet.wait(timeout=30)
+    fleet.close()  # final scrape pass + worker trace files are in by now
+
+    # -- merge: one Perfetto timeline, victim trace spans two tracks -------
+    doc = journey.merge_captures(tmp_path / "trace")
+    workers_meta = doc["otherData"]["workers"]
+    assert any(m["worker"] == "control" for m in workers_meta.values())
+    victim_sid = by_worker[victim_name][0]
+    victim_tid = traces[victim_sid]
+    exec_pids = {
+        e["pid"]
+        for e in doc["traceEvents"]
+        if e.get("name") == "serve.exec"
+        and isinstance(e.get("args"), dict)
+        and e["args"].get("trace_id") == victim_tid
+    }
+    incarn = {
+        (workers_meta[str(p)]["worker"], workers_meta[str(p)]["generation"])
+        for p in exec_pids
+    }
+    assert len(incarn) >= 2, incarn  # two generations, one trace id
+    assert (victim_name, victim_gen) in incarn
+
+    # -- doctor: the journey is machine-checkably whole --------------------
+    report = journey.doctor(doc, sid=victim_sid)
+    assert report["trace_id"] == victim_tid
+    assert report["ok"], report["anomalies"]
+    assert report["outcome"] == "done"
+    findings = {f["kind"] for f in report["findings"]}
+    assert "migration" in findings and "worker_exit" in findings
+    mig = next(f for f in report["findings"] if f["kind"] == "migration")
+    assert mig["from"].startswith(victim_name)
+    assert 0.0 <= mig["gap_s"] <= 60.0
+
+    # a session that never migrated: single incarnation, no migration
+    # finding, still anomaly-free
+    healthy = [
+        s for w, ss in by_worker.items() if w != victim_name for s in ss
+    ]
+    if healthy:
+        h_report = journey.doctor(doc, sid=healthy[0])
+        assert h_report["ok"], h_report["anomalies"]
+        assert h_report["outcome"] == "done"
+        assert not any(
+            f["kind"] == "migration" for f in h_report["findings"]
+        )
+
+    # -- the CLI read-back (what the CI smoke drives) -----------------------
+    from tpu_life.cli import main as cli_main
+
+    merged_path = tmp_path / "merged.trace.json"
+    assert cli_main([
+        "trace", "merge", str(tmp_path / "trace"), "-o", str(merged_path),
+    ]) == 0
+    cli_doc = json.loads(merged_path.read_text())
+    assert cli_doc["otherData"]["merged"] is True
+    assert cli_main([
+        "doctor", str(merged_path), "--sid", victim_sid, "--json",
+    ]) == 0

@@ -58,6 +58,8 @@ from dataclasses import dataclass
 import numpy as _np
 
 from tpu_life.mc.prng import key_halves, threefry2x32, threshold_u32
+from tpu_life.obs import flight as _flight
+from tpu_life.obs import trace as _trace
 
 #: Environment variable carrying a JSON plan spec; read once per process
 #: at CLI entry (``maybe_arm_from_env``), inherited by spawned workers.
@@ -399,6 +401,15 @@ def _record(point: str, outcome: str) -> None:
     fam = _REG_FAMILY
     if fam is not None:
         fam.labels(point=point, outcome=outcome).inc()
+    # the trace marker (docs/OBSERVABILITY.md "Distributed tracing"):
+    # every fired injection is an instant event in whatever timeline is
+    # active, so a drill's merged trace shows fault <-> latency
+    # correlation instead of only counters.  instant() is the standard
+    # one-global-check no-op when no tracer is active; a fire only
+    # happens under an armed plan, so the disarmed path never gets here.
+    _trace.instant("chaos.injection", point=point, decision=outcome)
+    # and the flight-recorder twin: injections are postmortem decisions
+    _flight.record("injection", point=point, decision=outcome)
 
 
 # -- the seam helpers (all no-ops when disarmed) -----------------------------

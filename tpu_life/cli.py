@@ -410,6 +410,14 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--metrics-dir", default=None, metavar="DIR",
                     help="per-worker JSONL sinks at DIR/wN.jsonl — read "
                     "them back merged with `tpu-life stats DIR/*.jsonl`")
+    fl.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="fleet trace collection (docs/OBSERVABILITY.md "
+                    "distributed tracing): workers run with active "
+                    "tracers and the supervisor drains their span + "
+                    "flight rings into per-worker capture files here on "
+                    "every monitor tick; fuse them with `tpu-life trace "
+                    "merge DIR` and read one session's journey back with "
+                    "`tpu-life doctor DIR --sid SID`")
     fl.add_argument("--log-dir", default=None, metavar="DIR",
                     help="per-worker stdout+stderr logs at DIR/wN.log "
                     "(default: a fresh temp dir)")
@@ -592,6 +600,49 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead of "
                     "the human table")
+
+    tr = sub.add_parser(
+        "trace",
+        help="distributed-trace tooling (docs/OBSERVABILITY.md): fuse a "
+        "fleet capture directory (`fleet --trace-dir`) into one "
+        "Perfetto-loadable timeline",
+    )
+    tr_sub = tr.add_subparsers(dest="trace_command", required=True)
+    trm = tr_sub.add_parser(
+        "merge",
+        help="merge per-worker capture files into one Chrome-trace JSON "
+        "with per-worker process tracks and handshake-estimated clock "
+        "offsets applied",
+    )
+    trm.add_argument("capture_dir", metavar="DIR",
+                     help="the `fleet --trace-dir` capture directory")
+    trm.add_argument("-o", "--output", default=None, metavar="FILE",
+                     help="merged trace path (default: DIR/merged.trace.json)")
+
+    dr = sub.add_parser(
+        "doctor",
+        help="flight-recorder postmortem (docs/OBSERVABILITY.md doctor): "
+        "reconstruct one session's causal journey — submit, rounds, "
+        "injections, kill, migration, resume, done — across workers "
+        "from a trace capture, with typed findings and anomaly checks",
+    )
+    dr.add_argument("capture", metavar="CAPTURE",
+                    help="a capture directory (`fleet --trace-dir`), a "
+                    "merged trace (`tpu-life trace merge`), or a single "
+                    "written trace file")
+    dr.add_argument("--sid", default=None,
+                    help="the session id to reconstruct (fleet sid like "
+                    "w0g1-s000003, or a worker-local sid)")
+    dr.add_argument("--trace-id", default=None,
+                    help="reconstruct by trace id directly (skips the "
+                    "sid -> trace resolution)")
+    dr.add_argument("--max-gap", type=float, default=None, metavar="SECONDS",
+                    help="bound on the kill -> resumed-on-survivor gap "
+                    "before the doctor flags migration_gap_exceeded "
+                    "(default 60)")
+    dr.add_argument("--json", action="store_true",
+                    help="emit the machine-readable journey report as "
+                    "one JSON object")
 
     sm = sub.add_parser(
         "submit",
@@ -847,6 +898,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "stats":
         # pure file read — the read-back toolchain never needs a device
         return _stats(args)
+    if args.command == "trace":
+        # pure file fusion — capture records in, one Perfetto doc out
+        return _trace_merge(args)
+    if args.command == "doctor":
+        # pure file read-back: the journey reconstruction needs no device
+        return _doctor(args)
     if args.command == "client":
         # pure HTTP: the gateway owns the devices, the client only needs
         # numpy + urllib — runs anywhere, no watchdog, no jax
@@ -1169,6 +1226,74 @@ def _stats(args) -> int:
     else:
         print(obs_stats.render(summary))
     return 0
+
+
+def _trace_merge(args) -> int:
+    """Fuse a fleet trace-capture directory (docs/OBSERVABILITY.md
+    "Distributed tracing") into one Perfetto-loadable Chrome-trace JSON:
+    per-worker process tracks, flight events as instant markers, clock
+    offsets applied.  Prints one JSON line naming the output and its
+    shape (events, incarnations, drops)."""
+    import json
+    from pathlib import Path
+
+    from tpu_life.obs import journey
+
+    try:
+        doc = journey.merge_captures(args.capture_dir)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"trace merge: {e}", file=sys.stderr)
+        return 2
+    out = args.output or str(Path(args.capture_dir) / "merged.trace.json")
+    Path(out).parent.mkdir(parents=True, exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    workers = doc["otherData"]["workers"]
+    print(
+        json.dumps(
+            {
+                "mode": "trace-merge",
+                "output": out,
+                "events": len(doc["traceEvents"]),
+                "incarnations": len(workers),
+                "dropped": sum(w.get("dropped", 0) for w in workers.values()),
+            }
+        )
+    )
+    return 0
+
+
+def _doctor(args) -> int:
+    """The flight-recorder postmortem (docs/OBSERVABILITY.md "Doctor"):
+    reconstruct one session's causal journey across workers and check
+    its invariants.  Exit 0 when the journey is anomaly-free (findings —
+    migrations, kills, injections — are information, not failures);
+    exit 1 when an invariant broke (double execution, unbounded
+    migration gap, no terminal event, unknown sid); exit 2 on usage or
+    unreadable-capture errors."""
+    import json
+
+    from tpu_life.obs import journey
+
+    if args.sid is None and args.trace_id is None:
+        print("doctor: pass --sid or --trace-id", file=sys.stderr)
+        return 2
+    try:
+        doc = journey.load_merged(args.capture)
+    except (FileNotFoundError, ValueError, json.JSONDecodeError) as e:
+        print(f"doctor: {e}", file=sys.stderr)
+        return 2
+    kwargs = {}
+    if args.max_gap is not None:
+        kwargs["max_gap_s"] = args.max_gap
+    report = journey.doctor(
+        doc, sid=args.sid, trace_id=args.trace_id, **kwargs
+    )
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(journey.render_report(report))
+    return 0 if report["ok"] else 1
 
 
 def _submit(args) -> int:
@@ -1800,6 +1925,7 @@ def _fleet(args) -> int:
                 site=args.site,
                 peers=tuple(args.peers or ()),
                 lease_ttl_s=args.lease_ttl,
+                trace_dir=args.trace_dir,
                 probe_interval_s=args.probe_interval,
                 backoff_base_s=args.restart_backoff,
                 # the flag counts RESTARTS; the breaker counts consecutive

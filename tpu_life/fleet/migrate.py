@@ -58,7 +58,7 @@ import time
 from collections import OrderedDict
 from pathlib import Path
 
-from tpu_life import chaos
+from tpu_life import chaos, obs
 from tpu_life.fleet.registry import fleet_sid
 from tpu_life.fleet.router import (
     REFUSAL_CODES,
@@ -80,6 +80,7 @@ PEER_REFUSAL_CODES = REFUSAL_CODES | {"fleet_unavailable"}
 #: router must not grow without bound; an evicted outcome degrades to
 #: ``never_snapshotted`` — still a truthful 410).
 MAX_OUTCOMES = 100_000
+
 
 
 def worker_spill_dir(root: str, name: str, generation: int) -> Path:
@@ -104,6 +105,12 @@ def resume_request(rec: SpillRecord) -> dict:
         body["temperature"] = rec.temperature
     if rec.timeout_s is not None:
         body["timeout_s"] = rec.timeout_s
+    if rec.trace_id is not None:
+        # trace continuity (docs/OBSERVABILITY.md "Distributed tracing"):
+        # the manifest-persisted id rides the resume wire body, so the
+        # survivor's session CONTINUES the dead worker's trace — one
+        # trace_id across generations and hosts
+        body["trace_id"] = rec.trace_id
     return body
 
 
@@ -331,6 +338,14 @@ class Migrator:
                 len(corrupt),
                 len(disabled),
             )
+            obs.flight.record(
+                "migrate.start",
+                worker=name,
+                generation=generation,
+                sessions=len(records),
+                corrupt=len(corrupt),
+                disabled=len(disabled),
+            )
             for sid in corrupt:
                 self._record_failure(
                     self._target_fsid(name, generation, sid),
@@ -414,13 +429,15 @@ class Migrator:
         attempt = 0
         while True:
             ready = self.supervisor.ready_workers()
-            outcome, hint = self._try_candidates(fsid, body, ready)
+            outcome, hint = self._try_candidates(
+                fsid, body, ready, rec.trace_id
+            )
             if outcome == "refused" and self.peers:
                 # every LOCAL survivor definitively declined (or none is
                 # ready): re-home across the host boundary — the peer
                 # control plane's router speaks the same protocol, and the
                 # original sid keeps answering through the peer proxy
-                outcome, peer_hint = self._try_peers(fsid, body)
+                outcome, peer_hint = self._try_peers(fsid, body, rec.trace_id)
                 hint = max(hint, peer_hint)
             if outcome in ("migrated", "peer", "failed"):
                 break
@@ -455,7 +472,9 @@ class Migrator:
                 outcome="peer" if outcome == "peer" else "migrated"
             ).inc()
 
-    def _try_candidates(self, fsid: str, body: bytes, ready) -> tuple[str, float]:
+    def _try_candidates(
+        self, fsid: str, body: bytes, ready, trace_id: str | None = None
+    ) -> tuple[str, float]:
         """One pass over the ready workers: ``('migrated' | 'failed' |
         'refused', retry_after_hint)`` — 'failed' is ambiguous or a
         protocol rejection (do not retry); 'refused' means every candidate
@@ -502,6 +521,14 @@ class Migrator:
                     target_gen,
                     wsid,
                 )
+                obs.flight.record(
+                    "migrate.resumed",
+                    sid=fsid,
+                    trace_id=trace_id,
+                    worker=worker.name,
+                    generation=target_gen,
+                    worker_sid=wsid,
+                )
                 return "migrated", 0.0
             code = _error_code(doc)
             if status == 503 and code in REFUSAL_CODES:
@@ -523,7 +550,9 @@ class Migrator:
             return "failed", 0.0
         return "refused", hint
 
-    def _try_peers(self, fsid: str, body: bytes) -> tuple[str, float]:
+    def _try_peers(
+        self, fsid: str, body: bytes, trace_id: str | None = None
+    ) -> tuple[str, float]:
         """One pass over the peer control planes: ``('peer' | 'failed' |
         'refused', hint)``.  The same no-ambiguous-retry discipline as the
         worker pass — a mid-exchange failure against a peer router may
@@ -544,6 +573,13 @@ class Migrator:
                 peer.rstrip("/") + ROUTE_SESSIONS, data=body, method="POST"
             )
             req.add_header("Content-Type", "application/json")
+            if trace_id is not None:
+                # trace continuity across the HOST boundary: the peer's
+                # ROUTER honors X-Trace-Id — without it, the peer would
+                # mint a fresh id (the header wins over the body field at
+                # the worker), severing the journey exactly on the
+                # cross-host rescue the trace exists to show
+                req.add_header("X-Trace-Id", trace_id)
             try:
                 try:
                     with urllib.request.urlopen(
@@ -586,6 +622,13 @@ class Migrator:
                     peer,
                     peer_sid,
                 )
+                obs.flight.record(
+                    "migrate.peer",
+                    sid=fsid,
+                    trace_id=trace_id,
+                    peer=peer,
+                    peer_sid=peer_sid,
+                )
                 return "peer", 0.0
             code = _error_code(doc)
             if status in (429, 503) and (
@@ -605,6 +648,7 @@ class Migrator:
     def _record_failure(
         self, fsid: str, reason: str, *, counter: str = "failed"
     ) -> None:
+        obs.flight.record("migrate.failed", sid=fsid, reason=reason)
         with self._lock:
             self._failed[fsid] = reason
             while len(self._failed) > MAX_OUTCOMES:

@@ -39,7 +39,7 @@ import urllib.request
 from http.server import ThreadingHTTPServer
 from urllib.parse import urlsplit
 
-from tpu_life import chaos
+from tpu_life import chaos, obs
 from tpu_life.fleet import errors as fl_errors
 from tpu_life.fleet.balancer import LeastDepthBalancer, prom_value
 from tpu_life.fleet.membership import ROUTE_HEARTBEAT, ROUTE_REGISTER
@@ -164,6 +164,7 @@ class Router:
         *,
         body: bytes | None = None,
         api_key: str | None = None,
+        trace_id: str | None = None,
     ) -> tuple[int, float | None, dict]:
         """One proxied request; returns (status, retry_after, json body).
         HTTP error statuses return normally (they are protocol answers to
@@ -205,6 +206,10 @@ class Router:
             req.add_header("Content-Type", "application/json")
         if api_key is not None:
             req.add_header("X-API-Key", api_key)
+        if trace_id is not None:
+            # cross-process trace propagation (docs/OBSERVABILITY.md):
+            # the worker stamps this id onto the session it creates
+            req.add_header("X-Trace-Id", trace_id)
         try:
             try:
                 with urllib.request.urlopen(
@@ -241,10 +246,17 @@ class Router:
 
     # -- routing -----------------------------------------------------------
     def route_submit(
-        self, body: bytes, api_key: str | None
+        self, body: bytes, api_key: str | None, trace_id: str | None = None
     ) -> tuple[int, float | None, dict]:
         """The submit pipeline: candidates by least depth, refusal-only
-        retry, pin on 201.  Returns (status, retry_after, response doc)."""
+        retry, pin on 201.  Returns (status, retry_after, response doc).
+
+        ``trace_id`` is the distributed-trace context this router MINTS
+        per submitted session (honoring a client-supplied ``X-Trace-Id``
+        — the handler validates and passes it): forwarded to the chosen
+        worker on the wire, recorded with the pin's flight event, and
+        carried by the session through every later hop (spill, kill,
+        migration) so the whole journey joins on one id."""
         if self._draining:
             raise ApiError(
                 503,
@@ -267,7 +279,12 @@ class Router:
             generation = worker.generation
             try:
                 status, retry_after, doc = self.forward(
-                    worker, "POST", ROUTE_SESSIONS, body=body, api_key=api_key
+                    worker,
+                    "POST",
+                    ROUTE_SESSIONS,
+                    body=body,
+                    api_key=api_key,
+                    trace_id=trace_id,
                 )
             except WorkerUnreachable as e:
                 if e.refused or not worker.alive:
@@ -288,6 +305,19 @@ class Router:
                     doc["session"] = self.sessions.pin(
                         worker.name, generation, sid
                     )
+                    # the journey's first control-plane event: which
+                    # fleet sid this trace was routed as, and to whom —
+                    # the join key `tpu-life doctor --sid` resolves with
+                    obs.flight.record(
+                        "route.submit",
+                        sid=doc["session"],
+                        worker_sid=sid,
+                        trace_id=trace_id,
+                        worker=worker.name,
+                        generation=generation,
+                    )
+                if trace_id is not None:
+                    doc.setdefault("trace_id", trace_id)
                 doc["worker"] = worker.name
                 self._c_routed.labels(worker=worker.name).inc()
                 # this worker's queue just grew: re-scrape before routing
@@ -654,7 +684,15 @@ class _Handler(JsonHandler):
         if path == ROUTE_SESSIONS:
             self._require(method, "POST", path)
             body = self._read_body()
-            status, retry_after, doc = rt.route_submit(body, api_key)
+            # the router MINTS the per-session trace id (honoring a
+            # client-supplied X-Trace-Id, validated typed) — the root of
+            # the session's cross-process journey
+            from tpu_life.gateway.protocol import parse_trace_id
+
+            trace_id = parse_trace_id(self.headers.get("X-Trace-Id"))
+            if trace_id is None:
+                trace_id = obs.new_trace_id()
+            status, retry_after, doc = rt.route_submit(body, api_key, trace_id)
             self._send_json(status, doc, retry_after=retry_after)
             return
         if path.startswith(ROUTE_SESSIONS + "/"):

@@ -56,7 +56,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from tpu_life import chaos
+from tpu_life import chaos, obs
 from tpu_life.fleet.placement import (
     PlacementError,
     apply_env_overlay,
@@ -150,6 +150,16 @@ class FleetConfig:
     total_devices: int | None = None
     #: platform kind the planner targets (cpu / tpu / gpu)
     placement_platform: str = "cpu"
+    #: fleet trace collection (docs/OBSERVABILITY.md "Distributed
+    #: tracing"): when set, every worker runs with an active tracer
+    #: (``--trace-events <trace_dir>/<name>g<gen>.trace.json``) and the
+    #: monitor tick DRAINS each worker's span + flight rings over
+    #: ``GET /v1/debug/trace`` into ``<trace_dir>/<name>.jsonl`` (one
+    #: scrape record per line, with a handshake-estimated clock offset),
+    #: plus this control plane's own flight ring into ``control.jsonl``
+    #: — the capture set ``tpu-life trace merge`` fuses into one
+    #: Perfetto timeline.  None = no collection (zero new requests).
+    trace_dir: str | None = None
 
 
 @dataclass
@@ -325,6 +335,13 @@ class Supervisor:
             "devices resolved by each worker (planned until reported)",
             labels=("worker",),
         )
+        # fleet trace collection (docs/OBSERVABILITY.md): capture-file
+        # appends come from the monitor thread and close() — serialized
+        # here.  _doomed carries (worker, generation, url) recycle
+        # victims whose kill is DEFERRED past the lock so their final
+        # trace scrape (bounded HTTP) never stalls the routing hot path.
+        self._capture_lock = threading.Lock()
+        self._doomed: list[tuple] = []
         for st in WorkerState:
             self._g_workers.labels(state=st.value).set(0.0)
 
@@ -447,6 +464,17 @@ class Supervisor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self.config.trace_dir is not None:
+            # last evidence pass: whatever the workers buffered since the
+            # final monitor tick, plus this process's own flight tail
+            with self._lock:
+                targets = [
+                    (w, w.generation, w.url)
+                    for w in self.workers
+                    if w.url is not None and w.alive
+                ]
+            self._scrape_traces(targets)
+            self._scrape_control()
         with self._lock:
             for w in self.workers:
                 if w.proc is not None and w.proc.poll() is None:
@@ -565,19 +593,32 @@ class Supervisor:
                 if self._tick_liveness(w, now):
                     to_probe.append((w, w.generation))
             self._update_gauges()
-        if not to_probe:
-            return
-        results = self._probe_all(to_probe)
-        with self._lock:
-            for w, gen, status in results:
-                if (
-                    w.generation != gen
-                    or not w.alive
-                    or w.state in (WorkerState.DOWN, WorkerState.FAILED)
-                ):
-                    continue  # stale answer: the next tick sees the truth
-                self._apply_probe(w, status, now)
-            self._update_gauges()
+        if to_probe:
+            results = self._probe_all(to_probe)
+            with self._lock:
+                for w, gen, status in results:
+                    if (
+                        w.generation != gen
+                        or not w.alive
+                        or w.state in (WorkerState.DOWN, WorkerState.FAILED)
+                    ):
+                        continue  # stale answer: the next tick sees the truth
+                    self._apply_probe(w, status, now)
+                self._update_gauges()
+        self._reap_doomed()
+        # fleet trace collection (docs/OBSERVABILITY.md): drain every
+        # live worker's span + flight rings into the capture dir —
+        # continuous, like the PR 11 chaos-counter scrape, so a SIGKILL
+        # loses at most one tick's events.  Runs OUTSIDE the lock.
+        if self.config.trace_dir is not None:
+            with self._lock:
+                targets = [
+                    (w, w.generation, w.url)
+                    for w in self.workers
+                    if w.url is not None and w.alive
+                ]
+            self._scrape_traces(targets)
+            self._scrape_control()
 
     def _probe_all(self, targets: list[tuple[Worker, int]]) -> list[tuple]:
         """Probe workers CONCURRENTLY: tick latency must be max(probe),
@@ -697,8 +738,7 @@ class Supervisor:
                         # worker re-registers when (if) it can reach us
                         self._expire_lease_locked(w)
                     else:
-                        w.recycling = True
-                        w.proc.kill()
+                        self._kill_for_recycle_locked(w)
                 return
             w.unready += 1
             if w.unready >= self.config.unready_threshold:
@@ -710,8 +750,7 @@ class Supervisor:
                 if w.remote:
                     self._expire_lease_locked(w)
                 else:
-                    w.recycling = True
-                    w.proc.kill()
+                    self._kill_for_recycle_locked(w)
 
     def _on_exit(self, w: Worker, now: float) -> None:
         rc = w.proc.poll()
@@ -719,6 +758,17 @@ class Supervisor:
         w.proc = None
         w.url = None
         w.unready = 0
+        # the journey's kill marker: a worker incarnation left the fleet
+        # (crash, SIGKILL, recycle, or drain exit) — what the doctor
+        # anchors a migration gap's left edge on
+        obs.flight.record(
+            "worker.exit",
+            worker=w.name,
+            generation=w.generation,
+            rc=rc,
+            draining=self._draining,
+            recycling=w.recycling,
+        )
         if self._draining:
             w.state = WorkerState.DOWN
             log.info("fleet: %s exited rc=%s (drain)", w.name, rc)
@@ -798,6 +848,9 @@ class Supervisor:
         w.state = WorkerState.DOWN
         w.unready = 0
         self._c_lease_expired.inc()
+        obs.flight.record(
+            "lease.expired", worker=w.name, generation=w.generation
+        )
         if self._draining:
             return
         if self.on_worker_exit is not None:
@@ -893,6 +946,9 @@ class Supervisor:
             w.ever_ready = False
             w.state = WorkerState.STARTING
             self._c_registrations.inc()
+            obs.flight.record(
+                "register", worker=w.name, generation=w.generation, url=url
+            )
             self._update_gauges()
             grant = {
                 "worker": w.name,
@@ -949,6 +1005,7 @@ class Supervisor:
         (caller holds the lock), evicting the oldest fence past the
         :data:`MAX_FENCES` bound."""
         self._fenced[(w.name, w.generation)] = None
+        obs.flight.record("fence", worker=w.name, generation=w.generation)
         while len(self._fenced) > MAX_FENCES:
             self._fenced.popitem(last=False)
 
@@ -961,6 +1018,134 @@ class Supervisor:
         site-prefixed twin of ``worker_spill_dir`` (two fleets sharing a
         store stay disjoint by site)."""
         return f"{self.config.site}{name}g{generation}"
+
+    # -- fleet trace collection (docs/OBSERVABILITY.md) ---------------------
+    def _scrape_traces(self, targets: list[tuple]) -> None:
+        """Drain each target worker's trace + flight rings concurrently
+        (tick latency must be max(scrape), not sum — the probe rule)."""
+        if not targets:
+            return
+        if len(targets) == 1:
+            self._scrape_one(*targets[0])
+            return
+        threads = [
+            threading.Thread(
+                target=self._scrape_one, args=t, daemon=True
+            )
+            for t in targets
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _scrape_one(self, w: Worker, generation: int, url: str) -> None:
+        """One best-effort drain of a worker's ``/v1/debug/trace``,
+        appended as a scrape record to ``<trace_dir>/<name>.jsonl``.
+        The clock offset is handshake-estimated: the worker's reported
+        ``now`` against the midpoint of our request window — on one
+        machine it reads ~0, across hosts it absorbs the wall-clock
+        delta so the merge can place both rings on the collector clock."""
+        t0 = time.time()
+        try:
+            req = urllib.request.Request(url + "/v1/debug/trace")
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                doc = json.loads(resp.read())
+        except Exception:
+            return  # unreachable/dying worker: evidence stays best-effort
+        t1 = time.time()
+        if not isinstance(doc, dict):
+            return
+        events = doc.get("events") or []
+        flights = doc.get("flight") or []
+        if not events and not flights:
+            return  # nothing new this tick: no capture line
+        now = doc.get("now")
+        offset = (
+            float(now) - (t0 + t1) / 2.0
+            if isinstance(now, (int, float))
+            else 0.0
+        )
+        self._append_capture(
+            f"{w.name}.jsonl",
+            {
+                "worker": w.name,
+                "generation": generation,
+                "pid": doc.get("pid"),
+                "run_id": doc.get("run_id"),
+                "wall_t0": doc.get("wall_t0"),
+                "offset_s": offset,
+                "scraped_at": t1,
+                "dropped": doc.get("dropped", 0),
+                "events": events,
+                "flight": flights,
+            },
+        )
+
+    def _scrape_control(self) -> None:
+        """Drain THIS process's flight ring (router pins, migrations,
+        the supervisor's own lifecycle verdicts) into ``control.jsonl``
+        — the control plane is a process in the journey too."""
+        flights = obs.flight.drain()
+        if not flights:
+            return
+        self._append_capture(
+            "control.jsonl",
+            {
+                "worker": "control",
+                "generation": 0,
+                "pid": os.getpid(),
+                "run_id": None,
+                "wall_t0": None,
+                "offset_s": 0.0,  # the collector IS the reference clock
+                "scraped_at": time.time(),
+                "dropped": 0,
+                "events": [],
+                "flight": flights,
+            },
+        )
+
+    def _append_capture(self, fname: str, rec: dict) -> None:
+        root = Path(self.config.trace_dir)
+        try:
+            with self._capture_lock:
+                root.mkdir(parents=True, exist_ok=True)
+                with open(root / fname, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+        except OSError:
+            log.warning("fleet: could not append trace capture %s", fname)
+
+    def _kill_for_recycle_locked(self, w: Worker) -> None:
+        """Kill a recycle victim (startup timeout / unready threshold).
+        With trace collection on and a scrapeable URL, the kill is
+        DEFERRED to the tick's unlocked tail so a best-effort final
+        drain of the victim's rings (the PR 11 chaos-counter-scrape
+        discipline: evidence leaves the process before the process
+        leaves) never runs HTTP under the supervisor lock — the routing
+        hot path (ready_workers/get) takes this lock on every request.
+        Untraced fleets kill inline, byte-for-byte the prior behavior."""
+        w.recycling = True
+        if self.config.trace_dir is not None and w.url is not None:
+            self._doomed.append((w, w.generation, w.url))
+        else:
+            w.proc.kill()
+
+    def _reap_doomed(self) -> None:
+        """The tick's unlocked tail: final-scrape each deferred recycle
+        victim, then deliver its kill (re-validated under the lock — the
+        generation must still be the condemned one and the process still
+        alive; a self-exit meanwhile already took the _on_exit path)."""
+        with self._lock:
+            doomed, self._doomed = self._doomed, []
+        for w, gen, url in doomed:
+            self._scrape_one(w, gen, url)
+            with self._lock:
+                if (
+                    w.generation == gen
+                    and w.proc is not None
+                    and w.proc.poll() is None
+                ):
+                    w.proc.kill()
 
     # -- chaos-injection retention (docs/CHAOS.md) --------------------------
     def _record_injections_locked(self, w: Worker, series: dict) -> None:
@@ -1059,6 +1244,19 @@ class Supervisor:
                 self.spill_namespace(w.name, w.generation),
                 "--spill-every",
                 str(self.config.spill_every),
+            ]
+        if self.config.trace_dir is not None:
+            # fleet trace collection: an ACTIVE tracer per incarnation —
+            # the scrape drains its ring live, and a graceful exit writes
+            # whatever was never drained to the per-generation file the
+            # merge also reads (a respawn must not clobber its
+            # predecessor's undrained tail)
+            argv += [
+                "--trace-events",
+                str(
+                    Path(self.config.trace_dir)
+                    / f"{w.name}g{w.generation}.trace.json"
+                ),
             ]
         return argv
 

@@ -85,7 +85,13 @@ class GatewayClient:
         self.rng = rng or random.Random()
 
     # -- transport ---------------------------------------------------------
-    def _request(self, method: str, path: str, body: dict | None = None) -> dict:
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: dict | None = None,
+        headers: dict | None = None,
+    ) -> dict:
         url = self.base_url + path
         data = None if body is None else json.dumps(body).encode()
         attempt = 0
@@ -94,6 +100,8 @@ class GatewayClient:
             req.add_header("Content-Type", "application/json")
             if self.api_key:
                 req.add_header("X-API-Key", self.api_key)
+            for k, v in (headers or {}).items():
+                req.add_header(k, v)
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                     return json.loads(resp.read() or b"{}")
@@ -151,13 +159,17 @@ class GatewayClient:
         seed: int | None = None,
         density: float | None = None,
         temperature: float | None = None,
+        trace_id: str | None = None,
     ) -> str:
         """Create a session (inline board, or seeded geometry); returns sid.
 
         ``seed`` and ``temperature`` are the stochastic-tier fields
         (docs/STOCHASTIC.md): seed names the counter-based PRNG stream
         (and, for seeded geometry, the staged board); temperature is the
-        per-session ising scalar.
+        per-session ising scalar.  ``trace_id`` rides the ``X-Trace-Id``
+        header (docs/OBSERVABILITY.md "Distributed tracing"): the router
+        honors it as the session's journey id instead of minting one —
+        how a client correlates ITS request id with the fleet's trace.
         """
         req: dict = {"rule": rule, "steps": steps}
         if timeout_s is not None:
@@ -177,7 +189,8 @@ class GatewayClient:
             ):
                 if v is not None:
                     req[k] = v
-        resp = self._request("POST", "/v1/sessions", req)
+        headers = {"X-Trace-Id": trace_id} if trace_id is not None else None
+        resp = self._request("POST", "/v1/sessions", req, headers=headers)
         return resp["session"]
 
     def poll(self, sid: str) -> dict:

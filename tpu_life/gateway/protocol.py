@@ -87,6 +87,11 @@ class SubmitSpec:
     seed: int | None = None
     temperature: float | None = None
     start_step: int = 0
+    #: distributed-trace context (docs/OBSERVABILITY.md "Distributed
+    #: tracing"): a client- or router-supplied id for this session's
+    #: cross-process journey; the ``X-Trace-Id`` header wins over the
+    #: body field at the HTTP layer, and a malformed value is a typed 400
+    trace_id: str | None = None
 
 
 def _require_int(payload: dict, key: str, *, minimum: int = 0) -> int:
@@ -101,6 +106,24 @@ def _require_int(payload: dict, key: str, *, minimum: int = 0) -> int:
             "invalid_request", f"{key!r} must be >= {minimum}, got {v}"
         )
     return v
+
+
+def parse_trace_id(raw) -> str | None:
+    """Validate a wire trace id (body field or ``X-Trace-Id`` header):
+    None passes through, anything else must match the bounded id shape
+    (``obs.TRACE_ID_RE``) — a hostile value must not ride into every
+    span, file name and flight event of the session's journey."""
+    if raw is None:
+        return None
+    from tpu_life import obs
+
+    if not obs.valid_trace_id(raw):
+        raise bad_request(
+            "invalid_trace_id",
+            "trace id must be 1-64 characters of [A-Za-z0-9._:-] "
+            "starting alphanumeric",
+        )
+    return raw
 
 
 def parse_board(raw, states: int) -> np.ndarray:
@@ -238,6 +261,7 @@ def parse_submit(payload) -> SubmitSpec:
     start_step = (
         _require_int(payload, "start_step") if "start_step" in payload else 0
     )
+    trace_id = parse_trace_id(payload.get("trace_id"))
 
     if "resume_b64" in payload:
         # failover resume: byte-exact contract-codec board + the absolute
@@ -251,6 +275,7 @@ def parse_submit(payload) -> SubmitSpec:
             seed=seed,
             temperature=temperature,
             start_step=start_step,
+            trace_id=trace_id,
         )
 
     if "board" in payload:
@@ -263,6 +288,7 @@ def parse_submit(payload) -> SubmitSpec:
             seed=seed,
             temperature=temperature,
             start_step=start_step,
+            trace_id=trace_id,
         )
 
     # seeded geometry: the self-contained demo path (run --size over HTTP);
@@ -315,6 +341,7 @@ def parse_submit(payload) -> SubmitSpec:
         seed=staged_seed,
         temperature=temperature,
         start_step=start_step,
+        trace_id=trace_id,
     )
 
 
@@ -350,6 +377,10 @@ def render_view(view: SessionView) -> dict:
     # to keep serving, so untouched sessions keep their exact prior shape
     if view.degraded_reason is not None:
         out["degraded_reason"] = view.degraded_reason
+    # the distributed-trace id (docs/OBSERVABILITY.md): echoed whenever
+    # the session carries one, so a client report names the exact trace
+    if view.trace_id is not None:
+        out["trace_id"] = view.trace_id
     return out
 
 
@@ -389,6 +420,7 @@ __all__ = [
     "decode_result",
     "parse_board",
     "parse_resume_board",
+    "parse_trace_id",
     "parse_submit",
     "render_result",
     "render_view",

@@ -53,6 +53,9 @@ from tpu_life.version import __version__
 ROUTE_SESSIONS = "/v1/sessions"
 ROUTE_SESSION = "/v1/sessions/{sid}"
 ROUTE_RESULT = "/v1/sessions/{sid}/result"
+#: The trace drain verb (docs/OBSERVABILITY.md "Distributed tracing"):
+#: each GET takes (and clears) the worker's buffered span + flight rings.
+ROUTE_TRACE = "/v1/debug/trace"
 
 
 @dataclass
@@ -238,7 +241,14 @@ class Gateway:
                     # log it, remember it (the CLI exits non-zero and the
                     # summary carries it), and shut down — a stepping-dead
                     # gateway that kept answering polls would only strand
-                    # its clients more slowly
+                    # its clients more slowly.  The flight ring gets the
+                    # verdict first, so the LAST capture (scrape or the
+                    # close-time dump) names the cause of death.
+                    from tpu_life import obs
+
+                    obs.flight.record(
+                        "pump_crash", error=f"{type(e).__name__}: {e}"
+                    )
                     log.exception("gateway: pump thread crashed")
                     self.pump_error = e
                     break
@@ -424,6 +434,10 @@ class _Handler(JsonHandler):
             if method != "GET":
                 raise gw_errors.method_not_allowed(method, path)
             return "/metrics", self._metrics, {}
+        if path == ROUTE_TRACE:
+            if method != "GET":
+                raise gw_errors.method_not_allowed(method, path)
+            return ROUTE_TRACE, self._debug_trace, {}
         if path == ROUTE_SESSIONS:
             if method != "POST":
                 raise gw_errors.method_not_allowed(method, path)
@@ -507,6 +521,14 @@ class _Handler(JsonHandler):
         self._send_text(200, text, "text/plain; version=0.0.4")
         return 200
 
+    def _debug_trace(self) -> int:
+        # the fleet trace-collection seam (docs/OBSERVABILITY.md): drain
+        # this worker's buffered span + flight events to the scraper.
+        # Destructive by design — each scrape is an increment, so the
+        # supervisor's per-tick collection never duplicates an event.
+        self._send_json(200, self.gw.service.drain_trace())
+        return 200
+
     def _create(self) -> int:
         gw = self.gw
         svc = gw.service
@@ -524,6 +546,17 @@ class _Handler(JsonHandler):
             gw._c_shed.inc()
             raise gw_errors.overloaded(shed[0], gw.shedder.high_water, shed[1])
         spec = protocol.parse_submit(self._read_body())
+        # distributed-trace context (docs/OBSERVABILITY.md): the header
+        # (what the fleet router forwards) wins over the body field (what
+        # a resume request carries); with neither, the gateway mints one
+        # — every HTTP-submitted session has a journey id from birth
+        trace_id = protocol.parse_trace_id(self.headers.get("X-Trace-Id"))
+        if trace_id is None:
+            trace_id = spec.trace_id
+        if trace_id is None:
+            from tpu_life import obs
+
+            trace_id = obs.new_trace_id()
         try:
             sid = svc.submit(
                 spec.board,
@@ -533,6 +566,7 @@ class _Handler(JsonHandler):
                 seed=spec.seed,
                 temperature=spec.temperature,
                 start_step=spec.start_step,
+                trace_id=trace_id,
             )
         except Exception as e:  # typed serve errors -> typed HTTP
             raise gw_errors.from_serve_error(e) from e

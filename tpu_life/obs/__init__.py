@@ -38,6 +38,7 @@ from tpu_life.obs.registry import (
     MetricsRegistry,
 )
 from tpu_life.obs.trace import (
+    DEFAULT_MAX_EVENTS,
     TELEMETRY_SCHEMA,
     Tracer,
     activate,
@@ -48,18 +49,22 @@ from tpu_life.obs.trace import (
     complete,
     instant,
     new_run_id,
+    new_trace_id,
     now,
     reset_span_count,
     span,
     span_count,
     start_tracing,
     stop_tracing,
+    tracing,
+    valid_trace_id,
 )
-from tpu_life.obs import stats
+from tpu_life.obs import flight, stats
 
 __all__ = [
     "TELEMETRY_SCHEMA",
     "DEFAULT_BUCKETS",
+    "DEFAULT_MAX_EVENTS",
     "Counter",
     "Family",
     "Gauge",
@@ -72,8 +77,10 @@ __all__ = [
     "ensure_parent",
     "async_end",
     "complete",
+    "flight",
     "instant",
     "new_run_id",
+    "new_trace_id",
     "now",
     "reset_span_count",
     "span",
@@ -81,4 +88,6 @@ __all__ = [
     "start_tracing",
     "stop_tracing",
     "stats",
+    "tracing",
+    "valid_trace_id",
 ]

@@ -34,15 +34,29 @@ Event vocabulary (all timestamps in microseconds since tracer start):
   (:meth:`Tracer.async_begin` / :meth:`Tracer.async_end`) — per-session
   queue-wait intervals in the serve layer, which overlap freely.
 - ``ph: "i"``     — instant markers (:meth:`Tracer.instant`).
+
+Distributed tracing (docs/OBSERVABILITY.md "Distributed tracing"): a
+**trace id** names one session's whole journey across processes — the
+fleet router mints one per submitted session (honoring a client-supplied
+``X-Trace-Id``), workers stamp it onto the session, the spill manifest
+persists it, and a migrated session CONTINUES the same trace on its
+survivor.  The buffer is a bounded ring (:data:`DEFAULT_MAX_EVENTS`;
+drops counted in ``Tracer.dropped`` / ``trace_spans_dropped_total``) so
+a long-running serve process never grows without bound, and
+:meth:`Tracer.drain` hands the buffered events to a fleet scraper
+(``GET /v1/debug/trace``) for cross-process merging
+(``tpu-life trace merge``).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 import uuid
+from collections import deque
 from contextlib import contextmanager, nullcontext
 from pathlib import Path
 
@@ -52,9 +66,35 @@ from pathlib import Path
 TELEMETRY_SCHEMA = 1
 
 
+#: Span-ring capacity (events) — a long-running serve process must not
+#: grow its trace buffer without bound between scrapes.  At roughly 200
+#: bytes per event dict this caps the buffer near ~13 MB; past it the
+#: OLDEST events are evicted (flight-recorder semantics: the most recent
+#: window survives) and ``Tracer.dropped`` counts the loss, exported as
+#: the ``trace_spans_dropped_total`` metric by the serve tier.
+DEFAULT_MAX_EVENTS = 65536
+
+#: The wire shape of a trace id: bounded, filesystem- and header-safe.
+#: Anything else on ``X-Trace-Id`` / ``trace_id`` is a typed 400 — a
+#: hostile header must not mint unbounded junk into every span.
+TRACE_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._:-]{0,63}")
+
+
 def new_run_id() -> str:
     """A fresh correlation id: 12 hex chars, unique per invocation."""
     return uuid.uuid4().hex[:12]
+
+
+def new_trace_id() -> str:
+    """A fresh distributed-trace id: 16 hex chars, minted once per
+    submitted session (by the fleet router, or the gateway when it fronts
+    clients directly) and carried through every hop the session takes."""
+    return uuid.uuid4().hex[:16]
+
+
+def valid_trace_id(s) -> bool:
+    """True when ``s`` is a legal client-supplied trace id."""
+    return isinstance(s, str) and TRACE_ID_RE.fullmatch(s) is not None
 
 
 def ensure_parent(path) -> None:
@@ -78,19 +118,45 @@ def reset_span_count() -> None:
 
 
 class Tracer:
-    """Collects Chrome trace events in memory; :meth:`write` emits the file.
+    """Collects Chrome trace events in a bounded ring; :meth:`write`
+    emits the file, :meth:`drain` hands the buffer to a fleet scraper.
 
-    In-memory buffering keeps the hot path to one dict append; the driver
-    and the serve service call :meth:`write` from a ``finally`` so a failed
-    run still leaves its partial trace on disk.
+    In-memory buffering keeps the hot path to one deque append; the
+    driver and the serve service call :meth:`write` from a ``finally`` so
+    a failed run still leaves its partial trace on disk.  The ring is
+    bounded (``max_events``): a months-running serve process evicts its
+    OLDEST events rather than growing without bound, and ``dropped``
+    counts the evictions (a B whose E was evicted — or vice versa — is
+    an unmatched pair the Perfetto loader tolerates).
     """
 
-    def __init__(self, path: str, run_id: str | None = None):
+    def __init__(
+        self,
+        path: str,
+        run_id: str | None = None,
+        *,
+        max_events: int = DEFAULT_MAX_EVENTS,
+    ):
         self.path = str(path)
         self.run_id = run_id or new_run_id()
         self._t0 = time.perf_counter()
+        #: wall clock at tracer start — the cross-process anchor: an
+        #: event's epoch time is ``wall_t0 + ts/1e6``, which is how the
+        #: fleet merge aligns per-worker rings on one timeline
+        self.wall_t0 = time.time()
         self._pid = os.getpid()
-        self._events: list[dict] = []
+        if max_events < 1:
+            raise ValueError(f"max_events must be >= 1, got {max_events}")
+        self.max_events = int(max_events)
+        self._events: deque = deque()
+        # emitters (pump/verb threads) and drain (the HTTP scrape
+        # handler) run on different threads: the ring is locked so a
+        # span racing a scrape lands on exactly one side of the drain,
+        # never on an abandoned buffer.  Events are host-phase-level —
+        # one uncontended acquire each is noise (the flight ring pays
+        # the same).
+        self._buf_lock = threading.Lock()
+        self.dropped = 0
 
     # -- clocks -----------------------------------------------------------
     def now(self) -> float:
@@ -100,13 +166,32 @@ class Tracer:
     def _ts(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def _emit(self, ev: dict) -> None:
+        with self._buf_lock:
+            self._events.append(ev)
+            # ring semantics: evict oldest past the cap (one popleft per
+            # append once saturated — O(1), no reallocation)
+            while len(self._events) > self.max_events:
+                self._events.popleft()
+                self.dropped += 1
+
+    def drain(self) -> list[dict]:
+        """Atomically take (and clear) the buffered events — the fleet
+        scrape path (``GET /v1/debug/trace``): each scrape is an
+        increment, and a graceful :meth:`write` afterwards emits only
+        what was never drained.  Locked against emitters, so a span
+        racing a scrape lands on exactly one side of the drain."""
+        with self._buf_lock:
+            taken, self._events = self._events, deque()
+        return list(taken)
+
     # -- event emitters ---------------------------------------------------
     @contextmanager
     def span(self, name: str, **attrs):
         """A nested B/E duration span around the enclosed block."""
         _PROBE["spans"] += 1
         tid = threading.get_ident()
-        self._events.append(
+        self._emit(
             {
                 "name": name,
                 "ph": "B",
@@ -119,7 +204,7 @@ class Tracer:
         try:
             yield self
         finally:
-            self._events.append(
+            self._emit(
                 {
                     "name": name,
                     "ph": "E",
@@ -132,7 +217,7 @@ class Tracer:
     def complete(self, name: str, start_s: float, end_s: float, **attrs) -> None:
         """A complete (ph ``X``) event for an interval measured after the
         fact — ``start_s``/``end_s`` are on this tracer's :meth:`now` clock."""
-        self._events.append(
+        self._emit(
             {
                 "name": name,
                 "ph": "X",
@@ -145,7 +230,7 @@ class Tracer:
         )
 
     def instant(self, name: str, **attrs) -> None:
-        self._events.append(
+        self._emit(
             {
                 "name": name,
                 "ph": "i",
@@ -160,7 +245,7 @@ class Tracer:
     def async_begin(self, name: str, aid: str, **attrs) -> None:
         """Open an async interval (``ph: "b"``) keyed by ``aid`` — for
         overlapping non-nested intervals like per-session queue waits."""
-        self._events.append(
+        self._emit(
             {
                 "name": name,
                 "cat": name,
@@ -174,7 +259,7 @@ class Tracer:
         )
 
     def async_end(self, name: str, aid: str, **attrs) -> None:
-        self._events.append(
+        self._emit(
             {
                 "name": name,
                 "cat": name,
@@ -191,12 +276,25 @@ class Tracer:
     def write(self) -> str:
         """Write the Chrome-trace JSON object; returns the path written."""
         ensure_parent(self.path)
+        with self._buf_lock:
+            # snapshot under the ring lock: a handler-thread emit (or a
+            # racing scrape) during the copy would otherwise mutate the
+            # deque mid-iteration and abort the write
+            events = list(self._events)
+            dropped = self.dropped
         doc = {
-            "traceEvents": self._events,
+            "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {
                 "run_id": self.run_id,
                 "telemetry_schema": TELEMETRY_SCHEMA,
+                # the cross-process anchors (docs/OBSERVABILITY.md
+                # "Distributed tracing"): the epoch second ts=0 maps to,
+                # and how many ring evictions this buffer suffered —
+                # additive fields, so schema-1 consumers are unaffected
+                "wall_t0": self.wall_t0,
+                "pid": self._pid,
+                "dropped": dropped,
             },
         }
         with open(self.path, "w") as f:
@@ -255,6 +353,13 @@ def stop_tracing(tracer: Tracer | None = None) -> str | None:
     if _ACTIVE is t:
         _ACTIVE = None
     return t.write()
+
+
+def tracing() -> bool:
+    """True while a tracer is active — the ONE global check callers use
+    before building costly span attributes (per-slot sid/trace lists):
+    the disarmed path stays a single ``None`` test, nothing allocated."""
+    return _ACTIVE is not None
 
 
 def span(name: str, **attrs):

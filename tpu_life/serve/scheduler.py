@@ -63,6 +63,21 @@ from tpu_life.serve.errors import QueueFull, SessionTimeout
 from tpu_life.serve.sessions import Session, SessionState
 
 
+def _slot_attrs(slots: dict) -> dict:
+    """Per-slot trace attributes for a dispatch/step span — WHICH
+    sessions (and which distributed traces) this device chunk advanced.
+    Guarded by the one-global-check discipline: with no active tracer
+    this is a single ``None`` test and allocates nothing."""
+    if not obs.tracing():
+        return {}
+    return {
+        "sids": [s.sid for s in slots.values()],
+        "trace_ids": sorted(
+            {s.trace_id for s in slots.values() if s.trace_id is not None}
+        ),
+    }
+
+
 @dataclass
 class RoundStats:
     """What one scheduling round did — the metrics payload."""
@@ -562,7 +577,10 @@ class Scheduler:
             if not slots:
                 continue
             with obs.span(
-                "serve.step-chunk", occupied=len(slots), steps=engine.chunk_steps
+                "serve.step-chunk",
+                occupied=len(slots),
+                steps=engine.chunk_steps,
+                **_slot_attrs(slots),
             ):
                 try:
                     advanced = engine.dispatch_chunk()
@@ -660,7 +678,10 @@ class Scheduler:
         if not any(s.steps_remaining > 0 for s in slots.values()):
             return False
         with obs.span(
-            "serve.dispatch", occupied=len(slots), steps=engine.chunk_steps
+            "serve.dispatch",
+            occupied=len(slots),
+            steps=engine.chunk_steps,
+            **_slot_attrs(slots),
         ):
             try:
                 advanced = engine.dispatch_chunk()

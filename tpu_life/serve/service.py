@@ -57,6 +57,7 @@ same tooling as a batch run.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -306,6 +307,17 @@ class SimulationService:
             "rebuild_failed / wedged)",
             labels=("outcome",),
         )
+        # the span-ring loss counter (docs/OBSERVABILITY.md "Distributed
+        # tracing"): events evicted from the bounded trace buffer between
+        # scrapes — a nonzero value tells the doctor a journey may have
+        # holes that are collection loss, not anomalies
+        self._c_trace_dropped = self.registry.counter(
+            "trace_spans_dropped_total",
+            "trace events evicted from the bounded span ring before any "
+            "scrape or write could collect them",
+        )
+        self._c_trace_dropped.labels()
+        self._trace_dropped_seen = 0
         self._g_mem_budget.set(float(self._memory_budget or 0))
         # key buckets whose estimated-bytes gauge was last set (released
         # engines' buckets zero out in the next round's sweep)
@@ -418,8 +430,18 @@ class SimulationService:
         seed: int | None = None,
         temperature: float | None = None,
         start_step: int = 0,
+        trace_id: str | None = None,
     ) -> str:
         """Admit one simulation request; returns its session id.
+
+        ``trace_id`` is the distributed-trace context
+        (docs/OBSERVABILITY.md "Distributed tracing"): the id naming this
+        session's whole cross-process journey, stamped onto every span
+        and flight event that touches it and persisted in the spill
+        manifest so a migrated resume CONTINUES the same trace.  The
+        gateway passes the client's ``X-Trace-Id`` (or the router's
+        minted one); None — the library default — adds no context and
+        costs nothing.
 
         Validates exactly what the driver validates (2-D int8 board, every
         state within the rule's range, non-negative budget) and raises
@@ -533,11 +555,15 @@ class SimulationService:
                         # covers it; a never-fits session is a client
                         # error, not overload, and stays out
                         self._c_rejections.inc()
-                    self._c_adm_rejected.labels(
-                        reason="insufficient_memory"
+                    reason = (
+                        "insufficient_memory"
                         if e.transient
                         else "session_too_large"
-                    ).inc()
+                    )
+                    self._c_adm_rejected.labels(reason=reason).inc()
+                    obs.flight.record(
+                        "rejection", reason=reason, trace_id=trace_id
+                    )
                     raise
             # backpressure check BEFORE the session exists anywhere; a bounce
             # is an admission outcome worth counting (rejection rate is the
@@ -547,6 +573,9 @@ class SimulationService:
             except QueueFull:
                 self._c_rejections.inc()
                 self._c_adm_rejected.labels(reason="queue_full").inc()
+                obs.flight.record(
+                    "rejection", reason="queue_full", trace_id=trace_id
+                )
                 raise
             now = self.clock()
             if timeout_s is None:
@@ -560,6 +589,19 @@ class SimulationService:
                 fault_at=fault_at,
                 seed=seed,
                 temperature=None if temperature is None else float(temperature),
+                start_step=start_step,
+                trace_id=trace_id,
+            )
+            # the admission flight event (docs/OBSERVABILITY.md): one
+            # ring append per accepted session — what the doctor joins
+            # the journey's start on.  start_step > 0 marks a resumed
+            # (migrated) life of an existing trajectory.
+            obs.flight.record(
+                "admission",
+                sid=s.sid,
+                trace_id=trace_id,
+                rule=s.rule.name,
+                steps=steps,
                 start_step=start_step,
             )
             if start_step > 0 and self._spill is not None:
@@ -578,12 +620,27 @@ class SimulationService:
                 self._c_finished.labels(state=s.state.value).inc()
                 self._h_latency.observe(0.0)
                 self._completed += 1
+                # the journey still needs its terminal event: this branch
+                # bypasses the scheduler (no session_finished hook), and
+                # a doctor reading only the admission would flag a
+                # cleanly-done session as no_terminal
+                obs.flight.record(
+                    "terminal",
+                    sid=s.sid,
+                    trace_id=trace_id,
+                    outcome=s.state.value,
+                    step=start_step,
+                )
             else:
                 self.scheduler.enqueue(s)
                 # the per-session queue-wait interval: an async (overlapping)
-                # trace span, closed at admission or terminal-in-queue
+                # trace span, closed at admission or terminal-in-queue —
+                # carrying the trace context so the merged fleet timeline
+                # shows WHOSE wait this was
                 with obs.activate(self._tracer):
-                    obs.async_begin("queue-wait", s.sid, steps=steps)
+                    obs.async_begin(
+                        "queue-wait", s.sid, steps=steps, trace_id=trace_id
+                    )
         log.debug("serve: submitted %s (%s, %d steps)", s.sid, rule.name, steps)
         return s.sid
 
@@ -657,6 +714,19 @@ class SimulationService:
         """Scheduler hook: a session got its batch slot after ``wait_s``."""
         self._h_queue_wait.observe(wait_s)
         obs.async_end("queue-wait", session.sid)
+        # the per-session execution interval (docs/OBSERVABILITY.md
+        # "Distributed tracing"): an async b/e pair from slot admission
+        # to the terminal transition, keyed by sid and stamped with the
+        # trace context — the interval the doctor's no-double-execution
+        # invariant compares across worker incarnations.  A salvage-
+        # reloaded session (engine recovery) re-begins under the same id;
+        # Perfetto nests re-begins and the doctor keys on the outer pair.
+        obs.async_begin(
+            "serve.exec",
+            session.sid,
+            trace_id=session.trace_id,
+            step=session.start_step + session.steps_done,
+        )
 
     def session_finished(self, session, latency_s: float) -> None:
         """Scheduler hook: a session reached a terminal state (done /
@@ -669,12 +739,30 @@ class SimulationService:
         if session.admitted_at is None:
             # it died waiting: close the still-open queue-wait interval
             obs.async_end("queue-wait", session.sid, outcome=session.state.value)
+        else:
+            obs.async_end(
+                "serve.exec",
+                session.sid,
+                trace_id=session.trace_id,
+                outcome=session.state.value,
+                step=session.start_step + session.steps_done,
+            )
+        obs.flight.record(
+            "terminal",
+            sid=session.sid,
+            trace_id=session.trace_id,
+            outcome=session.state.value,
+            step=session.start_step + session.steps_done,
+        )
 
     def engine_recovered(self, key, outcome: str) -> None:
         """Scheduler hook: a chunk-level fault on ``key`` was handled —
         masked in place (``replayed`` / the OOM ladder rungs) or, past
         the restart budget, failed typed (``budget_exhausted``)."""
         self._c_recoveries.labels(outcome=outcome).inc()
+        bucket = _key_bucket(key)
+        obs.instant("serve.recovery", compile_key=bucket, outcome=outcome)
+        obs.flight.record("recovery", compile_key=bucket, outcome=outcome)
 
     def drain(self, max_rounds: int | None = None) -> int:
         """Pump until every admitted session reaches a terminal state;
@@ -850,9 +938,9 @@ class SimulationService:
                 for _, engine, _ in plan:
                     engine.busy = False
         with self._lock:
-            for key, exc in chunk_faults:
-                self.scheduler.recover_engine(key, exc, stats)
             with obs.activate(self._tracer):
+                for key, exc in chunk_faults:
+                    self.scheduler.recover_engine(key, exc, stats)
                 self.scheduler.round_end(keyer, stats, rolled)
             if spill_plan:
                 self._apply_spill_failures(spill_failures)
@@ -922,8 +1010,18 @@ class SimulationService:
                     "waited_s": waited,
                 }
                 self._c_recoveries.labels(outcome="wedged").inc()
+                obs.flight.record("wedge", **self._wedged)
                 # salvage only from SETTLED engines — a faulted engine's
-                # chunk died and recover_engine owns its sessions
+                # chunk died and recover_engine owns its sessions.  NO
+                # obs.activate here: the tracer's active slot is one
+                # process global, and the wedged pump is still inside
+                # its own activate scope on another thread — nesting a
+                # second scope from the watchdog races the restore and
+                # can leak (or drop) the active tracer.  The salvaged
+                # sessions' terminal evidence rides the flight ring
+                # instead (session_finished records it unconditionally;
+                # the ring is lock-protected and activate-independent),
+                # which is what the doctor reads outcomes from.
                 salvaged = self._salvage_wedged_locked(plan, set(settled))
             log.error(
                 "serve: WEDGED — settle window blocked %.1fs (deadline "
@@ -1024,6 +1122,16 @@ class SimulationService:
                         seed=s.seed,
                         temperature=s.temperature,
                         timeout_s=timeout_s,
+                        trace_id=s.trace_id,
+                    )
+                    # the per-session durability marker: WHICH recovery
+                    # point this trace now has (instant() is a no-op
+                    # without an active tracer — one global check)
+                    obs.instant(
+                        "serve.session.spill",
+                        sid=s.sid,
+                        trace_id=s.trace_id,
+                        step=abs_step,
                     )
                     # the adopted trajectory is durable again: the
                     # spill-on-adopt urgency is spent (a plain bool flip —
@@ -1057,6 +1165,9 @@ class SimulationService:
                 continue
             s.spill_disabled = True
             self._c_spill_errors.inc()
+            obs.flight.record(
+                "spill_disabled", sid=s.sid, trace_id=s.trace_id, error=str(e)
+            )
             log.warning(
                 "serve: spill write for %s failed (%s); durability disabled "
                 "for this session — it keeps running without failover cover",
@@ -1092,6 +1203,13 @@ class SimulationService:
             self._c_device_idle.inc(idle_delta)
         if self._spill is not None:
             self._g_spilled.set(float(self._spill.spilled_count()))
+        if self._tracer is not None:
+            # fold ring evictions into the loss counter (monotone: the
+            # tracer's dropped count only grows; we tick the delta)
+            dropped = self._tracer.dropped
+            if dropped > self._trace_dropped_seen:
+                self._c_trace_dropped.inc(dropped - self._trace_dropped_seen)
+                self._trace_dropped_seen = dropped
         for key, count in self.scheduler.compile_counts().items():
             self._g_compiles.labels(compile_key=_key_bucket(key)).set(count)
         # the governor's footprint view: what each live engine is charged
@@ -1178,6 +1296,27 @@ class SimulationService:
         with ckpt_atomic_publish(Path(path)) as tmp:
             tmp.write_text(self.registry.prom_text())
 
+    def drain_trace(self) -> dict:
+        """Take (and clear) the buffered trace + flight events — the
+        payload behind the gateway's ``GET /v1/debug/trace`` drain verb
+        (docs/OBSERVABILITY.md "Distributed tracing").  Each call is an
+        increment: a fleet supervisor scraping on its monitor tick
+        assembles the whole timeline without ever re-reading an event.
+        With no tracer configured the span list is empty but the flight
+        ring (always on) still drains — a no-trace worker still
+        contributes its control-plane decisions to a postmortem."""
+        t = self._tracer
+        payload = {
+            "run_id": self.run_id,
+            "pid": os.getpid(),
+            "now": time.time(),
+            "wall_t0": t.wall_t0 if t is not None else None,
+            "dropped": t.dropped if t is not None else 0,
+            "events": t.drain() if t is not None else [],
+            "flight": obs.flight.drain(),
+        }
+        return payload
+
     def flush(self) -> None:
         """Wait out any still-in-flight device chunks without running a
         new round.  The drain tail calls this after ``idle()`` turns true:
@@ -1211,6 +1350,19 @@ class SimulationService:
                 self._write_prom()
                 log.info("prometheus snapshot -> %s", self.config.prom_file)
             if self._tracer is not None:
+                # the flight-recorder dump (docs/OBSERVABILITY.md): what
+                # is still in the control-plane ring rides into the
+                # written file as instant markers, so a solo gateway's
+                # trace file is a self-contained postmortem capture
+                t = self._tracer
+                for ev in obs.flight.snapshot():
+                    t._emit(
+                        obs.flight.as_instant(
+                            ev,
+                            pid=os.getpid(),
+                            ts=max(0.0, (ev["t"] - t.wall_t0) * 1e6),
+                        )
+                    )
                 obs.stop_tracing(self._tracer)
                 log.info(
                     "trace events -> %s (run_id=%s)", self._tracer.path, self.run_id

@@ -97,6 +97,13 @@ class Session:
     # compiled scan) or ``oom_host_demoted`` (the bit-identical host
     # executor).  Results stay byte-identical; only throughput degrades.
     degraded_reason: str | None = None
+    # distributed-trace context (docs/OBSERVABILITY.md "Distributed
+    # tracing"): the id naming this session's whole cross-process
+    # journey — minted by the router (or gateway) per submitted session,
+    # persisted in the spill manifest, and CARRIED ACROSS migration so a
+    # resumed session continues the same trace on its survivor.  None
+    # for library callers that never asked for one.
+    trace_id: str | None = None
 
     @property
     def steps_remaining(self) -> int:
@@ -143,6 +150,9 @@ class SessionView:
     lanes: int | None = None
     # the OOM fallback ladder's stamp (None when the key never degraded)
     degraded_reason: str | None = None
+    # the distributed-trace id (None when the session carries no trace
+    # context) — echoed on the wire so clients and the doctor join on it
+    trace_id: str | None = None
 
     @property
     def finished(self) -> bool:
@@ -192,6 +202,7 @@ class SessionStore:
             packed=s.packed,
             lanes=s.lanes,
             degraded_reason=s.degraded_reason,
+            trace_id=s.trace_id,
         )
 
     def result(self, sid: str) -> np.ndarray:

@@ -98,6 +98,7 @@ class SpillBackend:
         seed: int | None,
         temperature: float | None,
         timeout_s: float | None,
+        trace_id: str | None = None,
     ) -> bool:
         raise NotImplementedError
 
@@ -151,6 +152,10 @@ class SpillRecord:
     timeout_s: float | None  # deadline budget remaining at spill time
     height: int
     width: int
+    #: distributed-trace context (docs/OBSERVABILITY.md): persisting it
+    #: here is what lets a migrated resume CONTINUE the dead worker's
+    #: trace instead of starting a fresh one — None for pre-trace spills
+    trace_id: str | None = None
 
     @property
     def remaining(self) -> int:
@@ -184,6 +189,7 @@ class SpillStore(SpillBackend):
         seed: int | None,
         temperature: float | None,
         timeout_s: float | None,
+        trace_id: str | None = None,
     ) -> bool:
         """Spill one session's state; returns False when ``step`` is
         already the newest spilled step (a queued or retire-lagged
@@ -206,6 +212,7 @@ class SpillStore(SpillBackend):
             "seed": seed,
             "temperature": temperature,
             "timeout_s": timeout_s,
+            "trace_id": trace_id,
             "height": int(board.shape[0]),
             "width": int(board.shape[1]),
         }
@@ -323,6 +330,7 @@ def read_spill_sessions(
         seed = meta.get("seed")
         temperature = meta.get("temperature")
         timeout_s = meta.get("timeout_s")
+        trace_id = meta.get("trace_id")
         records.append(
             SpillRecord(
                 sid=sid,
@@ -335,6 +343,7 @@ def read_spill_sessions(
                 timeout_s=None if timeout_s is None else float(timeout_s),
                 height=height,
                 width=width,
+                trace_id=None if trace_id is None else str(trace_id),
             )
         )
     return records, corrupt, disabled

@@ -462,6 +462,7 @@ class HttpSpillBackend(SpillBackend):
         seed: int | None,
         temperature: float | None,
         timeout_s: float | None,
+        trace_id: str | None = None,
     ) -> bool:
         with self._lock:
             ns = self._namespace
@@ -477,6 +478,7 @@ class HttpSpillBackend(SpillBackend):
             "seed": seed,
             "temperature": temperature,
             "timeout_s": timeout_s,
+            "trace_id": trace_id,
             "height": int(board.shape[0]),
             "width": int(board.shape[1]),
         }
@@ -612,6 +614,7 @@ def read_remote_sessions(
         seed = meta.get("seed")
         temperature = meta.get("temperature")
         t_s = meta.get("timeout_s")
+        trace_id = meta.get("trace_id")
         records.append(
             SpillRecord(
                 sid=sid,
@@ -624,6 +627,7 @@ def read_remote_sessions(
                 timeout_s=None if t_s is None else float(t_s),
                 height=height,
                 width=width,
+                trace_id=None if trace_id is None else str(trace_id),
             )
         )
     return records, corrupt, disabled
