@@ -1163,6 +1163,172 @@ def run_mc_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def _conv_rule_spec(radius: int) -> str:
+    """A Larger-than-Life rule at ``radius`` for the stencil legs: birth/
+    survive bands scaled to ~the box population so the dynamics neither
+    die instantly nor saturate — the counting work (the thing measured)
+    is radius-determined either way."""
+    if radius == 1:
+        return "B3/S23"
+    area = (2 * radius + 1) ** 2 - 1
+    return (
+        f"R{radius},C2,S{area // 13}..{area // 4},"
+        f"B{area // 13}..{area // 5}"
+    )
+
+
+def run_conv_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_conv capture (ISSUE 15): cells/s vs kernel radius for
+    the banded-matmul counting path vs the roll shift-add path, on the
+    SAME board through the same jax executor — plus a Lenia
+    (continuous-tier) steps/s pair, the workload the matmul path exists
+    for.  Same delta-timing methodology as every other leg; the record
+    stamps ``crossover_radius`` (the first measured radius where matmul
+    wins) and per-radius ``matmul_speedup``, with run_id/seed riding
+    every leg like BENCH_mc (PR 12).  Both legs run with the bit-sliced
+    fast path disabled — this bench isolates the STENCIL executors; the
+    bitplane path has its own record (BENCH_r05 legs).
+    """
+    actual, pinned = _pin_and_verify(args, platform)
+
+    from tpu_life import mc
+    from tpu_life.backends.base import get_backend, make_runner
+    from tpu_life.models import lenia as lenia_mod
+    from tpu_life.models.rules import get_rule
+    from tpu_life.utils.timing import delta_seconds_per_step
+
+    n = args.conv_size
+    radii = tuple(int(r) for r in args.conv_radii.split(","))
+    seed = args.conv_seed
+    board = mc.seeded_board(n, n, seed=seed)
+    legs: list[dict] = []
+    speedups: dict[str, float] = {}
+    crossover = None
+    for radius in sorted(radii):
+        rule = get_rule(_conv_rule_spec(radius))
+        by_path: dict[str, float] = {}
+        for stencil in ("roll", "matmul"):
+            backend = get_backend(
+                args.backend, rule=rule, bitpack=False, stencil=stencil
+            )
+            runner = make_runner(backend, board, rule)
+            per = delta_seconds_per_step(
+                runner, args.conv_steps, args.conv_base_steps,
+                repeats=args.repeats,
+            )
+            by_path[stencil] = n * n / per
+            legs.append(
+                {
+                    "radius": radius,
+                    "rule": rule.name,
+                    "stencil": stencil,
+                    "backend": getattr(backend, "name", args.backend),
+                    "cells_per_sec": by_path[stencil],
+                    "steps_per_sec": 1.0 / per,
+                    "size": n,
+                    "steps": args.conv_steps,
+                    "base_steps": args.conv_base_steps,
+                    "seed": seed,
+                }
+            )
+        speedups[str(radius)] = by_path["matmul"] / by_path["roll"]
+        if crossover is None and by_path["matmul"] >= by_path["roll"]:
+            crossover = radius
+
+    # -- the matmul-vs-roll legs on the CPU reference executor -------------
+    # like BENCH_mc's packed-vs-roll legs: the numpy reference runs on
+    # every platform, is the oracle both paths are bit-compared against,
+    # and isolates the counting-executor effect from XLA's fusion — it
+    # is also where the crossover is demonstrable without a real chip
+    # (BLAS matmuls vs O(r) strided passes)
+    ref = get_backend("numpy")
+    rn = args.conv_ref_size
+    ref_board = mc.seeded_board(rn, rn, seed=seed)
+    ref_legs: list[dict] = []
+    ref_speedups: dict[str, float] = {}
+    ref_crossover = None
+    for radius in sorted(radii):
+        rule = get_rule(_conv_rule_spec(radius))
+        by_path = {}
+        for stencil in ("roll", "matmul"):
+            runner = make_runner(
+                get_backend("numpy", stencil=stencil), ref_board, rule
+            )
+            per = delta_seconds_per_step(
+                runner, args.conv_steps, args.conv_base_steps,
+                repeats=args.repeats,
+            )
+            by_path[stencil] = rn * rn / per
+            ref_legs.append(
+                {
+                    "radius": radius,
+                    "rule": rule.name,
+                    "stencil": stencil,
+                    "backend": "numpy",
+                    "cells_per_sec": by_path[stencil],
+                    "size": rn,
+                    "steps": args.conv_steps,
+                    "base_steps": args.conv_base_steps,
+                    "seed": seed,
+                }
+            )
+        ref_speedups[str(radius)] = by_path["matmul"] / by_path["roll"]
+        if ref_crossover is None and by_path["matmul"] >= by_path["roll"]:
+            ref_crossover = radius
+
+    # -- the continuous-tier (Lenia) pair ----------------------------------
+    lenia_rule = get_rule(args.conv_lenia_rule)
+    ln = args.conv_lenia_size
+    lenia_board = lenia_mod.seeded_board(ln, ln, seed=seed)
+    lenia_legs: dict[str, float] = {}
+    # halved step counts, re-separated: the front-door steps > base
+    # validation must survive the halving (9/8 would collapse to 4/4)
+    lenia_steps = max(3, args.conv_steps // 2)
+    lenia_base = min(max(1, args.conv_base_steps // 2), lenia_steps - 1)
+    for stencil in ("roll", "matmul"):
+        backend = get_backend(args.backend, rule=lenia_rule, stencil=stencil)
+        runner = make_runner(backend, lenia_board, lenia_rule)
+        per = delta_seconds_per_step(
+            runner, lenia_steps, lenia_base, repeats=args.repeats
+        )
+        lenia_legs[stencil] = 1.0 / per
+
+    return {
+        "metric": "conv_cells_per_sec",
+        # the headline: the matmul path at the widest measured radius —
+        # the regime the MXU work exists for
+        "value": legs[-1]["cells_per_sec"],
+        "unit": "cells/s",
+        "radii": list(sorted(radii)),
+        "legs": legs,
+        "matmul_speedup": speedups,
+        "crossover_radius": crossover,
+        # the reference-executor legs (numpy, both paths, same radii):
+        # where the crossover is measured chip-free; null crossovers are
+        # honest — they mean the roll path won at every measured radius
+        # on that executor
+        "reference_legs": ref_legs,
+        "reference_matmul_speedup": ref_speedups,
+        "reference_crossover_radius": ref_crossover,
+        "lenia_rule": lenia_rule.name,
+        "lenia_size": ln,
+        "lenia_steps_per_sec": lenia_legs["matmul"],
+        "lenia_steps_per_sec_roll": lenia_legs["roll"],
+        "lenia_matmul_speedup": lenia_legs["matmul"] / lenia_legs["roll"],
+        "seed": seed,
+        "size": n,
+        "steps": args.conv_steps,
+        "base_steps": args.conv_base_steps,
+        "repeats": args.repeats,
+        "backend": args.backend,
+        "bitpack": False,
+        "platform": platform,
+        "platform_actual": actual,
+        "platform_pinned": pinned,
+        "degraded": degraded,
+    }
+
+
 def run_bench(args, platform: str, degraded: bool) -> dict:
     actual, pinned = _pin_and_verify(args, platform)
 
@@ -1421,6 +1587,32 @@ def main() -> None:
     p.add_argument("--mc-seed", type=int, default=0)
     p.add_argument("--mc-rule", default="ising",
                    help="stochastic rule to measure (ising / noisy:<p>/<base>)")
+    # the BENCH_conv capture (ISSUE 15): the matmul-vs-roll stencil
+    # crossover and the continuous-tier (Lenia) throughput pair
+    p.add_argument("--conv", action="store_true",
+                   help="stencil bench: cells/s vs kernel radius for the "
+                   "banded-matmul vs roll counting paths, plus a Lenia "
+                   "steps/s pair (emits conv_cells_per_sec with "
+                   "crossover_radius + matmul_speedup)")
+    p.add_argument("--conv-size", type=int, default=None,
+                   help="square board edge (default 2048, 192 degraded)")
+    p.add_argument("--conv-radii", default="1,3,5,10", metavar="R1,R2,...",
+                   help="kernel radii of the matmul-vs-roll legs")
+    p.add_argument("--conv-steps", type=int, default=None,
+                   help="steps per timed run (default 120, 14 degraded)")
+    p.add_argument("--conv-base-steps", type=int, default=None,
+                   help="steps in the baseline run of the delta pair "
+                   "(default 12, 2 degraded)")
+    p.add_argument("--conv-seed", type=int, default=0)
+    p.add_argument("--conv-ref-size", type=int, default=128,
+                   help="board edge of the numpy-reference legs (the "
+                   "chip-free crossover measurement; 128 keeps the "
+                   "operands inside this container's BLAS fast regime)")
+    p.add_argument("--conv-lenia-rule", default=None,
+                   help="continuous-tier rule for the Lenia pair "
+                   "(default lenia:orbium, lenia:mini degraded)")
+    p.add_argument("--conv-lenia-size", type=int, default=None,
+                   help="Lenia board edge (default 512, 96 degraded)")
     args = p.parse_args()
 
     # fail fast on pure config errors — they must never trigger the
@@ -1453,6 +1645,16 @@ def main() -> None:
                 mc_mod.validate_board_shape(
                     mc_rule, (args.mc_size, args.mc_size)
                 )
+        except ValueError as e:
+            p.error(str(e))
+
+    if args.conv:
+        # pure config errors fail fast (the mc rule-check discipline)
+        try:
+            radii = [int(r) for r in args.conv_radii.split(",")]
+            if not radii or min(radii) < 1:
+                raise ValueError(f"bad --conv-radii {args.conv_radii!r}")
+            get_rule(args.conv_lenia_rule or "lenia:orbium")
         except ValueError as e:
             p.error(str(e))
 
@@ -1492,6 +1694,11 @@ def main() -> None:
         "--mc-steps": args.mc_steps,
         "--mc-base-steps": args.mc_base_steps,
         "--mc-sizes": args.mc_sizes,
+        "--conv-size": args.conv_size,
+        "--conv-steps": args.conv_steps,
+        "--conv-base-steps": args.conv_base_steps,
+        "--conv-lenia-rule": args.conv_lenia_rule,
+        "--conv-lenia-size": args.conv_lenia_size,
     }
     if args.size is None:
         args.size = 16384 if on_accel else DEGRADED_SIZE
@@ -1522,13 +1729,29 @@ def main() -> None:
         args.mc_base_steps = 40 if on_accel else 8
     if args.mc and args.mc_steps <= args.mc_base_steps:
         p.error("--mc-steps must be greater than --mc-base-steps (delta timing)")
+    # conv workload knobs: same accel/degraded split (the roll leg at
+    # radius 10 is 42 shifted adds per step — the degraded board must
+    # stay small enough for CI smoke)
+    if args.conv_size is None:
+        args.conv_size = 2048 if on_accel else 192
+    if args.conv_steps is None:
+        args.conv_steps = 120 if on_accel else 14
+    if args.conv_base_steps is None:
+        args.conv_base_steps = 12 if on_accel else 2
+    if args.conv_lenia_rule is None:
+        args.conv_lenia_rule = "lenia:orbium" if on_accel else "lenia:mini"
+    if args.conv_lenia_size is None:
+        args.conv_lenia_size = 512 if on_accel else 96
+    if args.conv and args.conv_steps <= args.conv_base_steps:
+        p.error("--conv-steps must be greater than --conv-base-steps (delta timing)")
     # resolve the backend up front (after snapshotting what the user pinned)
     # so every emitted record — success or failure — names what actually ran
     # (ADVICE r2 item 3): the composed flagship path on TPU, jax elsewhere.
     # The serve bench defaults to the vmapped jax engine on every platform
     # (the batched path is the thing being measured).
     if args.backend is None:
-        if args.serve or args.serve_pipeline or args.failover or args.fleet or args.mc:
+        if (args.serve or args.serve_pipeline or args.failover
+                or args.fleet or args.mc or args.conv):
             # the vmapped/fused single-device XLA path is the thing being
             # measured on both service-shaped benches
             args.backend = "jax"
@@ -1576,6 +1799,8 @@ def main() -> None:
             result = run_serve_bench(args, platform, degraded)
         elif args.mc:
             result = run_mc_bench(args, platform, degraded)
+        elif args.conv:
+            result = run_conv_bench(args, platform, degraded)
         else:
             result = run_bench(args, platform, degraded)
     except Exception as e:  # noqa: BLE001 — the JSON line must always appear
@@ -1634,6 +1859,10 @@ def main() -> None:
                 cmd += ["--mc-temperature", str(args.mc_temperature)]
                 cmd += ["--mc-seed", str(args.mc_seed)]
                 cmd += ["--mc-rule", args.mc_rule]
+            if args.conv:
+                cmd.append("--conv")
+                cmd += ["--conv-radii", args.conv_radii]
+                cmd += ["--conv-seed", str(args.conv_seed)]
             try:
                 r = subprocess.run(
                     cmd, capture_output=True, text=True, timeout=1800, env=env
@@ -1671,6 +1900,9 @@ def main() -> None:
         elif args.mc:
             metric, unit = "mc_sweeps_per_sec", "sweeps/s"
             size, steps = args.mc_size, args.mc_steps
+        elif args.conv:
+            metric, unit = "conv_cells_per_sec", "cells/s"
+            size, steps = args.conv_size, args.conv_steps
         else:
             metric, unit = "cell_updates_per_sec_per_chip", "cells/s/chip"
             size, steps = args.size, args.steps

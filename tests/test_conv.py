@@ -1,0 +1,317 @@
+"""The banded-matmul neighborhood path (ops/conv.py, docs/RULES.md).
+
+The contract under test: for INTEGER rules the matmul counting path is
+**bit-identical** to the roll path — across radii {1, 3, 5, 10}, both
+boundaries, odd and non-square boards, numpy and jax, solo and through
+serve (including a ``start_step`` resume) — and the ``auto`` routing
+follows the crossover model without ever moving the numpy oracle off
+the roll path.  Kernel-vs-board geometry rejects typed at every
+admission front.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_life.models.rules import (
+    GeometryError,
+    get_rule,
+    validate_rule_geometry,
+)
+from tpu_life.ops import conv
+from tpu_life.ops.reference import neighbor_counts_np, run_np, step_np
+
+RADIUS_RULES = {
+    1: "B3/S23",
+    3: "R3,C2,S10..20,B8..12",
+    5: "R5,C2,S34..58,B34..45",
+    10: "R10,C2,S80..170,B70..110",
+}
+
+# odd and non-square shapes, every dim >= 21 so radius 10 fits
+SHAPES = [(21, 33), (25, 22)]
+
+
+def _rule(radius: int, boundary: str):
+    spec = RADIUS_RULES[radius]
+    return get_rule(spec + (":T" if boundary == "torus" else ""))
+
+
+def _board(shape, states=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, states, size=shape).astype(np.int8)
+
+
+# -- factorization ----------------------------------------------------------
+def test_moore_box_with_center_is_rank_one():
+    # the perf contract: counting runs the FULL box (center included,
+    # subtracted after), which is exactly one matmul pair
+    rule = get_rule("bugs")
+    kern = conv.rule_kernel(rule).copy()
+    kern[rule.radius, rule.radius] += 1.0
+    assert len(conv.kernel_factors(kern)) == 1
+
+
+def test_integer_kernels_never_svd():
+    # integer kernels must decompose exactly — every factor entry
+    # reconstructs the kernel with zero error
+    for spec in ("conway", "bugs", "R3,C2,M1,S1..5,B2,NN"):
+        kern = conv.rule_kernel(get_rule(spec))
+        recon = sum(
+            np.outer(u.astype(np.float64), v.astype(np.float64))
+            for u, v in conv.kernel_factors(kern)
+        )
+        assert np.array_equal(recon, kern.astype(np.float64)), spec
+
+
+def test_kernel_factors_rejects_degenerate():
+    with pytest.raises(ValueError, match="zeros"):
+        conv.kernel_factors(np.zeros((3, 3)))
+    with pytest.raises(ValueError, match="odd-sided"):
+        conv.kernel_factors(np.ones((2, 4)))
+
+
+def test_weighted_kernel_svd_compresses():
+    # the Lenia ring's spectrum compresses well below its row count —
+    # the whole point of the SVD path — and still reconstructs exactly
+    # within the stated tolerance
+    rule = get_rule("lenia:orbium")
+    factors = conv.kernel_factors(rule.kernel)
+    assert len(factors) < 2 * rule.radius + 1
+    recon = sum(
+        np.outer(u.astype(np.float64), v.astype(np.float64))
+        for u, v in factors
+    )
+    assert np.abs(recon - rule.kernel.astype(np.float64)).max() < 1e-6
+
+
+# -- bit-identical counts: numpy --------------------------------------------
+@pytest.mark.parametrize("radius", sorted(RADIUS_RULES))
+@pytest.mark.parametrize("boundary", ["clamped", "torus"])
+@pytest.mark.parametrize("shape", SHAPES)
+def test_counts_matmul_bit_identical_np(radius, boundary, shape):
+    rule = _rule(radius, boundary)
+    board = _board(shape, seed=radius)
+    ref = neighbor_counts_np(
+        board, rule.radius, rule.include_center, rule.neighborhood, rule.boundary
+    )
+    got = conv.neighbor_counts_matmul_np(board, rule)
+    assert got.dtype == np.int32
+    assert np.array_equal(ref, got)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    ["R3,C2,M1,S1..9,B3..6,NN", "R2,C4,S2..8,B3..5,NN:T", "brians_brain"],
+)
+def test_counts_matmul_variants_np(spec):
+    # diamond neighborhoods, include_center, Generations states
+    rule = get_rule(spec)
+    board = _board((19, 27), states=rule.states, seed=1)
+    ref = run_np(board, rule, 4)
+    got = run_np(board, rule, 4, stencil="matmul")
+    assert np.array_equal(ref, got)
+
+
+# -- bit-identical steps: jax ----------------------------------------------
+@pytest.mark.parametrize("radius", sorted(RADIUS_RULES))
+@pytest.mark.parametrize("boundary", ["clamped", "torus"])
+def test_multi_step_matmul_bit_identical_jax(radius, boundary):
+    import jax.numpy as jnp
+
+    from tpu_life.ops.stencil import multi_step
+
+    rule = _rule(radius, boundary)
+    board = _board((23, 29), seed=radius + 100)
+    ref = run_np(board, rule, 5)
+    out = multi_step(
+        jnp.asarray(board), rule=rule, steps=5, stencil="matmul"
+    )
+    assert np.array_equal(np.asarray(out), ref)
+
+
+def test_jax_backend_matmul_pin_bit_identical():
+    # the full backend path honors --stencil matmul even for a rule the
+    # bit-sliced fast path would otherwise intercept
+    from tpu_life.backends.base import get_backend
+
+    rule = get_rule("conway")
+    board = _board((17, 23), seed=4)
+    be = get_backend("jax", stencil="matmul")
+    out = be.run(board, rule, 6)
+    assert np.array_equal(out, run_np(board, rule, 6))
+
+
+def test_numpy_backend_matmul_pin_bit_identical():
+    from tpu_life.backends.base import get_backend
+
+    rule = get_rule("bugs:T")
+    board = _board((26, 24), seed=9)
+    be = get_backend("numpy", stencil="matmul")
+    out = be.run(board, rule, 4)
+    assert np.array_equal(out, run_np(board, rule, 4))
+
+
+# -- routing ----------------------------------------------------------------
+def test_resolve_stencil_crossover_model():
+    conway = get_rule("conway")
+    bugs = get_rule("bugs")
+    len_r = get_rule("lenia:mini")
+    ising = get_rule("ising")
+    # explicit modes win everywhere
+    assert conv.resolve_stencil(conway, "matmul") == "matmul"
+    assert conv.resolve_stencil(bugs, "roll") == "roll"
+    # auto: crossover model on jax, roll pinned on the numpy oracle
+    assert conv.resolve_stencil(conway, "auto") == "roll"
+    assert conv.resolve_stencil(bugs, "auto") == "matmul"
+    assert conv.resolve_stencil(len_r, "auto") == "matmul"
+    assert conv.resolve_stencil(bugs, "auto", "numpy") == "roll"
+    assert conv.resolve_stencil(len_r, "auto", "numpy") == "roll"
+    assert conv.resolve_stencil(bugs, "matmul", "numpy") == "matmul"
+    # stochastic rules have no counting stencil to route
+    assert conv.resolve_stencil(ising, "auto") == "roll"
+    with pytest.raises(ValueError, match="stencil"):
+        conv.resolve_stencil(conway, "bogus")
+
+
+def test_autotune_candidates_carry_stencil_axis():
+    from tpu_life.autotune.space import enumerate_candidates, tune_key_for
+
+    key = tune_key_for(
+        get_rule("bugs"), (256, 256), device_kind="cpu", device_count=1
+    )
+    cands = enumerate_candidates(key)
+    stencils = {c.stencil for c in cands if c.backend == "jax"}
+    assert {"roll", "matmul"} <= stencils
+    # continuous keys: only float executors, both stencil legs
+    ckey = tune_key_for(
+        get_rule("lenia:mini"), (256, 256), device_kind="cpu", device_count=1
+    )
+    assert ckey.continuous and ckey.id().endswith("|cc")
+    ccands = enumerate_candidates(ckey)
+    assert all(c.backend == "jax" for c in ccands)
+    assert {c.stencil for c in ccands} == {"roll", "matmul"}
+    # pre-existing discrete cache ids are unchanged
+    assert "|cc" not in key.id()
+
+
+# -- serve: matmul path bit-identical, including resume ---------------------
+@pytest.mark.parametrize("backend", ["jax", "numpy"])
+def test_serve_matmul_bit_identical_with_resume(backend):
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    rule = get_rule("bugs")
+    board = _board((24, 30), seed=2)
+    oracle = run_np(board, rule, 9)
+    svc = SimulationService(
+        ServeConfig(
+            backend=backend, capacity=4, chunk_steps=4, stencil="matmul"
+        )
+    )
+    try:
+        sid = svc.submit(board, rule, 9)
+        mid = run_np(board, rule, 3)
+        sid2 = svc.submit(mid, rule, 6, start_step=3)
+        svc.drain()
+        assert np.array_equal(svc.result(sid), oracle)
+        assert np.array_equal(svc.result(sid2), oracle)
+        view = svc.poll(sid2)
+        assert view.steps == 9 and view.steps_done == 9
+        stats = svc.stats()
+        assert stats["matmul_keys"] == 1
+        assert set(stats["stencil_keys"].values()) == {"matmul"}
+    finally:
+        svc.close()
+
+
+def test_serve_stencil_stamps_in_round_records(tmp_path):
+    from tpu_life.obs import stats as obs_stats
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    sink = tmp_path / "serve.jsonl"
+    svc = SimulationService(
+        ServeConfig(
+            backend="jax",
+            capacity=2,
+            chunk_steps=2,
+            stencil="auto",
+            metrics=True,
+            metrics_file=str(sink),
+        )
+    )
+    try:
+        svc.submit(_board((22, 22), seed=3), get_rule("bugs"), 4)
+        svc.drain()
+    finally:
+        svc.close()
+    records = obs_stats.load_records(str(sink))
+    summary = obs_stats.summarize(records)
+    serve = summary["serve"]
+    assert serve["matmul_keys"] == 1
+    assert set(serve["stencil_keys"].values()) == {"matmul"}
+    # the prom-facing gauge exists too
+    assert svc._g_matmul_keys.value == 1.0
+
+
+# -- kernel-vs-board geometry: typed at every front -------------------------
+def test_validate_rule_geometry():
+    bugs = get_rule("bugs")
+    validate_rule_geometry(bugs, (11, 11))  # exactly fits
+    with pytest.raises(GeometryError, match="kernel diameter"):
+        validate_rule_geometry(bugs, (10, 64))
+    # radius-1 rules stay exempt (thin stripe boards are legal inputs)
+    validate_rule_geometry(get_rule("conway"), (1, 8))
+
+
+def test_serve_submit_rejects_oversized_kernel():
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    svc = SimulationService(ServeConfig(backend="numpy"))
+    try:
+        with pytest.raises(GeometryError):
+            svc.submit(_board((8, 8)), get_rule("bugs"), 2)
+        assert len(svc.store) == 0  # rejected before anything was stored
+    finally:
+        svc.close()
+
+
+def test_gateway_parse_rejects_oversized_kernel():
+    from tpu_life.gateway.errors import ApiError
+    from tpu_life.gateway.protocol import parse_submit
+
+    with pytest.raises(ApiError) as ei:
+        parse_submit({"rule": "bugs", "size": 8, "steps": 2})
+    assert ei.value.status == 400
+    assert ei.value.code == "radius_too_large"
+    # inline boards reject the same way
+    with pytest.raises(ApiError) as ei:
+        parse_submit(
+            {"rule": "bugs", "board": ["0" * 8] * 8, "steps": 2}
+        )
+    assert ei.value.code == "radius_too_large"
+
+
+def test_cli_run_exits_2_on_oversized_kernel(tmp_path, monkeypatch):
+    from tpu_life.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main(
+        [
+            "run", "--size", "8", "--steps", "2", "--rule", "bugs",
+            "--backend", "numpy",
+        ]
+    )
+    assert rc == 2
+
+
+def test_cli_sweep_exits_2_on_oversized_kernel(tmp_path, monkeypatch, capsys):
+    from tpu_life.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(SystemExit) as ei:
+        main(
+            [
+                "sweep", "--size", "8", "--steps", "2", "--rule",
+                "noisy:0.01/bugs", "--serve-backend", "numpy",
+            ]
+        )
+    assert ei.value.code == 2

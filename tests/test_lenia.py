@@ -1,0 +1,416 @@
+"""The continuous-CA (Lenia) tier (models/lenia.py, docs/RULES.md).
+
+Contracts under test: the spec grammar parses typed; the numpy roll
+oracle matches the checked-in KAT vectors byte-for-byte; the jax
+roll/matmul executors agree with the oracle to the stated tolerance;
+float32 boards ride the whole serving machinery — submit validation,
+vmapped engines, resume (``start_step``), spill round-trip, the
+governor's byte estimate, and the gateway's float result codec.
+"""
+
+import base64
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_life.io.codec import decode_board, encode_board
+from tpu_life.models import lenia
+from tpu_life.models.rules import get_rule
+from tpu_life.serve import ServeConfig, SimulationService
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+# -- spec grammar -----------------------------------------------------------
+def test_parse_presets_and_parametric():
+    r = get_rule("lenia")
+    assert r.name == "lenia:orbium" and r.radius == 13
+    assert r.continuous and not r.stochastic
+    assert r.board_dtype == "float32" and r.boundary == "torus"
+    assert get_rule("lenia:orbium") == r
+    mini = get_rule("lenia:mini")
+    assert mini.radius == 4
+    p = get_rule("lenia:R5,m0.2,s0.03,dt0.2,b1;0.7")
+    assert (p.radius, p.mu, p.sigma, p.dt, p.peaks) == (5, 0.2, 0.03, 0.2, (1.0, 0.7))
+    # the rule is frozen and hashable — CompileKey material
+    assert hash(p) == hash(get_rule("lenia:R5,m0.2,s0.03,dt0.2,b1;0.7"))
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "lenia:nope",
+        "lenia:R0",
+        "lenia:R5,m2",
+        "lenia:R5,s0",
+        "lenia:R5,dt0",
+        "lenia:R5,q3",
+        "lenia:R5,R6",
+        "lenia:m0.1",  # no radius
+        "lenia:R5,b0;0",  # all-zero rings
+    ],
+)
+def test_parse_rejects_malformed(spec):
+    with pytest.raises(ValueError):
+        get_rule(spec)
+
+
+def test_parse_torus_suffix_forms():
+    # the bare ':T' suffix (the default topology spelled out) and the
+    # preset+suffix form both parse
+    assert get_rule("lenia:T") == get_rule("lenia")
+    assert get_rule("lenia:mini:T").radius == 4
+
+
+def test_auto_backend_resolves_to_float_executor():
+    # `auto` must never wander continuous rules to an executor without a
+    # float path (on TPU hosts it used to pick pallas/sharded and raise)
+    from tpu_life.backends.base import get_backend
+
+    be = get_backend("auto", rule=get_rule("lenia:mini"))
+    assert getattr(be, "name", "") == "jax"
+
+
+def test_serve_tuned_backend_accepts_lenia():
+    # --serve-backend tuned resolves continuous keys through the
+    # autotune cache inside make_engine; submit must not pre-reject
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(20, 20, seed=1)
+    svc = SimulationService(ServeConfig(backend="tuned", capacity=2, chunk_steps=3))
+    try:
+        sid = svc.submit(b, rule, 6)
+        svc.drain()
+        assert np.allclose(
+            svc.result(sid), lenia.run_np(b, rule, 6), atol=lenia.FLOAT_ATOL
+        )
+    finally:
+        svc.close()
+
+
+def test_kernel_is_normalized_ring():
+    r = get_rule("lenia:mini")
+    k = r.kernel
+    assert k.dtype == np.float32 and k.shape == (9, 9)
+    assert abs(float(k.sum()) - 1.0) < 1e-6
+    assert k[4, 4] == 0.0  # the shell is zero at the center
+    assert (k >= 0).all()
+
+
+# -- the KAT vectors --------------------------------------------------------
+def _kat_cases():
+    with open(FIXTURES / "lenia_kat.json") as f:
+        return json.load(f)["cases"]
+
+
+@pytest.mark.parametrize("case", _kat_cases(), ids=lambda c: f"{c['rule']}@{c['steps']}")
+def test_numpy_oracle_matches_kat(case):
+    rule = get_rule(case["rule"])
+    h, w = case["height"], case["width"]
+    board = decode_board(base64.b64decode(case["board_b64"]), h, w)
+    expected = decode_board(base64.b64decode(case["expected_b64"]), h, w)
+    assert board.dtype == np.float32
+    # the staging is itself pinned: seed -> identical float board
+    staged = lenia.seeded_board(h, w, case["density"], seed=case["seed"])
+    assert np.array_equal(staged, board)
+    out = lenia.run_np(board, rule, case["steps"])
+    assert np.array_equal(out, expected)  # byte-exact oracle
+
+
+@pytest.mark.parametrize("stencil", ["roll", "matmul"])
+def test_jax_paths_allclose_to_oracle(stencil):
+    import jax.numpy as jnp
+
+    case = _kat_cases()[0]
+    rule = get_rule(case["rule"])
+    h, w = case["height"], case["width"]
+    board = decode_board(base64.b64decode(case["board_b64"]), h, w)
+    expected = decode_board(base64.b64decode(case["expected_b64"]), h, w)
+    step = lenia.make_lenia_step(jnp, rule, (h, w), stencil)
+    x = jnp.asarray(board)
+    for _ in range(case["steps"]):
+        x = step(x)
+    assert np.allclose(np.asarray(x), expected, atol=lenia.FLOAT_ATOL)
+
+
+def test_np_matmul_allclose_to_roll():
+    case = _kat_cases()[1]
+    rule = get_rule(case["rule"])
+    board = decode_board(
+        base64.b64decode(case["board_b64"]), case["height"], case["width"]
+    )
+    roll = lenia.run_np(board, rule, case["steps"])
+    mm = lenia.run_np(board, rule, case["steps"], stencil="matmul")
+    assert np.allclose(mm, roll, atol=lenia.FLOAT_ATOL)
+
+
+# -- the float codec --------------------------------------------------------
+def test_float_codec_round_trip():
+    b = lenia.seeded_board(11, 7, seed=9)
+    buf = encode_board(b)
+    assert len(buf) == 11 * 7 * 4
+    back = decode_board(buf, 11, 7)
+    assert back.dtype == np.float32 and np.array_equal(back, b)
+    # int boards keep their exact prior encoding
+    ib = np.zeros((3, 4), np.int8)
+    assert len(encode_board(ib)) == 3 * 5
+
+
+def test_float_codec_rejects_nan():
+    buf = np.full((2, 2), np.nan, "<f4").tobytes()
+    with pytest.raises(ValueError, match="NaN"):
+        decode_board(buf, 2, 2)
+
+
+def test_checkpoint_intact_accepts_float_boards(tmp_path):
+    from tpu_life.runtime.checkpoint import save_snapshot, snapshot_intact
+
+    b = lenia.seeded_board(10, 12, seed=1)
+    p = save_snapshot(tmp_path, 5, b, rule="lenia:mini")
+    assert snapshot_intact(p, 10, 12)
+    back = decode_board(p.read_bytes(), 10, 12)
+    assert np.array_equal(back, b)
+
+
+# -- runners / backends -----------------------------------------------------
+def test_runner_factory_typed_rejection():
+    from tpu_life.backends.base import get_backend, make_runner
+
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(16, 16)
+    with pytest.raises(ValueError, match="float path"):
+        make_runner(get_backend("stripes"), b, rule)
+
+
+def test_board_validation_typed():
+    rule = get_rule("lenia:mini")
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        lenia.validate_board(np.full((8, 8), 1.5, np.float32), rule)
+    with pytest.raises(ValueError, match="finite"):
+        lenia.validate_board(np.full((8, 8), np.nan, np.float32), rule)
+    with pytest.raises(ValueError, match="2-D"):
+        lenia.validate_board(np.zeros(8, np.float32), rule)
+    # int 0/1 boards lift losslessly to float
+    out = lenia.validate_board(np.eye(8, dtype=np.int8), rule)
+    assert out.dtype == np.float32 and out[0, 0] == 1.0
+
+
+# -- serve ------------------------------------------------------------------
+def test_serve_numpy_byte_identical_and_resume():
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(24, 24, seed=5)
+    oracle = lenia.run_np(b, rule, 10)
+    svc = SimulationService(ServeConfig(backend="numpy", capacity=4, chunk_steps=3))
+    try:
+        sid = svc.submit(b, rule, 10, seed=5)
+        mid = lenia.run_np(b, rule, 4)
+        sid_r = svc.submit(mid, rule, 6, start_step=4)
+        svc.drain()
+        out = svc.result(sid)
+        assert out.dtype == np.float32 and np.array_equal(out, oracle)
+        assert np.array_equal(svc.result(sid_r), oracle)
+        view = svc.poll(sid_r)
+        assert view.steps == 10 and view.steps_done == 10
+    finally:
+        svc.close()
+
+
+def test_serve_jax_allclose_compiles_once():
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(20, 20, seed=2)
+    oracle = lenia.run_np(b, rule, 8)
+    svc = SimulationService(ServeConfig(backend="jax", capacity=4, chunk_steps=4))
+    try:
+        sids = [svc.submit(b, rule, 8) for _ in range(3)]
+        svc.drain()
+        for sid in sids:
+            assert np.allclose(svc.result(sid), oracle, atol=lenia.FLOAT_ATOL)
+        (count,) = svc.scheduler.compile_counts().values()
+        assert count == 1  # three float sessions share one compiled batch
+        stats = svc.stats()
+        assert stats["matmul_keys"] == 1  # auto resolves matmul on jax
+    finally:
+        svc.close()
+
+
+def test_serve_rejects_float_board_out_of_range():
+    svc = SimulationService(ServeConfig(backend="numpy"))
+    try:
+        with pytest.raises(ValueError, match=r"\[0, 1\]"):
+            svc.submit(
+                np.full((8, 8), 2.0, np.float32), get_rule("lenia:mini"), 2
+            )
+        with pytest.raises(ValueError, match="float path"):
+            # slot-loop backends have no float executor
+            bad = SimulationService(ServeConfig(backend="stripes"))
+            try:
+                bad.submit(
+                    np.zeros((8, 8), np.float32), get_rule("lenia:mini"), 2
+                )
+            finally:
+                bad.close()
+    finally:
+        svc.close()
+
+
+def test_governor_estimates_float_bytes():
+    from tpu_life.serve.engine import compile_key_for
+    from tpu_life.serve.governor import estimate_engine_bytes
+
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(32, 32)
+    key = compile_key_for(rule, b, "jax", "matmul")
+    assert key.dtype == "float32"
+    est = estimate_engine_bytes(key, 8)
+    # float32 boards: 4 bytes/cell, doubled for the device double buffer
+    assert est >= 8 * 32 * 32 * 4 * 2
+
+
+def test_spill_round_trip_float(tmp_path):
+    from tpu_life.serve.spill import SpillStore, read_spill_sessions
+
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(16, 16, seed=3)
+    store = SpillStore(tmp_path)
+    assert store.save(
+        "s1", b, 7, rule=rule.name, steps_total=20, seed=3,
+        temperature=None, timeout_s=None,
+    )
+    records, corrupt, disabled = read_spill_sessions(tmp_path)
+    assert not corrupt and not disabled
+    (rec,) = records
+    assert rec.step == 7 and rec.steps_total == 20
+    assert rec.board.dtype == np.float32 and np.array_equal(rec.board, b)
+    assert get_rule(rec.rule) == rule
+
+
+def test_serve_spill_resume_equals_oracle(tmp_path):
+    # the failover shape: spill mid-run, resume from the spilled bytes
+    # via start_step on a fresh service — equals the uninterrupted
+    # oracle (numpy executor: byte-identical)
+    from tpu_life.serve.spill import read_spill_sessions
+
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(18, 18, seed=11)
+    oracle = lenia.run_np(b, rule, 12)
+    svc = SimulationService(
+        ServeConfig(
+            backend="numpy", capacity=2, chunk_steps=2,
+            spill_dir=str(tmp_path), spill_every=1,
+        )
+    )
+    try:
+        sid = svc.submit(b, rule, 12)
+        svc.pump(); svc.pump(); svc.pump()
+        records, _, _ = read_spill_sessions(tmp_path)
+        rec = next(r for r in records if r.sid == sid)
+        assert rec.board.dtype == np.float32 and 0 < rec.step < 12
+        svc.drain()
+    finally:
+        svc.close()
+    svc2 = SimulationService(ServeConfig(backend="numpy", capacity=2, chunk_steps=2))
+    try:
+        sid2 = svc2.submit(rec.board, rule, rec.remaining, start_step=rec.step)
+        svc2.drain()
+        assert np.array_equal(svc2.result(sid2), oracle)
+    finally:
+        svc2.close()
+
+
+# -- gateway ----------------------------------------------------------------
+def test_gateway_protocol_float_round_trip():
+    from tpu_life.gateway import protocol
+    from tpu_life.gateway.errors import ApiError
+
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(12, 10, seed=4)
+    # inline float board parses byte-exact (f32 -> json float -> f32)
+    spec = protocol.parse_submit(
+        {
+            "rule": "lenia:mini",
+            "board": [[float(c) for c in row] for row in b],
+            "steps": 3,
+        }
+    )
+    assert spec.board.dtype == np.float32 and np.array_equal(spec.board, b)
+    # seeded geometry stages the float twin
+    spec2 = protocol.parse_submit(
+        {"rule": "lenia:mini", "size": 16, "steps": 3, "seed": 4}
+    )
+    assert spec2.board.dtype == np.float32
+    assert np.array_equal(spec2.board, lenia.seeded_board(16, 16, seed=4))
+    # raw result payload carries the dtype stamp and round-trips
+    out = protocol.render_result(b, "raw", rule.name)
+    assert out["dtype"] == "float32"
+    back = protocol.decode_result(out)
+    assert back.dtype == np.float32 and np.array_equal(back, b)
+    # RLE has no float form: typed 400
+    with pytest.raises(ApiError) as ei:
+        protocol.render_result(b, "rle", rule.name)
+    assert ei.value.code == "invalid_format"
+    # resume round-trips the byte-exact float encoding
+    spec3 = protocol.parse_submit(
+        {
+            "rule": "lenia:mini",
+            "resume_b64": base64.b64encode(encode_board(b)).decode(),
+            "height": 12,
+            "width": 10,
+            "steps": 5,
+            "start_step": 7,
+        }
+    )
+    assert np.array_equal(spec3.board, b) and spec3.start_step == 7
+    # a digit-grid resume body for a continuous rule is a typed 400
+    with pytest.raises(ApiError) as ei:
+        protocol.parse_submit(
+            {
+                "rule": "lenia:mini",
+                "resume_b64": base64.b64encode(
+                    encode_board(np.zeros((12, 10), np.int8))
+                ).decode(),
+                "height": 12,
+                "width": 10,
+                "steps": 5,
+            }
+        )
+    assert ei.value.code == "invalid_board"
+    # out-of-range inline floats are a typed 400
+    with pytest.raises(ApiError) as ei:
+        protocol.parse_submit(
+            {"rule": "lenia:mini", "board": [[1.5, 0.0]], "steps": 1}
+        )
+    assert ei.value.code == "invalid_board"
+
+
+def test_gateway_http_lenia_byte_compare():
+    """One Lenia session through the real HTTP gateway (numpy executor),
+    byte-compared to the numpy oracle — the CI Conv-smoke shape."""
+    from tpu_life.gateway import Gateway, GatewayConfig
+    from tpu_life.gateway.client import GatewayClient
+
+    rule = get_rule("lenia:mini")
+    b = lenia.seeded_board(20, 20, seed=6)
+    oracle = lenia.run_np(b, rule, 6)
+    svc = SimulationService(ServeConfig(backend="numpy", capacity=2, chunk_steps=2))
+    gw = Gateway(svc, GatewayConfig(port=0))
+    gw.start()
+    try:
+        client = GatewayClient(f"http://127.0.0.1:{gw.port}", retries=0)
+        sid = client.submit(board=b, rule="lenia:mini", steps=6)
+        view = client.wait(sid)
+        assert view["state"] == "done"
+        out = client.result_board(sid)
+        assert out.dtype == np.float32 and np.array_equal(out, oracle)
+        # rle is a typed 400 for float sessions
+        import urllib.error
+
+        with pytest.raises(Exception) as ei:
+            client.result(sid, fmt="rle")
+        assert "invalid_format" in str(ei.value) or isinstance(
+            ei.value, urllib.error.HTTPError
+        )
+    finally:
+        gw.begin_drain()
+        gw.wait(timeout=30)
+        gw.close()

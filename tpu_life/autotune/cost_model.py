@@ -77,6 +77,17 @@ def estimate_cost(key: TuneKey, cfg: TunedConfig) -> float:
         # at equal k (RESULTS_blocksweep_r4_confirm.json) — a nudge, so a
         # *measured* XLA win still beats an assumed Pallas one
         cost *= 0.97
+    if cfg.backend == "jax" and cfg.stencil != "auto":
+        # the stencil axis (docs/RULES.md): the analytic view mirrors
+        # resolve_stencil's crossover model — banded matmuls win past
+        # the crossover radius (and always on weighted/continuous
+        # kernels, where the roll path unrolls O(r^2) shifted adds); a
+        # measured trial still overrides this ordering
+        from tpu_life.ops.conv import CROSSOVER_RADIUS
+
+        wide = key.continuous or key.radius >= CROSSOVER_RADIUS
+        if cfg.stencil == "matmul":
+            cost *= 0.85 if wide else 1.5
     return cost
 
 

@@ -60,6 +60,11 @@ class TuneKey:
     # adder tree.  Kept out of id() for deterministic keys so every
     # pre-existing cache entry stays addressable.
     stochastic: bool = False
+    # continuous (weighted-kernel float32) rules — the Lenia tier: only
+    # the float executors are legal, and the candidate axis that matters
+    # is the stencil (roll shift-adds vs banded matmuls).  Kept out of
+    # id() for discrete keys, like `stochastic`.
+    continuous: bool = False
 
     def id(self) -> str:
         """Stable string form — the JSON cache's entry key."""
@@ -70,6 +75,7 @@ class TuneKey:
             f"|{self.neighborhood}|{self.boundary}"
             f"|{h}x{w}|bp{int(self.bitpack_ok)}"
             + ("|mc" if self.stochastic else "")
+            + ("|cc" if self.continuous else "")
         )
 
     def to_dict(self) -> dict:
@@ -88,6 +94,11 @@ class TunedConfig:
     bitpack: bool = True
     sync_every: int = 0  # 0 = one fused run (never swept; host-sync cadence
     # belongs to snapshots/metrics, not throughput)
+    # the neighborhood-counting path (docs/RULES.md): the measured
+    # stencil axis — "auto" (pre-existing cache entries; the analytic
+    # crossover model applies), "roll", or "matmul".  Only the jax
+    # executor honors it today; sharded/pallas carry their own kernels.
+    stencil: str = "auto"
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -100,6 +111,7 @@ class TunedConfig:
             local_kernel=str(d.get("local_kernel", "auto")),
             bitpack=bool(d.get("bitpack", True)),
             sync_every=int(d.get("sync_every", 0)),
+            stencil=str(d.get("stencil", "auto")),
         )
 
     def backend_kwargs(self) -> dict:
@@ -108,13 +120,16 @@ class TunedConfig:
         kw: dict = {"bitpack": self.bitpack, "local_kernel": self.local_kernel}
         if self.block_steps is not None:
             kw["block_steps"] = self.block_steps
+        if self.stencil != "auto":
+            kw["stencil"] = self.stencil
         return kw
 
     def describe(self) -> str:
         k = "-" if self.block_steps is None else str(self.block_steps)
         return (
             f"{self.backend} k={k} local_kernel={self.local_kernel} "
-            f"bitpack={int(self.bitpack)} sync_every={self.sync_every}"
+            f"bitpack={int(self.bitpack)} sync_every={self.sync_every} "
+            f"stencil={self.stencil}"
         )
 
 
@@ -129,6 +144,7 @@ def tuned_record(backend: str, kwargs: dict) -> dict:
         local_kernel=kwargs.get("local_kernel") or "auto",
         bitpack=bool(kwargs.get("bitpack", True)),
         sync_every=int(kwargs.get("sync_every", 0)),
+        stencil=kwargs.get("stencil") or "auto",
     ).to_dict()
 
 
@@ -156,6 +172,8 @@ def _bitpack_eligible(rule: Rule) -> bool:
     ``bitlife.supports_family`` + the diamond/torus variants, and the
     stochastic tier's ``mc.packed_supports``) — kept import-light so key
     construction never needs jax."""
+    if getattr(rule, "continuous", False):
+        return False  # float boards have no bitplane form
     if getattr(rule, "stochastic", False):
         # the packed Metropolis engine (tpu_life.mc.packed): ising only —
         # noisy rules keep the int8 roll composition
@@ -199,6 +217,7 @@ def tune_key_for(
         shape_bucket=shape_bucket(h, w),
         bitpack_ok=_bitpack_eligible(rule),
         stochastic=bool(getattr(rule, "stochastic", False)),
+        continuous=bool(getattr(rule, "continuous", False)),
     )
 
 
@@ -236,6 +255,14 @@ def enumerate_candidates(
     """
     backends = tuple(backend_set or default_backend_set(key.device_kind))
     on_tpu = key.device_kind == "tpu"
+    if key.continuous:
+        # continuous keys: only the float executors are legal, and the
+        # axis that matters is the stencil — both offered so a measured
+        # sweep verifies the matmul (MXU) win instead of assuming it
+        return [
+            TunedConfig("jax", None, "auto", False, 0, "matmul"),
+            TunedConfig("jax", None, "auto", False, 0, "roll"),
+        ]
     if key.stochastic:
         # stochastic keys: only the key-schedule executors are legal
         # (mc.SUPPORTED_BACKENDS), and the knob that matters is the packed
@@ -253,6 +280,16 @@ def enumerate_candidates(
             out.append(TunedConfig("jax", None, "auto", key.bitpack_ok, 0))
             if key.bitpack_ok:
                 out.append(TunedConfig("jax", None, "auto", False, 0))
+            if key.radius > 1:
+                # the stencil axis (docs/RULES.md): at radius > 1 the
+                # banded-matmul counting path is a real contender —
+                # offer both so the crossover is measured, not guessed
+                out.append(
+                    TunedConfig("jax", None, "auto", False, 0, "matmul")
+                )
+                out.append(
+                    TunedConfig("jax", None, "auto", False, 0, "roll")
+                )
         elif backend == "sharded":
             if key.boundary == "torus":
                 h = shape[0] if shape is not None else key.shape_bucket[0]

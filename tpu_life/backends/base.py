@@ -127,6 +127,12 @@ def make_runner(
             start_step=start_step,
             packed=packed,
         )
+    if getattr(rule, "continuous", False):
+        # the continuous tier (models/lenia.py): float32 boards need a
+        # float executor — typed rejection elsewhere, never an int8 cast
+        from tpu_life.models.lenia import lenia_runner_for
+
+        return lenia_runner_for(backend, board, rule)
     prep = getattr(backend, "prepare", None)
     if prep is not None:
         return prep(board, rule)
@@ -269,6 +275,11 @@ def get_backend(name: str, *, rule: Rule | None = None, **kwargs) -> Backend:
             # stochastic rules run on the executors that implement the
             # counter-based key schedule; the single-device XLA path is
             # the accelerated one (numpy stays the explicit ground truth)
+            name = "jax"
+        elif rule is not None and getattr(rule, "continuous", False):
+            # continuous rules run on the float executors only — on a
+            # TPU host auto must not wander to pallas/sharded (no float
+            # path there) and raise; jax is the accelerated float path
             name = "jax"
         else:
             import jax

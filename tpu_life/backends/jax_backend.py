@@ -95,17 +95,51 @@ def packed_device_runner(
 class JaxBackend:
     name = "jax"
 
-    def __init__(self, *, device=None, pad_lanes: bool = True, bitpack: bool = True, **_):
+    def __init__(
+        self,
+        *,
+        device=None,
+        pad_lanes: bool = True,
+        bitpack: bool = True,
+        stencil: str = "auto",
+        **_,
+    ):
+        from tpu_life.ops.conv import validate_stencil
+
         self.device = device if device is not None else jax.devices()[0]
         self.pad_lanes = pad_lanes
         self.bitpack = bitpack
+        # the counting-path knob (--stencil, docs/RULES.md): roll
+        # shift-adds vs banded matmuls; "auto" follows the crossover
+        # model (matmul at large radii and on weighted kernels).  The
+        # bit-sliced fast paths below are untouched — they are already
+        # the radius-1 winner the crossover model keeps on roll.
+        self.stencil = validate_stencil(stencil)
 
     def prepare(self, board: np.ndarray, rule: Rule) -> Runner:
+        from tpu_life.ops.conv import resolve_stencil
+
         h, w = board.shape
         logical = (h, w)
-        if self.bitpack and bitlife.supports(rule):
+        if getattr(rule, "continuous", False):
+            # the continuous tier: float32 boards, weighted-kernel
+            # correlation (matmul under auto — its whole point)
+            from tpu_life.models.lenia import LeniaDeviceRunner
+
+            return LeniaDeviceRunner(
+                board,
+                rule,
+                stencil=resolve_stencil(rule, self.stencil, "jax"),
+                device=self.device,
+            )
+        stencil = resolve_stencil(rule, self.stencil, "jax")
+        # an explicit (or crossover-resolved) matmul pin outranks the
+        # bit-sliced fast paths: the user asked to run — and measure —
+        # the banded-matmul counting executor
+        bitpack = self.bitpack and stencil != "matmul"
+        if bitpack and bitlife.supports(rule):
             return packed_device_runner(board, rule, self.device)
-        if self.bitpack and bitlife.supports_diamond(rule):
+        if bitpack and bitlife.supports_diamond(rule):
             # 2-state von Neumann rules run bit-sliced too: the diamond as
             # stacked shifted row boxes under one CSA reduction
             return packed_device_runner(
@@ -116,7 +150,7 @@ class JaxBackend:
                     x, rule=rule, steps=n, logical_shape=logical
                 ),
             )
-        if self.bitpack and bitlife.supports_torus(rule):
+        if bitpack and bitlife.supports_torus(rule):
             # torus life-like rules run packed too: roll-based row wrap,
             # seam carries at the logical width (bitlife.make_torus_hshifts)
             return packed_device_runner(
@@ -129,12 +163,18 @@ class JaxBackend:
             )
         # torus boards must stay at exact shape: padding would sit between
         # the logical edges the torus glues together (lane alignment is a
-        # perf preference; correctness wins)
-        pad = self.pad_lanes and rule.boundary == "clamped"
+        # perf preference; correctness wins).  The matmul stencil's band
+        # operators are already lane-shaped dense matrices, so it skips
+        # the lane padding too — padding would only grow the operands.
+        pad = (
+            self.pad_lanes
+            and rule.boundary == "clamped"
+            and stencil != "matmul"
+        )
         w_pad = ceil_to(w, LANE) if pad else w
         x = jax.device_put(pad_board(board, h, w_pad), self.device)
         advance = lambda x, n: multi_step(
-            x, rule=rule, steps=n, logical_shape=logical
+            x, rule=rule, steps=n, logical_shape=logical, stencil=stencil
         )
         return DeviceRunner(
             x,

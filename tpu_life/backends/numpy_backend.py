@@ -13,8 +13,14 @@ from tpu_life.ops.reference import step_np
 class NumpyBackend:
     name = "numpy"
 
-    def __init__(self, **_):
-        pass
+    def __init__(self, *, stencil: str = "auto", **_):
+        # the counting-path knob (--stencil, docs/RULES.md): "auto"
+        # keeps this executor on the roll path — it is the oracle the
+        # matmul path is compared against; explicit "matmul" runs the
+        # banded-matmul counts here too (the parity tests' host leg)
+        from tpu_life.ops.conv import validate_stencil
+
+        self.stencil = validate_stencil(stencil)
 
     def run(
         self,
@@ -25,11 +31,28 @@ class NumpyBackend:
         chunk_steps: int = 0,
         callback: ChunkCallback | None = None,
     ) -> np.ndarray:
-        board = np.asarray(board, dtype=np.int8)
+        from tpu_life.ops.conv import resolve_stencil
+
+        stencil = resolve_stencil(rule, self.stencil, "numpy")
+        if getattr(rule, "continuous", False):
+            from tpu_life.models import lenia
+
+            board = lenia.validate_board(board, rule)
+            fn = lenia.make_lenia_step(np, rule, board.shape, stencil)
+        elif stencil == "matmul":
+            from tpu_life.ops.conv import make_counts_matmul
+
+            board = np.asarray(board, dtype=np.int8)
+            counts_fn = make_counts_matmul(np, rule, board.shape)
+            table = rule.transition_table
+            fn = lambda b: table[b.astype(np.int64), counts_fn(b)]
+        else:
+            board = np.asarray(board, dtype=np.int8)
+            fn = lambda b: step_np(b, rule)
         done = 0
         for n in chunk_sizes(steps, chunk_steps):
             for _ in range(n):
-                board = step_np(board, rule)
+                board = fn(board)
             done += n
             if callback is not None:
                 b = board

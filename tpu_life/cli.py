@@ -15,6 +15,19 @@ import sys
 from tpu_life.config import RunConfig
 
 
+def _add_stencil_arg(p) -> None:
+    """The neighborhood-counting knob (docs/RULES.md) — shared by every
+    front that steps boards (run / serve / sweep / gateway; the fleet
+    forwards it per worker)."""
+    p.add_argument(
+        "--stencil", default="auto", choices=["auto", "roll", "matmul"],
+        help="neighborhood-counting path: roll = shift-add stencil, "
+             "matmul = banded matmuls on the MXU (bit-identical for "
+             "integer rules; the large-radius / continuous-kernel path), "
+             "auto = the measured crossover model (numpy executors stay "
+             "on the roll oracle)")
+
+
 def _add_governor_args(p) -> None:
     """The serve-tier resource-governor knobs (docs/SERVING.md "Resource
     governance") — shared by every front that constructs a ServeConfig
@@ -178,6 +191,7 @@ def build_parser() -> argparse.ArgumentParser:
                      "roll engines instead of the default bitplane-packed "
                      "path — bit-identical, the packed path's oracle "
                      "(docs/STOCHASTIC.md)")
+    _add_stencil_arg(srv)
     srv.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                      help="default per-request deadline")
     srv.add_argument("--spill-dir", default=None, metavar="DIR",
@@ -250,6 +264,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="sweep on the int8 roll engines instead of the "
                     "default bitplane-packed Metropolis path — "
                     "bit-identical, the packed path's oracle")
+    _add_stencil_arg(sw)
     _add_governor_args(sw)
     sw.add_argument("--output-dir", default=None, metavar="DIR",
                     help="also write each final lattice to "
@@ -288,6 +303,7 @@ def build_parser() -> argparse.ArgumentParser:
     gw.add_argument("--no-bitpack", action="store_true",
                     help="pin stochastic (ising) batches to the int8 roll "
                     "engines (same semantics as `serve --no-bitpack`)")
+    _add_stencil_arg(gw)
     gw.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline")
     gw.add_argument("--spill-dir", default=None, metavar="DIR",
@@ -369,6 +385,7 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--sync-pump", action="store_true",
                     help="workers run host-synchronous rounds instead of "
                     "the pipelined pump (forwarded to every gateway)")
+    _add_stencil_arg(fl)
     fl.add_argument("--spill-dir", default=None, metavar="DIR",
                     help="durable sessions (docs/FLEET.md): workers spill "
                     "live sessions under per-generation subdirs here; on "
@@ -793,6 +810,7 @@ def _add_run_args(r: argparse.ArgumentParser) -> None:
         "adder tree AND the packed Metropolis engine for --rule ising "
         "(both bit-identical to their int8 twins)",
     )
+    _add_stencil_arg(r)
     r.add_argument("--snapshot-every", type=int, default=0)
     r.add_argument("--snapshot-dir", default="snapshots")
     r.add_argument(
@@ -966,6 +984,7 @@ def main(argv: list[str] | None = None) -> int:
         stream_io=args.stream_io,
         pad_lanes=not args.no_pad_lanes,
         bitpack=not args.no_bitpack,
+        stencil=args.stencil,
         snapshot_every=args.snapshot_every,
         snapshot_dir=args.snapshot_dir,
         keep_snapshots=args.keep_snapshots,
@@ -980,9 +999,16 @@ def main(argv: list[str] | None = None) -> int:
         metrics_file=args.metrics_file,
         verbose=args.verbose,
     )
+    from tpu_life.models.rules import GeometryError
     from tpu_life.runtime.driver import run
 
-    run(cfg)
+    try:
+        run(cfg)
+    except GeometryError as e:
+        # kernel-vs-board geometry (docs/RULES.md): typed exit 2, the
+        # CLI twin of the gateway's 400 radius_too_large
+        print(f"tpu_life: {e}", file=sys.stderr)
+        return 2
     return 0
 
 
@@ -1045,7 +1071,8 @@ def _info() -> int:
         "neighborhoods NM (Moore) / NN (von Neumann); topology clamped "
         "(default) / board-sized torus via the ':T' suffix; stochastic "
         "rules ising (needs --temperature) and noisy:<p>/<base> "
-        "(docs/STOCHASTIC.md)"
+        "(docs/STOCHASTIC.md); continuous rules lenia[:<preset>|:R..,m..,s..] "
+        "(float32 boards, docs/RULES.md; count path via --stencil)"
     )
     return 0
 
@@ -1412,6 +1439,7 @@ def _serve(args) -> int:
             spill_dir=args.spill_dir,
             spill_every=args.spill_every,
             mc_packed=not args.no_bitpack,
+            stencil=args.stencil,
             memory_budget_bytes=args.memory_budget_bytes,
             engine_max_restarts=args.engine_max_restarts,
             settle_deadline_s=args.settle_deadline,
@@ -1435,13 +1463,26 @@ def _serve(args) -> int:
                 # a seeded request (`submit --size`): no board file exists,
                 # the spool line fully describes the workload — staged from
                 # the counter-based stream so the seed names the same board
-                # on every host (docs/STOCHASTIC.md)
-                board = mc.seeded_board(
-                    req["height"],
-                    req["width"],
-                    states=get_rule(req.get("rule", "conway")).states,
-                    seed=int(req.get("seed", 0)),
-                )
+                # on every host (docs/STOCHASTIC.md).  Continuous rules
+                # stage the float twin (docs/RULES.md).
+                req_rule = get_rule(req.get("rule", "conway"))
+                if req_rule.continuous:
+                    from tpu_life.models.lenia import (
+                        seeded_board as lenia_seeded_board,
+                    )
+
+                    board = lenia_seeded_board(
+                        req["height"],
+                        req["width"],
+                        seed=int(req.get("seed", 0)),
+                    )
+                else:
+                    board = mc.seeded_board(
+                        req["height"],
+                        req["width"],
+                        states=req_rule.states,
+                        seed=int(req.get("seed", 0)),
+                    )
             sid = None
             while True:
                 try:
@@ -1590,6 +1631,11 @@ def _sweep(parser, args) -> int:
                 rule, args.serve_backend, bitpack=not args.no_bitpack
             ),
         )
+        # kernel-vs-board geometry (docs/RULES.md): typed exit 2 here
+        # too, before any board is staged
+        from tpu_life.models.rules import validate_rule_geometry
+
+        validate_rule_geometry(rule, (height, width))
     except ValueError as e:
         parser.error(str(e))
     board = mc.seeded_board(
@@ -1606,6 +1652,7 @@ def _sweep(parser, args) -> int:
             metrics=bool(args.metrics_file),
             metrics_file=args.metrics_file,
             mc_packed=not args.no_bitpack,
+            stencil=args.stencil,
             memory_budget_bytes=args.memory_budget_bytes,
             engine_max_restarts=args.engine_max_restarts,
             settle_deadline_s=args.settle_deadline,
@@ -1723,6 +1770,7 @@ def _gateway(args) -> int:
                 spill_url=args.spill_url,
                 spill_namespace=args.spill_namespace,
                 mc_packed=not args.no_bitpack,
+                stencil=args.stencil,
                 memory_budget_bytes=args.memory_budget_bytes,
                 engine_max_restarts=args.engine_max_restarts,
                 settle_deadline_s=args.settle_deadline,
@@ -1881,6 +1929,8 @@ def _fleet(args) -> int:
     ]
     if args.sync_pump:
         worker_args += ["--sync-pump"]
+    if args.stencil != "auto":
+        worker_args += ["--stencil", args.stencil]
     # the per-worker resource governor (docs/SERVING.md): each gateway
     # worker enforces its own budget/restart/watchdog knobs
     if args.memory_budget_bytes is not None:

@@ -64,6 +64,12 @@ class RunConfig:
     stream_io: bool | None = None
     pad_lanes: bool = True  # pad width to the 128-lane TPU tile
     bitpack: bool = True  # bit-sliced fast path for life-like rules
+    # the neighborhood-counting path (docs/RULES.md): "roll" shift-adds,
+    # "matmul" banded matmuls (bit-identical for integer rules; the MXU
+    # path for large radii and the continuous tier), "auto" = the
+    # crossover model (ops.conv.resolve_stencil; numpy stays the roll
+    # oracle, and --backend tuned consults the measured cache axis)
+    stencil: str = "auto"  # auto | roll | matmul
 
     # aux subsystems
     snapshot_every: int = 0

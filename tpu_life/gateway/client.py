@@ -233,11 +233,15 @@ class GatewayClient:
             return resp.read().decode()
 
 
-def board_rows(board: np.ndarray) -> list[str]:
-    """int8 board -> rows-of-digit-strings (the compact inline encoding)."""
+def board_rows(board: np.ndarray) -> list:
+    """int8 board -> rows-of-digit-strings (the compact inline encoding);
+    float32 (continuous-tier) boards -> nested float lists — the wire
+    shape ``parse_board`` accepts for continuous rules."""
     board = np.asarray(board)
     if board.ndim != 2:
         raise ValueError(f"board must be 2-D, got shape {board.shape}")
+    if np.issubdtype(board.dtype, np.floating):
+        return [[float(c) for c in row] for row in board]
     if board.min(initial=0) < 0 or board.max(initial=0) > 9:
         raise ValueError("inline boards carry digit states 0..9")
     return ["".join(str(int(c)) for c in row) for row in board]

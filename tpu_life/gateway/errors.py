@@ -176,6 +176,12 @@ def from_serve_error(e: Exception) -> ApiError:
         # terminal without a board (failed / cancelled): the session is
         # gone for good — 410, never retried
         return ApiError(410, "session_failed", str(e))
+    from tpu_life.models.rules import GeometryError
+
+    if isinstance(e, GeometryError):
+        # kernel-vs-board geometry (docs/RULES.md): the service's
+        # re-check of what parse_submit already fronts — same typed code
+        return bad_request("radius_too_large", str(e))
     if isinstance(e, ValueError):
         # the service's board/steps validation speaks ValueError
         return bad_request("invalid_request", str(e))

@@ -126,13 +126,33 @@ def parse_trace_id(raw) -> str | None:
     return raw
 
 
-def parse_board(raw, states: int) -> np.ndarray:
-    """Inline JSON board -> int8 array, with typed 400s for every malformation."""
+def _check_rule_geometry(rule, shape) -> None:
+    """Kernel-vs-board geometry as a typed 400 (docs/RULES.md): a
+    Larger-than-Life or continuous kernel wider than the board rejects
+    HERE — ``radius_too_large`` — never as a downstream shape error."""
+    from tpu_life.models.rules import GeometryError, validate_rule_geometry
+
+    try:
+        validate_rule_geometry(rule, shape)
+    except GeometryError as e:
+        raise bad_request("radius_too_large", str(e)) from None
+
+
+def parse_board(raw, rule) -> np.ndarray:
+    """Inline JSON board -> int8 (or, for continuous rules, float32)
+    array, with typed 400s for every malformation.
+
+    Discrete rules take digit-string rows or nested int lists; the
+    continuous tier additionally accepts float cells (values in
+    [0, 1]) — a digit-string row of 0s and 1s is legal there too.
+    """
+    continuous = bool(getattr(rule, "continuous", False))
+    states = rule.states
     if not isinstance(raw, list) or not raw:
         raise bad_request(
             "invalid_board", "'board' must be a non-empty list of rows"
         )
-    rows: list[list[int]] = []
+    rows: list[list] = []
     width = None
     for i, row in enumerate(raw):
         if isinstance(row, str):
@@ -145,17 +165,22 @@ def parse_board(raw, states: int) -> np.ndarray:
                 )
             cells = [int(c) for c in row]
         elif isinstance(row, list):
+            ok_types = (int, float) if continuous else (int,)
             if not all(
-                isinstance(c, int) and not isinstance(c, bool) for c in row
+                isinstance(c, ok_types) and not isinstance(c, bool)
+                for c in row
             ):
                 raise bad_request(
-                    "invalid_board", f"board row {i} must hold only integers"
+                    "invalid_board",
+                    f"board row {i} must hold only "
+                    + ("numbers" if continuous else "integers"),
                 )
             cells = row
         else:
             raise bad_request(
                 "invalid_board",
-                f"board row {i} must be a digit string or an int list",
+                f"board row {i} must be a digit string or "
+                + ("a number list" if continuous else "an int list"),
             )
         if not cells:
             raise bad_request("invalid_board", f"board row {i} is empty")
@@ -172,6 +197,20 @@ def parse_board(raw, states: int) -> np.ndarray:
             "board_too_large",
             f"board has {len(rows) * width} cells; the limit is {MAX_CELLS}",
         )
+    if continuous:
+        board = np.array(rows, dtype=np.float64)
+        if not np.isfinite(board).all():
+            raise bad_request(
+                "invalid_board", "board contains NaN or Inf"
+            )
+        lo, hi = float(board.min()), float(board.max())
+        if lo < 0.0 or hi > 1.0:
+            raise bad_request(
+                "invalid_board",
+                f"board values must be in [0, 1] for continuous rule "
+                f"{rule.name!r}; found {lo if lo < 0.0 else hi}",
+            )
+        return board.astype(np.float32)
     board = np.array(rows, dtype=np.int64)
     lo, hi = int(board.min()), int(board.max())
     if lo < 0 or hi >= states:
@@ -183,11 +222,15 @@ def parse_board(raw, states: int) -> np.ndarray:
     return board.astype(np.int8)
 
 
-def parse_resume_board(payload: dict, states: int) -> np.ndarray:
-    """``resume_b64`` + geometry -> the byte-exact int8 board, with typed
-    400s for malformed base64, geometry mismatch, or out-of-range states.
-    The bytes ARE the spill/snapshot contract format, so a resumed board
-    is identical down to the byte to what the dead worker spilled."""
+def parse_resume_board(payload: dict, rule) -> np.ndarray:
+    """``resume_b64`` + geometry -> the byte-exact board, with typed
+    400s for malformed base64, geometry mismatch, or out-of-range
+    states.  The bytes ARE the spill/snapshot contract format (the
+    float32 encoding for continuous rules — ``io/codec.py``), so a
+    resumed board is identical down to the byte to what the dead worker
+    spilled."""
+    continuous = bool(getattr(rule, "continuous", False))
+    states = rule.states
     height = _require_int(payload, "height", minimum=1)
     width = _require_int(payload, "width", minimum=1)
     if height * width > MAX_CELLS:
@@ -208,6 +251,29 @@ def parse_resume_board(payload: dict, states: int) -> np.ndarray:
         board = decode_board(buf, height, width)
     except ValueError as e:
         raise bad_request("invalid_board", str(e)) from None
+    if continuous:
+        if not np.issubdtype(board.dtype, np.floating):
+            raise bad_request(
+                "invalid_board",
+                f"continuous rule {rule.name!r} resumes from the float32 "
+                f"board encoding ({height * width * 4} bytes), got the "
+                f"digit-grid encoding",
+            )
+        lo = float(board.min(initial=0.0))
+        hi = float(board.max(initial=0.0))
+        if lo < 0.0 or hi > 1.0:
+            raise bad_request(
+                "invalid_board",
+                f"resume board values must be in [0, 1]; "
+                f"found {lo if lo < 0.0 else hi}",
+            )
+        return board
+    if np.issubdtype(board.dtype, np.floating):
+        raise bad_request(
+            "invalid_board",
+            f"rule {rule.name!r} resumes from the digit-grid board "
+            f"encoding, got the float32 encoding",
+        )
     lo, hi = int(board.min(initial=0)), int(board.max(initial=0))
     if lo < 0 or hi >= states:
         raise bad_request(
@@ -266,7 +332,8 @@ def parse_submit(payload) -> SubmitSpec:
     if "resume_b64" in payload:
         # failover resume: byte-exact contract-codec board + the absolute
         # stream position it corresponds to (docs/FLEET.md)
-        board = parse_resume_board(payload, rule.states)
+        board = parse_resume_board(payload, rule)
+        _check_rule_geometry(rule, board.shape)
         return SubmitSpec(
             board=board,
             rule=rule_name,
@@ -279,7 +346,8 @@ def parse_submit(payload) -> SubmitSpec:
         )
 
     if "board" in payload:
-        board = parse_board(payload["board"], rule.states)
+        board = parse_board(payload["board"], rule)
+        _check_rule_geometry(rule, board.shape)
         return SubmitSpec(
             board=board,
             rule=rule_name,
@@ -320,6 +388,7 @@ def parse_submit(payload) -> SubmitSpec:
         mc_validate_board_shape(rule, (height, width))
     except ValueError as e:
         raise bad_request("invalid_board", str(e)) from None
+    _check_rule_geometry(rule, (height, width))
     density = payload.get("density", 0.5)
     if isinstance(density, bool) or not isinstance(density, (int, float)):
         raise bad_request("invalid_request", "'density' must be a number")
@@ -328,11 +397,19 @@ def parse_submit(payload) -> SubmitSpec:
             "invalid_request", f"'density' must be in [0, 1], got {density}"
         )
     # counter-based staging (tpu_life.mc.prng): the board a seed names is
-    # identical on every host, so the echoed seed fully replays the run
+    # identical on every host, so the echoed seed fully replays the run.
+    # Continuous rules stage the float twin (models/lenia.seeded_board).
     staged_seed = 0 if seed is None else seed
-    board = seeded_board(
-        height, width, float(density), states=rule.states, seed=staged_seed
-    )
+    if getattr(rule, "continuous", False):
+        from tpu_life.models.lenia import seeded_board as lenia_seeded_board
+
+        board = lenia_seeded_board(
+            height, width, float(density), seed=staged_seed
+        )
+    else:
+        board = seeded_board(
+            height, width, float(density), states=rule.states, seed=staged_seed
+        )
     return SubmitSpec(
         board=board,
         rule=rule_name,
@@ -385,10 +462,23 @@ def render_view(view: SessionView) -> dict:
 
 
 def render_result(board: np.ndarray, fmt: str, rule: str) -> dict:
-    """Result payload in the requested encoding (``rle`` | ``raw``)."""
+    """Result payload in the requested encoding (``rle`` | ``raw``).
+
+    Continuous-tier (float32) boards have no RLE form — ``raw`` is the
+    byte-exact little-endian float32 contract encoding, stamped with a
+    ``dtype`` field so clients (and ``decode_result``) know what the
+    bytes are; asking a float board for ``rle`` is a typed 400.
+    """
     h, w = board.shape
     out = {"format": fmt, "height": int(h), "width": int(w), "rule": rule}
+    floating = np.issubdtype(board.dtype, np.floating)
     if fmt == "rle":
+        if floating:
+            raise bad_request(
+                "invalid_format",
+                "continuous (float32) boards have no RLE form; use "
+                "format=raw",
+            )
         states = max(2, int(board.max(initial=0)) + 1)
         try:
             states = get_rule(rule).states
@@ -397,6 +487,8 @@ def render_result(board: np.ndarray, fmt: str, rule: str) -> dict:
         out["rle"] = emit_rle(board, rule=rule, states=states)
     elif fmt == "raw":
         out["b64"] = base64.b64encode(encode_board(board)).decode("ascii")
+        if floating:
+            out["dtype"] = "float32"
     else:
         raise bad_request(
             "invalid_format", f"format must be 'rle' or 'raw', got {fmt!r}"

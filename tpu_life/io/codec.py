@@ -40,12 +40,29 @@ def _native():
     return native if native.available() else None
 
 
+def float_board_bytes(height: int, width: int) -> int:
+    """On-disk byte length of a float32 (continuous-tier) board."""
+    return height * width * 4
+
+
 def decode_board(buf: bytes | bytearray | memoryview, height: int, width: int) -> np.ndarray:
-    """Parse board bytes into an ``int8`` array of shape ``(height, width)``.
+    """Parse board bytes into an ``int8`` array of shape ``(height, width)``
+    — or a ``float32`` array for continuous-tier boards.
+
+    The two encodings are length-disambiguated: an ASCII digit board is
+    ``h * (w + 1)`` bytes, a float32 board ``4 * h * w`` little-endian
+    bytes, and the two can never coincide (``w + 1 == 4w`` has no
+    positive integer solution) — so every existing reader of the
+    contract codec transparently handles the continuous tier.
 
     Validates the newline grid structure and cell alphabet.  Dispatches to
     the threaded C++ codec (native/codec.cpp) for large boards when built.
     """
+    if len(buf) == float_board_bytes(height, width) and len(buf) != height * row_stride(width):
+        a = np.frombuffer(buf, dtype="<f4").reshape(height, width)
+        if not np.isfinite(a).all():
+            raise ValueError("float board contains NaN or Inf")
+        return a.astype(np.float32)
     if height * width >= _NATIVE_THRESHOLD:
         nat = _native()
         if nat is not None and len(buf) == height * row_stride(width):
@@ -68,10 +85,14 @@ def decode_board(buf: bytes | bytearray | memoryview, height: int, width: int) -
 
 
 def encode_board(board: np.ndarray) -> bytes:
-    """Serialize an ``int8`` state array to the on-disk byte format."""
+    """Serialize an ``int8`` state array to the on-disk byte format —
+    or a ``float32`` (continuous-tier) board to its raw little-endian
+    bytes (see :func:`decode_board` for the length disambiguation)."""
     board = np.asarray(board)
     if board.ndim != 2:
         raise ValueError(f"board must be 2-D, got shape {board.shape}")
+    if np.issubdtype(board.dtype, np.floating):
+        return np.ascontiguousarray(board, dtype="<f4").tobytes()
     h, w = board.shape
     if h * w >= _NATIVE_THRESHOLD:
         nat = _native()

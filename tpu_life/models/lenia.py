@@ -1,0 +1,449 @@
+"""Lenia: the continuous-CA rule family (docs/RULES.md).
+
+Where every discrete rule maps ``(state, integer count) -> state``
+through a LUT, Lenia (Chan 2019) runs a *smooth* world: float32 boards
+in [0, 1], a radially symmetric weighted kernel, a smooth growth
+function, and a clipped Euler update::
+
+    A' = clip(A + dt * G(K (*) A), 0, 1)
+    G(u) = 2 * exp(-(u - mu)^2 / (2 sigma^2)) - 1
+
+The kernel is the classic shell construction: with normalized polar
+radius ``rho = |d| / R`` and ring amplitudes ``b`` (``B = len(b)``
+shells), ``K(rho) = b[floor(B rho)] * core(B rho mod 1)`` where
+``core(x) = exp(4 - 1/(x (1 - x)))`` — a smooth bump peaking mid-shell,
+zero at both shell edges (and at the center).  ``K`` is normalized to
+sum 1 so the correlation is a weighted mean and ``G`` sees [0, 1].
+
+This is exactly the workload the banded-matmul neighborhoods
+(``ops.conv``) exist for: the kernel is weighted, wide (the ``orbium``
+preset is radius 13 — a 27x27 stencil the roll path would unroll into
+~700 shifted adds) and float32, so ``K (*) A`` runs as a handful of MXU
+matmul pairs.  :class:`LeniaRule` is a frozen :class:`Rule` subclass,
+so the whole serving stack — CompileKey grouping, vmapped engines,
+spill/resume, the gateway — carries it exactly like ``ising`` rode in
+as a rule subclass (PR 6); the board dtype ("float32") rides in the
+CompileKey, and the numpy roll executor is the pinned oracle
+(``tests/fixtures/lenia_kat.json`` holds its golden vectors).
+
+Float determinism contract (docs/RULES.md): the numpy roll oracle is
+byte-stable and KAT-pinned; the jax paths (roll and matmul) agree with
+it to ``allclose`` tolerance only — float summation order is executor-
+specific.  Anything that must be byte-exact (the CI gateway
+byte-compare, golden vectors) therefore runs the numpy executor.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from functools import cached_property
+
+import numpy as np
+
+from tpu_life.models.rules import Rule
+
+#: Executors carrying the float32 board path.  The single allow-list —
+#: runner factory, serve engine factory and driver pre-check all
+#: consult it (the ``mc.SUPPORTED_BACKENDS`` pattern).
+SUPPORTED_BACKENDS = ("jax", "numpy")
+
+#: allclose tolerance between float executors (numpy oracle vs the jax
+#: roll/matmul paths).  Stated, tested, and documented in docs/RULES.md:
+#: per-step error is summation-order-level (~1e-7) and the clipped
+#: update keeps it from compounding past this over KAT-length runs.
+FLOAT_ATOL = 1e-4
+
+
+def require_float_path(rule: Rule, backend_name: str) -> None:
+    """The hard gate: continuous rules only run on float executors.
+    A silent int8 cast would quantize the board to junk — worse than
+    an error."""
+    if backend_name not in SUPPORTED_BACKENDS:
+        raise ValueError(
+            f"continuous rule {rule.name!r} needs the jax or numpy "
+            f"backend (float32 boards; {backend_name!r} has no float "
+            f"path) — a quantized fallback would not be the rule you "
+            f"asked for"
+        )
+
+
+@dataclass(frozen=True)
+class LeniaRule(Rule):
+    """A Lenia world as a frozen, hashable rule value.
+
+    The inherited ``birth``/``survive``/``states`` fields are unused
+    (the transition is the growth function, not a count LUT); they keep
+    their defaults so the rule hashes and serializes like any other.
+    ``boundary`` defaults to the torus (the standard Lenia world) but
+    the clamped variant is legal — the kernel truncates at the edges
+    exactly like a clamped count stencil.
+    """
+
+    name: str = "lenia"
+    radius: int = 13
+    mu: float = 0.15  # growth-function center
+    sigma: float = 0.017  # growth-function width
+    dt: float = 0.1  # Euler step size
+    peaks: tuple = (1.0,)  # ring (shell) amplitudes, center outward
+    boundary: str = "torus"
+
+    def __post_init__(self):
+        super().__post_init__()
+        if not (0.0 < float(self.mu) < 1.0):
+            raise ValueError(f"lenia mu must be in (0, 1), got {self.mu}")
+        if not (0.0 < float(self.sigma) < 1.0):
+            raise ValueError(
+                f"lenia sigma must be in (0, 1), got {self.sigma}"
+            )
+        if not (0.0 < float(self.dt) <= 1.0):
+            raise ValueError(f"lenia dt must be in (0, 1], got {self.dt}")
+        if not self.peaks or any(
+            not (0.0 <= float(b) <= 1.0) for b in self.peaks
+        ):
+            raise ValueError(
+                f"lenia ring amplitudes must be a non-empty tuple in "
+                f"[0, 1], got {self.peaks!r}"
+            )
+        if max(float(b) for b in self.peaks) <= 0.0:
+            raise ValueError("lenia needs at least one nonzero ring")
+
+    @property
+    def continuous(self) -> bool:
+        return True
+
+    @cached_property
+    def kernel(self) -> np.ndarray:
+        """The normalized float32 shell kernel, ``(2r+1, 2r+1)``."""
+        r = self.radius
+        dy, dx = np.mgrid[-r : r + 1, -r : r + 1].astype(np.float64)
+        rho = np.sqrt(dy * dy + dx * dx) / r
+        nb = len(self.peaks)
+        srho = rho * nb
+        shell = np.minimum(np.floor(srho), nb - 1)
+        frac = srho - shell
+        with np.errstate(divide="ignore", over="ignore"):
+            core = np.where(
+                (frac > 0.0) & (frac < 1.0),
+                np.exp(4.0 - 1.0 / np.maximum(frac * (1.0 - frac), 1e-12)),
+                0.0,
+            )
+        amp = np.asarray(self.peaks, np.float64)[shell.astype(np.int64)]
+        k = np.where(rho < 1.0, amp * core, 0.0)
+        total = k.sum()
+        if total <= 0.0:
+            raise ValueError(
+                f"lenia kernel for {self.name!r} is degenerate (all-zero "
+                f"after the shell construction)"
+            )
+        return (k / total).astype(np.float32)
+
+
+# -- the step ---------------------------------------------------------------
+def growth(xp, u, rule: LeniaRule):
+    """The smooth growth field ``G(u)`` in [-1, 1]."""
+    mu = xp.float32(rule.mu)
+    inv2s2 = xp.float32(1.0 / (2.0 * float(rule.sigma) ** 2))
+    d = u - mu
+    return xp.float32(2.0) * xp.exp(-(d * d) * inv2s2) - xp.float32(1.0)
+
+
+def _make_roll_conv(xp, rule: LeniaRule, shape: tuple[int, int]):
+    """The weighted roll path: the kernel unrolled into shifted-scaled
+    adds over a padded board — the oracle shape (numpy) and the
+    below-crossover executor.  O(nnz(K)) slices per step."""
+    h, w = int(shape[0]), int(shape[1])
+    r = rule.radius
+    kern = rule.kernel
+    offsets = [
+        (dy, dx, float(kern[dy + r, dx + r]))
+        for dy in range(-r, r + 1)
+        for dx in range(-r, r + 1)
+        if kern[dy + r, dx + r] != 0.0
+    ]
+    mode = "wrap" if rule.boundary == "torus" else "constant"
+
+    def conv(a):
+        padded = xp.pad(a, ((r, r), (r, r)), mode=mode)
+        out = None
+        for dy, dx, wgt in offsets:
+            sl = padded[r + dy : r + dy + h, r + dx : r + dx + w] * xp.float32(
+                wgt
+            )
+            out = sl if out is None else out + sl
+        return out
+
+    return conv
+
+
+def make_lenia_step(
+    xp, rule: LeniaRule, shape: tuple[int, int], stencil: str = "matmul"
+):
+    """One Lenia step ``f32[h, w] -> f32[h, w]``, pure and traceable.
+
+    ``stencil`` picks the correlation executor: ``matmul`` builds the
+    banded operators once (``ops.conv`` — the MXU path), ``roll`` the
+    unrolled shifted adds (the oracle shape).
+    """
+    if stencil == "matmul":
+        from tpu_life.ops.conv import make_conv
+
+        conv = make_conv(xp, shape, rule.kernel, rule.boundary)
+    else:
+        conv = _make_roll_conv(xp, rule, shape)
+    dt = float(rule.dt)
+
+    def step(board):
+        u = conv(board.astype(xp.float32))
+        a = board + xp.float32(dt) * growth(xp, u, rule)
+        return xp.clip(a, xp.float32(0.0), xp.float32(1.0)).astype(
+            xp.float32
+        )
+
+    return step
+
+
+def step_np(
+    board: np.ndarray, rule: LeniaRule, stencil: str = "roll"
+) -> np.ndarray:
+    """One ground-truth numpy step (roll by default — the KAT oracle)."""
+    return make_lenia_step(np, rule, board.shape, stencil)(
+        np.asarray(board, np.float32)
+    )
+
+
+def run_np(
+    board: np.ndarray, rule: LeniaRule, steps: int, stencil: str = "roll"
+) -> np.ndarray:
+    """``steps`` oracle steps — what serve results are byte-compared to
+    (on the numpy executor) and allclose-compared to (jax paths)."""
+    fn = make_lenia_step(np, rule, board.shape, stencil)
+    board = np.asarray(board, np.float32)
+    for _ in range(steps):
+        board = fn(board)
+    return board
+
+
+def validate_board(board: np.ndarray, rule: LeniaRule) -> np.ndarray:
+    """Submit-time float-board validation shared by every front: 2-D,
+    finite, within [0, 1]; returns the float32 copy the engines step."""
+    board = np.asarray(board)
+    if board.ndim != 2:
+        raise ValueError(f"board must be 2-D, got shape {board.shape}")
+    b = board.astype(np.float32)
+    if not np.isfinite(b).all():
+        raise ValueError(
+            f"continuous rule {rule.name!r} needs a finite board; found "
+            f"NaN or Inf"
+        )
+    lo, hi = float(b.min(initial=0.0)), float(b.max(initial=0.0))
+    if lo < 0.0 or hi > 1.0:
+        raise ValueError(
+            f"continuous rule {rule.name!r} needs board values in "
+            f"[0, 1]; found {lo if lo < 0.0 else hi}"
+        )
+    return b
+
+
+def seeded_board(
+    height: int, width: int, density: float = 0.5, *, seed: int = 0
+) -> np.ndarray:
+    """A seeded float32 board from the counter-based stream: each cell
+    alive with probability ``density`` carrying a uniform [0, 1)
+    magnitude, dead (0.0) otherwise.  Identical on every host — the
+    continuous twin of ``mc.prng.seeded_board``, same ``SUB_BOARD``
+    substream, so the stamped seed fully replays the run."""
+    from tpu_life.mc import prng
+
+    if not 0.0 <= density <= 1.0:
+        raise ValueError(f"density must be in [0, 1], got {density}")
+    k0, k1 = prng.key_halves(seed)
+    mask_u = prng.cell_uniforms(
+        np, (height, width), k0, k1, np.uint32(0), prng.SUB_BOARD
+    )
+    mag_u = prng.cell_uniforms(
+        np, (height, width), k0, k1, np.uint32(1), prng.SUB_BOARD
+    )
+    alive = (
+        np.ones((height, width), bool)
+        if density >= 1.0
+        else mask_u < np.uint32(prng.threshold_u32(density))
+    )
+    mag = (mag_u.astype(np.float64) * (1.0 / 4294967296.0)).astype(np.float32)
+    return np.where(alive, mag, np.float32(0.0)).astype(np.float32)
+
+
+# -- runners (the driver path) ----------------------------------------------
+class LeniaHostRunner:
+    """NumPy Runner — the ground truth behind ``run --rule lenia:*``."""
+
+    def __init__(self, board: np.ndarray, rule: LeniaRule, *, stencil="roll"):
+        self.board = validate_board(board, rule)
+        self._fn = make_lenia_step(np, rule, self.board.shape, stencil)
+
+    def advance(self, steps: int) -> None:
+        for _ in range(steps):
+            self.board = self._fn(self.board)
+
+    def sync(self) -> None:
+        pass
+
+    def fetch(self) -> np.ndarray:
+        return self.board
+
+    def snapshot(self):
+        return lambda board=self.board: board
+
+    def live_count(self) -> int:
+        # the discrete notion degrades gracefully: cells above one half
+        return int(np.count_nonzero(self.board >= 0.5))
+
+
+class LeniaDeviceRunner:
+    """Single-device XLA Runner: fused float scan, donated buffers."""
+
+    def __init__(
+        self,
+        board: np.ndarray,
+        rule: LeniaRule,
+        *,
+        stencil: str = "matmul",
+        device=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        board = validate_board(board, rule)
+        self.x = jax.device_put(jnp.asarray(board, jnp.float32), device)
+        step = make_lenia_step(jnp, rule, board.shape, stencil)
+
+        def advance(x, *, steps):
+            def body(b, _):
+                return step(b), None
+
+            x, _ = jax.lax.scan(body, x, None, length=steps)
+            return x
+
+        self._advance = jax.jit(
+            advance, static_argnames=("steps",), donate_argnums=0
+        )
+
+    def advance(self, steps: int) -> None:
+        if steps > 0:
+            self.x = self._advance(self.x, steps=steps)
+
+    def sync(self) -> None:
+        import jax
+
+        jax.block_until_ready(self.x)
+        np.asarray(self.x[:1, :1])
+
+    def fetch(self) -> np.ndarray:
+        return np.asarray(self.x)
+
+    def snapshot(self):
+        # valid until the next advance donates the buffer — materialize
+        # within the chunk callback, matching DeviceRunner's contract
+        return lambda x=self.x: np.asarray(x)
+
+    def live_count(self) -> int:
+        return int(np.count_nonzero(np.asarray(self.x) >= 0.5))
+
+
+def lenia_runner_for(backend, board: np.ndarray, rule: LeniaRule):
+    """Runner factory for continuous rules, dispatched on the backend —
+    the float twin of ``mc.engine.mc_runner_for``.  The backend's
+    resolved stencil mode routes the correlation executor; numpy under
+    ``auto`` stays the roll oracle (``ops.conv.resolve_stencil``)."""
+    from tpu_life.ops.conv import resolve_stencil
+
+    name = getattr(backend, "name", "") or type(backend).__name__
+    require_float_path(rule, name)
+    stencil = resolve_stencil(
+        rule, getattr(backend, "stencil", "auto"), name
+    )
+    if name == "jax":
+        return LeniaDeviceRunner(
+            board,
+            rule,
+            stencil=stencil,
+            device=getattr(backend, "device", None),
+        )
+    return LeniaHostRunner(board, rule, stencil=stencil)
+
+
+# -- the spec grammar -------------------------------------------------------
+#: Named presets (docs/RULES.md).  ``orbium`` is the classic glider's
+#: parameter point (R13, mu 0.15, sigma 0.017, dt 0.1, one ring);
+#: ``mini`` is a cheap small-kernel world sized for tests and CI smoke.
+PRESETS: dict[str, dict] = {
+    "orbium": dict(radius=13, mu=0.15, sigma=0.017, dt=0.1, peaks=(1.0,)),
+    "mini": dict(radius=4, mu=0.15, sigma=0.04, dt=0.25, peaks=(1.0,)),
+}
+
+_FIELD_RE = re.compile(r"^(dt|[RMSB])(.*)$", re.IGNORECASE)
+
+
+def parse_lenia(spec: str) -> LeniaRule:
+    """``lenia`` / ``lenia:<preset>`` / parametric
+    ``lenia:R<r>,m<mu>,s<sigma>[,dt<dt>][,b<a1;a2;...>]`` (+ optional
+    ``:T`` torus suffix — the default topology anyway) with typed
+    errors for every malformation, mirroring :func:`parse_rule`.
+    """
+    raw = spec.strip()
+    body = raw[len("lenia"):].lstrip(":").strip()
+    boundary = "torus"
+    m_t = re.search(r":\s*[tT]\s*$", body)
+    if m_t is not None:
+        body = body[: m_t.start()].strip()
+    elif body.lower() == "t":
+        # the bare 'lenia:T' form: the suffix with no body — the default
+        # preset on its (already default) torus
+        body = ""
+    if not body:
+        return LeniaRule(name="lenia:orbium", **PRESETS["orbium"])
+    key = body.lower().replace("-", "_")
+    if key in PRESETS:
+        return LeniaRule(name=f"lenia:{key}", **PRESETS[key])
+    if not body.startswith(("R", "r")):
+        # not a preset and not parametric: reject loudly with the menu
+        raise ValueError(
+            f"unknown lenia spec {spec!r}: presets are "
+            f"{sorted(PRESETS)}, or parametric "
+            f"'lenia:R<r>,m<mu>,s<sigma>[,dt<dt>][,b<a1;a2;...>]'"
+        )
+    fields: dict[str, str] = {}
+    for part in body.split(","):
+        part = part.strip()
+        m = _FIELD_RE.match(part)
+        if not m:
+            raise ValueError(f"bad lenia field {part!r} in {spec!r}")
+        k, v = m.group(1), m.group(2)
+        k = "R" if k.lower() == "r" else k.lower()
+        if k in fields:
+            raise ValueError(f"duplicate lenia field {k!r} in {spec!r}")
+        fields[k] = v
+    if "R" not in fields:
+        raise ValueError(f"lenia spec {spec!r} needs a radius field R<r>")
+    try:
+        radius = int(fields["R"])
+        mu = float(fields.get("m", "0.15"))
+        sigma = float(fields.get("s", "0.017"))
+        dt = float(fields.get("dt", "0.1"))
+        peaks = tuple(
+            float(b) for b in fields.get("b", "1").split(";") if b.strip()
+        )
+    except ValueError:
+        raise ValueError(
+            f"bad lenia parameter value in {spec!r} (fields: R=int, "
+            f"m/s/dt=float, b=floats joined by ';')"
+        ) from None
+    return LeniaRule(
+        name=raw,
+        radius=radius,
+        mu=mu,
+        sigma=sigma,
+        dt=dt,
+        peaks=peaks,
+        boundary=boundary,
+    )

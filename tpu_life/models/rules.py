@@ -120,6 +120,21 @@ class Rule:
         only run on executors that honor the key schedule."""
         return False
 
+    @property
+    def continuous(self) -> bool:
+        """True for continuous-state CA (``tpu_life.models.lenia``):
+        float32 boards in [0, 1], a weighted kernel instead of a count
+        LUT, and an Euler update instead of a transition table.  They
+        run only on executors with a float path (jax / numpy)."""
+        return False
+
+    @property
+    def board_dtype(self) -> str:
+        """The board element dtype this rule steps ("int8" for every
+        discrete rule; "float32" on the continuous tier) — what the
+        serve CompileKey and the codecs key on."""
+        return "float32" if self.continuous else "int8"
+
     def __str__(self) -> str:
         return self.name
 
@@ -220,6 +235,12 @@ def _parse_noisy(spec: str) -> NoisyRule:
             f"{base.name!r} in {spec!r} (substream composition of two "
             f"stochastic rules is not defined)"
         )
+    if base.continuous:
+        raise ValueError(
+            f"noisy base must be a discrete rule, got continuous rule "
+            f"{base.name!r} in {spec!r} (a 0<->1 flip is meaningless on "
+            f"float boards)"
+        )
     # a multi-state base is rejected by NoisyRule.__post_init__ (the one
     # check that also guards direct construction)
     return NoisyRule(
@@ -234,6 +255,40 @@ def _parse_noisy(spec: str) -> NoisyRule:
         flip_p=p,
         base=base,
     )
+
+
+class GeometryError(ValueError):
+    """A rule whose kernel cannot fit the board it was submitted with.
+
+    Raised by :func:`validate_rule_geometry` and caught TYPED at every
+    admission front — ``run``/``sweep`` exit 2, serve submit rejects
+    before anything is stored, the gateway answers 400
+    ``radius_too_large`` — instead of surfacing as a downstream shape
+    (or silently wrong torus double-count) error.
+    """
+
+
+def validate_rule_geometry(rule: Rule, shape: tuple[int, int]) -> None:
+    """Reject a kernel larger than the board: ``2r + 1 > min(h, w)``.
+
+    ``parse_rule`` accepts any ``R<r>`` Larger-than-Life radius (and the
+    continuous tier any kernel radius), but a kernel wider than the
+    board is never the simulation the client asked for: clamped boards
+    degenerate, torus neighborhoods would alias around the wrap seam.
+    Radius-1 rules are exempt — thin boards (1xN stripes, 2x2 toys) are
+    long-standing legal inputs with well-defined reference semantics.
+    """
+    r = int(rule.radius)
+    if r <= 1:
+        return
+    h, w = int(shape[0]), int(shape[1])
+    if 2 * r + 1 > min(h, w):
+        raise GeometryError(
+            f"rule {rule.name!r} has kernel diameter {2 * r + 1} "
+            f"(radius {r}) but the board is only {h}x{w}; the kernel "
+            f"must fit the board (2r+1 <= min(h, w)) — shrink the "
+            f"radius or grow the board"
+        )
 
 
 def _expand_ranges(spec: str) -> frozenset:
@@ -270,6 +325,10 @@ def parse_rule(spec: str) -> Rule:
       per-session temperature) and ``noisy:<p>/<base>`` (per-cell flip
       probability ``p`` over any registered 2-state rule):
       ``noisy:0.01/conway``, ``noisy:0.05/B36/S23:T``
+    - continuous rules (``tpu_life.models.lenia``, docs/RULES.md):
+      ``lenia`` / ``lenia:<preset>`` / parametric
+      ``lenia:R<r>,m<mu>,s<sigma>[,dt<dt>][,b<a1;a2;...>]`` — float32
+      boards, weighted-kernel correlation, smooth growth
     """
     spec = spec.strip()
     if spec.lower().startswith("noisy:"):
@@ -277,6 +336,12 @@ def parse_rule(spec: str) -> Rule:
         # mistaken for a bounded-grid suffix; the base spec inside may
         # still carry ':T' (parsed recursively)
         return _parse_noisy(spec)
+    if spec.lower() == "lenia" or spec.lower().startswith("lenia:"):
+        # the continuous tier (docs/RULES.md): lenia presets and the
+        # parametric spec own their colon grammar, like noisy: does
+        from tpu_life.models.lenia import parse_lenia
+
+        return parse_lenia(spec)
     m_t = re.search(r":\s*[tT](.*)$", spec)
     if m_t is not None:
         dims = m_t.group(1).strip()
@@ -417,6 +482,12 @@ register_rule(
 # the periodic lattice.  Temperature is per-session, not part of the rule;
 # `noisy:<p>/<base>` specs are parsed, not registered (p-parameterized).
 register_rule("ising", IsingRule())
+# Continuous tier (tpu_life.models.lenia, docs/RULES.md): registered so
+# `info` lists it; the parse path resolves the lenia: prefix before the
+# registry, so this entry and parse_lenia("lenia") are the same preset.
+from tpu_life.models.lenia import parse_lenia as _parse_lenia  # noqa: E402
+
+register_rule("lenia", _parse_lenia("lenia"))
 # The reference binary's *effective* rule as shipped: its unconditional rule-overwrite makes
 # the B3 branch dead code, so live' = (count == 2 and live), i.e. B/S2
 # (Parallel_Life_MPI.cpp:44-50; SURVEY.md §2.2).  Offered as an explicit

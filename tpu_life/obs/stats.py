@@ -161,6 +161,16 @@ def _serve_summary(rounds: list[dict]) -> dict:
         out["engine_recoveries"] = sum(
             r.get("engine_recoveries", 0) for r in rounds
         )
+    # the stencil stamp (ISSUE 15): live matmul-path engines (a gauge —
+    # the last record is the run's final view) and each CompileKey's
+    # resolved counting path, union'd across the run's rounds — only
+    # when the sink carries them, so older sinks summarize byte-stable
+    if any("matmul_keys" in r for r in rounds):
+        out["matmul_keys"] = last.get("matmul_keys", 0)
+        stencil_keys: dict = {}
+        for r in rounds:
+            stencil_keys.update(r.get("stencil_keys") or {})
+        out["stencil_keys"] = stencil_keys
     return out
 
 
@@ -240,6 +250,16 @@ def _merge_serve(per_run: dict) -> dict:
             if merged["steps_advanced"]
             else 0.0
         )
+    # the stencil stamp merges like the fleet's live-engine view:
+    # matmul-key gauges sum across concurrent workers, the per-key path
+    # maps union (workers of one fleet resolve each key identically)
+    matmul = [s["matmul_keys"] for s in summaries if "matmul_keys" in s]
+    if matmul:
+        merged["matmul_keys"] = sum(matmul)
+        stencil_keys: dict = {}
+        for s in summaries:
+            stencil_keys.update(s.get("stencil_keys") or {})
+        merged["stencil_keys"] = stencil_keys
     return merged
 
 
@@ -438,6 +458,14 @@ def render(summary: dict) -> str:
             lines.append(
                 f"  snapshot_s={_fmt(serve['snapshot_seconds'])}  "
                 f"spilled_sessions_max={_fmt(serve.get('spilled_sessions_max'))}"
+            )
+        if "matmul_keys" in serve:
+            paths = serve.get("stencil_keys") or {}
+            lines.append(
+                f"  matmul_keys={_fmt(serve['matmul_keys'])}  "
+                + " ".join(
+                    f"{k}:{v}" for k, v in sorted(paths.items())
+                )
             )
         if "steps_advanced_packed" in serve:
             lines.append(

@@ -1,0 +1,297 @@
+"""MXU-native neighborhoods: the stencil as banded matmuls.
+
+Every rule in the repo used to run the same separable shift-add box sum
+(``ops.stencil``) — the one stencil shape that never touches a TPU's
+matrix units.  The TPU Ising paper (PAPERS.md, arXiv:1903.11714)
+computes neighbor sums as a band-matrix multiply, and the TPU
+distributed-linear-algebra paper (arXiv:2112.09017) shows dense small
+matmuls are where the hardware's FLOPs live.  This module is that road:
+
+    counts = sum_i  A_i @ board @ B_i
+
+where each ``A_i`` is a static ``(h, h)`` band matrix encoding one
+rank-1 kernel factor's *row* profile and ``B_i`` a ``(w, w)`` band
+encoding its *column* profile — torus bands wrap, clamped bands
+truncate.  The matrices are built once per CompileKey (plain host
+numpy) and ride into the compiled program as constants; the per-step
+work is ``2·rank`` MXU matmuls instead of ``O(r)``–``O(r^2)`` VPU
+shift-adds, so kernel radius becomes a *parameter* instead of a
+hard-coded roll pattern.
+
+Factorizations (``kernel_factors``):
+
+- **separable one-hot** kernels (the Moore box) are exactly rank 1:
+  one ``(ones, ones)`` pair, integer-exact;
+- the **von Neumann diamond** decomposes exactly by rows: one one-hot
+  row-shift factor per ``dy``, each paired with a contiguous column
+  box — still integer-exact;
+- **weighted float32** kernels (the Lenia ring, any ``Rule.kernel``)
+  go through a host-side float64 SVD truncated at machine precision,
+  falling back to the exact per-row decomposition when the spectrum
+  does not compress — reconstruction is verified, never assumed.
+
+Exactness contract: for integer rules every factor entry is 0/1 and
+every partial sum is a small integer (bounded by ``(2r+1)^2``), which
+float32 represents exactly in ANY summation order — so the matmul path
+is **bit-identical** to the roll path, on numpy and under XLA.  Float
+(continuous) kernels are exact up to summation order: the matmul and
+roll paths agree to ``allclose`` tolerance, and the numpy roll
+executor stays the pinned oracle (tests/test_conv.py).
+
+Routing (``resolve_stencil``): ``--stencil roll|matmul|auto`` picks the
+counting path per CompileKey.  ``auto`` follows the measured crossover
+model — roll below :data:`CROSSOVER_RADIUS`, matmul at or above it,
+always matmul for continuous (weighted-kernel) rules — except on the
+numpy executors, which stay on the roll path so the ground-truth oracle
+never silently moves (the ``mc_packed`` principle).  The autotune tier
+carries the same choice as a measured candidate axis
+(``TunedConfig.stencil``, docs/AUTOTUNE.md), so ``auto`` under
+``--backend tuned`` is measured, not guessed; ``BENCH_conv``
+(``bench.py --conv``) captures the crossover itself.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from tpu_life.models.rules import Rule
+
+#: The analytic ``auto`` crossover: integer rules at or above this
+#: radius take the matmul path.  Bracketed by the ``BENCH_conv`` legs
+#: (radii 1/3/5/10) so the model is re-verified per capture — on the
+#: CPU reference BLAS wins from mid radii; on MXU hardware the measured
+#: ``crossover_radius`` is expected to drop.  Override per deployment
+#: with ``TPU_LIFE_STENCIL_CROSSOVER`` or pin ``--stencil`` outright.
+CROSSOVER_RADIUS = int(os.environ.get("TPU_LIFE_STENCIL_CROSSOVER", 4))
+
+#: Executor stencil modes (the CLI grammar).
+STENCIL_MODES = ("auto", "roll", "matmul")
+
+#: Relative truncation threshold for the SVD factorization of weighted
+#: kernels, and the reconstruction bound the factors must meet.
+_SVD_RTOL = 1e-6
+
+
+def validate_stencil(mode: str) -> str:
+    if mode not in STENCIL_MODES:
+        raise ValueError(
+            f"stencil must be one of {'|'.join(STENCIL_MODES)}, got {mode!r}"
+        )
+    return mode
+
+
+def resolve_stencil(rule: Rule, mode: str, backend: str = "jax") -> str:
+    """The per-CompileKey counting path: ``roll`` or ``matmul``.
+
+    Explicit modes win.  ``auto`` applies the crossover model — matmul
+    for continuous (weighted-kernel) rules and for integer rules with
+    ``radius >= CROSSOVER_RADIUS`` — but pins the numpy executors to
+    roll: they are the ground-truth oracle the matmul path is compared
+    against, and an oracle that silently moves with the fast path it
+    checks is no oracle (the same rule the packed Metropolis tier
+    follows).  Stochastic rules have no counting stencil to route
+    (ising sweeps its own checkerboard; the noisy deterministic half
+    keeps the roll composition) and always resolve to roll.
+    """
+    validate_stencil(mode)
+    if getattr(rule, "stochastic", False):
+        return "roll"
+    if mode != "auto":
+        return mode
+    if backend == "numpy":
+        return "roll"
+    if getattr(rule, "continuous", False):
+        return "matmul"
+    return "matmul" if rule.radius >= CROSSOVER_RADIUS else "roll"
+
+
+# -- kernels ----------------------------------------------------------------
+def rule_kernel(rule: Rule) -> np.ndarray:
+    """The rule's neighborhood as a float32 ``(2r+1, 2r+1)`` kernel.
+
+    Continuous rules carry their own weighted kernel (``rule.kernel``,
+    e.g. the Lenia ring); integer rules get the one-hot Moore box or
+    von Neumann diamond, with the center zeroed unless
+    ``include_center`` — matching ``neighbor_counts``'s subtraction, so
+    the two paths count the identical neighborhood.
+    """
+    own = getattr(rule, "kernel", None)
+    if own is not None:
+        return np.asarray(own, np.float32)
+    r = rule.radius
+    k = 2 * r + 1
+    if rule.neighborhood == "von_neumann":
+        dy, dx = np.mgrid[-r : r + 1, -r : r + 1]
+        kern = (np.abs(dy) + np.abs(dx) <= r).astype(np.float32)
+    else:
+        kern = np.ones((k, k), np.float32)
+    if not rule.include_center:
+        kern[r, r] = 0.0
+    return kern
+
+
+def kernel_factors(kernel: np.ndarray) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Decompose ``kernel`` into rank-1 ``(u, v)`` pairs with
+    ``kernel == sum_i outer(u_i, v_i)`` — verified, never assumed.
+
+    One-hot kernels take exact structural decompositions (a separable
+    box is one pair; anything else splits by rows, each row a one-hot
+    shift times the row's weights).  Weighted kernels go through a
+    float64 SVD truncated at machine precision, with the exact per-row
+    split as the fallback when the spectrum does not compress below the
+    row count.
+    """
+    kern = np.asarray(kernel, np.float64)
+    if kern.ndim != 2 or kern.shape[0] != kern.shape[1] or kern.shape[0] % 2 != 1:
+        raise ValueError(
+            f"kernel must be odd-sided square, got shape {kern.shape}"
+        )
+    scale = float(np.abs(kern).max())
+    if scale == 0.0:
+        raise ValueError("kernel is all zeros")
+
+    def rows() -> list[tuple[np.ndarray, np.ndarray]]:
+        out = []
+        for i in range(kern.shape[0]):
+            if not np.any(kern[i]):
+                continue
+            u = np.zeros(kern.shape[0], np.float32)
+            u[i] = 1.0
+            out.append((u, kern[i].astype(np.float32)))
+        return out
+
+    # exact rank-1 (the Moore box, gaussian outer products): u from the
+    # heaviest row's support, v the row itself — integer-exact when the
+    # kernel is, unlike SVD's sqrt-scaled factors
+    i0 = int(np.argmax(np.abs(kern).sum(axis=1)))
+    v0 = kern[i0]
+    piv = v0[int(np.argmax(np.abs(v0)))]
+    if piv != 0.0:
+        u0 = kern[:, int(np.argmax(np.abs(v0)))] / piv
+        if np.array_equal(np.outer(u0, v0), kern):
+            return [(u0.astype(np.float32), v0.astype(np.float32))]
+    if np.array_equal(kern, np.rint(kern)):
+        # integer kernels carry the bit-identity contract: SVD's
+        # sqrt-scaled factors would trade it for a rounding budget —
+        # the exact per-row split costs more matmuls, never exactness
+        return rows()
+    svd_u, svd_s, svd_vt = np.linalg.svd(kern)
+    keep = int(np.sum(svd_s > _SVD_RTOL * svd_s[0]))
+    if 0 < keep < kern.shape[0]:
+        factors = [
+            (
+                (svd_u[:, i] * svd_s[i]).astype(np.float32),
+                svd_vt[i].astype(np.float32),
+            )
+            for i in range(keep)
+        ]
+        recon = sum(
+            np.outer(u.astype(np.float64), v.astype(np.float64))
+            for u, v in factors
+        )
+        if np.abs(recon - kern).max() <= _SVD_RTOL * scale:
+            return factors
+    return rows()
+
+
+def band_matrix(n: int, profile: np.ndarray, boundary: str) -> np.ndarray:
+    """The ``(n, n)`` float32 band realizing one 1-D correlation pass:
+    ``(M @ x)[i] = sum_d profile[d + r] * x[i + d]``.
+
+    Torus bands wrap (offsets taken mod ``n``, weights of aliased
+    offsets summing — the exact periodic correlation even when the
+    kernel overhangs the board); clamped bands truncate at the edges
+    (the zero-padding semantics of the roll path).
+    """
+    profile = np.asarray(profile, np.float32)
+    r = (len(profile) - 1) // 2
+    m = np.zeros((n, n), np.float32)
+    idx = np.arange(n)
+    for d in range(-r, r + 1):
+        w = profile[d + r]
+        if w == 0.0:
+            continue
+        if boundary == "torus":
+            m[idx, (idx + d) % n] += w
+        else:
+            src = idx + d
+            ok = (src >= 0) & (src < n)
+            m[idx[ok], src[ok]] += w
+    return m
+
+
+def band_operators(
+    shape: tuple[int, int], kernel: np.ndarray, boundary: str
+) -> list[tuple[np.ndarray, np.ndarray]]:
+    """The static per-CompileKey operator pairs: ``(A_i, B_i)`` float32
+    arrays with ``conv(X) = sum_i A_i @ X @ B_i``.
+
+    ``A_i = band(h, u_i)`` applies the factor's row profile;
+    ``B_i = band(w, v_i).T`` its column profile (the transpose turns
+    the row-correlation band into the right-multiplying form).
+    """
+    h, w = int(shape[0]), int(shape[1])
+    return [
+        (band_matrix(h, u, boundary), band_matrix(w, v, boundary).T)
+        for u, v in kernel_factors(kernel)
+    ]
+
+
+def make_conv(xp, shape: tuple[int, int], kernel: np.ndarray, boundary: str):
+    """``fn(X_f32) -> f32`` computing the 2-D correlation of ``X`` with
+    ``kernel`` as banded matmuls.  ``xp`` is numpy or jax.numpy; under
+    jnp the operators become constants of the compiled program, so XLA
+    schedules them straight onto the MXU."""
+    ops = [
+        (xp.asarray(a), xp.asarray(b))
+        for a, b in band_operators(shape, kernel, boundary)
+    ]
+
+    def conv(x):
+        out = None
+        for a, b in ops:
+            t = xp.matmul(xp.matmul(a, x), b)
+            out = t if out is None else out + t
+        return out
+
+    return conv
+
+
+def make_counts_matmul(xp, rule: Rule, shape: tuple[int, int]):
+    """``fn(board) -> int32 counts`` — the matmul twin of
+    ``stencil.neighbor_counts`` / ``reference.neighbor_counts_np``.
+
+    Live cells lift to float32, the banded correlation runs on the MXU,
+    and the result lowers back to int32.  Every value along the way is
+    a small integer exactly representable in float32, so the lowering
+    is exact and the counts are bit-identical to the roll path.
+
+    Center handling mirrors the roll path: the correlation runs with
+    the center cell INCLUDED — the full Moore box is exactly rank 1
+    (one matmul pair), where the punctured box is rank 2 — and the
+    center is subtracted afterwards when the rule excludes it.
+    """
+    kern = rule_kernel(rule)
+    subtract_center = False
+    if not getattr(rule, "continuous", False) and not rule.include_center:
+        kern = kern.copy()
+        kern[rule.radius, rule.radius] += 1.0
+        subtract_center = True
+    conv = make_conv(xp, shape, kern, rule.boundary)
+
+    def counts(board):
+        alive = (board == 1).astype(xp.float32)
+        c = conv(alive).astype(xp.int32)
+        if subtract_center:
+            c = c - alive.astype(xp.int32)
+        return c
+
+    return counts
+
+
+def neighbor_counts_matmul_np(board: np.ndarray, rule: Rule) -> np.ndarray:
+    """One-shot numpy matmul counts (tests and oracles; the executors
+    build :func:`make_counts_matmul` once per key instead)."""
+    return make_counts_matmul(np, rule, board.shape)(board)

@@ -95,15 +95,36 @@ def step_np_wrap_cols(ext: np.ndarray, rule: Rule) -> np.ndarray:
     return rule.transition_table[ext.astype(np.int64), counts]
 
 
-def step_np(board: np.ndarray, rule: Rule) -> np.ndarray:
-    """One synchronous CA step via the rule's full transition LUT."""
-    counts = neighbor_counts_np(
-        board, rule.radius, rule.include_center, rule.neighborhood, rule.boundary
-    )
+def step_np(board: np.ndarray, rule: Rule, stencil: str = "roll") -> np.ndarray:
+    """One synchronous CA step via the rule's full transition LUT.
+
+    ``stencil`` routes the counting executor: ``roll`` (the default —
+    this module IS the roll oracle) or ``matmul`` (the banded-matmul
+    path of ``ops.conv``, bit-identical for integer rules).  The
+    continuous tier dispatches to its own float oracle.
+    """
+    if getattr(rule, "continuous", False):
+        from tpu_life.models import lenia
+
+        return lenia.step_np(board, rule, stencil)
+    if stencil == "matmul":
+        from tpu_life.ops.conv import neighbor_counts_matmul_np
+
+        counts = neighbor_counts_matmul_np(board, rule)
+    else:
+        counts = neighbor_counts_np(
+            board,
+            rule.radius,
+            rule.include_center,
+            rule.neighborhood,
+            rule.boundary,
+        )
     return rule.transition_table[board.astype(np.int64), counts]
 
 
-def run_np(board: np.ndarray, rule: Rule, steps: int) -> np.ndarray:
+def run_np(
+    board: np.ndarray, rule: Rule, steps: int, stencil: str = "roll"
+) -> np.ndarray:
     for _ in range(steps):
-        board = step_np(board, rule)
+        board = step_np(board, rule, stencil)
     return board

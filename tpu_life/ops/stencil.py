@@ -184,8 +184,54 @@ def validity_mask(
     )[None, :]
 
 
-def make_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
-    """One full-array CA step ``int8[h, w] -> int8[h, w]``."""
+def make_step(
+    rule: Rule,
+    stencil: str = "roll",
+    shape: tuple[int, int] | None = None,
+) -> Callable[[jax.Array], jax.Array]:
+    """One full-array CA step — ``int8[h, w] -> int8[h, w]`` for
+    discrete rules, ``f32 -> f32`` on the continuous tier.
+
+    ``stencil`` picks the neighborhood executor (docs/RULES.md):
+    ``roll`` is the classic shift-add pass; ``matmul`` expresses the
+    count as banded matmuls (``ops.conv`` — bit-identical for integer
+    rules, the MXU path for large radii and weighted kernels).  The
+    matmul operators are shape-static, so that path needs ``shape`` up
+    front (engines and runners know it; ``None`` + matmul builds the
+    operators lazily on the first call's shape).
+    """
+    if getattr(rule, "continuous", False):
+        from tpu_life.models.lenia import make_lenia_step
+
+        if shape is None:
+            # shape-lazy wrapper: build on first call (plain-jit use)
+            cache: dict = {}
+
+            def step_cc(board: jax.Array) -> jax.Array:
+                fn = cache.get(board.shape)
+                if fn is None:
+                    fn = make_lenia_step(jnp, rule, board.shape, stencil)
+                    cache[board.shape] = fn
+                return fn(board)
+
+            return step_cc
+        return make_lenia_step(jnp, rule, shape, stencil)
+    if stencil == "matmul":
+        from tpu_life.ops.conv import make_counts_matmul
+
+        cache = {}
+
+        def counts_for(board):
+            fn = cache.get(board.shape)
+            if fn is None:
+                fn = make_counts_matmul(jnp, rule, board.shape)
+                cache[board.shape] = fn
+            return fn(board)
+
+        def step_mm(board: jax.Array) -> jax.Array:
+            return apply_rule(board, counts_for(board), rule)
+
+        return step_mm
 
     def step(board: jax.Array) -> jax.Array:
         counts = neighbor_counts(
@@ -201,9 +247,16 @@ def make_step(rule: Rule) -> Callable[[jax.Array], jax.Array]:
 
 
 def make_masked_step(
-    rule: Rule, logical_shape: tuple[int, int]
+    rule: Rule, logical_shape: tuple[int, int], stencil: str = "roll"
 ) -> Callable[[jax.Array], jax.Array]:
     """A step that also pins physical padding cells dead (see validity_mask)."""
+    if getattr(rule, "continuous", False):
+        # continuous boards run unpadded (the runners stage exact
+        # shapes); the int8 padding mask below would corrupt a float
+        # board silently
+        raise ValueError(
+            "continuous rules cannot run on padded/masked boards"
+        )
     if rule.boundary == "torus":
         # padding/masking would sit between the logical edges the torus
         # glues together; torus boards must run unpadded (exact shape)
@@ -211,7 +264,7 @@ def make_masked_step(
             "torus boundary cannot run on padded/masked boards; keep the "
             "board at its exact logical shape"
         )
-    step = make_step(rule)
+    step = make_step(rule, stencil)
 
     def masked(
         board: jax.Array,
@@ -226,7 +279,7 @@ def make_masked_step(
 
 @partial(
     jax.jit,
-    static_argnames=("rule", "steps", "logical_shape"),
+    static_argnames=("rule", "steps", "logical_shape", "stencil"),
     donate_argnums=0,
 )
 def multi_step(
@@ -235,18 +288,21 @@ def multi_step(
     rule: Rule,
     steps: int,
     logical_shape: tuple[int, int] | None = None,
+    stencil: str = "roll",
 ) -> jax.Array:
     """``steps`` fused CA steps under one jit via ``lax.scan``.
 
     The epoch loop lives on-device — the analogue of the reference's
     update/exchange/barrier loop (Parallel_Life_MPI.cpp:215-221) with the
-    barrier dissolved into dataflow.
+    barrier dissolved into dataflow.  ``stencil`` routes the
+    neighborhood executor (roll shift-adds vs banded matmuls — both
+    static args, so each (rule, shape, stencil) compiles once).
     """
     if logical_shape is None or tuple(logical_shape) == tuple(board.shape):
-        step = make_step(rule)
+        step = make_step(rule, stencil, tuple(board.shape))
         body = lambda b, _: (step(b), None)
     else:
-        masked = make_masked_step(rule, tuple(logical_shape))
+        masked = make_masked_step(rule, tuple(logical_shape), stencil)
         body = lambda b, _: (masked(b), None)
     out, _ = jax.lax.scan(body, board, None, length=steps)
     return out

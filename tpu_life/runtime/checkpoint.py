@@ -139,7 +139,11 @@ def snapshot_intact(p: Path, height: int, width: int) -> bool:
         except (ValueError, OSError):
             return False
     try:
-        if p.stat().st_size != h * (w + 1):
+        # the two contract encodings (io/codec.py): ASCII digit grid
+        # (discrete boards) or raw little-endian float32 (the continuous
+        # tier) — their lengths can never coincide, so either size is an
+        # unambiguous intact witness for its geometry
+        if p.stat().st_size not in (h * (w + 1), 4 * h * w):
             return False
     except OSError:
         return False

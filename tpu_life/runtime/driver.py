@@ -124,6 +124,12 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
                 rule, cfg.backend, bitpack=cfg.bitpack
             ),
         )
+        # kernel-vs-board geometry (docs/RULES.md): a Larger-than-Life or
+        # continuous kernel wider than the board is the typed
+        # GeometryError — the CLI exits 2, never a downstream shape error
+        from tpu_life.models.rules import validate_rule_geometry
+
+        validate_rule_geometry(rule, (height, width))
 
     timer = Timer()  # spans I/O too, like the reference's Wtime bracket
 
@@ -169,18 +175,25 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
         pad_lanes=cfg.pad_lanes,
         bitpack=cfg.bitpack,
         local_kernel=cfg.local_kernel,
+        stencil=cfg.stencil,
     )
     if cfg.block_steps is not None:
         backend_kwargs["block_steps"] = cfg.block_steps
     if tuned is not None:
         # tuned knobs fill in wherever the user left the default; an
-        # explicit flag (--block-steps, --local-kernel, --no-bitpack)
-        # always wins over the cache — tuning informs, never overrides
+        # explicit flag (--block-steps, --local-kernel, --no-bitpack,
+        # --stencil) always wins over the cache — tuning informs, never
+        # overrides
         if cfg.block_steps is None and tuned.block_steps is not None:
             backend_kwargs["block_steps"] = tuned.block_steps
         if cfg.local_kernel == "auto":
             backend_kwargs["local_kernel"] = tuned.local_kernel
         backend_kwargs["bitpack"] = cfg.bitpack and tuned.bitpack
+        if cfg.stencil == "auto" and tuned.stencil != "auto":
+            # the measured stencil axis (docs/AUTOTUNE.md): under
+            # --stencil auto the cache's verdict beats the analytic
+            # crossover model — auto is measured, not guessed
+            backend_kwargs["stencil"] = tuned.stencil
     registry = obs.MetricsRegistry()
     builds = registry.counter(
         "run_backend_builds_total",
@@ -278,19 +291,32 @@ def _run(cfg: RunConfig, run_id: str) -> RunResult:
                 if source is None:
                     # counter-based staging (tpu_life.mc.prng): the board
                     # a seed names is identical on every host/backend, so
-                    # the stamped seed fully replays the run
-                    b = mc.seeded_board(
-                        height, width, states=rule.states, seed=cfg.seed
-                    )
+                    # the stamped seed fully replays the run.  The
+                    # continuous tier stages its float twin.
+                    if rule.continuous:
+                        from tpu_life.models.lenia import (
+                            seeded_board as lenia_seeded_board,
+                        )
+
+                        b = lenia_seeded_board(height, width, seed=cfg.seed)
+                    else:
+                        b = mc.seeded_board(
+                            height, width, states=rule.states, seed=cfg.seed
+                        )
                 else:
                     b = read_board(source, height, width)
-                    max_state = int(b.max(initial=0))
-                    if max_state >= rule.states:
-                        raise ValueError(
-                            f"board contains state {max_state} but rule "
-                            f"{rule.name!r} has only {rule.states} states "
-                            f"(0..{rule.states - 1})"
-                        )
+                    if rule.continuous:
+                        from tpu_life.models.lenia import validate_board
+
+                        b = validate_board(b, rule)
+                    else:
+                        max_state = int(b.max(initial=0))
+                        if max_state >= rule.states:
+                            raise ValueError(
+                                f"board contains state {max_state} but rule "
+                                f"{rule.name!r} has only {rule.states} states "
+                                f"(0..{rule.states - 1})"
+                            )
                 r = make_runner(
                     backend,
                     b,

@@ -71,16 +71,25 @@ class CompileKey:
 
     rule: Rule
     shape: tuple[int, int]  # (height, width)
-    dtype: str  # board element type ("int8" today)
+    dtype: str  # board element type ("int8"; "float32" on the continuous tier)
     backend: str  # executor family ("jax" / "numpy" / "sharded" / ...)
+    # the resolved counting path (docs/RULES.md): "roll" shift-adds or
+    # "matmul" banded one-hot/weighted matmuls.  Resolved per rule at
+    # submit (ServeConfig.stencil through ops.conv.resolve_stencil), so
+    # it is a pure function of the other fields + config — it never
+    # splits a batch, but it IS part of what the engine compiles.
+    stencil: str = "roll"
 
 
-def compile_key_for(rule: Rule, board: np.ndarray, backend: str) -> CompileKey:
+def compile_key_for(
+    rule: Rule, board: np.ndarray, backend: str, stencil: str = "roll"
+) -> CompileKey:
     return CompileKey(
         rule=rule,
         shape=(int(board.shape[0]), int(board.shape[1])),
-        dtype=str(board.dtype),
+        dtype=rule.board_dtype,
         backend=backend,
+        stencil=stencil,
     )
 
 
@@ -117,6 +126,17 @@ class EngineBase:
         self.key = key
         self.capacity = capacity
         self.chunk_steps = chunk_steps
+        # the board element dtype this engine stores and steps — int8
+        # everywhere but the continuous tier's float32 boards
+        self.dtype = np.dtype(getattr(key, "dtype", "int8"))
+        # the per-key stencil stamp (docs/OBSERVABILITY.md): which
+        # counting path this engine compiled — None on the stochastic
+        # engines (their sweep has no counting stencil to route)
+        self.stencil = (
+            None
+            if getattr(key.rule, "stochastic", False)
+            else getattr(key, "stencil", "roll")
+        )
         self.compile_count = 0
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self._remaining = np.zeros(capacity, dtype=np.int64)
@@ -183,7 +203,7 @@ class EngineBase:
                 f"board shape {board.shape} does not match engine key {self.key.shape}"
             )
         self._remaining[slot] = steps
-        self._load_slot(slot, np.asarray(board, np.int8), steps)
+        self._load_slot(slot, np.asarray(board, self.dtype), steps)
 
     def remaining(self, slot: int) -> int:
         return int(self._remaining[slot])
@@ -390,8 +410,12 @@ class VmapEngine(EngineBase):
 
         h, w = key.shape
         self._jnp = jnp
+        # dtype-general batch: int8 for discrete rules, float32 on the
+        # continuous tier — everything else (freeze mask, double buffer,
+        # slot writer) is dtype-agnostic (jnp accepts numpy dtypes)
+        self._dt = self.dtype
         self._boards = jax.device_put(
-            jnp.zeros((capacity, h, w), dtype=jnp.int8)
+            jnp.zeros((capacity, h, w), dtype=self._dt)
         )
         self._rem_dev = jax.device_put(jnp.zeros(capacity, dtype=jnp.int32))
         self._prev = None  # the in-flight chunk's input batch (double buffer)
@@ -419,8 +443,11 @@ class VmapEngine(EngineBase):
             rule=self.key.rule.name,
             shape=f"{self.key.shape[0]}x{self.key.shape[1]}",
             backend=self.key.backend,
+            stencil=self.stencil,
         )
-        step = jax.vmap(make_step(self.key.rule))
+        step = jax.vmap(
+            make_step(self.key.rule, self.stencil or "roll", self.key.shape)
+        )
         length = self.chunk_steps
 
         def chunk(boards, rem):
@@ -447,13 +474,13 @@ class VmapEngine(EngineBase):
             self._boards,
             self._rem_dev,
             jnp.int32(slot),
-            jnp.asarray(board, jnp.int8),
+            jnp.asarray(board, self._dt),
             jnp.int32(steps),
         )
 
     def _clear_slot(self, slot: int) -> None:
         h, w = self.key.shape
-        self._load_slot(slot, np.zeros((h, w), np.int8), 0)
+        self._load_slot(slot, np.zeros((h, w), self.dtype), 0)
 
     def _dispatch_impl(self) -> None:
         if self._chunk is None:
@@ -504,7 +531,28 @@ class HostBatchEngine(EngineBase):
     def __init__(self, key: CompileKey, capacity: int, chunk_steps: int):
         super().__init__(key, capacity, chunk_steps)
         h, w = key.shape
-        self._boards = np.zeros((capacity, h, w), dtype=np.int8)
+        self._boards = np.zeros((capacity, h, w), dtype=self.dtype)
+        # the per-slot step function, built ONCE per engine: the numpy
+        # roll oracle for discrete keys (bit-identity ground truth), the
+        # float oracle for continuous keys, and the matmul counting body
+        # when the key's stencil pins it (its band operators are static
+        # per key — rebuilding them per step would be pure churn)
+        rule = key.rule
+        stencil = self.stencil or "roll"
+        if getattr(rule, "continuous", False):
+            from tpu_life.models.lenia import make_lenia_step
+
+            self._step = make_lenia_step(np, rule, (h, w), stencil)
+        elif stencil == "matmul":
+            from tpu_life.ops.conv import make_counts_matmul
+
+            counts_fn = make_counts_matmul(np, rule, (h, w))
+            table = rule.transition_table
+            self._step = lambda b: table[b.astype(np.int64), counts_fn(b)]
+        else:
+            from tpu_life.ops.reference import step_np
+
+            self._step = lambda b: step_np(b, rule)
 
     def _load_slot(self, slot: int, board: np.ndarray, steps: int) -> None:
         self._boards[slot] = board
@@ -516,13 +564,10 @@ class HostBatchEngine(EngineBase):
         pass  # deferred: the chunk runs at collect time (see class doc)
 
     def _collect_impl(self, advanced: dict[int, int]) -> None:
-        from tpu_life.ops.reference import step_np
-
-        rule = self.key.rule
         for slot, n in advanced.items():
             b = self._boards[slot]
             for _ in range(n):
-                b = step_np(b, rule)
+                b = self._step(b)
             self._boards[slot] = b
 
     def _peek_board(self, slot: int) -> np.ndarray:
@@ -607,6 +652,28 @@ def make_engine(
         from tpu_life.mc.engine import make_mc_engine
 
         return make_mc_engine(key, capacity, chunk_steps, packed=mc_packed)
+    if getattr(key.rule, "continuous", False):
+        # continuous keys need a float executor (models/lenia.py): the
+        # vmapped device batch or the numpy oracle — a slot-loop backend
+        # would silently cast float boards to int8, which is junk, so
+        # anything else is the typed rejection
+        from tpu_life.models.lenia import require_float_path
+
+        backend_name = key.backend
+        if backend_name == "tuned":
+            from tpu_life import autotune
+            from tpu_life.runtime.metrics import log
+
+            tk = autotune.tune_key_for(key.rule, key.shape)
+            tuned, source = autotune.resolve(tk, mode="cache", shape=key.shape)
+            log.info(
+                "serve: autotune %s -> %s (%s)", tk.id(), tuned.describe(), source
+            )
+            backend_name = tuned.backend
+        require_float_path(key.rule, backend_name)
+        if backend_name == "jax":
+            return VmapEngine(key, capacity, chunk_steps)
+        return HostBatchEngine(key, capacity, chunk_steps)
     backend_name = key.backend
     backend_kwargs: dict = {}
     if backend_name == "tuned":

@@ -95,7 +95,13 @@ def estimate_engine_bytes(key, capacity: int, *, mc_packed: bool = True) -> int:
 
         board_bytes = capacity * h * packed_width(w) * 4
     else:
-        board_bytes = capacity * h * w  # int8
+        # element width from the key's dtype: 1 for int8 boards, 4 for
+        # the continuous tier's float32 boards (docs/SERVING.md
+        # estimator table)
+        import numpy as _np
+
+        itemsize = _np.dtype(getattr(key, "dtype", "int8")).itemsize
+        board_bytes = capacity * h * w * itemsize
     copies = 2 if key.backend == "jax" else 1  # the double buffer
     total = board_bytes * copies
     total += capacity * 4  # the remaining-steps vector (int32)

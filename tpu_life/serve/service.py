@@ -123,6 +123,13 @@ class ServeConfig:
     # (--no-bitpack) pins the int8 roll engines — the oracle
     # configuration the packed path is byte-compared against in CI.
     mc_packed: bool = True
+    # the neighborhood-counting path (--stencil, docs/RULES.md): "roll"
+    # shift-adds, "matmul" banded matmuls (bit-identical for integer
+    # rules, the MXU path for large radii and the continuous tier), or
+    # "auto" — the measured crossover model per rule, with the numpy
+    # executor pinned to roll so the oracle never silently moves.
+    # Resolved per CompileKey at submit (ops.conv.resolve_stencil).
+    stencil: str = "auto"
     # the resource governor (docs/SERVING.md "Resource governance"):
     # admission-time memory budget for the estimated engine footprint.
     # None derives devices x per-kind default from device_info(); <= 0
@@ -180,6 +187,9 @@ class SimulationService:
                 f"settle_deadline_s must be > 0, "
                 f"got {self.config.settle_deadline_s}"
             )
+        from tpu_life.ops.conv import validate_stencil
+
+        validate_stencil(self.config.stencil)
         self.clock = clock
         self.run_id = self.config.run_id or obs.new_run_id()
         self.store = SessionStore()
@@ -307,6 +317,15 @@ class SimulationService:
             "rebuild_failed / wedged)",
             labels=("outcome",),
         )
+        # the stencil-path gauge (docs/RULES.md / OBSERVABILITY.md): how
+        # many live CompileKeys compiled the banded-matmul counting path
+        # — merged across the fleet by `tpu-life stats` like the packed
+        # attribution was
+        self._g_matmul_keys = self.registry.gauge(
+            "serve_matmul_keys",
+            "live engines whose CompileKey compiled the matmul stencil",
+        )
+        self._g_matmul_keys.labels()
         # the span-ring loss counter (docs/OBSERVABILITY.md "Distributed
         # tracing"): events evicted from the bounded trace buffer between
         # scrapes — a nonzero value tells the doctor a journey may have
@@ -478,27 +497,44 @@ class SimulationService:
                 seed = 0
         if seed is not None:
             seed = int(seed)
-        # validate BEFORE the int8 cast: a wider-dtype caller array with
-        # state 256 would wrap to 0 and sail through a post-cast check —
-        # simulated junk, not a rejection
-        board = np.asarray(board)
-        if board.ndim != 2:
-            raise ValueError(f"board must be 2-D, got shape {board.shape}")
-        max_state = int(board.max(initial=0))
-        if max_state >= rule.states:
-            raise ValueError(
-                f"board contains state {max_state} but rule {rule.name!r} "
-                f"has only {rule.states} states (0..{rule.states - 1})"
-            )
-        min_state = int(board.min(initial=0))
-        if min_state < 0:
-            # the driver's file codec cannot produce negatives, but a
-            # library caller's array can — reject rather than simulate junk
-            raise ValueError(
-                f"board contains negative state {min_state}; states are "
-                f"0..{rule.states - 1}"
-            )
-        board = board.astype(np.int8)
+        if rule.continuous:
+            # the continuous tier (models/lenia.py): float32 boards in
+            # [0, 1], finite — and only on the float executors.  The
+            # "tuned" pseudo-backend passes here: make_engine resolves
+            # it through the autotune cache and re-applies the gate on
+            # whatever executor the cache actually names.
+            from tpu_life.models import lenia
+
+            if self.config.backend != "tuned":
+                lenia.require_float_path(rule, self.config.backend)
+            board = lenia.validate_board(board, rule)
+        else:
+            # validate BEFORE the int8 cast: a wider-dtype caller array
+            # with state 256 would wrap to 0 and sail through a post-cast
+            # check — simulated junk, not a rejection
+            board = np.asarray(board)
+            if board.ndim != 2:
+                raise ValueError(f"board must be 2-D, got shape {board.shape}")
+            max_state = int(board.max(initial=0))
+            if max_state >= rule.states:
+                raise ValueError(
+                    f"board contains state {max_state} but rule {rule.name!r} "
+                    f"has only {rule.states} states (0..{rule.states - 1})"
+                )
+            min_state = int(board.min(initial=0))
+            if min_state < 0:
+                # the driver's file codec cannot produce negatives, but a
+                # library caller's array can — reject rather than simulate junk
+                raise ValueError(
+                    f"board contains negative state {min_state}; states are "
+                    f"0..{rule.states - 1}"
+                )
+            board = board.astype(np.int8)
+        # kernel-vs-board geometry (docs/RULES.md): a kernel wider than
+        # the board is a typed rejection at every admission front
+        from tpu_life.models.rules import validate_rule_geometry
+
+        validate_rule_geometry(rule, board.shape)
         # board-area admission check against the PRNG counter width: the
         # packed engine carries the wide two-word cell index; the roll
         # engines are pinned narrow, so over-2^32-cell boards on them are
@@ -531,7 +567,16 @@ class SimulationService:
             # session exists anywhere, so an XLA RESOURCE_EXHAUSTED
             # becomes a typed rejection instead of a dead worker.
             if self._memory_budget is not None:
-                key = compile_key_for(rule, board, self.config.backend)
+                from tpu_life.ops.conv import resolve_stencil
+
+                key = compile_key_for(
+                    rule,
+                    board,
+                    self.config.backend,
+                    resolve_stencil(
+                        rule, self.config.stencil, self.config.backend
+                    ),
+                )
                 sched = self.scheduler
                 reserved = self._governor.reserved_bytes(
                     sched.engines,
@@ -846,9 +891,15 @@ class SimulationService:
 
     def _keyer(self):
         cfg = self.config
+        from tpu_life.ops.conv import resolve_stencil
 
         def keyer(s) -> CompileKey:
-            return compile_key_for(s.rule, s.board, cfg.backend)
+            return compile_key_for(
+                s.rule,
+                s.board,
+                cfg.backend,
+                resolve_stencil(s.rule, cfg.stencil, cfg.backend),
+            )
 
         return keyer
 
@@ -1198,6 +1249,12 @@ class SimulationService:
         self._g_occupancy.set(occ)
         depth = sum(1 for e in self.scheduler.engines.values() if e.inflight)
         self._g_pipeline_depth.set(depth)
+        matmul_keys = sum(
+            1
+            for e in self.scheduler.engines.values()
+            if getattr(e, "stencil", None) == "matmul"
+        )
+        self._g_matmul_keys.set(float(matmul_keys))
         idle_delta = self.scheduler.idle_seconds_delta()
         if idle_delta > 0:
             self._c_device_idle.inc(idle_delta)
@@ -1249,6 +1306,16 @@ class SimulationService:
                 # this round's steps run by bitplane-packed engines, so
                 # `tpu-life stats` splits throughput by storage path
                 "steps_advanced_packed": stats.steps_advanced_packed,
+                # the stencil stamp (docs/RULES.md): live engines on the
+                # banded-matmul counting path, and each key's resolved
+                # path — the per-round record a tailing consumer (and
+                # the fleet merge) attributes throughput with
+                "matmul_keys": matmul_keys,
+                "stencil_keys": {
+                    _key_bucket(k): e.stencil
+                    for k, e in self.scheduler.engines.items()
+                    if getattr(e, "stencil", None) is not None
+                },
                 "sessions_done": self._completed,
                 "sessions_per_sec": self._completed / elapsed
                 if elapsed > 0
@@ -1409,6 +1476,14 @@ class SimulationService:
             "rounds": self._rounds,
             "steps_advanced": self._steps_total,
             "steps_advanced_packed": self._steps_packed_total,
+            # the per-key stencil stamp (docs/RULES.md): which counting
+            # path each live CompileKey compiled, and the matmul count
+            "matmul_keys": int(self._g_matmul_keys.value),
+            "stencil_keys": {
+                _key_bucket(k): e.stencil
+                for k, e in self.scheduler.engines.items()
+                if getattr(e, "stencil", None) is not None
+            },
             "elapsed_s": elapsed,
             "sessions_per_sec": self._completed / elapsed if elapsed > 0 else 0.0,
             "batch_occupancy_mean": self._occupancy_sum / self._rounds
