@@ -982,6 +982,123 @@ def run_governor_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def run_stream_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_stream capture (docs/STREAMING.md): live-session
+    streaming cost, two legs.
+
+    Wire leg: one watched session through the real service pump — the
+    mean ndjson bytes per delta frame (the XOR-RLE / masked-threshold
+    encoding the wire actually carries) and the p99 inter-frame arrival
+    gap at a live reader (the cadence a watcher experiences).
+
+    Fan-out leg: N watchers attached to ONE sid on the router-side
+    multiplexer (a prefilled broadcast buffer; an anchor watcher keeps
+    the fan alive) — the headline watchers/s is the attach+first-frame
+    rate, and ``upstream_opens`` staying at 1 is the sublinearity proof:
+    the worker pays for one watcher however many the router serves.
+    """
+    import threading
+
+    from tpu_life import mc
+    from tpu_life.fleet.fanout import FanoutHub
+    from tpu_life.serve.service import ServeConfig, SimulationService
+    from tpu_life.serve.stream import KEY_EVERY
+
+    seed = args.stream_seed
+    size = args.serve_size
+    steps = args.serve_steps * 4
+    svc = SimulationService(
+        ServeConfig(
+            capacity=2, chunk_steps=4, backend=args.backend, pipeline=False
+        )
+    )
+    frames: list[dict] = []
+    arrivals: list[float] = []
+    try:
+        board = mc.seeded_board(size, size, 0.45, seed=seed)
+        sid = svc.submit(board, args.rule, steps, seed=seed)
+        svc.stream_subscribe(sid)
+        t = threading.Thread(
+            target=lambda: svc.drain(max_rounds=10 * steps + 64), daemon=True
+        )
+        t.start()
+        cursor, eof = 0, False
+        deadline = time.monotonic() + 120.0
+        while not eof and time.monotonic() < deadline:
+            got, cursor, eof = svc.stream_read(sid, cursor, timeout=0.25)
+            now = time.perf_counter()
+            for f in got:
+                frames.append(f)
+                arrivals.append(now)
+        t.join(timeout=60)
+    finally:
+        svc.close()
+
+    deltas = [f for f in frames if f.get("type") == "delta"]
+    keys = [f for f in frames if f.get("type") == "key"]
+    delta_bytes = (
+        sum(len(json.dumps(f)) for f in deltas) / len(deltas)
+        if deltas
+        else 0.0
+    )
+    gaps = sorted(
+        b - a for a, b in zip(arrivals, arrivals[1:])
+    )
+    p99_ms = gaps[int(0.99 * (len(gaps) - 1))] * 1e3 if gaps else 0.0
+
+    # fan-out leg: replay the captured stream as a synthetic upstream
+    n_watchers = args.stream_watchers
+
+    def upstream(fsid, cursor):
+        yield from keys[:1]
+        yield from deltas[:KEY_EVERY]
+        yield {"type": "end", "seq": 0, "step": steps, "state": "done"}
+
+    hub = FanoutHub(open_upstream=upstream)
+    watchers_per_sec = 0.0
+    try:
+        anchor = hub.watch("bench")
+        next(anchor)  # holds the fan open across the measured attaches
+        # wait for the prefill to land so every attach drains real frames
+        deadline = time.monotonic() + 30.0
+        while (
+            hub.upstream_opens("bench") == 0 and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        time.sleep(0.05)
+        t0 = time.perf_counter()
+        for _ in range(n_watchers):
+            g = hub.watch("bench")
+            next(g)  # attach + first frame delivered
+            g.close()
+        elapsed = time.perf_counter() - t0
+        watchers_per_sec = n_watchers / elapsed if elapsed > 0 else 0.0
+        opens = hub.upstream_opens("bench")
+        anchor.close()
+    finally:
+        hub.close()
+
+    return {
+        "metric": "stream_watchers_per_sec",
+        "value": watchers_per_sec,
+        "unit": "watchers/s",
+        "platform": platform,
+        "backend": args.backend,
+        "rule": args.rule,
+        "size": size,
+        "steps": steps,
+        "seed": seed,
+        "watchers": n_watchers,
+        "upstream_opens": opens,
+        "frames": len(frames),
+        "keyframes": len(keys),
+        "delta_frames": len(deltas),
+        "delta_bytes_per_frame": delta_bytes,
+        "frame_p99_ms": p99_ms,
+        "degraded": degraded,
+    }
+
+
 def run_cross_host_bench(args, platform: str, degraded: bool) -> dict:
     """The BENCH_cross_host capture (docs/FLEET.md "Cross-host
     topology"): the two-control-plane drill — wire registration, a lease
@@ -1549,6 +1666,18 @@ def main() -> None:
     p.add_argument("--chaos-seed", type=int, default=0)
     p.add_argument("--chaos-workers", type=int, default=2)
     p.add_argument("--chaos-kills", type=int, default=1)
+    # the BENCH_stream capture (docs/STREAMING.md): delta-frame wire cost,
+    # watcher-observed frame cadence, and fan-out attach throughput
+    p.add_argument("--stream", action="store_true",
+                   help="live-session streaming bench: one watched session "
+                   "through the service pump (delta bytes/frame, p99 "
+                   "inter-frame gap) plus N watchers against the fan-out "
+                   "multiplexer (emits stream_watchers_per_sec with "
+                   "upstream_opens as the sublinearity proof)")
+    p.add_argument("--stream-watchers", type=int, default=None,
+                   help="fan-out leg watcher count (default 2000, 500 "
+                   "degraded)")
+    p.add_argument("--stream-seed", type=int, default=0)
     # the BENCH_governor capture (docs/SERVING.md "Resource governance"):
     # the governor drill — masked OOMs, a wedge-recycle rescue — vs its
     # fault-free twin; reuses the --chaos-* knobs (seed / workers)
@@ -1719,6 +1848,10 @@ def main() -> None:
         args.serve_size = 512 if on_accel else 128
     if args.serve_steps is None:
         args.serve_steps = 128 if on_accel else 32
+    # stream workload knobs: the wire leg rides the serve-size defaults;
+    # the fan-out leg's attach count follows the accel/degraded split
+    if args.stream_watchers is None:
+        args.stream_watchers = 2000 if on_accel else 500
     # mc workload knobs: same accel/degraded split (a sweep is ~2 stencil
     # passes + a hash per cell, so the degraded lattice stays small)
     if args.mc_size is None:
@@ -1751,7 +1884,7 @@ def main() -> None:
     # (the batched path is the thing being measured).
     if args.backend is None:
         if (args.serve or args.serve_pipeline or args.failover
-                or args.fleet or args.mc or args.conv):
+                or args.fleet or args.mc or args.conv or args.stream):
             # the vmapped/fused single-device XLA path is the thing being
             # measured on both service-shaped benches
             args.backend = "jax"
@@ -1795,6 +1928,8 @@ def main() -> None:
             result = run_governor_bench(args, platform, degraded)
         elif args.cross_host:
             result = run_cross_host_bench(args, platform, degraded)
+        elif args.stream:
+            result = run_stream_bench(args, platform, degraded)
         elif args.serve:
             result = run_serve_bench(args, platform, degraded)
         elif args.mc:

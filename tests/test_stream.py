@@ -1,0 +1,447 @@
+"""Live-session streaming units (docs/STREAMING.md): the frame codec,
+the per-session delta ring, mid-run steering through the freeze-mask
+seam, and the bit-reproducibility contract for steered sessions.
+
+The spine assertion, mirrored from the stream chaos drill: a steered
+session's bytes equal a solo ``replay_edit_log`` of its edit log — at a
+DIFFERENT chunk cadence, both pumps, det + ising + lenia — so edit
+placement is provably chunk-independent and executor-independent
+(allclose at ``lenia.FLOAT_ATOL`` for the continuous tier)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_life.models.lenia import FLOAT_ATOL
+from tpu_life.models.lenia import seeded_board as lenia_board
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.serve import ServeConfig, SessionState, SimulationService
+from tpu_life.serve.stream import (
+    KEY_EVERY,
+    MAX_EDIT_CELLS,
+    RING_FRAMES,
+    StreamHub,
+    StreamProtocolError,
+    apply_frame,
+    board_crc,
+    estimate_stream_bytes,
+    make_delta,
+    make_keyframe,
+    parse_edit_log,
+    render_edit_log,
+    replay_edit_log,
+    validate_cells,
+)
+
+
+def _wire(frame: dict) -> dict:
+    """Every frame must survive the actual wire: json text, one line."""
+    line = json.dumps(frame)
+    assert "\n" not in line
+    return json.loads(line)
+
+
+# -- the frame codec ---------------------------------------------------------
+def test_keyframe_roundtrip_discrete():
+    board = random_board(12, 10, seed=3, density=0.4)
+    f = _wire(make_keyframe(0, 7, board, executor="numpy:HostBatchEngine"))
+    assert f["type"] == "key" and f["executor"] == "numpy:HostBatchEngine"
+    assert f["crc"] == board_crc(board)
+    got = apply_frame(None, f)
+    assert got.tobytes() == board.astype(np.int8).tobytes()
+
+
+def test_keyframe_roundtrip_float():
+    board = lenia_board(16, 16, 0.4, seed=5)
+    f = _wire(make_keyframe(0, 0, board))
+    assert f["dtype"] == "float32" and "rle" not in f
+    got = apply_frame(None, f)
+    assert got.dtype == np.float32
+    assert got.tobytes() == np.ascontiguousarray(board, "<f4").tobytes()
+
+
+def test_delta_two_state_is_bare_xor_mask():
+    prev = random_board(10, 10, seed=1, density=0.3)
+    new = prev.copy()
+    new[2, 3] = 1 - new[2, 3]
+    new[7, 1] = 1 - new[7, 1]
+    f, recon = make_delta(1, 2, prev, new)
+    assert recon is new  # int path: the new board IS the reconstruction
+    f = _wire(f)
+    assert "values_b64" not in f  # the mask alone reconstructs
+    got = apply_frame(prev.copy(), f)
+    assert got.tobytes() == new.astype(np.int8).tobytes()
+
+
+def test_delta_multistate_carries_values():
+    prev = random_board(8, 8, seed=2, density=0.5, states=4)
+    new = prev.copy()
+    new[1, 1] = (new[1, 1] + 1) % 4
+    new[5, 6] = 3
+    f, _ = make_delta(4, 8, prev, new)
+    f = _wire(f)
+    assert "values_b64" in f
+    got = apply_frame(prev.copy(), f)
+    assert got.tobytes() == new.astype(np.int8).tobytes()
+
+
+def test_delta_float_masked_threshold_bounds_drift():
+    """Sub-threshold motion is dropped per frame, but the producer diffs
+    against its own reconstruction — so client drift stays <= atol of
+    the true board after ANY number of frames, not atol * frames."""
+    rng = np.random.default_rng(0)
+    true = rng.random((12, 12), dtype=np.float32)
+    client = true.copy()
+    base = true.copy()
+    for step in range(40):
+        true = np.clip(
+            true + rng.uniform(-3e-5, 3e-5, true.shape).astype(np.float32),
+            0.0,
+            1.0,
+        )
+        frame, base = make_delta(step, step, base, true)
+        client = apply_frame(client, _wire(frame))
+    assert np.allclose(client, true, atol=FLOAT_ATOL)
+
+
+def test_delta_crc_mismatch_is_typed():
+    prev = random_board(6, 6, seed=9)
+    new = prev.copy()
+    new[0, 0] = 1 - new[0, 0]
+    f, _ = make_delta(1, 1, prev, new)
+    f["crc"] = (f["crc"] + 1) & 0xFFFFFFFF
+    with pytest.raises(StreamProtocolError, match="CRC"):
+        apply_frame(prev.copy(), f)
+
+
+def test_delta_without_base_is_typed():
+    prev = random_board(6, 6, seed=9)
+    new = prev.copy()
+    new[1, 1] = 1 - new[1, 1]
+    f, _ = make_delta(0, 1, prev, new)
+    with pytest.raises(StreamProtocolError, match="no keyframe base"):
+        apply_frame(None, f)
+
+
+def test_frame_gap_breaks_the_chain_and_metadata_passes():
+    board = random_board(5, 5, seed=0)
+    assert apply_frame(board, {"type": "frame_gap", "seq": 3, "dropped": 2}) is None
+    for kind in ("edit", "end", "shed"):
+        assert apply_frame(board, {"type": kind}) is board
+    with pytest.raises(StreamProtocolError, match="unknown frame type"):
+        apply_frame(board, {"type": "mystery"})
+
+
+# -- the hub: ring, cadence, gaps, fast-forward ------------------------------
+def _produce_n(hub, sid, n, *, h=6, w=6, start=0):
+    boards = []
+    board = random_board(h, w, seed=11, density=0.4)
+    for i in range(n):
+        board = board.copy()
+        board[i % h, (2 * i) % w] = 1 - board[i % h, (2 * i) % w]
+        hub.produce(sid, board, start + i)
+        boards.append(board)
+    return boards
+
+
+def test_hub_key_cadence_and_delta_fill():
+    hub = StreamHub(ring_frames=64, key_every=4)
+    hub.subscribe("s0")
+    _produce_n(hub, "s0", 9)
+    frames, cursor, eof = hub.read("s0", 0, timeout=0)
+    kinds = [f["type"] for f in frames]
+    # a keyframe, key_every deltas, the next keyframe, ...
+    assert kinds == ["key", "delta", "delta", "delta", "delta",
+                     "key", "delta", "delta", "delta"]
+    assert [f["seq"] for f in frames] == list(range(9))
+    assert cursor == 9 and not eof
+
+
+def test_hub_reader_folds_to_latest_board():
+    hub = StreamHub(ring_frames=64, key_every=4)
+    hub.subscribe("s0")
+    boards = _produce_n(hub, "s0", 7)
+    frames, _, _ = hub.read("s0", 0, timeout=0)
+    got = None
+    for f in frames:
+        got = apply_frame(got, _wire(f))
+    assert got.tobytes() == boards[-1].astype(np.int8).tobytes()
+
+
+def test_hub_overflow_gives_typed_gap_then_keyframe_resync():
+    hub = StreamHub(ring_frames=8, key_every=4)
+    hub.subscribe("s0")
+    boards = _produce_n(hub, "s0", 30)
+    frames, cursor, _ = hub.read("s0", 0, timeout=0)
+    assert frames[0]["type"] == "frame_gap" and frames[0]["dropped"] > 0
+    assert frames[1]["type"] == "key"  # resync anchor, always buffered
+    got = None
+    for f in frames:
+        got = apply_frame(got, _wire(f))
+    assert got.tobytes() == boards[-1].astype(np.int8).tobytes()
+    assert hub.gaps_total == 30 - 8  # one tick per evicted frame
+    # the resumed cursor reads clean — no second gap
+    _produce_n(hub, "s0", 2, start=30)
+    more, _, _ = hub.read("s0", cursor, timeout=0)
+    assert len(more) == 2
+    assert all(f["type"] in ("key", "delta") for f in more)
+
+
+def test_hub_fast_forward_resets_ring_for_failover_cursor():
+    """The failover fast-forward (a fan reconnects with the dead
+    worker's spilled seq, AHEAD of this fresh hub): the ring must reset
+    to the cursor — frames this incarnation numbered below it are
+    cleared, the next frame is a keyframe AT the cursor, and a
+    subsequent read returns exactly it (the ring-indexing regression:
+    base_seq must move with next_seq)."""
+    hub = StreamHub(ring_frames=64, key_every=32)
+    hub.subscribe("s0")
+    _produce_n(hub, "s0", 3)  # seqs 0..2 of this incarnation
+    frames, _, _ = hub.read("s0", 18, timeout=0)  # reconnect far ahead
+    assert frames == []
+    boards = _produce_n(hub, "s0", 2, start=50)
+    frames, cursor, _ = hub.read("s0", 18, timeout=0)
+    assert [f["seq"] for f in frames] == [18, 19]
+    assert frames[0]["type"] == "key"
+    got = None
+    for f in frames:
+        got = apply_frame(got, _wire(f))
+    assert got.tobytes() == boards[-1].astype(np.int8).tobytes()
+    assert cursor == 20
+
+
+def test_hub_seq_snapshot_and_start_seq_continuity():
+    hub = StreamHub()
+    hub.subscribe("s0")
+    _produce_n(hub, "s0", 5)
+    assert hub.seq_snapshot("s0") == 5
+    assert hub.seq_snapshot("missing", default=9) == 9
+    # the survivor's hub continues the spilled sequence space
+    hub2 = StreamHub()
+    hub2.subscribe("r0", start_seq=5)
+    _produce_n(hub2, "r0", 1)
+    frames, _, _ = hub2.read("r0", 5, timeout=0)
+    assert frames[0]["type"] == "key" and frames[0]["seq"] == 5
+
+
+def test_hub_finish_emits_end_and_unsubscribe_discards():
+    hub = StreamHub()
+    hub.subscribe("s0")
+    _produce_n(hub, "s0", 2)
+    hub.finish("s0", "done", 10)
+    frames, _, eof = hub.read("s0", 0, timeout=0)
+    assert frames[-1] == {"type": "end", "seq": 2, "step": 10, "state": "done"}
+    assert eof
+    assert hub.unsubscribe("s0") is True  # last watcher: state discarded
+    assert not hub.active()
+
+
+def test_estimate_stream_bytes_scales_with_dtype():
+    int_est = estimate_stream_bytes((64, 64), "int8", RING_FRAMES)
+    f32_est = estimate_stream_bytes((64, 64), "float32", RING_FRAMES)
+    assert f32_est > int_est > 64 * 64
+    assert KEY_EVERY <= RING_FRAMES  # a resync key always fits the ring
+
+
+# -- edit validation and the log codec ---------------------------------------
+def test_validate_cells_typed_rejections():
+    rule = get_rule("conway")
+    with pytest.raises(ValueError, match="list"):
+        validate_cells("nope", (8, 8), rule)
+    with pytest.raises(ValueError, match="row, col, value"):
+        validate_cells([[1, 2]], (8, 8), rule)
+    with pytest.raises(ValueError, match="outside"):
+        validate_cells([[8, 0, 1]], (8, 8), rule)
+    with pytest.raises(ValueError, match="states"):
+        validate_cells([[1, 1, 7]], (8, 8), rule)
+    with pytest.raises(ValueError, match=str(MAX_EDIT_CELLS)):
+        validate_cells([[0, 0, 1]] * (MAX_EDIT_CELLS + 1), (8, 8), rule)
+
+
+def test_validate_cells_float_range():
+    rule = get_rule("lenia")
+    assert validate_cells([[1, 1, 0.75]], (8, 8), rule) == [(1, 1, 0.75)]
+    with pytest.raises(ValueError):
+        validate_cells([[1, 1, 1.5]], (8, 8), rule)
+
+
+def test_edit_log_codec_roundtrip():
+    log = [(9, [(0, 5, 1)]), (3, [(1, 1, 1), (2, 0, 0)])]
+    raw = render_edit_log(log)
+    assert json.loads(json.dumps(raw)) == raw  # manifest-safe
+    # parse is shape-only (cells stay wire lists) and sorts by step
+    assert parse_edit_log(raw) == [
+        (3, [[1, 1, 1], [2, 0, 0]]),
+        (9, [[0, 5, 1]]),
+    ]
+
+
+# -- steered sessions == solo edit-log replay (the contract) -----------------
+def _steered_case(rule_name):
+    if rule_name == "conway":
+        board = random_board(16, 16, seed=21, density=0.4)
+        kw = {}
+        edits = [[8, [[1, 1, 1], [2, 3, 1]]], [16, [[3, 4, 0], [1, 1, 1]]]]
+    elif rule_name == "ising":
+        from tpu_life import mc
+
+        board = mc.seeded_board(16, 16, 0.5, seed=21)
+        kw = {"seed": 21, "temperature": 2.3}
+        edits = [[8, [[1, 1, 1], [2, 3, 1]]], [16, [[3, 4, 0], [1, 1, 1]]]]
+    else:  # lenia: the orbium kernel (radius 13) needs 2r+1 <= min(h, w)
+        board = lenia_board(32, 32, 0.4, seed=21)
+        kw = {}
+        edits = [[8, [[1, 1, 0.75], [2, 3, 0.6]]], [16, [[3, 4, 0.0]]]]
+    return board, kw, edits
+
+
+@pytest.mark.parametrize("pipeline", [True, False])
+@pytest.mark.parametrize("rule_name", ["conway", "ising", "lenia"])
+def test_scheduled_edits_match_oracle_replay(rule_name, pipeline):
+    """Session bytes == solo replay of the edit log, at a DIFFERENT
+    chunk cadence — edit placement is chunk-independent, both pumps,
+    all three tiers."""
+    board, kw, edits = _steered_case(rule_name)
+    steps = 24
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="numpy",
+                    pipeline=pipeline)
+    )
+    try:
+        sid = svc.submit(board, rule_name, steps, scheduled_edits=edits, **kw)
+        svc.drain(max_rounds=200)
+        v = svc.poll(sid)
+        assert v.state is SessionState.DONE, v.error
+        got = svc.result(sid)
+        assert v.edits == len(edits)
+    finally:
+        svc.close()
+    expect = replay_edit_log(
+        board, rule_name, steps, edits, chunk_steps=7, **kw
+    )
+    if rule_name == "lenia":
+        assert np.allclose(got, expect, atol=FLOAT_ATOL)
+    else:
+        assert got.tobytes() == expect.tobytes()
+
+
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_live_edit_between_chunks_logged_and_reproducible(pipeline):
+    """A PATCH-style live edit lands on a chunk boundary, is recorded at
+    its materialized step, and the logged step replays to the same
+    bytes."""
+    board = random_board(16, 16, seed=5, density=0.4)
+    steps = 40
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="numpy",
+                    pipeline=pipeline)
+    )
+    try:
+        sid = svc.submit(board, "conway", steps)
+        # a few rounds in flight, then steer
+        for _ in range(3):
+            svc.pump()
+        view = svc.edit_cells(sid, [[1, 1, 1], [4, 4, 1]])
+        assert view.sid == sid
+        svc.drain(max_rounds=200)
+        v = svc.poll(sid)
+        assert v.state is SessionState.DONE, v.error
+        got = svc.result(sid)
+        log = svc.store.get(sid).edits  # the applied log, canonical form
+        assert len(log) == 1 and len(log[0][1]) == 2
+        step = log[0][0]
+        assert 0 < step <= steps and step % 4 == 0  # a chunk boundary
+    finally:
+        svc.close()
+    expect = replay_edit_log(board, "conway", steps, log, chunk_steps=5)
+    assert got.tobytes() == expect.tobytes()
+
+
+def test_edit_terminal_session_is_typed():
+    board = random_board(8, 8, seed=1)
+    svc = SimulationService(
+        ServeConfig(capacity=1, chunk_steps=4, backend="numpy",
+                    pipeline=False)
+    )
+    try:
+        sid = svc.submit(board, "conway", 4)
+        svc.drain(max_rounds=50)
+        assert svc.poll(sid).state is SessionState.DONE
+        with pytest.raises(ValueError, match="terminal"):
+            svc.edit_cells(sid, [[1, 1, 1]])
+    finally:
+        svc.close()
+
+
+# -- the service stream path: pump tap, edits in-band, resume ----------------
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_service_stream_folds_to_result_with_edit_frames(pipeline):
+    board = random_board(16, 16, seed=8, density=0.4)
+    steps = 24
+    edits = [[8, [[2, 2, 1], [3, 3, 1]]]]
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, backend="numpy",
+                    pipeline=pipeline)
+    )
+    try:
+        sid = svc.submit(board, "conway", steps, scheduled_edits=edits)
+        svc.stream_subscribe(sid)
+        svc.drain(max_rounds=200)
+        frames, cursor, eof = [], 0, False
+        while not eof:
+            got, cursor, eof = svc.stream_read(sid, cursor, timeout=0.1)
+            frames.extend(got)
+            assert len(frames) < 500  # the ring is bounded; eof must come
+        assert [f["seq"] for f in frames] == list(range(len(frames)))
+        kinds = {f["type"] for f in frames}
+        assert "key" in kinds and "edit" in kinds
+        assert frames[-1]["type"] == "end" and frames[-1]["state"] == "done"
+        board_folded = None
+        for f in frames:
+            board_folded = apply_frame(board_folded, _wire(f))
+        assert board_folded.tobytes() == svc.result(sid).tobytes()
+        # keyframes name their producer — the splice postmortem stamp
+        keys = [f for f in frames if f["type"] == "key"]
+        assert all(f["executor"] for f in keys)
+        svc.stream_unsubscribe(sid)
+        assert svc.stats()["stream_frames_total"] == len(frames)
+    finally:
+        svc.close()
+
+
+def test_service_resume_continues_sequence_space():
+    """The failover chain in miniature: a first life streams some
+    frames, its seq snapshot rides the spill manifest, and the second
+    life's first frame continues the numbering exactly there."""
+    board = random_board(12, 12, seed=4, density=0.4)
+    svc1 = SimulationService(
+        ServeConfig(capacity=1, chunk_steps=2, backend="numpy",
+                    pipeline=False)
+    )
+    try:
+        sid = svc1.submit(board, "conway", 10)
+        svc1.stream_subscribe(sid)
+        svc1.drain(max_rounds=100)
+        frames, cursor, eof = [], 0, False
+        while not eof:
+            got, cursor, eof = svc1.stream_read(sid, cursor, timeout=0.1)
+            frames.extend(got)
+        seq = svc1.hub.seq_snapshot(sid, default=0)
+        assert seq == len(frames)
+        mid = svc1.result(sid)
+    finally:
+        svc1.close()
+    svc2 = SimulationService(
+        ServeConfig(capacity=1, chunk_steps=2, backend="numpy",
+                    pipeline=False)
+    )
+    try:
+        rid = svc2.submit(mid, "conway", 6, start_step=10, stream_seq=seq)
+        svc2.stream_subscribe(rid)
+        svc2.drain(max_rounds=100)
+        got, _, _ = svc2.stream_read(rid, seq, timeout=0.1)
+        assert got and got[0]["type"] == "key" and got[0]["seq"] == seq
+    finally:
+        svc2.close()

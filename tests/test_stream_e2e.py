@@ -1,0 +1,141 @@
+"""End-to-end live streaming across a worker death (docs/STREAMING.md):
+a real HTTP watcher on the router's fan-out tier rides ONE connection
+through a SIGKILL of the worker computing its session.
+
+The acceptance mirrored from the stream chaos drill, but with the
+plainest possible client — ``GatewayClient.stream`` + ``apply_frame``,
+no reconnect logic at all: the router's fan reconnects upstream (the
+migrator resumes the session from the spilled manifest, edit log and
+``stream_seq`` included) and renumbers densely, so the watcher must
+observe strictly consecutive sequence numbers, a terminal ``end`` with
+state ``done``, and a folded board byte-identical to both the fetched
+result and the solo edit-log replay oracle."""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from tpu_life.fleet import Fleet, FleetConfig
+from tpu_life.gateway.client import GatewayClient
+from tpu_life.models.patterns import random_board
+from tpu_life.serve.stream import apply_frame, replay_edit_log
+
+
+@pytest.fixture
+def spill_fleet(tmp_path):
+    fleet = Fleet(
+        FleetConfig(
+            workers=2,
+            port=0,
+            worker_args=(
+                "--serve-backend", "numpy", "--capacity", "4",
+                "--chunk-steps", "2",
+            ),
+            log_dir=str(tmp_path / "logs"),
+            spill_dir=str(tmp_path / "spill"),
+            spill_every=1,
+            probe_interval_s=0.1,
+            backoff_base_s=0.2,
+        )
+    )
+    fleet.start()
+    assert fleet.wait_ready(timeout=90, min_workers=2), fleet.supervisor.states()
+    yield fleet
+    fleet.begin_drain()
+    if not fleet.wait(timeout=30):
+        for w in fleet.supervisor.workers:  # aid post-mortems
+            if w.log_path.exists():
+                print(f"--- {w.name} log tail ---")
+                print(w.log_path.read_text()[-2000:])
+    fleet.close()
+
+
+class _Watcher(threading.Thread):
+    """One plain HTTP watcher: fold every frame, record every seq."""
+
+    def __init__(self, base_url: str, sid: str):
+        super().__init__(daemon=True)
+        self.client = GatewayClient(base_url, retries=4)
+        self.sid = sid
+        self.frames: list = []
+        self.board = None
+        self.error: Exception | None = None
+
+    def run(self):
+        try:
+            for frame in self.client.stream(self.sid):
+                self.frames.append(frame)
+                self.board = apply_frame(self.board, frame)
+                if frame.get("type") in ("end", "shed"):
+                    return
+        except Exception as e:  # surfaced in the main-thread asserts
+            self.error = e
+
+
+def test_watcher_rides_through_sigkill_byte_identical(spill_fleet):
+    fleet = spill_fleet
+    base_url = f"http://127.0.0.1:{fleet.port}"
+    client = GatewayClient(base_url, retries=8)
+
+    steps = 600
+    board = random_board(24, 20, seed=903, density=0.4)
+    edits = [[steps // 3, [[1, 1, 1], [2, 3, 1]]],
+             [(2 * steps) // 3, [[3, 4, 0], [1, 1, 1]]]]
+    sid = client.submit(board=board, rule="conway", steps=steps,
+                        scheduled_edits=edits)
+    # a second watched session keeps the survivor honest about fan
+    # isolation: its stream must stay clean through its neighbor's kill
+    other_board = random_board(24, 20, seed=904, density=0.4)
+    other = client.submit(board=other_board, rule="conway", steps=steps)
+
+    watchers = {s: _Watcher(base_url, s) for s in (sid, other)}
+    for w in watchers.values():
+        w.start()
+
+    # kill only after every session has published spill passes AND the
+    # watchers hold live frames — the kill must land MID-stream
+    deadline = time.monotonic() + 60
+    while True:
+        views = {s: client.poll(s) for s in (sid, other)}
+        if (
+            all(8 <= v["steps_done"] < v["steps"] for v in views.values())
+            and all(len(w.frames) >= 2 for w in watchers.values())
+        ):
+            break
+        assert time.monotonic() < deadline, (views, {
+            s: len(w.frames) for s, w in watchers.items()})
+        time.sleep(0.05)
+
+    victim_name = views[sid]["worker"]
+    victim = fleet.supervisor.get(victim_name)
+    os.kill(victim.proc.pid, signal.SIGKILL)
+
+    for s in (sid, other):
+        view = client.wait(s, timeout=120)
+        assert view["state"] == "done", (s, view)
+    for s, w in watchers.items():
+        w.join(timeout=60)
+        assert not w.is_alive(), f"watcher of {s} never terminated"
+        assert w.error is None, (s, w.error)
+
+    for s, w in watchers.items():
+        # dense seqs across the kill: the fan-out tier's contract
+        seqs = [f["seq"] for f in w.frames]
+        assert seqs == list(range(seqs[0], seqs[0] + len(seqs))), (
+            s, seqs[:20], seqs[-20:])
+        assert w.frames[-1]["type"] == "end"
+        assert w.frames[-1]["state"] == "done", w.frames[-1]
+        # the folded stream IS the session: byte-compare to the result
+        fetched = client.result_board(s)
+        assert w.board is not None and w.board.tobytes() == fetched.tobytes()
+
+    # and the steered session is byte-identical to its solo edit-log
+    # replay — bit-reproducibility survives steering + failover + fan
+    oracle = replay_edit_log(board, "conway", steps, edits, chunk_steps=5)
+    assert client.result_board(sid).tobytes() == oracle.tobytes()
+    other_oracle = replay_edit_log(other_board, "conway", steps, [],
+                                   chunk_steps=5)
+    assert client.result_board(other).tobytes() == other_oracle.tobytes()

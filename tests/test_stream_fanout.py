@@ -1,0 +1,259 @@
+"""The watcher fan-out tier (docs/STREAMING.md "Fan-out topology"):
+N watchers of one session cost ONE upstream stream — proven by counting
+upstream opens under ten thousand watchers — plus the typed-shed
+backpressure contract, the dense outgoing renumbering that keeps
+reconnected watcher sequences gapless across an upstream failover, and
+the cursor-aware rejoin.
+
+``open_upstream`` is injectable, so every contract here is proven
+without sockets: the fakes below ARE the seam the router binds to a
+worker HTTP stream."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_life import obs
+from tpu_life.fleet.fanout import BUFFER_FRAMES, FanoutHub, SHED_SLOW_READER
+
+
+def _key(seq, step=0):
+    return {"type": "key", "seq": seq, "step": step, "h": 4, "w": 4,
+            "rle": "x = 4, y = 4\n4b$4b$4b$4b!", "executor": "t", "crc": 0}
+
+
+def _delta(seq, step=0):
+    return {"type": "delta", "seq": seq, "step": step, "mask": "", "crc": 0}
+
+
+def _end(seq, state="done"):
+    return {"type": "end", "seq": seq, "state": state}
+
+
+def _wait_for(predicate, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        assert time.monotonic() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.005)
+
+
+def _drain(gen, limit=10_000):
+    frames = []
+    for frame in gen:
+        frames.append(frame)
+        assert len(frames) <= limit
+    return frames
+
+
+# -- sublinearity: the whole reason the tier exists --------------------------
+def test_ten_thousand_watchers_one_upstream():
+    opens = []
+
+    def upstream(fsid, cursor):
+        opens.append(cursor)
+        yield _key(0)
+        for i in range(1, 9):
+            yield _delta(i)
+        yield _end(9)
+
+    hub = FanoutHub(open_upstream=upstream)
+    anchor = hub.watch("sid-popular")
+    assert next(anchor)["type"] == "key"  # fan alive; puller ran
+    _wait_for(lambda: hub._fans["sid-popular"].done, what="upstream drain")
+    for _ in range(10_000):
+        g = hub.watch("sid-popular")
+        first = next(g)  # joins at the buffered keyframe
+        assert first["type"] == "key"
+        g.close()
+    assert opens == [0]
+    assert hub.upstream_opens("sid-popular") == 1
+    _drain(anchor)
+    hub.close()
+
+
+def test_fan_torn_down_when_last_watcher_leaves():
+    opens = []
+
+    def upstream(fsid, cursor):
+        opens.append(cursor)
+        yield _key(0)
+        yield _end(1)
+
+    hub = FanoutHub(open_upstream=upstream)
+    _drain(hub.watch("s"))
+    assert hub.watcher_count() == 0 and "s" not in hub._fans
+    # a LATER watcher is a fresh fan — frames are produced for watchers,
+    # not archived
+    _drain(hub.watch("s"))
+    assert opens == [0, 0]
+    hub.close()
+
+
+# -- backpressure: typed shed of the slowest, peers unharmed -----------------
+def test_overflow_sheds_slowest_watcher_typed():
+    release = threading.Event()
+    fast_frames = []
+
+    def upstream(fsid, cursor):
+        yield _key(0)
+        release.wait(10)
+        for i in range(1, 41):
+            # pace the producer against the fast consumer (stay well
+            # inside the buffer), so only the PARKED watcher falls past
+            # it — the shed verdict must be deterministic, not a race
+            _wait_for(lambda: len(fast_frames) >= i - 4,
+                      what="fast consumer")
+            yield _delta(i)
+        yield _end(41)
+
+    registry = obs.MetricsRegistry()
+    hub = FanoutHub(open_upstream=upstream, buffer_frames=8,
+                    registry=registry)
+    slow = hub.watch("s")
+    assert next(slow)["type"] == "key"  # registered, cursor parked at 1
+    fast_done = threading.Event()
+
+    def run_fast():
+        for frame in hub.watch("s"):
+            fast_frames.append(frame)
+        fast_done.set()
+
+    t = threading.Thread(target=run_fast, daemon=True)
+    t.start()
+    _wait_for(lambda: hub.watcher_count() == 2, what="fast watcher join")
+    release.set()
+    # stay parked until the buffer has rolled past the slow watcher's
+    # cursor — only then is its shed verdict in
+    _wait_for(lambda: hub._fans["s"].start > 1, what="buffer overflow")
+    # the slow watcher fell past the bounded buffer: one typed shed
+    # frame, then its stream ends
+    got = _drain(slow)
+    assert got and got[-1]["type"] == "shed"
+    assert got[-1]["reason"] == SHED_SLOW_READER
+    assert hub.shed_total == 1
+    prom = registry.prom_text()
+    assert 'watcher_shed_total{reason="slow_reader"} 1' in prom
+    # the fast peer was never stalled or shed: dense to the end
+    assert fast_done.wait(10)
+    assert fast_frames[-1]["type"] == "end"
+    assert all(f["type"] != "shed" for f in fast_frames)
+    seqs = [f["seq"] for f in fast_frames]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    hub.close()
+
+
+def test_late_joiner_past_keyframes_gets_typed_gap_then_key():
+    release = threading.Event()
+    anchor_frames = []
+
+    def upstream(fsid, cursor):
+        yield _key(0)
+        for i in range(1, 21):
+            # keep the anchor inside the tiny buffer: overflow must eat
+            # the keyframe, never shed the anchor
+            _wait_for(lambda: len(anchor_frames) >= i - 2,
+                      what="anchor consumer")
+            yield _delta(i)
+        release.wait(10)
+        yield _key(21)
+        yield _end(22)
+
+    hub = FanoutHub(open_upstream=upstream, buffer_frames=4)
+    anchor_done = threading.Event()
+
+    def run_anchor():
+        for frame in hub.watch("s"):
+            anchor_frames.append(frame)
+        anchor_done.set()
+
+    threading.Thread(target=run_anchor, daemon=True).start()
+    _wait_for(lambda: "s" in hub._fans and hub._fans["s"].out_next >= 21,
+              what="buffer overflow")
+    late = hub.watch("s")
+    first = next(late)  # buffer holds only deltas now: unreconstructable
+    assert first["type"] == "frame_gap" and first["dropped"] == -1
+    release.set()
+    rest = _drain(late)
+    # deltas before the re-key are skipped — the client could never
+    # apply them; the keyframe heals the stream
+    assert [f["type"] for f in rest] == ["key", "end"]
+    assert anchor_done.wait(10)
+    hub.close()
+
+
+# -- failover: dense renumbering + cursor-aware reconnect --------------------
+def test_upstream_failover_renumbers_dense():
+    """Upstream seqs jump across a failover (the dead worker numbered
+    frames it never delivered; the survivor re-keys past them) — the fan
+    reconnects at the next UPSTREAM seq it needs, but watchers see the
+    fan's own consecutive numbering: gapless by construction."""
+    calls = []
+
+    def upstream(fsid, cursor):
+        calls.append(cursor)
+        if len(calls) == 1:
+            def first_life():
+                yield _key(0)
+                for i in range(1, 5):
+                    yield _delta(i)
+                raise ConnectionError("worker SIGKILLed mid-stream")
+            return first_life()
+
+        def survivor():
+            assert cursor == 5  # resumes at the next needed upstream seq
+            yield _key(18, step=36)  # spilled stream_seq: re-keyed past
+            yield _delta(19, step=38)
+            yield _end(20)
+        return survivor()
+
+    hub = FanoutHub(open_upstream=upstream, sleep=lambda s: None)
+    frames = _drain(hub.watch("s"))
+    assert calls == [0, 5]
+    assert [f["seq"] for f in frames] == list(range(8))  # DENSE
+    assert [f["type"] for f in frames] == [
+        "key", "delta", "delta", "delta", "delta", "key", "delta", "end",
+    ]
+    # the original upstream numbering is gone from the wire; steps and
+    # payloads are untouched (CRCs are content-based, so renumbering is
+    # safe)
+    assert frames[5]["step"] == 36
+    hub.close()
+
+
+def test_watcher_reconnect_with_cursor_resumes_exactly():
+    def upstream(fsid, cursor):
+        yield _key(0)
+        for i in range(1, 12):
+            yield _delta(i)
+        yield _end(12)
+
+    hub = FanoutHub(open_upstream=upstream)
+    anchor = hub.watch("s")
+    next(anchor)
+    _wait_for(lambda: hub._fans["s"].done, what="upstream drain")
+    # a watcher drops at outgoing seq 4 and reconnects with its cursor
+    rejoin = hub.watch("s", cursor=4)
+    frames = _drain(rejoin)
+    assert [f["seq"] for f in frames] == list(range(4, 13))
+    _drain(anchor)
+    hub.close()
+
+
+def test_upstream_lost_for_good_ends_typed():
+    def upstream(fsid, cursor):
+        raise ConnectionError("no route to worker")
+        yield  # pragma: no cover
+
+    hub = FanoutHub(open_upstream=upstream, max_reconnects=2,
+                    sleep=lambda s: None)
+    frames = _drain(hub.watch("s"))
+    assert len(frames) == 1
+    assert frames[0]["type"] == "end" and frames[0]["state"] == "lost"
+    hub.close()
+
+
+def test_buffer_bound_validated():
+    with pytest.raises(ValueError, match="buffer_frames"):
+        FanoutHub(open_upstream=lambda f, c: iter(()), buffer_frames=1)
+    assert BUFFER_FRAMES >= 2

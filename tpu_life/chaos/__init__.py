@@ -87,6 +87,9 @@ POINTS: dict[str, tuple[str, ...]] = {
     # fleet router transport
     "router.submit.reset": ("reset",),  # pre-send reset (refusal path)
     "router.poll.reset": ("mid_exchange", "mid_body"),  # ambiguity paths
+    # live-session streaming (docs/STREAMING.md)
+    "stream.reset": ("reset",),  # worker stream drops MID-FRAME (torn line)
+    "watch.slow_reader": ("sleep",),  # a fan-out watcher stalls `seconds`
     # fleet supervisor / migrator
     "probe.skew": ("skew",),  # monitor clock reads skew by up to `seconds`
     "migrate.die": ("die",),  # the migration thread is never started

@@ -94,6 +94,19 @@ GOVERNOR_POINTS: dict[str, dict] = {
                      "seconds": 30.0},
 }
 
+#: The stream drill's fault mix (``tpu-life chaos --stream``,
+#: docs/STREAMING.md "Chaos"): torn worker streams mid-frame (the
+#: fan-out puller must reconnect at its cursor and the watcher must see
+#: GAPLESS seqs) and a stalled router->watcher write (absorbed by the
+#: broadcast buffer, never propagated to the pump).  ``seconds`` stays
+#: well under the buffer's slack so the stall is exercised without
+#: shedding the drill's own watchers.
+STREAM_POINTS: dict[str, dict] = {
+    "stream.reset": {"rate": 0.1, "mode": "reset", "times": 2},
+    "watch.slow_reader": {"rate": 0.05, "mode": "sleep", "times": 2,
+                          "seconds": 0.4},
+}
+
 
 @dataclass
 class DrillConfig:
@@ -123,6 +136,14 @@ class DrillConfig:
     # death outside the wedge-recycle/kill schedule; both points fired)
     governor: bool = False
     settle_deadline_s: float = 1.0
+    # the stream drill (docs/STREAMING.md): arm STREAM_POINTS by
+    # default, schedule mid-run edits on every session, hang live
+    # watchers on each sid through the SIGKILL, and verify the extra
+    # ``stream_continuity`` invariant — gapless seqs across failover,
+    # watcher agreement, reconstruction == the replay_edit_log oracle
+    stream: bool = False
+    lenia_sessions: int = 1  # stream drill only: continuous-tier sids
+    watchers_per_session: int = 2
 
 
 @dataclass
@@ -141,9 +162,102 @@ class WorkItem:
     detail: str = ""
     resubmits: int = 0
     delivered: bool = False  # a DONE answer matched the oracle
+    # stream drill fields: the pre-scheduled steering this session
+    # carries ([[step, cells], ...]) — its oracle is then the
+    # ``replay_edit_log`` of the same log — and whether the oracle
+    # compare is allclose (continuous tier) rather than byte-equal
+    edits: list = field(default_factory=list)
+    continuous: bool = False
+
+
+def _build_stream_items(cfg: DrillConfig) -> list[WorkItem]:
+    """The stream drill's workload: every session carries pre-scheduled
+    mid-run edits, and its oracle is ``replay_edit_log`` of the same log
+    run solo (at a DIFFERENT chunk cadence than the fleet's, so the
+    compare also proves edit placement is chunk-independent)."""
+    from tpu_life.models.lenia import seeded_board as lenia_board
+    from tpu_life.serve.stream import replay_edit_log
+
+    items: list[WorkItem] = []
+
+    def edits_for(steps: int, value) -> list:
+        zero = 0.0 if isinstance(value, float) else 0
+        return [
+            [max(1, steps // 3), [[1, 1, value], [2, 3, value]]],
+            [max(2, (2 * steps) // 3), [[3, 4, zero], [1, 1, value]]],
+        ]
+
+    def oracle(board, rule, steps, edits, *, seed=None, temperature=None):
+        return replay_edit_log(
+            board, rule, steps, edits,
+            seed=seed, temperature=temperature,
+            chunk_steps=max(3, cfg.chunk_steps + 1),
+        )
+
+    for i in range(cfg.det_sessions):
+        steps = max(
+            cfg.chunk_steps * cfg.min_progress,
+            cfg.steps - (cfg.steps * i) // (2 * max(cfg.det_sessions, 1)),
+        )
+        seed = cfg.seed * 1000 + i
+        board = mc.seeded_board(cfg.size, cfg.size, 0.45, seed=seed)
+        edits = edits_for(steps, 1)
+        items.append(
+            WorkItem(
+                tag=f"det{i}",
+                rule="conway",
+                board=board,
+                steps=steps,
+                seed=seed,
+                temperature=None,
+                oracle=oracle(board, "conway", steps, edits).tobytes(),
+                edits=edits,
+            )
+        )
+    for i in range(cfg.ising_sessions):
+        seed = cfg.seed * 1000 + 500 + i
+        temp = 2.0 + 0.3 * i
+        steps = max(cfg.chunk_steps * cfg.min_progress, cfg.steps // 2)
+        board = mc.seeded_board(16, 16, 0.5, seed=seed)
+        edits = edits_for(steps, 1)
+        items.append(
+            WorkItem(
+                tag=f"ising{i}",
+                rule="ising",
+                board=board,
+                steps=steps,
+                seed=seed,
+                temperature=temp,
+                oracle=oracle(
+                    board, "ising", steps, edits, seed=seed, temperature=temp
+                ).tobytes(),
+                edits=edits,
+            )
+        )
+    for i in range(cfg.lenia_sessions):
+        seed = cfg.seed * 1000 + 800 + i
+        steps = max(cfg.chunk_steps * cfg.min_progress, cfg.steps // 3)
+        board = lenia_board(32, 32, 0.4, seed=seed)
+        edits = edits_for(steps, 0.75)
+        items.append(
+            WorkItem(
+                tag=f"lenia{i}",
+                rule="lenia",
+                board=board,
+                steps=steps,
+                seed=seed,
+                temperature=None,
+                oracle=oracle(board, "lenia", steps, edits).tobytes(),
+                edits=edits,
+                continuous=True,
+            )
+        )
+    return items
 
 
 def _build_items(cfg: DrillConfig) -> list[WorkItem]:
+    if cfg.stream:
+        return _build_stream_items(cfg)
     items: list[WorkItem] = []
     rule = get_rule("conway")
     for i in range(cfg.det_sessions):
@@ -205,6 +319,20 @@ def _parse(raw: bytes) -> dict:
         return {}
 
 
+def _oracle_match(item: WorkItem, board: np.ndarray) -> bool:
+    """DONE board vs precomputed oracle: byte-equal for the discrete
+    tiers; allclose at ``models.lenia.FLOAT_ATOL`` for the continuous
+    tier (the masked-threshold delta tolerance, docs/STREAMING.md)."""
+    if not item.continuous:
+        return board.tobytes() == item.oracle
+    from tpu_life.models.lenia import FLOAT_ATOL
+
+    want = np.frombuffer(item.oracle, dtype="<f4").reshape(board.shape)
+    return bool(
+        np.allclose(np.asarray(board, dtype=np.float32), want, atol=FLOAT_ATOL)
+    )
+
+
 class _Driller:
     """One drill run's state: the fleet, the client, the verdicts."""
 
@@ -213,8 +341,12 @@ class _Driller:
         self.items = _build_items(cfg)
         if cfg.points is not None:
             points = cfg.points
+        elif cfg.governor:
+            points = GOVERNOR_POINTS
+        elif cfg.stream:
+            points = STREAM_POINTS
         else:
-            points = GOVERNOR_POINTS if cfg.governor else DEFAULT_POINTS
+            points = DEFAULT_POINTS
         self.plan = chaos.ChaosPlan(cfg.seed, points)
         self.accepted = 0  # 201s the clients received (== routed, invariant)
         self.kills: list[dict] = []
@@ -300,6 +432,7 @@ class _Driller:
                 steps=item.steps,
                 seed=item.seed,
                 temperature=item.temperature,
+                scheduled_edits=item.edits or None,
             )
         except Exception as e:  # noqa: BLE001 - a refused submit is data
             item.outcome = "rejected"
@@ -396,7 +529,7 @@ class _Driller:
                     board = None
                 if board is not None:
                     item.outcome = "done"
-                    if board.tobytes() == item.oracle:
+                    if _oracle_match(item, board):
                         item.delivered = True
                     else:
                         self.violate(
@@ -588,6 +721,156 @@ def _check_governor(d: "_Driller", fleet) -> None:
         )
 
 
+class _StreamWatcher:
+    """One live watcher of one fleet sid: a thread consuming the
+    router's ndjson delta stream through the standard client,
+    reconnecting at its cursor on tears (the documented watcher
+    recourse) and folding every frame through ``apply_frame`` — so the
+    drill can assert gapless seqs across the SIGKILL and compare the
+    reconstruction against the ``replay_edit_log`` oracle."""
+
+    def __init__(self, base_url: str, item: WorkItem, tag: str):
+        import threading
+
+        self.base_url = base_url
+        self.item = item
+        self.fsid = item.sid
+        self.tag = tag
+        self.frames: list[dict] = []
+        self.board = None  # the running apply_frame reconstruction
+        self.recon_error = ""  # first StreamProtocolError, if any
+        self.error = ""
+        self._t = threading.Thread(
+            target=self._run, name=f"drill-watch-{tag}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._t.start()
+
+    def join(self, timeout: float) -> None:
+        self._t.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._t.is_alive()
+
+    def _run(self) -> None:
+        from tpu_life.serve.stream import StreamProtocolError, apply_frame
+
+        client = GatewayClient(self.base_url, retries=4)
+        cursor = 0
+        attempts = 0
+        while attempts <= 20:
+            try:
+                for frame in client.stream(self.fsid, cursor=cursor):
+                    self.frames.append(frame)
+                    seq = frame.get("seq")
+                    if isinstance(seq, int):
+                        cursor = seq + 1
+                    try:
+                        self.board = apply_frame(self.board, frame)
+                    except StreamProtocolError as e:
+                        if not self.recon_error:
+                            self.recon_error = str(e)
+                    if frame.get("type") in ("end", "shed"):
+                        return
+                # closed without a terminal frame: reconnect at cursor
+                attempts += 1
+            except Exception as e:  # noqa: BLE001 - transport tear: retry
+                attempts += 1
+                self.error = str(e)
+                time.sleep(0.2)
+        if not self.error:
+            self.error = "reconnect budget exhausted without an end frame"
+
+
+def _check_stream(d: "_Driller", watchers: list[_StreamWatcher]) -> None:
+    """The stream invariant (docs/STREAMING.md), appended to the
+    standard six when ``--stream`` is armed:
+
+    - both stream points actually fired (torn upstream + stalled
+      watcher write — the seams this drill exists to exercise);
+    - every watcher terminated on a typed ``end`` with state ``done``
+      (no hang, no shed, no synthetic ``lost``) and its sequence
+      numbers are strictly consecutive ACROSS the mid-stream SIGKILL;
+    - watchers of the same sid agree byte-for-byte on every shared seq
+      (the fan-out broadcast is one stream, not N reconciliations);
+    - each watcher's folded reconstruction equals the session's
+      ``replay_edit_log`` oracle — byte-equal for the discrete tiers,
+      allclose at ``FLOAT_ATOL`` for lenia — so bit-reproducibility
+      under steering is machine-verified end to end.
+    """
+    d.extra_invariants.append("stream_continuity")
+    inj = d.injections_by_point()
+    local = {p: sum(c.values()) for p, c in chaos.counts().items()}
+    for point in ("stream.reset", "watch.slow_reader"):
+        if inj.get(point, 0) + local.get(point, 0) < 1:
+            d.violate(
+                "stream_continuity",
+                f"{point} never fired (injections: {inj}) — the seam was "
+                f"not exercised; pick a seed that reaches it",
+            )
+    for w in watchers:
+        if w.alive:
+            d.violate("stream_continuity", f"{w.tag} never terminated")
+            continue
+        if w.error:
+            d.violate("stream_continuity", f"{w.tag}: {w.error}")
+        seqs = [
+            f["seq"] for f in w.frames if isinstance(f.get("seq"), int)
+        ]
+        for a, b in zip(seqs, seqs[1:]):
+            if b != a + 1:
+                d.violate(
+                    "stream_continuity",
+                    f"{w.tag} seq gap: {a} -> {b} (gapless-across-failover "
+                    f"broken)",
+                )
+                break
+        if w.item.resubmits:
+            # the session was typed-lost and resubmitted under a fresh
+            # sid: this watcher's ORIGINAL stream legitimately ended
+            # early, so terminal-state/reconstruction checks don't apply
+            continue
+        last = w.frames[-1] if w.frames else {}
+        if last.get("type") != "end" or last.get("state") != "done":
+            d.violate(
+                "stream_continuity",
+                f"{w.tag} ended {last.get('type')!r}/{last.get('state')!r}, "
+                f"expected end/done",
+            )
+            continue
+        if w.recon_error:
+            d.violate(
+                "stream_continuity", f"{w.tag} reconstruction: {w.recon_error}"
+            )
+        elif w.board is None or not _oracle_match(w.item, w.board):
+            d.violate(
+                "stream_continuity",
+                f"{w.tag} reconstruction differs from the replay_edit_log "
+                f"oracle",
+            )
+    by_sid: dict[str, list[_StreamWatcher]] = {}
+    for w in watchers:
+        by_sid.setdefault(w.fsid, []).append(w)
+    for fsid, ws in by_sid.items():
+        maps = [
+            {f["seq"]: f for f in w.frames if isinstance(f.get("seq"), int)}
+            for w in ws
+        ]
+        shared = set(maps[0])
+        for m in maps[1:]:
+            shared &= set(m)
+        for s in sorted(shared):
+            if any(m[s] != maps[0][s] for m in maps[1:]):
+                d.violate(
+                    "stream_continuity",
+                    f"watchers of {fsid} disagree at seq {s} — the "
+                    f"broadcast is not byte-identical",
+                )
+                break
+
+
 class _RecycleWatch:
     """Background sampler of supervisor state: records every observed
     unready-recycle — a worker leaving READY and coming back under a
@@ -729,6 +1012,19 @@ def run_drill(cfg: DrillConfig) -> dict:
         client = GatewayClient(d.base_url, retries=8)
         for item in d.items:
             d.submit_item(client, item)
+        watchers: list[_StreamWatcher] = []
+        if cfg.stream:
+            # hang N live watchers on every accepted sid BEFORE the
+            # kill lands: the whole point is that they ride through it
+            for item in d.items:
+                if item.sid is None:
+                    continue
+                for w in range(cfg.watchers_per_session):
+                    watchers.append(
+                        _StreamWatcher(d.base_url, item, f"{item.tag}.w{w}")
+                    )
+            for w in watchers:
+                w.start()
         d.run_kills(client)
         # poll everything to terminal; play the documented client
         # recourse for typed losses (resubmit from scratch, fresh sid)
@@ -754,11 +1050,17 @@ def run_drill(cfg: DrillConfig) -> dict:
                     f"{item.tag} never yielded its oracle board "
                     f"(final: {item.outcome} {item.detail})",
                 )
+        if cfg.stream:
+            join_deadline = time.monotonic() + cfg.wait_timeout_s
+            for w in watchers:
+                w.join(max(0.1, join_deadline - time.monotonic()))
         d._scrape_injections()
         d.check_metrics()
         if cfg.governor:
             d.recycles = list(watch.recycles)
             _check_governor(d, fleet)
+        if cfg.stream:
+            _check_stream(d, watchers)
     finally:
         if watch is not None:
             watch.stop()
@@ -781,8 +1083,14 @@ def run_drill(cfg: DrillConfig) -> dict:
         k["recovery_s"] for k in d.kills if k.get("recovery_s") is not None
     ]
     done = outcomes.get("done", 0)
+    if cfg.governor:
+        kind = "governor_drill"
+    elif cfg.stream:
+        kind = "stream_drill"
+    else:
+        kind = "chaos_drill"
     summary = {
-        "kind": "governor_drill" if cfg.governor else "chaos_drill",
+        "kind": kind,
         # the replay stamp (docs/CHAOS.md): seed + canonical plan + its
         # digest — a failed CI drill is rerun locally from exactly these
         "seed": cfg.seed,
@@ -793,6 +1101,25 @@ def run_drill(cfg: DrillConfig) -> dict:
         # governor mode: the wedge-recycle evidence (worker, successor
         # generation, readyz-500 -> ready-again wall seconds)
         **({"recycles": d.recycles} if cfg.governor else {}),
+        # stream mode: the fan-out evidence — watcher count, total
+        # frames observed, and how many watchers ended on a clean done
+        **(
+            {
+                "stream": {
+                    "watchers": len(watchers),
+                    "frames_total": sum(len(w.frames) for w in watchers),
+                    "ended_done": sum(
+                        1
+                        for w in watchers
+                        if w.frames
+                        and w.frames[-1].get("type") == "end"
+                        and w.frames[-1].get("state") == "done"
+                    ),
+                }
+            }
+            if cfg.stream
+            else {}
+        ),
         "sessions": len(d.items),
         "accepted": d.accepted,
         "outcomes": outcomes,

@@ -545,6 +545,20 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SECONDS",
                     help="worker wedge-watchdog deadline for --governor "
                          "(forwarded as each worker's --settle-deadline)")
+    ch.add_argument("--stream", action="store_true",
+                    help="the live-session stream drill (docs/STREAMING.md): "
+                         "every session carries pre-scheduled mid-run edits "
+                         "and live watchers on the fan-out tier; arms "
+                         "stream.reset + watch.slow_reader and verifies "
+                         "gapless watcher seqs across the SIGKILL, watcher "
+                         "agreement, and reconstruction == the "
+                         "replay_edit_log oracle")
+    ch.add_argument("--lenia-sessions", type=int, default=1,
+                    help="--stream only: continuous-tier (lenia) sessions "
+                         "in the watched mix (oracle compare is allclose "
+                         "at FLOAT_ATOL)")
+    ch.add_argument("--watchers", type=int, default=2,
+                    help="--stream only: live watchers per session")
     ch.add_argument("--cross-host", action="store_true",
                     help="the two-control-plane drill (docs/FLEET.md "
                     "cross-host topology): two supervisors with disjoint "
@@ -2128,11 +2142,17 @@ def _chaos_drill(args) -> int:
         except (ValueError, chaos.ChaosError) as e:
             print(f"chaos: bad --plan: {e}", file=sys.stderr)
             return 2
+    if args.governor and args.stream:
+        print(
+            "chaos: --governor and --stream are separate drills; pick one",
+            file=sys.stderr,
+        )
+        return 2
     if args.cross_host:
-        if args.governor:
+        if args.governor or args.stream:
             print(
-                "chaos: --governor and --cross-host are separate drills; "
-                "pick one",
+                "chaos: --governor/--stream and --cross-host are separate "
+                "drills; pick one",
                 file=sys.stderr,
             )
             return 2
@@ -2156,15 +2176,21 @@ def _chaos_drill(args) -> int:
         summary_file=args.summary_file,
         governor=args.governor,
         settle_deadline_s=args.settle_deadline,
+        stream=args.stream,
+        lenia_sessions=args.lenia_sessions,
+        watchers_per_session=args.watchers,
     )
     print(
         json.dumps(
             {
                 "mode": "chaos",
                 "governor": cfg.governor,
+                "stream": cfg.stream,
                 "seed": cfg.seed,
                 "workers": cfg.workers,
-                "sessions": cfg.det_sessions + cfg.ising_sessions,
+                "sessions": cfg.det_sessions
+                + cfg.ising_sessions
+                + (cfg.lenia_sessions if cfg.stream else 0),
                 "kills": cfg.kills,
                 "workdir": cfg.workdir,
             }
@@ -2174,7 +2200,11 @@ def _chaos_drill(args) -> int:
     summary = run_drill(cfg)
     print(json.dumps(summary), flush=True)
     if not summary["ok"]:
-        flag = " --governor" if cfg.governor else ""
+        flag = (
+            " --governor"
+            if cfg.governor
+            else (" --stream" if cfg.stream else "")
+        )
         print(
             f"chaos: INVARIANT FAILURE — replay verbatim with: "
             f"tpu-life chaos{flag} --seed {cfg.seed} "

@@ -111,6 +111,17 @@ def resume_request(rec: SpillRecord) -> dict:
         # survivor's session CONTINUES the dead worker's trace — one
         # trace_id across generations and hosts
         body["trace_id"] = rec.trace_id
+    # steered-session continuity (docs/STREAMING.md): the applied edit
+    # log (provenance — already baked into the spilled board), the
+    # unapplied scheduled tail (the survivor re-applies it at the
+    # recorded steps), and the delta-stream sequence floor (a
+    # reconnected watcher's numbering stays gapless across the failover)
+    if rec.edits:
+        body["edits"] = rec.edits
+    if rec.scheduled_edits:
+        body["scheduled_edits"] = rec.scheduled_edits
+    if rec.stream_seq:
+        body["stream_seq"] = rec.stream_seq
     return body
 
 

@@ -42,6 +42,7 @@ from urllib.parse import urlsplit
 from tpu_life import chaos, obs
 from tpu_life.fleet import errors as fl_errors
 from tpu_life.fleet.balancer import LeastDepthBalancer, prom_value
+from tpu_life.fleet.fanout import FanoutHub
 from tpu_life.fleet.membership import ROUTE_HEARTBEAT, ROUTE_REGISTER
 from tpu_life.fleet.registry import SessionRegistry
 from tpu_life.fleet.supervisor import (
@@ -60,6 +61,17 @@ from tpu_life.version import __version__
 #: Worker 503 codes that mean "definitively not admitted" — safe to retry
 #: the submission on the next candidate without risking a duplicate.
 REFUSAL_CODES = frozenset({"overloaded", "queue_full", "draining"})
+
+#: Socket read timeout on an upstream worker stream: frames arrive every
+#: scheduling round while a session runs, so a read that blocks this
+#: long means the link (or the worker) is gone — the fan-out puller
+#: reconnects with its cursor and the survivor re-keys.
+STREAM_READ_TIMEOUT_S = 30.0
+
+#: How long a fan-out upstream open waits on a 409 ``migrating`` answer
+#: before treating the sid as lost — failover replay is seconds, not
+#: minutes.
+STREAM_MIGRATE_WAIT_S = 30.0
 
 
 class WorkerUnreachable(Exception):
@@ -108,6 +120,10 @@ class Router:
         # without one, worker death stays terminal (410, reason
         # ``spill_disabled``).
         self.migrator = None
+        # the watcher fan-out tier (docs/STREAMING.md): N watchers of one
+        # sid share ONE upstream worker stream; the shed counter and the
+        # live-watcher gauge land in the fleet registry
+        self.fanout = FanoutHub(open_upstream=self._open_upstream, registry=registry)
         self._server = _RouterHTTPServer((config.host, config.port), _Handler)
         self._server.router = self
         self.host, self.port = self._server.server_address[:2]
@@ -139,6 +155,7 @@ class Router:
         if self._closed:
             return
         self._closed = True
+        self.fanout.close()
         if self._serve_thread is not None:
             self._server.shutdown()
         self._server.server_close()
@@ -387,8 +404,48 @@ class Router:
             return fl_errors.worker_lost(pin.worker, fsid, reason=st[1])
         return fl_errors.worker_lost(pin.worker, fsid)
 
+    def _open_upstream(self, fsid: str, cursor: int):
+        """One upstream worker stream for the fan-out tier (runs on a
+        fan's puller thread): resolve the pin FRESH — after a failover it
+        names the survivor — waiting bounded through a 409 ``migrating``
+        window, then consume the worker's ndjson frames starting at
+        ``cursor``.  Transport failures (and torn frames) raise; the
+        :class:`FanoutHub` reconnects with the next cursor it needs."""
+        deadline = time.monotonic() + STREAM_MIGRATE_WAIT_S
+        while True:
+            try:
+                worker, sid = self.resolve(fsid)
+                break
+            except ApiError as e:
+                if e.code == "migrating" and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                    continue
+                raise
+        if chaos.partitioned(f"{self.config.site}router", worker.name):
+            raise ConnectionRefusedError("chaos: net partition")
+        url = f"{worker.url}{ROUTE_SESSIONS}/{sid}/stream?cursor={int(cursor)}"
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=STREAM_READ_TIMEOUT_S) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    # a torn frame: the worker died mid-write — the
+                    # reconnect-with-cursor contract, not a parse bug
+                    raise ConnectionError(
+                        f"{fsid}: torn frame on upstream stream"
+                    ) from None
+
     def route_pinned(
-        self, method: str, fsid: str, tail: str, api_key: str | None
+        self,
+        method: str,
+        fsid: str,
+        tail: str,
+        api_key: str | None,
+        body: bytes | None = None,
     ) -> tuple[int, float | None, dict]:
         # a session rescued onto a PEER control plane (docs/FLEET.md
         # "Cross-host topology") answers under its original sid: the pin
@@ -397,11 +454,15 @@ class Router:
         # the exact same protocol
         peer = self.migrator.peer_of(fsid) if self.migrator is not None else None
         if peer is not None:
-            return self._route_peer(method, fsid, peer, tail, api_key)
+            return self._route_peer(method, fsid, peer, tail, api_key, body=body)
         worker, sid = self.resolve(fsid)
         try:
             status, retry_after, doc = self.forward(
-                worker, method, f"{ROUTE_SESSIONS}/{sid}{tail}", api_key=api_key
+                worker,
+                method,
+                f"{ROUTE_SESSIONS}/{sid}{tail}",
+                api_key=api_key,
+                body=body,
             )
         except WorkerUnreachable as e:
             dead = e.refused or not worker.alive
@@ -439,6 +500,7 @@ class Router:
         peer: tuple[str, str],
         tail: str,
         api_key: str | None,
+        body: bytes | None = None,
     ) -> tuple[int, float | None, dict]:
         """Proxy one pinned request to the peer control plane that adopted
         the session; the client keeps its original fleet sid."""
@@ -448,8 +510,10 @@ class Router:
                 peer_url, "net partition to peer control plane"
             )
         req = urllib.request.Request(
-            f"{peer_url}{ROUTE_SESSIONS}/{peer_sid}{tail}", method=method
+            f"{peer_url}{ROUTE_SESSIONS}/{peer_sid}{tail}", data=body, method=method
         )
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
         if api_key is not None:
             req.add_header("X-API-Key", api_key)
         try:
@@ -577,6 +641,9 @@ class _Handler(JsonHandler):
 
     def do_DELETE(self):  # noqa: N802
         self._dispatch("DELETE")
+
+    def do_PATCH(self):  # noqa: N802
+        self._dispatch("PATCH")
 
     def _dispatch(self, method: str) -> None:
         parts = urlsplit(self.path)
@@ -723,7 +790,60 @@ class _Handler(JsonHandler):
                 )
                 self._send_json(status, doc, retry_after=retry_after)
                 return
+            if tail == "cells":
+                # mid-run steering (docs/STREAMING.md): forward the cell
+                # mask verbatim to the exact worker that owns the session
+                self._require(method, "PATCH", path)
+                body = self._read_body()
+                status, retry_after, doc = rt.route_pinned(
+                    "PATCH", fsid, "/cells", api_key, body=body
+                )
+                self._send_json(status, doc, retry_after=retry_after)
+                return
+            if tail == "stream":
+                self._require(method, "GET", path)
+                self._stream(rt, fsid, query)
+                return
         raise gw_errors.not_found(f"no route for {path}")
+
+    def _stream(self, rt: Router, fsid: str, query: str) -> None:
+        """``GET /v1/sessions/{fsid}/stream`` — one watcher on the
+        fan-out tier (docs/STREAMING.md): frames come off the sid's
+        shared broadcast buffer, never a dedicated worker connection.
+        Admission errors (404 / 409 migrating / 410) answer typed BEFORE
+        the 200; after the header the connection belongs to the frame
+        grammar."""
+        from urllib.parse import parse_qs
+
+        raw = parse_qs(query).get("cursor", ["0"])[0]
+        try:
+            cursor = int(raw)
+        except ValueError:
+            raise gw_errors.bad_request(
+                "invalid_request", f"bad cursor {raw!r}"
+            ) from None
+        if cursor < 0:
+            raise gw_errors.bad_request("invalid_request", "'cursor' must be >= 0")
+        rt.resolve(fsid)  # typed 404/409/410 while an answer is still JSON
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        gen = rt.fanout.watch(fsid, cursor)
+        try:
+            for frame in gen:
+                # chaos seam (docs/CHAOS.md ``watch.slow_reader``): a
+                # seeded stall in THIS watcher's write loop — its cursor
+                # falls behind the broadcast buffer and the shed path,
+                # not the pump or its peer watchers, absorbs the damage
+                stall = chaos.delay("watch.slow_reader")
+                if stall > 0:
+                    time.sleep(stall)
+                self.wfile.write((json.dumps(frame) + "\n").encode())
+                self.wfile.flush()
+        finally:
+            gen.close()
 
     def _require(self, method: str, expected: str, path: str) -> None:
         if method != expected:

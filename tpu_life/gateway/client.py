@@ -160,6 +160,7 @@ class GatewayClient:
         density: float | None = None,
         temperature: float | None = None,
         trace_id: str | None = None,
+        scheduled_edits: list | None = None,
     ) -> str:
         """Create a session (inline board, or seeded geometry); returns sid.
 
@@ -178,6 +179,11 @@ class GatewayClient:
             req["temperature"] = temperature
         if seed is not None:
             req["seed"] = seed
+        if scheduled_edits is not None:
+            # pre-scheduled steering (docs/STREAMING.md): the worker
+            # applies each [step, cells] entry at exactly that step via
+            # the freeze-mask seam, as if PATCHed live at that moment
+            req["scheduled_edits"] = scheduled_edits
         if board is not None:
             req["board"] = board_rows(board)
         else:
@@ -205,6 +211,57 @@ class GatewayClient:
 
     def cancel(self, sid: str) -> bool:
         return bool(self._request("DELETE", f"/v1/sessions/{sid}")["cancelled"])
+
+    def edit_cells(self, sid: str, cells: list) -> dict:
+        """Mid-run steering (docs/STREAMING.md): PATCH a list of
+        ``[row, col, value]`` triples onto the running board; applied
+        between chunks and recorded in the session's edit log."""
+        return self._request(
+            "PATCH", f"/v1/sessions/{sid}/cells", {"cells": cells}
+        )
+
+    def stream(self, sid: str, *, cursor: int = 0):
+        """Watch a session live: a generator of frame dicts off the
+        chunked ndjson delta stream (docs/STREAMING.md) — keyframes,
+        deltas, edit markers, ``frame_gap`` resyncs, and the terminal
+        ``end``.  One connection, no retries: a transport drop
+        mid-stream surfaces as :class:`GatewayError` so the caller can
+        reconnect with ``cursor`` set to the next sequence it needs
+        (the server fast-forwards and re-keys).  Non-2xx admission
+        responses (404 unknown sid, 503 watcher-buffer pressure) raise
+        the usual typed error."""
+        url = f"{self.base_url}/v1/sessions/{sid}/stream?cursor={int(cursor)}"
+        req = urllib.request.Request(url, method="GET")
+        if self.api_key:
+            req.add_header("X-API-Key", self.api_key)
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            payload = _error_payload(e)
+            raise GatewayError(
+                e.code,
+                payload.get("code", "http_error"),
+                payload.get("message", str(e)),
+                retry_after=parse_retry_after(e.headers),
+            ) from None
+        except (urllib.error.URLError, ConnectionError, socket.timeout) as e:
+            raise GatewayError(0, "unreachable", f"{url}: {e}") from None
+        try:
+            with resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        # a torn frame (connection died mid-line) — the
+                        # reconnect-with-cursor contract, not a parse bug
+                        raise GatewayError(
+                            0, "stream_torn", f"{sid}: torn frame mid-stream"
+                        ) from None
+        except (ConnectionError, socket.timeout, OSError) as e:
+            raise GatewayError(0, "stream_torn", f"{sid}: {e}") from None
 
     def wait(self, sid: str, *, interval: float = 0.05, timeout: float = 120.0) -> dict:
         """Poll until the session is terminal; returns the final view."""

@@ -92,6 +92,16 @@ class SubmitSpec:
     #: cross-process journey; the ``X-Trace-Id`` header wins over the
     #: body field at the HTTP layer, and a malformed value is a typed 400
     trace_id: str | None = None
+    #: steered-session resume fields (docs/STREAMING.md): ``edits`` is
+    #: the APPLIED edit log (already baked into the staged board —
+    #: provenance for replay), ``scheduled_edits`` the unapplied tail the
+    #: service must re-apply, ``stream_seq`` the delta-stream sequence
+    #: floor so a reconnected watcher's numbering stays gapless across
+    #: failover.  Cell-level validation is the service's (shape- and
+    #: rule-aware); here the shape of the log itself is the contract.
+    edits: list | None = None
+    scheduled_edits: list | None = None
+    stream_seq: int = 0
 
 
 def _require_int(payload: dict, key: str, *, minimum: int = 0) -> int:
@@ -284,6 +294,33 @@ def parse_resume_board(payload: dict, rule) -> np.ndarray:
     return board
 
 
+def _parse_edit_log_field(payload: dict, key: str) -> list | None:
+    """Shape-check a wire edit log (``[[step, [[r, c, v], ...]], ...]``)
+    as a typed 400; cell-level validation (bounds, states, the float
+    range) is the service's, shape- and rule-aware, surfaced as 400 via
+    the standard ValueError mapping."""
+    raw = payload.get(key)
+    if raw is None:
+        return None
+    if not isinstance(raw, list):
+        raise bad_request(
+            "invalid_request", f"{key!r} must be a list of [step, cells] pairs"
+        )
+    for i, entry in enumerate(raw):
+        if (
+            not isinstance(entry, (list, tuple))
+            or len(entry) != 2
+            or isinstance(entry[0], bool)
+            or not isinstance(entry[0], int)
+            or not isinstance(entry[1], list)
+        ):
+            raise bad_request(
+                "invalid_request",
+                f"{key!r} entry {i} must be a [step, cells] pair",
+            )
+    return raw
+
+
 def parse_submit(payload) -> SubmitSpec:
     """Request JSON -> :class:`SubmitSpec`; raises :class:`ApiError` (400s)."""
     if not isinstance(payload, dict):
@@ -328,6 +365,11 @@ def parse_submit(payload) -> SubmitSpec:
         _require_int(payload, "start_step") if "start_step" in payload else 0
     )
     trace_id = parse_trace_id(payload.get("trace_id"))
+    edits = _parse_edit_log_field(payload, "edits")
+    scheduled_edits = _parse_edit_log_field(payload, "scheduled_edits")
+    stream_seq = (
+        _require_int(payload, "stream_seq") if "stream_seq" in payload else 0
+    )
 
     if "resume_b64" in payload:
         # failover resume: byte-exact contract-codec board + the absolute
@@ -343,6 +385,9 @@ def parse_submit(payload) -> SubmitSpec:
             temperature=temperature,
             start_step=start_step,
             trace_id=trace_id,
+            edits=edits,
+            scheduled_edits=scheduled_edits,
+            stream_seq=stream_seq,
         )
 
     if "board" in payload:
@@ -357,6 +402,9 @@ def parse_submit(payload) -> SubmitSpec:
             temperature=temperature,
             start_step=start_step,
             trace_id=trace_id,
+            edits=edits,
+            scheduled_edits=scheduled_edits,
+            stream_seq=stream_seq,
         )
 
     # seeded geometry: the self-contained demo path (run --size over HTTP);
@@ -419,6 +467,9 @@ def parse_submit(payload) -> SubmitSpec:
         temperature=temperature,
         start_step=start_step,
         trace_id=trace_id,
+        edits=edits,
+        scheduled_edits=scheduled_edits,
+        stream_seq=stream_seq,
     )
 
 
@@ -458,6 +509,11 @@ def render_view(view: SessionView) -> dict:
     # the session carries one, so a client report names the exact trace
     if view.trace_id is not None:
         out["trace_id"] = view.trace_id
+    # steering provenance (docs/STREAMING.md): the count of recorded
+    # cell edits — present only when the session was steered, so
+    # untouched sessions keep their exact prior response shape
+    if view.edits:
+        out["edits"] = view.edits
     return out
 
 

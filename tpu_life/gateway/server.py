@@ -53,6 +53,10 @@ from tpu_life.version import __version__
 ROUTE_SESSIONS = "/v1/sessions"
 ROUTE_SESSION = "/v1/sessions/{sid}"
 ROUTE_RESULT = "/v1/sessions/{sid}/result"
+#: Live-session verbs (docs/STREAMING.md): the chunked ndjson delta
+#: stream and the mid-run cell-edit steering verb.
+ROUTE_STREAM = "/v1/sessions/{sid}/stream"
+ROUTE_CELLS = "/v1/sessions/{sid}/cells"
 #: The trace drain verb (docs/OBSERVABILITY.md "Distributed tracing"):
 #: each GET takes (and clears) the worker's buffered span + flight rings.
 ROUTE_TRACE = "/v1/debug/trace"
@@ -385,6 +389,9 @@ class _Handler(JsonHandler):
     def do_DELETE(self):  # noqa: N802
         self._dispatch("DELETE")
 
+    def do_PATCH(self):  # noqa: N802
+        self._dispatch("PATCH")
+
     def _dispatch(self, method: str) -> None:
         parts = urlsplit(self.path)
         path = parts.path.rstrip("/") or "/"
@@ -457,6 +464,25 @@ class _Handler(JsonHandler):
                     raise gw_errors.method_not_allowed(method, path)
                 fmt = parse_qs(query).get("format", ["rle"])[0]
                 return ROUTE_RESULT, self._result, {"sid": sid, "fmt": fmt}
+            if tail == "stream":
+                if method != "GET":
+                    raise gw_errors.method_not_allowed(method, path)
+                raw = parse_qs(query).get("cursor", ["0"])[0]
+                try:
+                    cursor = int(raw)
+                except ValueError:
+                    raise gw_errors.bad_request(
+                        "invalid_request", f"bad cursor {raw!r}"
+                    ) from None
+                if cursor < 0:
+                    raise gw_errors.bad_request(
+                        "invalid_request", "'cursor' must be >= 0"
+                    )
+                return ROUTE_STREAM, self._stream, {"sid": sid, "cursor": cursor}
+            if tail == "cells":
+                if method != "PATCH":
+                    raise gw_errors.method_not_allowed(method, path)
+                return ROUTE_CELLS, self._edit_cells, {"sid": sid}
         raise gw_errors.not_found(f"no route for {path}")
 
     # -- handlers (each returns the status it sent) ------------------------
@@ -567,6 +593,9 @@ class _Handler(JsonHandler):
                 temperature=spec.temperature,
                 start_step=spec.start_step,
                 trace_id=trace_id,
+                edits=spec.edits,
+                scheduled_edits=spec.scheduled_edits,
+                stream_seq=spec.stream_seq,
             )
         except Exception as e:  # typed serve errors -> typed HTTP
             raise gw_errors.from_serve_error(e) from e
@@ -605,6 +634,80 @@ class _Handler(JsonHandler):
         body = protocol.render_result(board, fmt, view.rule)
         body["session"] = sid
         self._send_json(200, body)
+        return 200
+
+    def _stream(self, sid: str, cursor: int) -> int:
+        """``GET /v1/sessions/{sid}/stream`` — the chunked ndjson delta
+        stream (docs/STREAMING.md).  Subscribe is the admission point
+        (404 unknown, 503 when the governor refuses the watcher
+        buffer); after the 200 header the connection belongs to the
+        frame grammar until ``end`` (or a ``stream.reset`` chaos drop).
+        The handler thread only ever blocks on the hub's condition —
+        never on the service lock — so a slow reader cannot stall the
+        pump."""
+        svc = self.gw.service
+        try:
+            svc.stream_subscribe(sid, cursor=cursor)
+        except Exception as e:
+            raise gw_errors.from_serve_error(e) from e
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            # chunkless streaming: no Content-Length, so the connection
+            # cannot be reused — say so and mean it
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            while True:
+                frames, cursor, eof = svc.stream_read(sid, cursor, timeout=0.25)
+                for frame in frames:
+                    line = (json.dumps(frame) + "\n").encode()
+                    if chaos.decide("stream.reset") is not None:
+                        # mid-FRAME connection drop: half a line, then a
+                        # hard close — the client's resync path, not its
+                        # happy path, is what this exercises
+                        chaos.record_fire("stream.reset", "reset")
+                        self.wfile.write(line[: max(1, len(line) // 2)])
+                        self.wfile.flush()
+                        raise BrokenPipeError("chaos: stream.reset")
+                    self.wfile.write(line)
+                if frames:
+                    self.wfile.flush()
+                if eof:
+                    break
+                if not frames and self.gw.drained:
+                    # the pump exited (drain or crash): no frame will
+                    # ever arrive again — release the watcher instead of
+                    # spinning on an empty ring
+                    break
+            return 200
+        finally:
+            svc.stream_unsubscribe(sid)
+
+    def _edit_cells(self, sid: str) -> int:
+        """``PATCH /v1/sessions/{sid}/cells`` — mid-run steering
+        (docs/STREAMING.md): a validated cell mask applied between
+        chunks via the freeze-mask seam and recorded in the session's
+        edit log."""
+        gw = self.gw
+        svc = gw.service
+        body = self._read_body()
+        if not isinstance(body, dict):
+            raise gw_errors.bad_request(
+                "invalid_request", "request body must be a JSON object"
+            )
+        cells = body.get("cells")
+        if not isinstance(cells, list):
+            raise gw_errors.bad_request(
+                "invalid_request",
+                "'cells' must be a list of [row, col, value] triples",
+            )
+        try:
+            view = svc.edit_cells(sid, cells)
+        except Exception as e:
+            raise gw_errors.from_serve_error(e) from e
+        gw.wake()  # the pump may be napping — the edit needs a round
+        self._send_json(200, protocol.render_view(view))
         return 200
 
     def _cancel(self, sid: str) -> int:

@@ -171,6 +171,20 @@ def _serve_summary(rounds: list[dict]) -> dict:
         for r in rounds:
             stencil_keys.update(r.get("stencil_keys") or {})
         out["stencil_keys"] = stencil_keys
+    # the live-session stamps (ISSUE 16): frames/gaps are cumulative
+    # counters (max = the final reading, robust to a tail round that
+    # dropped the gated stamp), watchers is a gauge (max = the peak) —
+    # only when the sink carries them, so unstreamed runs stay byte-stable
+    if any("stream_frames_total" in r for r in rounds):
+        out["stream_frames_total"] = max(
+            r.get("stream_frames_total", 0) for r in rounds
+        )
+        out["stream_frame_gaps_total"] = max(
+            r.get("stream_frame_gaps_total", 0) for r in rounds
+        )
+        out["stream_watchers"] = max(
+            r.get("stream_watchers", 0) for r in rounds
+        )
     return out
 
 
@@ -260,6 +274,21 @@ def _merge_serve(per_run: dict) -> dict:
         for s in summaries:
             stencil_keys.update(s.get("stencil_keys") or {})
         merged["stencil_keys"] = stencil_keys
+    # streaming merges like the counts: frames and gaps sum across the
+    # fleet's workers, watcher peaks sum too (concurrent workers each
+    # held that many watchers at once)
+    frames = [
+        s["stream_frames_total"] for s in summaries
+        if "stream_frames_total" in s
+    ]
+    if frames:
+        merged["stream_frames_total"] = sum(frames)
+        merged["stream_frame_gaps_total"] = sum(
+            s.get("stream_frame_gaps_total", 0) for s in summaries
+        )
+        merged["stream_watchers"] = sum(
+            s.get("stream_watchers", 0) for s in summaries
+        )
     return merged
 
 
@@ -372,6 +401,8 @@ def summarize(records: list[dict]) -> dict:
         for family, out_key in (
             ("serve_engine_recoveries_total", "engine_recoveries_by_outcome"),
             ("serve_admission_rejected_total", "admission_rejected_by_reason"),
+            # the fan-out tier's typed sheds (ISSUE 16), by reason
+            ("watcher_shed_total", "watcher_shed_by_reason"),
         ):
             by_label: dict = {}
             for (name, labels_id, _), v in counters.items():
@@ -487,6 +518,18 @@ def render(summary: dict) -> str:
                 for k, v in sorted(serve["admission_rejected_by_reason"].items())
             )
             lines.append(f"  admission_rejected: {detail}")
+        if "stream_frames_total" in serve:
+            lines.append(
+                f"  stream_frames={_fmt(serve['stream_frames_total'])}  "
+                f"frame_gaps={_fmt(serve.get('stream_frame_gaps_total'))}  "
+                f"stream_watchers={_fmt(serve.get('stream_watchers'))}"
+            )
+        if "watcher_shed_by_reason" in serve:
+            detail = " ".join(
+                f"{k}={_fmt(v)}"
+                for k, v in sorted(serve["watcher_shed_by_reason"].items())
+            )
+            lines.append(f"  watcher_shed: {detail}")
         if "memory_budget_bytes" in serve:
             lines.append(
                 f"  memory_budget_bytes={_fmt(serve['memory_budget_bytes'])}"

@@ -50,6 +50,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from tpu_life import obs
 from tpu_life.runtime import recovery
 from tpu_life.runtime.metrics import log
@@ -190,12 +192,114 @@ class Scheduler:
                 engine.release(slot)
         self.deferred.clear()
 
+    # -- mid-run steering (docs/STREAMING.md "Edits") -----------------------
+    def _load_budget(self, s: Session) -> int:
+        """The step budget a slot load may carry: the session's remaining
+        steps, capped at the next scheduled edit's boundary so the slot
+        FREEZES exactly there (``remaining == 0`` is the engines' own
+        freeze mask) whatever the chunk cadence — the seam a resumed
+        edit log re-applies through at bit-exact positions."""
+        budget = s.steps_remaining
+        if s.scheduled_edits:
+            abs_done = s.start_step + s.steps_done
+            budget = min(budget, max(0, s.scheduled_edits[0][0] - abs_done))
+        return budget
+
+    def apply_edits(self, stats: RoundStats | None = None) -> int:
+        """Drain verb-queued cell edits (``pending_edits``) and due
+        scheduled edits into their sessions' slots, between chunks.
+
+        Runs at the top of both round shapes, before any dispatch: for
+        each key with an edit due, the in-flight chunk (if any) is
+        collected — an edit is a sync point for ITS key only; other
+        keys' pipelines never notice — then the slot's materialized
+        board is peeked, mutated, and reloaded at the same absolute
+        position (the freeze-mask seam: collect -> peek -> mutate ->
+        load).  Scheduled edits log at their ORIGINAL recorded step,
+        verb edits at the current materialized step; both land in
+        ``s.edits``, the log the spill manifest persists and the replay
+        oracle re-executes.  Returns how many log entries were applied.
+        """
+        stats = stats if stats is not None else RoundStats()
+        applied = 0
+        for key in list(self.running):
+            slots = self.running.get(key)
+            engine = self.engines.get(key)
+            if not slots or engine is None:
+                continue
+            due = [
+                (slot, s)
+                for slot, s in list(slots.items())
+                if s.pending_edits
+                or (
+                    s.scheduled_edits
+                    and s.scheduled_edits[0][0] <= s.start_step + s.steps_done
+                )
+            ]
+            if not due:
+                continue
+            if engine.inflight:
+                try:
+                    engine.collect_chunk()
+                except recovery.RECOVERABLE as e:
+                    # the chunk under the edit died: recover the key in
+                    # place; the edits stay pending and apply next round
+                    # against the rebuilt engine's replayed boards
+                    self.recover_engine(key, e, stats)
+                    continue
+            for slot, s in due:
+                if slots.get(slot) is not s:
+                    continue  # evicted/cancelled while collecting
+                try:
+                    board, lag = engine.peek_slot(slot)
+                except recovery.RECOVERABLE as e:
+                    self.recover_engine(key, e, stats)
+                    break
+                # lag is 0 after the collect above; rewind defensively so
+                # the log step always names a materialized board
+                s.steps_done -= lag
+                board = np.array(board, copy=True)
+                abs_done = s.start_step + s.steps_done
+                entries = []
+                while (
+                    s.scheduled_edits
+                    and s.scheduled_edits[0][0] <= abs_done
+                ):
+                    entries.append(s.scheduled_edits.pop(0))
+                entries.extend((abs_done, cells) for cells in s.pending_edits)
+                s.pending_edits.clear()
+                hook = getattr(self.observer, "session_edited", None)
+                for step, cells in entries:
+                    for r, c, v in cells:
+                        board[r, c] = v
+                    s.edits.append((step, cells))
+                    applied += 1
+                    if hook is not None:
+                        hook(s, step, cells)
+                try:
+                    engine.load(
+                        slot,
+                        board,
+                        self._load_budget(s),
+                        seed=s.seed,
+                        temperature=s.temperature,
+                        start_step=abs_done,
+                    )
+                except recovery.RECOVERABLE as e:
+                    del slots[slot]
+                    engine.release(slot)
+                    s.fail(f"edit reload failed: {type(e).__name__}: {e}")
+                    self._notify_finished(s)
+                    stats.failed += 1
+        return applied
+
     # -- one scheduling round ---------------------------------------------
     def round(self, keyer) -> RoundStats:
         """Expire deadlines, admit from the queue, advance every engine one
         chunk, retire finished slots.  ``keyer(session) -> CompileKey``.
         """
         stats = RoundStats()
+        self.apply_edits(stats)
         now = self.clock()
         with obs.span("serve.admit"):
             self._expire(now, stats)
@@ -284,7 +388,7 @@ class Scheduler:
                 engine.load(
                     slot,
                     s.board,
-                    s.steps_remaining,
+                    self._load_budget(s),
                     seed=s.seed,
                     temperature=s.temperature,
                     start_step=s.start_step + s.steps_done,
@@ -468,7 +572,7 @@ class Scheduler:
                 new_engine.load(
                     slot,
                     board,
-                    s.steps_remaining,
+                    self._load_budget(s),
                     seed=s.seed,
                     temperature=s.temperature,
                     start_step=s.start_step + s.steps_done,
@@ -625,6 +729,7 @@ class Scheduler:
         in ``_fresh``; they retire at the NEXT round's end, once their
         chunk has settled behind its successor."""
         self._process_deferred()
+        self.apply_edits(stats)
         now = self.clock()
         with obs.span("serve.admit"):
             self._expire(now, stats)

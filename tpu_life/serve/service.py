@@ -74,6 +74,13 @@ from tpu_life.runtime.profiling import maybe_profile
 from tpu_life.serve.engine import CompileKey, compile_key_for
 from tpu_life.serve.errors import Draining, InsufficientMemory, QueueFull
 from tpu_life.serve.scheduler import RoundStats, Scheduler
+from tpu_life.serve.stream import (
+    StreamHub,
+    estimate_stream_bytes,
+    parse_edit_log,
+    render_edit_log,
+    validate_cells,
+)
 from tpu_life.serve.sessions import (
     SessionState,
     SessionStore,
@@ -337,6 +344,38 @@ class SimulationService:
         )
         self._c_trace_dropped.labels()
         self._trace_dropped_seen = 0
+        # the live-stream tier (docs/STREAMING.md): per-session delta
+        # rings between the pump's retire phase and the watcher sockets.
+        # The hub has its OWN lock — the pump appends bounded frames
+        # under it, handler threads block in read() on it, and neither
+        # ever holds the service lock across a socket
+        self.hub = StreamHub()
+        # governor charge per streamed sid (docs/SERVING.md "Resource
+        # governance"): the first watcher of a session reserves its delta
+        # ring's estimated bytes against the admission budget
+        self._stream_charged: dict[str, int] = {}
+        self._g_stream_watchers = self.registry.gauge(
+            "stream_watchers", "live stream subscriptions on this worker"
+        )
+        self._c_stream_frames = self.registry.counter(
+            "stream_frames_total", "delta-stream frames produced"
+        )
+        self._c_stream_gaps = self.registry.counter(
+            "stream_frame_gaps_total",
+            "frames dropped from bounded delta rings (slow readers resync "
+            "through a typed frame_gap marker; the pump never stalls)",
+        )
+        for fam in (
+            self._g_stream_watchers,
+            self._c_stream_frames,
+            self._c_stream_gaps,
+        ):
+            fam.labels()
+        # mirror floors: the hub's plain-int totals folded into the
+        # registry as monotone deltas each round (the trace_dropped
+        # pattern)
+        self._stream_frames_seen = 0
+        self._stream_gaps_seen = 0
         self._g_mem_budget.set(float(self._memory_budget or 0))
         # key buckets whose estimated-bytes gauge was last set (released
         # engines' buckets zero out in the next round's sweep)
@@ -450,8 +489,22 @@ class SimulationService:
         temperature: float | None = None,
         start_step: int = 0,
         trace_id: str | None = None,
+        edits=None,
+        scheduled_edits=None,
+        stream_seq: int = 0,
     ) -> str:
         """Admit one simulation request; returns its session id.
+
+        ``edits`` / ``scheduled_edits`` / ``stream_seq`` are the steered-
+        session resume fields (docs/STREAMING.md): ``edits`` is a prior
+        life's APPLIED edit log (``[[step, [[r, c, v], ...]], ...]``,
+        every step <= start_step — already baked into ``board``, carried
+        for provenance), ``scheduled_edits`` its not-yet-applied tail
+        (start_step <= step < start_step + total steps — re-applied at
+        exactly the recorded steps during re-execution, which is what
+        extends the bit-reproducibility contract to edited sessions),
+        and ``stream_seq`` the frames a prior life already streamed, so
+        the survivor's hub continues the same gapless sequence space.
 
         ``trace_id`` is the distributed-trace context
         (docs/OBSERVABILITY.md "Distributed tracing"): the id naming this
@@ -551,6 +604,32 @@ class SimulationService:
         start_step = int(start_step)
         if start_step < 0:
             raise ValueError(f"start_step must be >= 0, got {start_step}")
+        stream_seq = int(stream_seq)
+        if stream_seq < 0:
+            raise ValueError(f"stream_seq must be >= 0, got {stream_seq}")
+        # the steered-session resume logs: validated against THIS board's
+        # geometry and rule before anything is stored
+        edit_history = []
+        for step, cells in parse_edit_log(edits if edits is not None else []):
+            if step > start_step:
+                raise ValueError(
+                    f"applied edit at step {step} is past start_step "
+                    f"{start_step}; unapplied edits belong in "
+                    f"'scheduled_edits'"
+                )
+            edit_history.append((step, validate_cells(cells, board.shape, rule)))
+        edit_scheduled = []
+        for step, cells in parse_edit_log(
+            scheduled_edits if scheduled_edits is not None else []
+        ):
+            if not start_step <= step < start_step + steps:
+                raise ValueError(
+                    f"scheduled edit at step {step} is outside this "
+                    f"session's run [{start_step}, {start_step + steps})"
+                )
+            edit_scheduled.append(
+                (step, validate_cells(cells, board.shape, rule))
+            )
         # admission is a read-modify-write on the queue: everything from the
         # backpressure check to the enqueue happens under the lock, so two
         # racing submits can neither both squeeze past a full queue nor
@@ -636,6 +715,9 @@ class SimulationService:
                 temperature=None if temperature is None else float(temperature),
                 start_step=start_step,
                 trace_id=trace_id,
+                edits=edit_history,
+                scheduled_edits=edit_scheduled,
+                stream_seq=stream_seq,
             )
             # the admission flight event (docs/OBSERVABILITY.md): one
             # ring append per accepted session — what the doctor joins
@@ -754,6 +836,153 @@ class SimulationService:
                 self.session_finished(s, max(0.0, self.clock() - s.submitted_at))
             return True
 
+    # -- mid-run steering + the streaming result channel --------------------
+    def edit_cells(self, sid: str, cells) -> SessionView:
+        """Apply a validated cell-mask to a live session between chunks
+        (docs/STREAMING.md "Edits"): the PATCH verb behind
+        ``/v1/sessions/{sid}/cells``.
+
+        A QUEUED session's board is mutated in place (logged at
+        ``start_step`` — the edit is part of the board the run starts
+        from); a RUNNING session's edit is queued on the session and
+        drained by the scheduler at the next round boundary through the
+        freeze-mask seam (collect -> peek -> mutate -> reload), logged at
+        the materialized step it lands on.  Every applied edit enters the
+        session's edit log, which spills with the manifest — so the
+        bit-reproducibility contract extends to steered sessions.  Typed
+        ``ValueError`` on a terminal session, a session whose compute
+        already finished, or a malformed mask.
+        """
+        with self._lock:
+            s = self.store.get(sid)
+            if s.state in TERMINAL:
+                raise ValueError(
+                    f"session {sid} is {s.state.value}; cannot edit a "
+                    f"terminal session"
+                )
+            if s.state is SessionState.RUNNING and s.steps_remaining == 0:
+                raise ValueError(
+                    f"session {sid} has finished computing (awaiting "
+                    f"retirement); cannot edit"
+                )
+            validated = validate_cells(cells, s.board.shape, s.rule)
+            if s.state is SessionState.QUEUED:
+                for r, c, v in validated:
+                    s.board[r, c] = v
+                s.edits.append((s.start_step, validated))
+                with obs.activate(self._tracer):
+                    self.session_edited(s, s.start_step, validated)
+            else:
+                s.pending_edits.append(validated)
+            return self.store.view(sid)
+
+    def stream_subscribe(self, sid: str, cursor: int = 0) -> None:
+        """Register one watcher of ``sid``'s delta stream.
+
+        The FIRST watcher of a session charges the stream's estimated
+        ring bytes against the memory budget (docs/SERVING.md "Resource
+        governance") — transient :class:`InsufficientMemory` when it
+        does not fit next to the reserved engines, so a watcher storm
+        backpressures typed instead of growing the worker until the OOM
+        killer finds it.  Subscribing to an already-terminal session
+        still yields a stream: one final keyframe plus the ``end`` frame.
+        """
+        with self._lock:
+            s = self.store.get(sid)  # UnknownSession -> 404 upstream
+            if sid not in self._stream_charged:
+                est = estimate_stream_bytes(
+                    s.board.shape, str(s.board.dtype), self.hub.ring_frames
+                )
+                if self._memory_budget is not None:
+                    reserved = sum(
+                        self._governor.reserved_bytes(
+                            self.scheduler.engines,
+                            (self._keyer()(q) for q in self.scheduler.queue),
+                            self.config.capacity,
+                            mc_packed=self.config.mc_packed,
+                        ).values()
+                    )
+                    charged = sum(self._stream_charged.values())
+                    if reserved + charged + est > self._memory_budget:
+                        self._c_rejections.inc()
+                        self._c_adm_rejected.labels(
+                            reason="watcher_buffer"
+                        ).inc()
+                        obs.flight.record(
+                            "rejection",
+                            reason="watcher_buffer",
+                            sid=sid,
+                            trace_id=s.trace_id,
+                        )
+                        raise InsufficientMemory(
+                            f"watcher buffer for {sid} needs ~{est} bytes "
+                            f"next to {reserved + charged} reserved; budget "
+                            f"is {self._memory_budget}",
+                            transient=True,
+                            estimated_bytes=est,
+                            budget_bytes=self._memory_budget,
+                        )
+                self._stream_charged[sid] = est
+            self.hub.subscribe(sid, start_seq=s.stream_seq)
+            if s.state in TERMINAL:
+                step = s.start_step + s.steps_done
+                if s.state is SessionState.DONE and s.result is not None:
+                    self.hub.produce(
+                        sid, s.result, step, executor=self.config.backend
+                    )
+                self.hub.finish(sid, s.state.value, step)
+
+    def stream_read(
+        self, sid: str, cursor: int, timeout: float | None = 0.25
+    ) -> tuple[list, int, bool]:
+        """Blocking frame read — NO service lock held (the hub has its
+        own condition), so a watcher waiting on frames never delays
+        submit/poll/cancel or the pump.  Returns
+        ``(frames, next_cursor, eof)``."""
+        return self.hub.read(sid, cursor, timeout)
+
+    def stream_unsubscribe(self, sid: str) -> None:
+        with self._lock:
+            if self.hub.unsubscribe(sid):
+                # last watcher gone: the ring state was discarded, so the
+                # governor charge is released with it
+                self._stream_charged.pop(sid, None)
+
+    def _produce_frames(self) -> None:
+        """The pump's frame tap (locked, both pump shapes): one hub
+        append per watched session per round, read from each engine's
+        double buffer (``peek_slot`` — the materialized board at
+        ``start_step + steps_done - lag``; never waits on the in-flight
+        chunk).  Queued watched sessions get their initial keyframe from
+        the submitted board, so a watcher sees the start state while the
+        session still waits for a slot."""
+        if not self.hub.active():
+            return
+        sched = self.scheduler
+        for key, slots in sched.running.items():
+            engine = sched.engines.get(key)
+            if engine is None:
+                continue
+            label = f"{key.backend}:{type(engine).__name__}"
+            for slot, s in list(slots.items()):
+                if not self.hub.wants(s.sid):
+                    continue
+                try:
+                    board, lag = engine.peek_slot(slot)
+                except recovery.RECOVERABLE:
+                    continue  # the recovery path owns this engine now
+                self.hub.produce(
+                    s.sid,
+                    np.asarray(board),
+                    s.start_step + s.steps_done - lag,
+                    executor=label,
+                )
+        for s in sched.queue:
+            if self.hub.wants(s.sid):
+                self.hub.produce(
+                    s.sid, s.board, s.start_step, executor="queued"
+                )
+
     # -- scheduler telemetry observer ---------------------------------------
     def session_admitted(self, session, wait_s: float) -> None:
         """Scheduler hook: a session got its batch slot after ``wait_s``."""
@@ -773,11 +1002,48 @@ class SimulationService:
             step=session.start_step + session.steps_done,
         )
 
+    def session_edited(self, session, step: int, cells) -> None:
+        """Scheduler hook: an edit-log entry was applied to ``session``
+        at absolute ``step`` (also called directly for QUEUED edits).
+        The stream mirrors it as a metadata frame; the flight ring keeps
+        the steering decision for postmortems."""
+        self.hub.record_edit(session.sid, step, cells)
+        obs.flight.record(
+            "edit",
+            sid=session.sid,
+            trace_id=session.trace_id,
+            step=step,
+            cells=len(cells),
+        )
+        obs.instant(
+            "serve.session.edit",
+            sid=session.sid,
+            trace_id=session.trace_id,
+            step=step,
+            cells=len(cells),
+        )
+
     def session_finished(self, session, latency_s: float) -> None:
         """Scheduler hook: a session reached a terminal state (done /
         failed / cancelled) ``latency_s`` after submission."""
         self._c_finished.labels(state=session.state.value).inc()
         self._h_latency.observe(latency_s)
+        if self.hub.wants(session.sid):
+            # close the stream: a DONE session's watchers get the final
+            # board (keyframe) then the terminal frame; failed/cancelled
+            # get the terminal frame alone — every read drains to EOF
+            step = session.start_step + session.steps_done
+            if (
+                session.state is SessionState.DONE
+                and session.result is not None
+            ):
+                self.hub.produce(
+                    session.sid,
+                    session.result,
+                    step,
+                    executor=self.config.backend,
+                )
+            self.hub.finish(session.sid, session.state.value, step)
         if self._spill is not None:
             # a terminal session must never resume: its spill dies with it
             self._spill.delete(session.sid)
@@ -908,6 +1174,7 @@ class SimulationService:
             "serve.round", round=self._rounds, pump="sync"
         ):
             stats = self.scheduler.round(self._keyer())
+            self._produce_frames()
             plan = self._spill_plan()
             if plan:
                 # the sync pump is fully settled after round(): every lag
@@ -993,6 +1260,7 @@ class SimulationService:
                 for key, exc in chunk_faults:
                     self.scheduler.recover_engine(key, exc, stats)
                 self.scheduler.round_end(keyer, stats, rolled)
+                self._produce_frames()
             if spill_plan:
                 self._apply_spill_failures(spill_failures)
                 self._sweep_spills(spill_plan)
@@ -1163,6 +1431,16 @@ class SimulationService:
                 timeout_s = (
                     None if s.deadline is None else max(0.0, s.deadline - now)
                 )
+                # the steered-session manifest fields (docs/STREAMING.md):
+                # the applied edit log (bit-reproducibility provenance),
+                # the not-yet-applied tail a survivor must re-apply at
+                # exactly the recorded steps, and the stream-sequence
+                # floor a reconnected watcher stays gapless under.  Both
+                # lists are pump-thread-private (apply_edits mutates them
+                # in the locked begin phase, this pass runs on the same
+                # thread), so reading them unlocked is safe.
+                edits = render_edit_log(s.edits) or None
+                scheduled = render_edit_log(s.scheduled_edits) or None
                 try:
                     self._spill.save(
                         s.sid,
@@ -1174,6 +1452,11 @@ class SimulationService:
                         temperature=s.temperature,
                         timeout_s=timeout_s,
                         trace_id=s.trace_id,
+                        edits=edits,
+                        scheduled_edits=scheduled,
+                        stream_seq=self.hub.seq_snapshot(
+                            s.sid, default=s.stream_seq
+                        ),
                     )
                     # the per-session durability marker: WHICH recovery
                     # point this trace now has (instant() is a no-op
@@ -1267,6 +1550,18 @@ class SimulationService:
             if dropped > self._trace_dropped_seen:
                 self._c_trace_dropped.inc(dropped - self._trace_dropped_seen)
                 self._trace_dropped_seen = dropped
+        # mirror the stream hub's plain-int totals into the registry as
+        # monotone deltas (same pattern as the trace-drop fold above)
+        frames_now = self.hub.frames_total
+        if frames_now > self._stream_frames_seen:
+            self._c_stream_frames.inc(frames_now - self._stream_frames_seen)
+            self._stream_frames_seen = frames_now
+        gaps_now = self.hub.gaps_total
+        if gaps_now > self._stream_gaps_seen:
+            self._c_stream_gaps.inc(gaps_now - self._stream_gaps_seen)
+            self._stream_gaps_seen = gaps_now
+        stream_watchers = self.hub.watcher_count()
+        self._g_stream_watchers.set(float(stream_watchers))
         for key, count in self.scheduler.compile_counts().items():
             self._g_compiles.labels(compile_key=_key_bucket(key)).set(count)
         # the governor's footprint view: what each live engine is charged
@@ -1338,6 +1633,18 @@ class SimulationService:
                         "spill_errors": self._c_spill_errors.value,
                     }
                     if self._spill is not None
+                    else {}
+                ),
+                # the stream stamps (docs/STREAMING.md), present only
+                # once the stream tier has ever been touched — records of
+                # never-streamed services keep their pre-stream shape
+                **(
+                    {
+                        "stream_watchers": stream_watchers,
+                        "stream_frames_total": self._stream_frames_seen,
+                        "stream_frame_gaps_total": self._stream_gaps_seen,
+                    }
+                    if stream_watchers or self._stream_frames_seen
                     else {}
                 ),
                 # live distribution snapshots (null until first sample):
@@ -1461,6 +1768,9 @@ class SimulationService:
             ),
             "snapshot_seconds": self._snapshot_s_total,
             "spill_errors": self._c_spill_errors.value,
+            "stream_watchers": int(self._g_stream_watchers.value),
+            "stream_frames_total": int(self._c_stream_frames.value),
+            "stream_frame_gaps_total": int(self._c_stream_gaps.value),
             "queue_wait_p50": self._h_queue_wait.quantile(0.5),
             "queue_wait_p95": self._h_queue_wait.quantile(0.95),
             "queue_wait_p99": self._h_queue_wait.quantile(0.99),

@@ -104,6 +104,22 @@ class Session:
     # resumed session continues the same trace on its survivor.  None
     # for library callers that never asked for one.
     trace_id: str | None = None
+    # mid-run steering (docs/STREAMING.md "Edits"): ``pending_edits``
+    # holds validated-but-unapplied cell lists from PATCH verbs, drained
+    # at the next round boundary through the freeze-mask seam;
+    # ``edits`` is the applied log — [(absolute_step, [(r, c, v), ...])]
+    # in application order — that spills with the manifest so the
+    # bit-reproducibility contract extends to steered sessions (session
+    # bytes == a solo run replaying this log); ``scheduled_edits`` is a
+    # resumed session's future portion of a prior life's log, re-applied
+    # at exactly the recorded steps during re-execution.
+    pending_edits: list = field(default_factory=list)
+    edits: list = field(default_factory=list)
+    scheduled_edits: list = field(default_factory=list)
+    # the stream sequence floor: frames a previous life of this session
+    # already produced (from the spill manifest), so the survivor's hub
+    # continues the same gapless sequence space
+    stream_seq: int = 0
 
     @property
     def steps_remaining(self) -> int:
@@ -153,6 +169,10 @@ class SessionView:
     # the distributed-trace id (None when the session carries no trace
     # context) — echoed on the wire so clients and the doctor join on it
     trace_id: str | None = None
+    # steering attribution: how many edit-log entries this session has
+    # accumulated (0 for never-steered sessions — the wire render gates
+    # on it so unsteered responses stay byte-stable)
+    edits: int = 0
 
     @property
     def finished(self) -> bool:
@@ -203,6 +223,7 @@ class SessionStore:
             lanes=s.lanes,
             degraded_reason=s.degraded_reason,
             trace_id=s.trace_id,
+            edits=len(s.edits) + len(s.scheduled_edits),
         )
 
     def result(self, sid: str) -> np.ndarray:

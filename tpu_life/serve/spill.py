@@ -99,6 +99,9 @@ class SpillBackend:
         temperature: float | None,
         timeout_s: float | None,
         trace_id: str | None = None,
+        edits: list | None = None,
+        scheduled_edits: list | None = None,
+        stream_seq: int = 0,
     ) -> bool:
         raise NotImplementedError
 
@@ -156,6 +159,16 @@ class SpillRecord:
     #: here is what lets a migrated resume CONTINUE the dead worker's
     #: trace instead of starting a fresh one — None for pre-trace spills
     trace_id: str | None = None
+    #: the steered-session fields (docs/STREAMING.md): the applied edit
+    #: log (steps <= ``step``; already baked into ``board`` — carried for
+    #: provenance), the not-yet-applied tail the survivor must re-apply
+    #: at exactly the recorded steps, and the stream-sequence floor a
+    #: reconnected watcher's gapless numbering continues from.  None /
+    #: None / 0 for never-steered, never-watched sessions (the manifest
+    #: omits the keys entirely, keeping pre-stream manifests byte-stable).
+    edits: list | None = None
+    scheduled_edits: list | None = None
+    stream_seq: int = 0
 
     @property
     def remaining(self) -> int:
@@ -177,6 +190,9 @@ class SpillStore(SpillBackend):
         # per-sid steps this store wrote (prune only ever touches its own
         # writes — the checkpoint retention contract)
         self._written: dict[str, list[int]] = {}
+        # per-sid edit-log length at the last save: a same-step save with
+        # a grown log must not dedup away (the queued-edit case)
+        self._edit_counts: dict[str, int] = {}
 
     def save(
         self,
@@ -190,12 +206,23 @@ class SpillStore(SpillBackend):
         temperature: float | None,
         timeout_s: float | None,
         trace_id: str | None = None,
+        edits: list | None = None,
+        scheduled_edits: list | None = None,
+        stream_seq: int = 0,
     ) -> bool:
         """Spill one session's state; returns False when ``step`` is
         already the newest spilled step (a queued or retire-lagged
-        session — rewriting identical bytes would be pure churn)."""
+        session — rewriting identical bytes would be pure churn).  A
+        same-step save with a GROWN edit log still writes: a queued
+        session steered before admission changed state the manifest must
+        carry, even though its step did not move."""
         written = self._written.setdefault(sid, [])
-        if written and written[-1] == step:
+        edit_count = len(edits or []) + len(scheduled_edits or [])
+        if (
+            written
+            and written[-1] == step
+            and self._edit_counts.get(sid, 0) == edit_count
+        ):
             return False
         d = self.root / sid
         # chaos seam (docs/CHAOS.md): a disk-full / dead-disk write fails
@@ -216,9 +243,19 @@ class SpillStore(SpillBackend):
             "height": int(board.shape[0]),
             "width": int(board.shape[1]),
         }
+        # the steered-session keys appear ONLY when set: a never-steered,
+        # never-watched session's manifest stays byte-stable across PRs
+        if edits:
+            manifest["edits"] = edits
+        if scheduled_edits:
+            manifest["scheduled_edits"] = scheduled_edits
+        if stream_seq:
+            manifest["stream_seq"] = int(stream_seq)
         with atomic_publish(d / MANIFEST) as tmp:
             tmp.write_text(json.dumps(manifest))
-        written.append(step)
+        if not written or written[-1] != step:
+            written.append(step)
+        self._edit_counts[sid] = edit_count
         self._written[sid] = prune_snapshots(d, KEEP_SNAPSHOTS, written)
         return True
 
@@ -244,6 +281,7 @@ class SpillStore(SpillBackend):
         broken even the marker write may fail, which degrades the reason
         to ``never_snapshotted`` — still a truthful 410."""
         self._written.pop(sid, None)
+        self._edit_counts.pop(sid, None)
         d = self.root / sid
         try:
             if d.exists():
@@ -261,6 +299,7 @@ class SpillStore(SpillBackend):
     def delete(self, sid: str) -> None:
         """Drop a session's spill (terminal transition: done / failed /
         cancelled) — from here on the session must never resume."""
+        self._edit_counts.pop(sid, None)
         if self._written.pop(sid, None) is not None or (self.root / sid).exists():
             shutil.rmtree(self.root / sid, ignore_errors=True)
 
@@ -344,6 +383,9 @@ def read_spill_sessions(
                 height=height,
                 width=width,
                 trace_id=None if trace_id is None else str(trace_id),
+                edits=meta.get("edits"),
+                scheduled_edits=meta.get("scheduled_edits"),
+                stream_seq=int(meta.get("stream_seq", 0)),
             )
         )
     return records, corrupt, disabled

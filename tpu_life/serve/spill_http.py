@@ -332,6 +332,7 @@ class HttpSpillBackend(SpillBackend):
         self._lock = threading.Lock()
         self._namespace = namespace
         self._written: dict[str, list[int]] = {}
+        self._edit_counts: dict[str, int] = {}
 
     @property
     def namespace(self) -> str:
@@ -351,6 +352,7 @@ class HttpSpillBackend(SpillBackend):
                 return
             self._namespace = namespace
             self._written = {}
+            self._edit_counts = {}
         log.info("spill: rebound to remote namespace %s", namespace)
 
     # -- transport ----------------------------------------------------------
@@ -463,11 +465,18 @@ class HttpSpillBackend(SpillBackend):
         temperature: float | None,
         timeout_s: float | None,
         trace_id: str | None = None,
+        edits: list | None = None,
+        scheduled_edits: list | None = None,
+        stream_seq: int = 0,
     ) -> bool:
+        edit_count = len(edits or []) + len(scheduled_edits or [])
         with self._lock:
             ns = self._namespace
             written = self._written.setdefault(sid, [])
-        if written and written[-1] == step:
+            last_edits = self._edit_counts.get(sid, 0)
+        # a same-step save with a GROWN edit log still writes (the
+        # queued-edit case — the manifest changed, the step did not)
+        if written and written[-1] == step and last_edits == edit_count:
             return False
         payload = encode_board(board)
         self._put(sid, snap_name(step), payload, ns=ns)
@@ -482,8 +491,18 @@ class HttpSpillBackend(SpillBackend):
             "height": int(board.shape[0]),
             "width": int(board.shape[1]),
         }
+        # steered-session keys only when set (byte-stable otherwise)
+        if edits:
+            manifest["edits"] = edits
+        if scheduled_edits:
+            manifest["scheduled_edits"] = scheduled_edits
+        if stream_seq:
+            manifest["stream_seq"] = int(stream_seq)
         self._put(sid, MANIFEST, json.dumps(manifest).encode(), ns=ns)
-        written.append(step)
+        with self._lock:
+            self._edit_counts[sid] = edit_count
+        if not written or written[-1] != step:
+            written.append(step)
         # retention mirrors the local store (newest KEEP_SNAPSHOTS);
         # a failed prune is a leak, not a durability loss — best-effort
         while len(written) > KEEP_SNAPSHOTS:
@@ -500,6 +519,7 @@ class HttpSpillBackend(SpillBackend):
         with self._lock:
             ns = self._namespace
             self._written.pop(sid, None)
+            self._edit_counts.pop(sid, None)
         try:
             # drop the stale snapshots first (bytes we can no longer keep
             # fresh must not masquerade as a recovery point), then publish
@@ -517,6 +537,7 @@ class HttpSpillBackend(SpillBackend):
     def delete(self, sid: str) -> None:
         with self._lock:
             known = self._written.pop(sid, None) is not None
+            self._edit_counts.pop(sid, None)
         if not known:
             return
         try:
@@ -628,6 +649,9 @@ def read_remote_sessions(
                 height=height,
                 width=width,
                 trace_id=None if trace_id is None else str(trace_id),
+                edits=meta.get("edits"),
+                scheduled_edits=meta.get("scheduled_edits"),
+                stream_seq=int(meta.get("stream_seq", 0)),
             )
         )
     return records, corrupt, disabled
