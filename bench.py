@@ -1446,6 +1446,86 @@ def run_conv_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def run_obs_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_obs capture (ISSUE 18): what telemetry time-series
+    sampling costs.  Drives a small serve workload with the snapshot ring
+    enabled, then times isolated ring samples on the live registry — the
+    marginal per-round cost at the worst-case every-round cadence — and
+    measures the /v1/debug/series scrape payload for the run.  A shape
+    check more than a speed contest: the record pins sampling overhead
+    per round and scrape bytes per tick so a regression shows up in the
+    trajectory."""
+    actual, pinned = _pin_and_verify(args, platform)
+
+    from tpu_life.models.patterns import random_board
+    from tpu_life.obs import timeseries
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    n = args.serve_size
+    sessions = args.serve_sessions
+    steps = args.serve_steps
+    svc = SimulationService(
+        ServeConfig(
+            capacity=args.serve_capacity,
+            chunk_steps=args.serve_chunk_steps,
+            max_queue=max(sessions, 1),
+            backend=args.backend,
+            # dense enough that a seconds-long degraded run really samples
+            series_every_s=0.05,
+        )
+    )
+    boards = [
+        random_board(n, n, seed=i) for i in range(min(sessions, 8))
+    ]
+    timeseries.reset_sample_count()
+    elapsed, stats = _drive_serve_mix(
+        svc, boards, args.rule, [steps] * sessions
+    )
+    in_run_samples = timeseries.sample_count()
+    payload = svc.read_series(0)
+    # the scrape tick as the supervisor sees it: the full JSON body for
+    # everything the run accumulated (cursor resets make this the
+    # worst-case first scrape; steady-state ticks carry one snapshot)
+    scrape_bytes = len(json.dumps(payload))
+    snapshots = len(payload["snapshots"])
+    per_snapshot = scrape_bytes / snapshots if snapshots else 0.0
+    # overhead: K isolated samples of the same live registry into a fresh
+    # ring — what every pump round would pay if cadence == every round
+    ring = timeseries.SeriesRing(256)
+    k = 200
+    t0 = time.perf_counter()
+    for _ in range(k):
+        ring.sample(svc.registry)
+    sample_s = (time.perf_counter() - t0) / k
+    rounds = stats["rounds"]
+    return {
+        "metric": "obs_sample_overhead_us",
+        "value": sample_s * 1e6,
+        "unit": "us/sample",
+        "rule": args.rule,
+        "platform": platform,
+        "platform_actual": actual,
+        "platform_pinned": pinned,
+        "backend": args.backend,
+        "size": n,
+        "steps": steps,
+        "sessions": sessions,
+        "done": stats["done"],
+        "failed": stats["failed"],
+        "rounds": rounds,
+        "in_run_samples": in_run_samples,
+        "scrape_bytes_per_tick": scrape_bytes,
+        "scrape_snapshots": snapshots,
+        "scrape_bytes_per_snapshot": per_snapshot,
+        "sample_overhead_us": sample_s * 1e6,
+        "overhead_frac_of_round": (sample_s * rounds / elapsed)
+        if elapsed > 0 and rounds
+        else 0.0,
+        "series_schema": payload["schema"],
+        "degraded": degraded,
+    }
+
+
 def run_bench(args, platform: str, degraded: bool) -> dict:
     actual, pinned = _pin_and_verify(args, platform)
 
@@ -1687,6 +1767,14 @@ def main() -> None:
                    "wedged settle rescued via unready-recycle + "
                    "migration) vs a fault-free twin — emits "
                    "governor_sessions_per_sec")
+    # the BENCH_obs capture (docs/OBSERVABILITY.md "Time series"): what
+    # the telemetry snapshot ring costs — sampling overhead per round and
+    # scrape bytes per /v1/debug/series tick; rides the --serve-* knobs
+    p.add_argument("--obs", action="store_true",
+                   help="observability bench: a small serve workload "
+                   "with the metric time-series ring enabled — emits "
+                   "obs_sample_overhead_us plus scrape_bytes_per_tick "
+                   "(record-shape check, not a speed contest)")
     # the BENCH_cross_host capture (docs/FLEET.md "Cross-host topology"):
     # the two-control-plane drill as one record — reuses the --chaos-*
     # knobs (seed / workers / kills) for its shape
@@ -1836,7 +1924,8 @@ def main() -> None:
     if args.base_steps is None:
         args.base_steps = 100 if on_accel else DEGRADED_BASE_STEPS
     if (
-        not (args.serve or args.serve_pipeline or args.failover or args.fleet)
+        not (args.serve or args.serve_pipeline or args.failover
+             or args.fleet or args.obs)
         and args.steps <= args.base_steps
     ):
         p.error("--steps must be greater than --base-steps (delta timing)")
@@ -1883,8 +1972,8 @@ def main() -> None:
     # The serve bench defaults to the vmapped jax engine on every platform
     # (the batched path is the thing being measured).
     if args.backend is None:
-        if (args.serve or args.serve_pipeline or args.failover
-                or args.fleet or args.mc or args.conv or args.stream):
+        if (args.serve or args.serve_pipeline or args.failover or args.fleet
+                or args.mc or args.conv or args.stream or args.obs):
             # the vmapped/fused single-device XLA path is the thing being
             # measured on both service-shaped benches
             args.backend = "jax"
@@ -1930,6 +2019,8 @@ def main() -> None:
             result = run_cross_host_bench(args, platform, degraded)
         elif args.stream:
             result = run_stream_bench(args, platform, degraded)
+        elif args.obs:
+            result = run_obs_bench(args, platform, degraded)
         elif args.serve:
             result = run_serve_bench(args, platform, degraded)
         elif args.mc:
@@ -1963,10 +2054,13 @@ def main() -> None:
                     cmd += [flag, str(value)]
             if args.no_bitpack:
                 cmd.append("--no-bitpack")
-            if args.serve or args.serve_pipeline or args.failover or args.fleet:
+            if (args.serve or args.serve_pipeline or args.failover
+                    or args.fleet or args.obs):
                 # the retry must measure the same MODE, not fall back to
                 # the kernel bench and mislabel the record
-                if args.failover:
+                if args.obs:
+                    cmd.append("--obs")
+                elif args.failover:
                     cmd += ["--failover", "--failover-spill-every",
                             str(args.failover_spill_every)]
                 elif args.fleet:
@@ -2029,6 +2123,9 @@ def main() -> None:
         elif args.fleet:
             metric, unit = "fleet_cells_per_sec", "cells/s"
             size, steps = args.serve_size, args.serve_steps
+        elif args.obs:
+            metric, unit = "obs_sample_overhead_us", "us/sample"
+            size, steps = args.serve_size, args.serve_steps
         elif args.serve:
             metric, unit = "serve_sessions_per_sec", "sessions/s"
             size, steps = args.serve_size, args.serve_steps
@@ -2053,7 +2150,8 @@ def main() -> None:
             "degraded_reason": "error",
             "error": repr(e)[:500],
         }
-        if args.serve or args.serve_pipeline or args.failover or args.fleet:
+        if (args.serve or args.serve_pipeline or args.failover
+                or args.fleet or args.obs):
             failure["sessions"] = args.serve_sessions
             failure["batch_capacity"] = args.serve_capacity
             if args.fleet:

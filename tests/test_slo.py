@@ -1,0 +1,328 @@
+"""SLO engine units (docs/OBSERVABILITY.md "SLOs and burn rates"): spec
+validation and loading (JSON, the TOML subset, typed errors), the
+multi-window burn-rate judgement over a synthetic series store, breach
+emission into the flight ring with refire suppression, the recovery
+clock fed by exit/ready hooks, and the doctor's breach-to-cause join
+over a merged capture.
+"""
+
+import json
+
+import pytest
+
+from tpu_life.obs import flight, slo
+from tpu_life.obs.slo import (
+    SloEngine,
+    SloSpec,
+    default_specs,
+    load_specs,
+    render_slo_report,
+    slo_report,
+)
+from tpu_life.obs.timeseries import SeriesStore
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_ring():
+    flight.reset()
+    yield
+    flight.reset()
+
+
+# ---------------------------------------------------------------------------
+# spec validation and loading
+# ---------------------------------------------------------------------------
+def test_default_specs_cover_the_stack():
+    specs = default_specs()
+    assert [s.name for s in specs] == [
+        "admission-p99", "session-success", "frame-gap", "recovery-time",
+    ]
+    kinds = {s.name: s.kind for s in specs}
+    assert kinds["admission-p99"] == "quantile"
+    assert kinds["recovery-time"] == "recovery"
+
+
+@pytest.mark.parametrize(
+    "kw,match",
+    [
+        (dict(name="x", kind="zap", objective=1.0), "kind"),
+        (dict(name="x", kind="quantile", objective=0.0, metric="m"), "objective"),
+        (dict(name="x", kind="quantile", objective=1.0), "needs a metric"),
+        (dict(name="x", kind="ratio", objective=1.0, bad="b"), "needs bad and total"),
+        (dict(name="x", kind="quantile", objective=1.0, metric="m", q=2.0), "q must"),
+        (dict(name="x", kind="recovery", objective=1.0,
+              fast_window_s=10.0, slow_window_s=5.0), "fast_window_s"),
+    ],
+)
+def test_spec_validation_is_typed(kw, match):
+    with pytest.raises(ValueError, match=match):
+        SloSpec(**kw)
+
+
+def test_load_specs_json(tmp_path):
+    f = tmp_path / "slo.json"
+    f.write_text(json.dumps({"slo": [
+        {"name": "lat", "kind": "quantile", "metric": "m", "objective": 0.5},
+        {"name": "err", "kind": "ratio", "bad": "b", "total": "t",
+         "objective": 0.01, "burn_threshold": 2.0},
+    ]}))
+    specs = load_specs(str(f))
+    assert [s.name for s in specs] == ["lat", "err"]
+    assert specs[1].burn_threshold == 2.0
+    # a bare list works too
+    f2 = tmp_path / "bare.json"
+    f2.write_text(json.dumps([
+        {"name": "lat", "kind": "quantile", "metric": "m", "objective": 0.5},
+    ]))
+    assert load_specs(str(f2))[0].name == "lat"
+
+
+def test_load_specs_toml_subset(tmp_path):
+    f = tmp_path / "slo.toml"
+    f.write_text(
+        '# objectives\n'
+        '[[slo]]\n'
+        'name = "lat"\n'
+        'kind = "quantile"\n'
+        'metric = "serve_queue_wait_seconds"\n'
+        'objective = 0.25\n'
+        'q = 0.95\n'
+        '\n'
+        '[[slo]]\n'
+        'name = "rec"\n'
+        'kind = "recovery"\n'
+        'objective = 30\n'
+    )
+    specs = load_specs(str(f))
+    assert specs[0].q == 0.95 and specs[0].objective == 0.25
+    assert specs[1].kind == "recovery"
+
+
+@pytest.mark.parametrize(
+    "text,match",
+    [
+        ('{"slo": [{"name": "x"}]}', "needs name, kind, and objective"),
+        ('{"slo": [{"name": "x", "kind": "recovery", "objective": 1, '
+         '"zap": 3}]}', "unknown slo field"),
+        ('{"slo": []}', "no slo specs"),
+        ('{"nope": []}', "expected"),
+        ('{"slo": [{"name": "x", "kind": "recovery", "objective": 1}, '
+         '{"name": "x", "kind": "recovery", "objective": 2}]}', "duplicate"),
+        ('not json', "bad JSON"),
+    ],
+)
+def test_load_specs_json_errors_are_typed(tmp_path, text, match):
+    f = tmp_path / "slo.json"
+    f.write_text(text)
+    with pytest.raises(ValueError, match=match):
+        load_specs(str(f))
+
+
+def test_toml_subset_errors_point_at_the_line(tmp_path):
+    f = tmp_path / "slo.toml"
+    f.write_text('[[slo]]\nname = "x"\n[other]\n')
+    with pytest.raises(ValueError, match=r"slo\.toml:3"):
+        load_specs(str(f))
+    f.write_text('name = "orphan"\n')
+    with pytest.raises(ValueError, match=r"slo\.toml:1"):
+        load_specs(str(f))
+
+
+# ---------------------------------------------------------------------------
+# burn evaluation over a synthetic store
+# ---------------------------------------------------------------------------
+def _ratio_store(bad_per_s: float, now: float = 1000.0) -> SeriesStore:
+    """A store where `bad_total` burns at bad_per_s against 10/s total,
+    covering both windows."""
+    store = SeriesStore()
+    snaps = []
+    for i, t in enumerate(range(0, 1001, 100)):
+        snaps.append({
+            "seq": i, "t": float(t),
+            "c": {"bad_total": bad_per_s * 100.0, "all_total": 10.0 * 100.0},
+        })
+    store.extend("w0", 0, snaps)
+    return store
+
+
+def _clock(t0=1000.0):
+    state = {"t": t0}
+
+    def clock():
+        return state["t"]
+
+    clock.state = state
+    return clock
+
+
+def test_ratio_breach_fires_flight_and_suppresses_refire():
+    spec = SloSpec(name="err", kind="ratio", bad="bad_total",
+                   total="all_total", objective=0.01,
+                   fast_window_s=300.0, slow_window_s=900.0)
+    # 1 bad/s of 10/s total = 10% error rate: 10x the 1% objective
+    store = _ratio_store(bad_per_s=1.0)
+    clock = _clock(1000.0)
+    eng = SloEngine([spec], store, clock=clock)
+    fired = eng.evaluate(now=1000.0)
+    assert len(fired) == 1
+    ev = fired[0]
+    assert ev["slo"] == "err" and ev["burn"] == pytest.approx(10.0)
+    assert ev["worker"] == "w0"  # the top contributor is named
+    # the breach landed in the flight ring, typed
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert "slo.breach" in kinds
+    # refire suppression: the same breach stays quiet inside the window
+    assert eng.evaluate(now=1000.0 + 1.0) == []
+    clock.state["t"] = 1000.0 + slo.REFIRE_SUPPRESS_S + 1.0
+    assert len(eng.evaluate()) == 1
+    st = eng.status()["err"]
+    assert st["breaching"] and st["burn_fast"] == pytest.approx(10.0)
+
+
+def test_ratio_within_objective_stays_quiet():
+    spec = SloSpec(name="err", kind="ratio", bad="bad_total",
+                   total="all_total", objective=0.01,
+                   fast_window_s=300.0, slow_window_s=900.0)
+    # 0.05 bad/s of 10/s = 0.5% — half the budget
+    eng = SloEngine([spec], _ratio_store(bad_per_s=0.005 * 10))
+    assert eng.evaluate(now=1000.0) == []
+    assert not eng.status()["err"]["breaching"]
+    assert eng.breaches_fired == 0
+
+
+def test_multi_window_rule_needs_both_windows_burning():
+    # bad only in the last 100 s: the fast window burns, the slow one
+    # absorbs it — no page (the SRE blip rule)
+    spec = SloSpec(name="err", kind="ratio", bad="bad_total",
+                   total="all_total", objective=0.01,
+                   fast_window_s=100.0, slow_window_s=1000.0)
+    store = SeriesStore()
+    snaps = []
+    for i, t in enumerate(range(0, 1001, 100)):
+        snaps.append({
+            "seq": i, "t": float(t),
+            "c": {"bad_total": 100.0 if t == 1000 else 0.0,
+                  "all_total": 1000.0},
+        })
+    store.extend("w0", 0, snaps)
+    eng = SloEngine([spec], store)
+    assert eng.evaluate(now=1000.0) == []
+    st = eng.status()["err"]
+    assert st["burn_fast"] > 1.0 > st["burn_slow"]
+
+
+def test_quantile_breach_observes_windowed_p():
+    spec = SloSpec(name="lat", kind="quantile", metric="wait", q=0.5,
+                   objective=0.2, fast_window_s=300.0, slow_window_s=900.0)
+    store = SeriesStore()
+    h = {"le": [0.1, 1.0, 10.0], "buckets": [0, 8, 8, 8], "count": 8,
+         "sum": 4.0}
+    store.extend("w0", 0, [
+        {"seq": 0, "t": 0.0, "c": {},
+         "h": {"wait": {"le": [0.1, 1.0, 10.0], "buckets": [0, 0, 0, 0],
+                        "count": 0, "sum": 0.0}}},
+        {"seq": 1, "t": 1000.0, "c": {}, "h": {"wait": h}},
+    ])
+    eng = SloEngine([spec], store)
+    fired = eng.evaluate(now=1000.0)
+    assert len(fired) == 1
+    # the median of all-mass-in-(0.1,1] interpolates to 0.55 > 0.2
+    assert fired[0]["observed"] == pytest.approx(0.55)
+    assert fired[0]["slo_kind"] == "quantile"
+
+
+def test_no_data_is_not_a_breach():
+    eng = SloEngine(default_specs(), SeriesStore())
+    assert eng.evaluate(now=123.0) == []
+    for st in eng.status().values():
+        assert not st["breaching"] and st["observed"] is None
+
+
+# ---------------------------------------------------------------------------
+# the recovery clock
+# ---------------------------------------------------------------------------
+def test_recovery_breach_names_the_victim_on_late_ready():
+    spec = SloSpec(name="rec", kind="recovery", objective=0.5)
+    eng = SloEngine([spec], SeriesStore())
+    eng.note_worker_exit("w1", 3, t=100.0)
+    eng.note_worker_ready("w1", 4, t=100.4)  # inside the bound: quiet
+    assert eng.breaches_fired == 0
+    eng.note_worker_exit("w1", 4, t=200.0)
+    eng.note_worker_ready("w1", 5, t=201.0)  # 1.0 s > 0.5 s objective
+    assert eng.breaches_fired == 1
+    ev = [e for e in flight.snapshot() if e["kind"] == "slo.breach"][-1]
+    assert ev["worker"] == "w1"
+    assert ev["observed"] == pytest.approx(1.0)
+    assert ev["slo_kind"] == "recovery"
+
+
+def test_open_outage_breaches_without_waiting_for_ready():
+    # a worker that never comes back must still page
+    spec = SloSpec(name="rec", kind="recovery", objective=0.5)
+    eng = SloEngine([spec], SeriesStore())
+    eng.note_worker_exit("w0", 1, t=100.0)
+    assert eng.evaluate(now=100.2) == []  # still inside the bound
+    fired = eng.evaluate(now=101.0)
+    assert len(fired) == 1 and fired[0]["worker"] == "w0"
+    # the open outage fires ONCE; the eventual late ready does not refire
+    assert eng.evaluate(now=102.0) == []
+    eng.note_worker_ready("w0", 2, t=103.0)
+    assert eng.breaches_fired == 1
+
+
+def test_crash_loop_keeps_the_original_outage_edge():
+    spec = SloSpec(name="rec", kind="recovery", objective=10.0)
+    eng = SloEngine([spec], SeriesStore())
+    eng.note_worker_exit("w0", 1, t=100.0)
+    eng.note_worker_exit("w0", 2, t=105.0)  # respawn died too
+    eng.note_worker_ready("w0", 3, t=112.0)
+    # judged from the FIRST exit (12 s), not the respawn's (7 s)
+    ev = [e for e in flight.snapshot() if e["kind"] == "slo.breach"][-1]
+    assert ev["observed"] == pytest.approx(12.0)
+
+
+# ---------------------------------------------------------------------------
+# the doctor join
+# ---------------------------------------------------------------------------
+def _instant(name, ts_s, **args):
+    return {"name": name, "ph": "i", "ts": ts_s * 1e6, "pid": 1, "tid": 0,
+            "s": "p", "args": args}
+
+
+def test_slo_report_joins_breach_to_same_worker_cause():
+    doc = {"traceEvents": [
+        _instant("flight.worker.exit", 10.0, worker="w1", generation=2),
+        _instant("flight.worker.exit", 11.0, worker="w0", generation=1),
+        _instant("flight.slo.breach", 12.0, slo="recovery-time",
+                 slo_kind="recovery", observed=2.0, objective=0.5,
+                 burn=4.0, window_s=2.0, worker="w1"),
+    ]}
+    report = slo_report(doc)
+    assert not report["ok"]
+    [b] = report["breaches"]
+    assert b["kind"] == "slo_breach" and b["slo"] == "recovery-time"
+    assert b["worker"] == "w1"
+    # the nearer w0 exit is skipped: the same-worker cause wins
+    assert b["cause"]["kind"] == "flight.worker.exit"
+    assert b["cause"]["args"]["worker"] == "w1"
+    text = render_slo_report(report)
+    assert "BREACH recovery-time" in text and "worker.exit" in text
+
+
+def test_slo_report_cause_horizon_bounds_the_join():
+    doc = {"traceEvents": [
+        _instant("flight.worker.exit", 10.0, worker="w0"),
+        _instant("flight.slo.breach", 10.0 + 500.0, slo="x", slo_kind="ratio",
+                 observed=1.0, objective=0.1, burn=10.0, window_s=300.0,
+                 worker="w0"),
+    ]}
+    [b] = slo_report(doc, horizon_s=120.0)["breaches"]
+    assert b["cause"] is None
+    [b2] = slo_report(doc, horizon_s=600.0)["breaches"]
+    assert b2["cause"]["kind"] == "flight.worker.exit"
+
+
+def test_slo_report_clean_capture_is_ok():
+    report = slo_report({"traceEvents": [_instant("flight.worker.exit", 1.0)]})
+    assert report == {"breaches": [], "ok": True}
+    assert "OK" in render_slo_report(report)

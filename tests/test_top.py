@@ -1,0 +1,266 @@
+"""Console units for ``tpu-life top`` (docs/OBSERVABILITY.md "top"): the
+Prometheus exposition parser (histogram reassembly included), the
+view-building deltas with the counter-reset rule, the renderer's breach
+highlighting, the shared refresh loop, and ``top --once --json``
+end-to-end against an in-process numpy gateway.
+"""
+
+import io
+import json
+
+import pytest
+
+from tpu_life.cli import main
+from tpu_life.obs import console
+from tpu_life.obs.console import (
+    TopClient,
+    build_view,
+    parse_labels,
+    parse_prom_text,
+    refresh_loop,
+    render_view,
+)
+
+PROM = """\
+# HELP serve_steps_total steps
+# TYPE serve_steps_total counter
+serve_steps_total{worker="w0"} 100
+serve_steps_total{worker="w1"} 40
+# TYPE serve_packed_steps_total counter
+serve_packed_steps_total{worker="w0"} 50
+serve_packed_steps_total{worker="w1"} 0
+# TYPE serve_rounds_total counter
+serve_rounds_total{worker="w0"} 10
+# TYPE serve_queue_depth gauge
+serve_queue_depth{worker="w0"} 3
+# TYPE serve_queue_wait_seconds histogram
+serve_queue_wait_seconds_bucket{worker="w0",le="0.1"} 2
+serve_queue_wait_seconds_bucket{worker="w0",le="1"} 5
+serve_queue_wait_seconds_bucket{worker="w0",le="+Inf"} 6
+serve_queue_wait_seconds_sum{worker="w0"} 9.5
+serve_queue_wait_seconds_count{worker="w0"} 6
+"""
+
+
+# ---------------------------------------------------------------------------
+# exposition parsing
+# ---------------------------------------------------------------------------
+def test_parse_labels_handles_escapes():
+    got = parse_labels(r'a="x",b="say \"hi\"",c="line\nbreak"')
+    assert got == {"a": "x", "b": 'say "hi"', "c": "line\nbreak"}
+
+
+def test_parse_prom_text_scalars_and_types():
+    p = parse_prom_text(PROM)
+    assert p["types"]["serve_steps_total"] == "counter"
+    assert ("serve_steps_total", {"worker": "w0"}, 100.0) in p["scalars"]
+    assert ("serve_queue_depth", {"worker": "w0"}, 3.0) in p["scalars"]
+
+
+def test_parse_prom_text_reassembles_histograms():
+    p = parse_prom_text(PROM)
+    [h] = p["hists"].values()
+    assert h["name"] == "serve_queue_wait_seconds"
+    assert h["labels"] == {"worker": "w0"}
+    assert h["le"] == [0.1, 1.0]
+    assert h["buckets"] == [2.0, 5.0, 6.0]  # cumulative, +Inf last
+    assert h["count"] == 6 and h["sum"] == pytest.approx(9.5)
+
+
+def test_parse_prom_text_survives_garbage_lines():
+    p = parse_prom_text("not a sample\nx{borked 3\nok_total 2\n")
+    assert ("ok_total", {}, 2.0) in p["scalars"]
+
+
+def test_histogram_suffix_requires_declared_type():
+    # a counter that merely ENDS in _count must stay a scalar
+    text = "# TYPE widget_count counter\nwidget_count 5\n"
+    p = parse_prom_text(text)
+    assert ("widget_count", {}, 5.0) in p["scalars"]
+    assert not p["hists"]
+
+
+# ---------------------------------------------------------------------------
+# the view
+# ---------------------------------------------------------------------------
+def test_build_view_first_paint_has_no_rates():
+    v = build_view(None, parse_prom_text(PROM))
+    assert v["interval_s"] is None
+    assert v["workers"]["w0"]["steps_s"] is None
+    assert v["workers"]["w0"]["queue"] == 3.0
+    # packed fraction needs no delta: it is a ratio of cumulatives
+    assert v["workers"]["w0"]["packed_frac"] == pytest.approx(0.5)
+    assert v["workers"]["w1"]["packed_frac"] == 0.0
+
+
+def test_build_view_rates_are_deltas_over_interval():
+    prev = parse_prom_text(PROM)
+    prev["t"] = 100.0
+    cur = parse_prom_text(PROM.replace(
+        'serve_steps_total{worker="w0"} 100',
+        'serve_steps_total{worker="w0"} 140',
+    ))
+    cur["t"] = 102.0
+    v = build_view(prev, cur)
+    assert v["interval_s"] == pytest.approx(2.0)
+    assert v["workers"]["w0"]["steps_s"] == pytest.approx(20.0)
+    assert v["workers"]["w1"]["steps_s"] == pytest.approx(0.0)
+    assert v["fleet"]["steps_s"] == pytest.approx(20.0)
+
+
+def test_build_view_counter_reset_reads_new_value_as_delta():
+    # w0 restarted between scrapes: cumulative fell 100 -> 8; the view
+    # must report 8/dt, never a negative rate
+    prev = parse_prom_text(PROM)
+    prev["t"] = 100.0
+    cur = parse_prom_text(PROM.replace(
+        'serve_steps_total{worker="w0"} 100',
+        'serve_steps_total{worker="w0"} 8',
+    ))
+    cur["t"] = 101.0
+    v = build_view(prev, cur)
+    assert v["workers"]["w0"]["steps_s"] == pytest.approx(8.0)
+
+
+def test_build_view_carries_slo_and_states_from_healthz():
+    healthz = {
+        "slo": {"admission-p99": {"kind": "quantile", "objective": 1.0,
+                                  "burn_fast": 2.0, "burn_slow": 1.5,
+                                  "observed": 2.0, "breaching": True}},
+        "workers": {"w0": "ready"},
+    }
+    v = build_view(None, parse_prom_text(PROM), healthz)
+    assert v["slo"]["admission-p99"]["breaching"]
+    assert v["states"] == {"w0": "ready"}
+
+
+def test_render_view_highlights_breach_and_totals():
+    prev = parse_prom_text(PROM)
+    prev["t"] = 100.0
+    cur = parse_prom_text(PROM)
+    cur["t"] = 102.0
+    healthz = {"slo": {"rec": {"kind": "recovery", "objective": 30.0,
+                               "burn_fast": 3.0, "burn_slow": 3.0,
+                               "observed": 90.0, "breaching": True}}}
+    text = render_view(build_view(prev, cur, healthz), color=True)
+    assert "BREACH" in text and "\x1b[31" in text
+    assert "TOTAL" in text  # two workers -> the fleet row paints
+    plain = render_view(build_view(prev, cur, healthz), color=False)
+    assert "BREACH" in plain and "\x1b[31" not in plain
+
+
+# ---------------------------------------------------------------------------
+# the refresh loop
+# ---------------------------------------------------------------------------
+def test_refresh_loop_paints_through_scrape_errors():
+    out = io.StringIO()
+    calls = {"n": 0}
+
+    def paint():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("fleet restarting")
+        return "frame"
+
+    rc = refresh_loop(paint, 0.0, out=out, clear=False, max_iterations=2)
+    assert rc == 0
+    assert "[unreachable: fleet restarting]" in out.getvalue()
+    assert "frame" in out.getvalue()
+
+
+def test_refresh_loop_once_paints_single_frame_no_clear():
+    out = io.StringIO()
+    rc = refresh_loop(lambda: "only", 0.0, once=True, out=out)
+    assert rc == 0
+    assert out.getvalue() == "only\n"
+
+
+def test_refresh_loop_keyboard_interrupt_is_clean_exit():
+    def paint():
+        raise KeyboardInterrupt
+
+    assert refresh_loop(paint, 0.0, out=io.StringIO()) == 0
+
+
+# ---------------------------------------------------------------------------
+# end to end: top --once --json against a live gateway
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def gateway():
+    from tpu_life.gateway import Gateway, GatewayConfig
+    from tpu_life.models.patterns import random_board
+    from tpu_life.serve import ServeConfig, SimulationService
+
+    svc = SimulationService(
+        ServeConfig(capacity=2, chunk_steps=4, max_queue=8, backend="numpy")
+    )
+    gw = Gateway(svc, GatewayConfig(port=0))
+    gw.start()
+    try:
+        for i in range(2):
+            svc.submit(random_board(16, 16, seed=i), "conway", 8)
+        svc.drain()
+        yield gw
+    finally:
+        gw.close()
+
+
+def test_top_client_views_live_gateway(gateway):
+    client = TopClient(f"http://127.0.0.1:{gateway.port}")
+    first = client.view()
+    # single gateway: samples carry no worker label -> one `local` row
+    assert "local" in first["workers"]
+    assert first["interval_s"] is None
+    second = client.view()
+    assert second["interval_s"] is not None
+    assert second["workers"]["local"]["steps_s"] is not None
+
+
+def test_top_once_json_cli_contract(gateway, capsys):
+    rc = main([
+        "top", "--url", f"http://127.0.0.1:{gateway.port}",
+        "--once", "--json", "--interval", "0.05",
+    ])
+    assert rc == 0
+    view = json.loads(capsys.readouterr().out)
+    assert set(view) >= {"t", "interval_s", "workers", "fleet", "slo"}
+    assert view["interval_s"] is not None  # two samples: rates are real
+    row = view["workers"]["local"]
+    assert set(row) >= {"steps_s", "queue", "packed_frac", "watchers"}
+
+
+def test_top_json_without_once_is_usage_error(capsys):
+    assert main(["top", "--json"]) == 2
+    assert "--once" in capsys.readouterr().err
+
+
+def test_top_unreachable_once_is_typed_error(capsys):
+    rc = main(["top", "--url", "http://127.0.0.1:1", "--once", "--json"])
+    assert rc == 2
+    assert "top:" in capsys.readouterr().err
+
+
+def test_stats_watch_reuses_refresh_loop(tmp_path, monkeypatch, capsys):
+    # the single-shot path must stay byte-identical without --watch;
+    # with it, the loop re-reads the sink (bounded here via the loop's
+    # max_iterations knob)
+    sink = tmp_path / "m.jsonl"
+    sink.write_text(json.dumps(
+        {"kind": "serve_round", "steps_advanced": 8, "sessions": 1}
+    ) + "\n")
+    assert main(["stats", str(sink), "--json"]) == 0
+    single = capsys.readouterr().out
+
+    orig = console.refresh_loop
+
+    def bounded(paint, interval_s, **kw):
+        kw["max_iterations"] = 2
+        kw["clear"] = False
+        return orig(paint, 0.0, **{k: v for k, v in kw.items()
+                                   if k != "interval_s"})
+
+    monkeypatch.setattr(console, "refresh_loop", bounded)
+    assert main(["stats", str(sink), "--json", "--watch", "5"]) == 0
+    watched = capsys.readouterr().out
+    # two paints, each byte-identical to the single-shot line
+    assert watched == single + single

@@ -352,6 +352,13 @@ def build_parser() -> argparse.ArgumentParser:
     gw.add_argument("--trace-events", default=None, metavar="FILE",
                     help="write Chrome trace-event JSON for the serve "
                     "rounds (docs/OBSERVABILITY.md)")
+    gw.add_argument("--series-every", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="metric time-series sampling cadence "
+                    "(docs/OBSERVABILITY.md time series): the pump "
+                    "snapshots the registry into a bounded ring this "
+                    "often, scraped via GET /v1/debug/series?cursor=; "
+                    "0 disables the ring entirely")
     gw.add_argument("--platform", default=None,
                     help="force a JAX platform (cpu/tpu), like `run --platform`")
     gw.add_argument("--verbose", "-v", action="store_true")
@@ -435,6 +442,22 @@ def build_parser() -> argparse.ArgumentParser:
                     "every monitor tick; fuse them with `tpu-life trace "
                     "merge DIR` and read one session's journey back with "
                     "`tpu-life doctor DIR --sid SID`")
+    fl.add_argument("--series-every", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="fleet series collection cadence "
+                    "(docs/OBSERVABILITY.md time series): the monitor "
+                    "tick scrapes each worker's snapshot ring and "
+                    "samples the fleet's own registry this often — the "
+                    "SLO engine's data plane; with --trace-dir the "
+                    "scrapes also land in *.series.jsonl capture files; "
+                    "0 disables collection")
+    fl.add_argument("--slo", default=None, metavar="FILE", dest="slo_file",
+                    help="declarative SLO specs (docs/OBSERVABILITY.md "
+                    "SLOs and burn rates): a JSON or TOML file of "
+                    "objectives evaluated with multi-window burn rates "
+                    "on the monitor tick; a breach fires a typed "
+                    "slo.breach flight event `tpu-life doctor --slo` "
+                    "joins to its cause (default: the built-in specs)")
     fl.add_argument("--log-dir", default=None, metavar="DIR",
                     help="per-worker stdout+stderr logs at DIR/wN.log "
                     "(default: a fresh temp dir)")
@@ -631,6 +654,28 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead of "
                     "the human table")
+    st.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="re-read and re-render every N seconds (the "
+                    "`top` refresh loop) until ^C; without this flag the "
+                    "single-shot output is unchanged")
+
+    tp = sub.add_parser(
+        "top",
+        help="live fleet console (docs/OBSERVABILITY.md top): per-worker "
+        "throughput, queue depth, governor bytes vs budget, "
+        "packed/matmul fractions, stream watchers, and SLO burn-rate "
+        "gauges with breach highlighting, over GET /metrics + /healthz",
+    )
+    tp.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="fleet router (or single gateway) base URL")
+    tp.add_argument("--interval", type=float, default=2.0, metavar="SECONDS",
+                    help="refresh cadence")
+    tp.add_argument("--once", action="store_true",
+                    help="paint one frame and exit (two samples one "
+                    "interval apart, so the rates are real)")
+    tp.add_argument("--json", action="store_true",
+                    help="with --once: emit the view as one JSON object — "
+                    "the scripting/autoscaler input contract")
 
     tr = sub.add_parser(
         "trace",
@@ -674,6 +719,12 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("--json", action="store_true",
                     help="emit the machine-readable journey report as "
                     "one JSON object")
+    dr.add_argument("--slo", action="store_true",
+                    help="SLO postmortem instead of a session journey "
+                    "(docs/OBSERVABILITY.md): join every slo.breach "
+                    "flight event in the capture to its plausible cause "
+                    "— a kill, a lease expiry, an injection — with typed "
+                    "findings; needs no --sid")
 
     sm = sub.add_parser(
         "submit",
@@ -936,6 +987,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "doctor":
         # pure file read-back: the journey reconstruction needs no device
         return _doctor(args)
+    if args.command == "top":
+        # pure HTTP: scrapes /metrics + /healthz — the operator console
+        # runs anywhere the router is reachable, no jax, no watchdog
+        return _top(args)
     if args.command == "client":
         # pure HTTP: the gateway owns the devices, the client only needs
         # numpy + urllib — runs anywhere, no watchdog, no jax
@@ -1248,25 +1303,34 @@ def _tune(args) -> int:
 def _stats(args) -> int:
     """The read-back half of the telemetry loop (docs/OBSERVABILITY.md):
     ingest a metrics JSONL sink — run chunks, serve rounds, registry
-    snapshot records in any mix — and report the aggregates."""
+    snapshot records in any mix — and report the aggregates.  With
+    --watch the same read-and-summarize pass re-runs every N seconds on
+    `top`'s refresh loop (the sinks are append-only, so a re-read is the
+    live view); without the flag the single-shot output is unchanged."""
     import json
 
     from tpu_life.obs import stats as obs_stats
 
-    records = []
-    for i, path in enumerate(args.metrics_file):
-        for rec in obs_stats.load_records(path):
-            # sink provenance: one file = one worker across ALL its
-            # restarts (each a fresh run_id) — the devices aggregate
-            # needs the worker identity, not the generation's
-            rec.setdefault("_sink", i)
-            records.append(rec)
-    summary = obs_stats.summarize(records)
-    if args.json:
-        print(json.dumps(summary))
-    else:
-        print(obs_stats.render(summary))
-    return 0
+    def summarize_once():
+        records = []
+        for i, path in enumerate(args.metrics_file):
+            for rec in obs_stats.load_records(path):
+                # sink provenance: one file = one worker across ALL its
+                # restarts (each a fresh run_id) — the devices aggregate
+                # needs the worker identity, not the generation's
+                rec.setdefault("_sink", i)
+                records.append(rec)
+        summary = obs_stats.summarize(records)
+        if args.json:
+            return json.dumps(summary)
+        return obs_stats.render(summary)
+
+    if args.watch is None:
+        print(summarize_once())
+        return 0
+    from tpu_life.obs import console
+
+    return console.refresh_loop(summarize_once, args.watch)
 
 
 def _trace_merge(args) -> int:
@@ -1316,6 +1380,24 @@ def _doctor(args) -> int:
 
     from tpu_life.obs import journey
 
+    if args.slo:
+        # SLO postmortem: capture-wide, so no --sid needed — every
+        # slo.breach instant is joined to its nearest plausible cause
+        from tpu_life.obs import slo as obs_slo
+
+        try:
+            doc = journey.load_merged(args.capture)
+        except (FileNotFoundError, ValueError, json.JSONDecodeError) as e:
+            print(f"doctor: {e}", file=sys.stderr)
+            return 2
+        report = obs_slo.slo_report(doc)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(obs_slo.render_slo_report(report))
+        # breaches are FINDINGS (the postmortem worked), not failures —
+        # exit 0 mirrors the journey path where kills are information
+        return 0
     if args.sid is None and args.trace_id is None:
         print("doctor: pass --sid or --trace-id", file=sys.stderr)
         return 2
@@ -1335,6 +1417,44 @@ def _doctor(args) -> int:
     else:
         print(journey.render_report(report))
     return 0 if report["ok"] else 1
+
+
+def _top(args) -> int:
+    """The live operator console (docs/OBSERVABILITY.md "top"): scrape
+    the router's merged /metrics + /healthz on a refresh loop and render
+    per-worker throughput, queue depth, governor bytes vs budget,
+    packed/matmul fractions, stream watchers, and SLO burn-rate gauges.
+    `--once --json` emits one machine-readable view for scripting."""
+    import json
+    import time as _time
+
+    from tpu_life.obs import console
+
+    client = console.TopClient(args.url, timeout=max(1.0, args.interval))
+    if args.once:
+        # two samples one interval apart so the per-second rates are
+        # real deltas, not the all-zero first frame
+        try:
+            client.view()
+            _time.sleep(min(args.interval, 2.0))
+            view = client.view()
+        except Exception as e:
+            print(f"top: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(view))
+        else:
+            print(console.render_view(view, color=sys.stdout.isatty()))
+        return 0
+    if args.json:
+        print("top: --json requires --once", file=sys.stderr)
+        return 2
+    color = sys.stdout.isatty()
+
+    def paint():
+        return console.render_view(client.view(), color=color)
+
+    return console.refresh_loop(paint, args.interval)
 
 
 def _submit(args) -> int:
@@ -1788,6 +1908,7 @@ def _gateway(args) -> int:
                 memory_budget_bytes=args.memory_budget_bytes,
                 engine_max_restarts=args.engine_max_restarts,
                 settle_deadline_s=args.settle_deadline,
+                series_every_s=args.series_every,
             )
         )
     except ValueError as e:
@@ -1990,6 +2111,8 @@ def _fleet(args) -> int:
                 peers=tuple(args.peers or ()),
                 lease_ttl_s=args.lease_ttl,
                 trace_dir=args.trace_dir,
+                series_every_s=args.series_every,
+                slo_file=args.slo_file,
                 probe_interval_s=args.probe_interval,
                 backoff_base_s=args.restart_backoff,
                 # the flag counts RESTARTS; the breaker counts consecutive
@@ -2018,8 +2141,9 @@ def _fleet(args) -> int:
         )
         print(f"fleet: placement error: {e}", file=sys.stderr)
         return 2
-    except ValueError as e:
-        # e.g. a malformed --site prefix: typed, before any worker spawns
+    except (ValueError, OSError) as e:
+        # e.g. a malformed --site prefix or an unreadable/invalid --slo
+        # spec file: typed, before any worker spawns
         print(f"fleet: {e}", file=sys.stderr)
         return 2
     fleet.install_signal_handlers()

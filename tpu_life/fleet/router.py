@@ -692,6 +692,10 @@ class _Handler(JsonHandler):
                     # (e.g. the serve wedge watchdog's engine_wedged) —
                     # why an unready-recycle is in flight, not just that
                     "unready_reasons": rt.supervisor.unready_reasons(),
+                    # the SLO engine's live burn gauges (docs/
+                    # OBSERVABILITY.md "SLOs and burn rates") — what
+                    # `tpu-life top` paints its breach table from
+                    "slo": rt.supervisor.slo_status(),
                 },
             )
             return

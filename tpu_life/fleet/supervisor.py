@@ -160,6 +160,20 @@ class FleetConfig:
     #: — the capture set ``tpu-life trace merge`` fuses into one
     #: Perfetto timeline.  None = no collection (zero new requests).
     trace_dir: str | None = None
+    #: fleet series collection (docs/OBSERVABILITY.md "Time series"):
+    #: the monitor tick scrapes every live worker's snapshot ring over
+    #: ``GET /v1/debug/series?cursor=`` into a per-(worker, generation)
+    #: store — the SLO engine's data plane — at most once per this many
+    #: seconds, and samples the fleet's own registry (the control
+    #: series: router/lease/shed counters) on the same cadence.  With
+    #: ``trace_dir`` set the scrapes also land in ``<name>.series.jsonl``
+    #: capture files for offline replay.  0 disables collection.
+    series_every_s: float = 1.0
+    #: declarative SLO specs (docs/OBSERVABILITY.md "SLOs and burn
+    #: rates"): a JSON/TOML spec file evaluated with multi-window burn
+    #: rates on the monitor tick; None = the built-in defaults.  A bad
+    #: spec file raises at construction, before any process exists.
+    slo_file: str | None = None
 
 
 @dataclass
@@ -342,6 +356,25 @@ class Supervisor:
         # trace scrape (bounded HTTP) never stalls the routing hot path.
         self._capture_lock = threading.Lock()
         self._doomed: list[tuple] = []
+        # fleet series collection + the SLO engine (docs/OBSERVABILITY.md
+        # "Time series" / "SLOs and burn rates"): the per-(worker,
+        # generation) snapshot store the tick scrapes into, the cursors
+        # it owns (the worker's ring read is non-destructive), this
+        # process's own registry ring (the control series), and the burn-
+        # rate engine judging the store every collection pass.  A bad
+        # --slo file raises HERE, before any process exists — like a bad
+        # placement plan.
+        self._registry = registry
+        self.series_store = obs.timeseries.SeriesStore()
+        self._series_cursors: dict[tuple[str, int], int] = {}
+        self._control_series = obs.timeseries.SeriesRing()
+        self._series_next = 0.0
+        specs = (
+            obs.slo.load_specs(config.slo_file)
+            if config.slo_file is not None
+            else obs.slo.default_specs()
+        )
+        self.slo_engine = obs.slo.SloEngine(specs, self.series_store)
         for st in WorkerState:
             self._g_workers.labels(state=st.value).set(0.0)
 
@@ -464,7 +497,7 @@ class Supervisor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
-        if self.config.trace_dir is not None:
+        if self.config.trace_dir is not None or self.config.series_every_s > 0:
             # last evidence pass: whatever the workers buffered since the
             # final monitor tick, plus this process's own flight tail
             with self._lock:
@@ -473,8 +506,12 @@ class Supervisor:
                     for w in self.workers
                     if w.url is not None and w.alive
                 ]
-            self._scrape_traces(targets)
-            self._scrape_control()
+            if self.config.trace_dir is not None:
+                self._scrape_traces(targets)
+                self._scrape_control()
+            if self.config.series_every_s > 0:
+                self._scrape_series(targets)
+                self._sample_control_series()
         with self._lock:
             for w in self.workers:
                 if w.proc is not None and w.proc.poll() is None:
@@ -619,6 +656,29 @@ class Supervisor:
                 ]
             self._scrape_traces(targets)
             self._scrape_control()
+        # fleet series collection + SLO evaluation (docs/OBSERVABILITY.md):
+        # scrape every live worker's snapshot ring, sample the fleet's own
+        # registry, then judge the store's windows — rate-limited to one
+        # pass per series_every_s whatever the probe cadence is.  Runs
+        # OUTSIDE the lock like the trace scrape (max, not sum, latency).
+        if self.config.series_every_s > 0 and now >= self._series_next:
+            self._series_next = now + self.config.series_every_s
+            with self._lock:
+                targets = [
+                    (w, w.generation, w.url)
+                    for w in self.workers
+                    if w.url is not None and w.alive
+                ]
+            self._scrape_series(targets)
+            self._sample_control_series()
+            try:
+                self.slo_engine.evaluate()
+            except Exception:  # pragma: no cover - alerting must not kill ticks
+                log.exception("fleet: slo evaluation failed")
+
+    def slo_status(self) -> dict:
+        """The live burn gauges (``/healthz`` ``slo`` section, ``top``)."""
+        return self.slo_engine.status()
 
     def _probe_all(self, targets: list[tuple[Worker, int]]) -> list[tuple]:
         """Probe workers CONCURRENTLY: tick latency must be max(probe),
@@ -709,10 +769,17 @@ class Supervisor:
             # verdict was — evidence is evidence
             self._record_injections_locked(w, info.pop("_chaos_injections"))
         if status == "ready":
+            was_ready = w.state is WorkerState.READY
             w.state = WorkerState.READY
             w.ever_ready = True
             w.unready = 0
             w.unready_reason = None
+            if not was_ready:
+                # the recovery-time SLO's closing edge: a name that had
+                # an open outage just answered ready again
+                self.slo_engine.note_worker_ready(
+                    w.name, w.generation, time.time()
+                )
             if isinstance(info, dict) and info.get("devices"):
                 w.devices = int(info["devices"])
                 w.device_kind = info.get("device_kind") or w.device_kind
@@ -769,6 +836,10 @@ class Supervisor:
             draining=self._draining,
             recycling=w.recycling,
         )
+        if not self._draining:
+            # the recovery-time SLO's clock starts at the death edge (a
+            # drain exit is the goal, not an outage)
+            self.slo_engine.note_worker_exit(w.name, w.generation, time.time())
         if self._draining:
             w.state = WorkerState.DOWN
             log.info("fleet: %s exited rc=%s (drain)", w.name, rc)
@@ -853,6 +924,8 @@ class Supervisor:
         )
         if self._draining:
             return
+        # a lease expiry is this tier's worker death: same recovery clock
+        self.slo_engine.note_worker_exit(w.name, w.generation, time.time())
         if self.on_worker_exit is not None:
             try:
                 self.on_worker_exit(w.name, w.generation)
@@ -1105,6 +1178,91 @@ class Supervisor:
             },
         )
 
+    def _scrape_series(self, targets: list[tuple]) -> None:
+        """Read each target worker's snapshot ring concurrently (the
+        probe rule: pass latency is max(scrape), not sum)."""
+        if not targets:
+            return
+        if len(targets) == 1:
+            self._scrape_series_one(*targets[0])
+            return
+        threads = [
+            threading.Thread(
+                target=self._scrape_series_one, args=t, daemon=True
+            )
+            for t in targets
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _scrape_series_one(self, w: Worker, generation: int, url: str) -> None:
+        """One best-effort cursor read of a worker's
+        ``/v1/debug/series``: new snapshots land in the per-(worker,
+        generation) store (the SLO engine's window substrate) and — with
+        ``trace_dir`` set — in ``<name>.series.jsonl`` for offline
+        replay.  The cursor is per INCARNATION: a respawned worker's
+        ring restarts at seq 0 under a new generation key, so a counter
+        reset reads as a new series, never a negative rate."""
+        key = (w.name, generation)
+        cursor = self._series_cursors.get(key, 0)
+        t0 = time.time()
+        try:
+            req = urllib.request.Request(f"{url}/v1/debug/series?cursor={cursor}")
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                doc = json.loads(resp.read())
+        except Exception:
+            return  # unreachable/dying worker: collection stays best-effort
+        if not isinstance(doc, dict):
+            return
+        snapshots = doc.get("snapshots") or []
+        dropped = int(doc.get("dropped") or 0)
+        next_cursor = doc.get("next_cursor")
+        if isinstance(next_cursor, int) and next_cursor >= cursor:
+            self._series_cursors[key] = next_cursor
+        if not snapshots and not dropped:
+            return  # nothing new this pass: no store growth, no capture line
+        self.series_store.extend(w.name, generation, snapshots, dropped=dropped)
+        if self.config.trace_dir is not None:
+            self._append_capture(
+                f"{w.name}.series.jsonl",
+                {
+                    "worker": w.name,
+                    "generation": generation,
+                    "pid": doc.get("pid"),
+                    "run_id": doc.get("run_id"),
+                    "scraped_at": time.time(),
+                    "latency_s": round(time.time() - t0, 6),
+                    "cursor": cursor,
+                    "next_cursor": next_cursor,
+                    "dropped": dropped,
+                    "snapshots": snapshots,
+                },
+            )
+
+    def _sample_control_series(self) -> None:
+        """Snapshot the fleet's OWN registry (router routes, leases,
+        restarts, ``watcher_shed_total`` — the control plane's signals)
+        into its ring and the store under the ``control`` series."""
+        snap = self._control_series.sample(self._registry)
+        self.series_store.extend("control", 0, [snap])
+        if self.config.trace_dir is not None:
+            self._append_capture(
+                "control.series.jsonl",
+                {
+                    "worker": "control",
+                    "generation": 0,
+                    "pid": os.getpid(),
+                    "run_id": None,
+                    "scraped_at": time.time(),
+                    "cursor": snap["seq"],
+                    "next_cursor": snap["seq"] + 1,
+                    "dropped": 0,
+                    "snapshots": [snap],
+                },
+            )
+
     def _append_capture(self, fname: str, rec: dict) -> None:
         root = Path(self.config.trace_dir)
         try:
@@ -1139,6 +1297,8 @@ class Supervisor:
             doomed, self._doomed = self._doomed, []
         for w, gen, url in doomed:
             self._scrape_one(w, gen, url)
+            if self.config.series_every_s > 0:
+                self._scrape_series_one(w, gen, url)
             with self._lock:
                 if (
                     w.generation == gen

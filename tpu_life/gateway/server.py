@@ -60,6 +60,10 @@ ROUTE_CELLS = "/v1/sessions/{sid}/cells"
 #: The trace drain verb (docs/OBSERVABILITY.md "Distributed tracing"):
 #: each GET takes (and clears) the worker's buffered span + flight rings.
 ROUTE_TRACE = "/v1/debug/trace"
+#: The series scrape verb (docs/OBSERVABILITY.md "Time series"):
+#: cursor-based, NON-destructive reads of the worker's bounded ring of
+#: periodic metric snapshots — repeatable, unlike the trace drain.
+ROUTE_SERIES = "/v1/debug/series"
 
 
 @dataclass
@@ -445,6 +449,21 @@ class _Handler(JsonHandler):
             if method != "GET":
                 raise gw_errors.method_not_allowed(method, path)
             return ROUTE_TRACE, self._debug_trace, {}
+        if path == ROUTE_SERIES:
+            if method != "GET":
+                raise gw_errors.method_not_allowed(method, path)
+            raw = parse_qs(query).get("cursor", ["0"])[0]
+            try:
+                cursor = int(raw)
+            except ValueError:
+                raise gw_errors.bad_request(
+                    "invalid_request", f"bad cursor {raw!r}"
+                ) from None
+            if cursor < 0:
+                raise gw_errors.bad_request(
+                    "invalid_request", "'cursor' must be >= 0"
+                )
+            return ROUTE_SERIES, self._debug_series, {"cursor": cursor}
         if path == ROUTE_SESSIONS:
             if method != "POST":
                 raise gw_errors.method_not_allowed(method, path)
@@ -553,6 +572,14 @@ class _Handler(JsonHandler):
         # Destructive by design — each scrape is an increment, so the
         # supervisor's per-tick collection never duplicates an event.
         self._send_json(200, self.gw.service.drain_trace())
+        return 200
+
+    def _debug_series(self, cursor: int) -> int:
+        # the fleet series-scrape seam (docs/OBSERVABILITY.md "Time
+        # series"): snapshots with seq >= cursor off the worker's bounded
+        # ring.  Non-destructive — the SCRAPER owns the cursor, so a
+        # replayed or concurrent scrape reads the same snapshots.
+        self._send_json(200, self.gw.service.read_series(cursor))
         return 200
 
     def _create(self) -> int:

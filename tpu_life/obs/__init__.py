@@ -18,6 +18,19 @@ package ties them together around one generated ``run_id``:
 - :mod:`tpu_life.obs.stats` — the read-back toolchain behind
   ``tpu-life stats``: one JSONL file in, throughput aggregates and
   histogram quantiles out (``--json`` for machines).
+- :mod:`tpu_life.obs.timeseries` — bounded rings of periodic registry
+  snapshots with pure windowed queries (``rate``,
+  ``quantile_over_window``), scraped fleet-wide through
+  ``GET /v1/debug/series?cursor=`` into a per-(worker, generation)
+  store; disabled sampling is one ``is None`` check, asserted via the
+  :func:`~tpu_life.obs.timeseries.sample_count` probe.
+- :mod:`tpu_life.obs.slo` — declarative SLO specs (JSON/TOML or
+  built-in defaults) evaluated with multi-window burn rates on the
+  supervisor tick; a breach is a typed ``slo.breach`` flight event that
+  ``tpu-life doctor --slo`` joins to its cause.
+- :mod:`tpu_life.obs.console` — the ``tpu-life top`` operator console:
+  a Prometheus-exposition parser, client-side counter deltas, and the
+  refresh loop ``stats --watch`` shares.
 
 Correlation model: the driver / serve service / bench each generate one
 ``run_id`` per invocation and stamp it into every trace file, every JSONL
@@ -59,7 +72,7 @@ from tpu_life.obs.trace import (
     tracing,
     valid_trace_id,
 )
-from tpu_life.obs import flight, stats
+from tpu_life.obs import console, flight, slo, stats, timeseries
 
 __all__ = [
     "TELEMETRY_SCHEMA",
@@ -73,6 +86,9 @@ __all__ = [
     "Tracer",
     "activate",
     "active_tracer",
+    "console",
+    "slo",
+    "timeseries",
     "async_begin",
     "ensure_parent",
     "async_end",

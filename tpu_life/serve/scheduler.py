@@ -862,6 +862,16 @@ class Scheduler:
         (the service drains this into its counter every round)."""
         return sum(e.idle_seconds_delta() for e in self.engines.values())
 
+    def queue_age_oldest_s(self) -> float:
+        """Wall age of the oldest still-queued session (0.0 when the
+        queue is empty) — the head-of-line demand signal the sampled
+        time series carries for the autoscaler: depth says how many are
+        waiting, age says how badly the fleet is behind."""
+        if not self.queue:
+            return 0.0
+        now = self.clock()
+        return max(0.0, now - min(s.submitted_at for s in self.queue))
+
     def _notify_finished(self, session: Session) -> None:
         """Tell the observer a session the scheduler drove reached a
         terminal state, with its submit-to-finish latency."""

@@ -155,6 +155,13 @@ class ServeConfig:
     # machine-readable reason, so a fleet supervisor's unready-recycle +
     # migration path rescues the sessions.  None disables the watchdog.
     settle_deadline_s: float | None = None
+    # time-series retention (docs/OBSERVABILITY.md "Time series"): the
+    # pump's retire tail snapshots the registry into a bounded ring at
+    # most once per series_every_s, scraped non-destructively through
+    # GET /v1/debug/series?cursor=.  0 disables the ring entirely — the
+    # hot path then pays one is-None check and nothing else.
+    series_every_s: float = 1.0
+    series_max_snapshots: int = 512
 
 
 class SimulationService:
@@ -193,6 +200,16 @@ class SimulationService:
             raise ValueError(
                 f"settle_deadline_s must be > 0, "
                 f"got {self.config.settle_deadline_s}"
+            )
+        if self.config.series_every_s < 0:
+            raise ValueError(
+                f"series_every_s must be >= 0 (0 disables sampling), "
+                f"got {self.config.series_every_s}"
+            )
+        if self.config.series_every_s > 0 and self.config.series_max_snapshots < 1:
+            raise ValueError(
+                f"series_max_snapshots must be >= 1, "
+                f"got {self.config.series_max_snapshots}"
             )
         from tpu_life.ops.conv import validate_stencil
 
@@ -233,6 +250,13 @@ class SimulationService:
         self._g_queue_depth = self.registry.gauge(
             "serve_queue_depth", "sessions waiting for a batch slot"
         )
+        # head-of-line demand (docs/OBSERVABILITY.md "Time series"):
+        # depth says how many wait, age says how badly we're behind —
+        # the pair the autoscaler's input contract needs
+        self._g_queue_age = self.registry.gauge(
+            "serve_queue_age_oldest_seconds",
+            "wall age of the oldest still-queued session",
+        )
         self._g_occupancy = self.registry.gauge(
             "serve_batch_occupancy", "occupied slot fraction at the last step"
         )
@@ -248,6 +272,16 @@ class SimulationService:
         # round counter even while every gauge legitimately sits still
         self._c_rounds = self.registry.counter(
             "serve_rounds_total", "scheduling rounds executed"
+        )
+        # step throughput as registry counters (not just the per-round
+        # record's plain ints): the sampled time series and `tpu-life
+        # top` derive steps/s and the packed fraction from these
+        self._c_steps = self.registry.counter(
+            "serve_steps_total", "device steps advanced across all sessions"
+        )
+        self._c_steps_packed = self.registry.counter(
+            "serve_packed_steps_total",
+            "the slice of serve_steps_total run by bitplane-packed engines",
         )
         self._c_finished = self.registry.counter(
             "serve_sessions_finished_total",
@@ -385,10 +419,13 @@ class SimulationService:
         # an absent one is a question)
         for fam in (
             self._g_queue_depth,
+            self._g_queue_age,
             self._g_occupancy,
             self._c_submitted,
             self._c_rejections,
             self._c_rounds,
+            self._c_steps,
+            self._c_steps_packed,
             self._h_queue_wait,
             self._h_latency,
             self._g_pipeline_depth,
@@ -439,6 +476,16 @@ class SimulationService:
             else None
         )
         self._t0 = clock()
+        # time-series retention (docs/OBSERVABILITY.md "Time series"):
+        # None when disabled, so the retire tail's only cost is one
+        # attribute check — the tracer's one-global-check discipline,
+        # pinned by the sample_count() probe in the overhead guard
+        self._series = (
+            obs.timeseries.SeriesRing(self.config.series_max_snapshots)
+            if self.config.series_every_s > 0
+            else None
+        )
+        self._series_next = 0.0  # monotonic deadline of the next sample
         self._completed = 0
         self._rounds = 0
         self._occupancy_sum = 0.0  # for mean batch occupancy in stats()
@@ -1526,9 +1573,14 @@ class SimulationService:
         self._steps_total += stats.steps_advanced
         self._steps_packed_total += stats.steps_advanced_packed
         self._c_rounds.inc()
+        if stats.steps_advanced:
+            self._c_steps.inc(stats.steps_advanced)
+        if stats.steps_advanced_packed:
+            self._c_steps_packed.inc(stats.steps_advanced_packed)
         occ = stats.occupancy / stats.slots if stats.slots else 0.0
         self._occupancy_sum += occ
         self._g_queue_depth.set(stats.queue_depth)
+        self._g_queue_age.set(self.scheduler.queue_age_oldest_s())
         self._g_occupancy.set(occ)
         depth = sum(1 for e in self.scheduler.engines.values() if e.inflight)
         self._g_pipeline_depth.set(depth)
@@ -1657,6 +1709,14 @@ class SimulationService:
                 "completion_p95": lat.quantile(0.95),
             }
         )
+        # the series sample rides the retire tail, rate-limited to one
+        # snapshot per series_every_s no matter how fast rounds spin;
+        # disabled sampling is the single is-None check above this line
+        if self._series is not None:
+            now_mono = self.clock()
+            if now_mono >= self._series_next:
+                self._series_next = now_mono + self.config.series_every_s
+                self._series.sample(self.registry)
         if self.config.prom_file:
             # live exposition: rewrite the snapshot every round (atomic
             # rename, so a mid-run scrape never reads a torn file) instead
@@ -1689,6 +1749,27 @@ class SimulationService:
             "events": t.drain() if t is not None else [],
             "flight": obs.flight.drain(),
         }
+        return payload
+
+    def read_series(self, cursor: int = 0) -> dict:
+        """Retained metric snapshots with ``seq >= cursor`` — the payload
+        behind ``GET /v1/debug/series?cursor=`` (docs/OBSERVABILITY.md
+        "Time series").  Unlike the trace drain this read is
+        NON-destructive and repeatable: the scraper owns the cursor, so
+        a replayed scrape (or a second scraper) sees the same snapshots;
+        ``dropped`` counts what the bounded ring evicted past the cursor
+        before this read.  A disabled ring answers an empty, well-shaped
+        payload rather than a 404 — the scraper needs no config probe."""
+        if self._series is None:
+            payload = {
+                "schema": obs.timeseries.SERIES_SCHEMA,
+                "snapshots": [],
+                "next_cursor": 0,
+                "dropped": 0,
+            }
+        else:
+            payload = self._series.read(cursor)
+        payload.update(run_id=self.run_id, pid=os.getpid(), now=time.time())
         return payload
 
     def flush(self) -> None:
