@@ -1526,6 +1526,185 @@ def run_obs_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def run_mesh_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_mesh capture (docs/SERVING.md "Mega-board sessions"):
+    one mega-board on the sharded mesh engine tier.  Three numbers in one
+    record: cells/s through the full pump contract (delta-timed so the
+    compile cancels), the sharding-overhead fraction — how much of each
+    mesh step the solo single-device path does NOT account for, i.e. the
+    ppermute halo exchanges plus the lane duplication — and the
+    shard-wise spill -> cross-shape re-gather wall times.  The mesh
+    result is byte-compared to the solo run so every throughput number
+    is also a correctness witness."""
+    actual, pinned = _pin_and_verify(args, platform)
+
+    import jax
+
+    devices = len(jax.devices())
+    if devices < 2:
+        # host platforms resolve to one device: re-run THIS leg in a
+        # child interpreter with a forced 8-device host mesh (the same
+        # knob the test suite pins) — a mesh on one device measures
+        # nothing.  The child's record line is relayed verbatim.
+        if platform == "tpu":
+            raise RuntimeError("mesh bench needs >= 2 devices")
+        env = dict(os.environ)
+        env["TPU_LIFE_PLATFORM"] = "cpu"
+        env["TPU_LIFE_BENCH_NO_RETRY"] = "1"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        cmd = [
+            sys.executable, os.path.abspath(__file__), "--mesh",
+            "--platform", "cpu", "--rule", args.rule,
+            "--mesh-size", str(args.mesh_size),
+            "--mesh-steps", str(args.mesh_steps),
+            "--mesh-base-steps", str(args.mesh_base_steps),
+            "--serve-chunk-steps", str(args.serve_chunk_steps),
+        ]
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1800, env=env
+        )
+        for line in reversed(r.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        raise RuntimeError(
+            f"mesh child emitted no record (rc={r.returncode}): "
+            f"{r.stderr[-500:]}"
+        )
+
+    import shutil
+    import tempfile
+
+    from tpu_life.backends.base import get_backend
+    from tpu_life.models.patterns import random_board
+    from tpu_life.models.rules import get_rule
+    from tpu_life.serve.engine import compile_key_for
+    from tpu_life.serve.mesh_engine import (
+        MeshEngine,
+        mesh_backend_name,
+        plan_mesh_shape,
+    )
+    from tpu_life.serve.spill import SpillStore, read_mesh_session_dir
+
+    rule = get_rule(args.rule)
+    n = args.mesh_size
+    board = random_board(n, n, seed=7).astype(rule.board_dtype)
+    shape = plan_mesh_shape(devices, (n, n), rule)
+    if shape is None:
+        raise RuntimeError(
+            f"no legal mesh factorization of {devices} devices over "
+            f"a {n}x{n} {args.rule} board"
+        )
+    key = compile_key_for(rule, board, mesh_backend_name(shape), "roll")
+    chunk = args.serve_chunk_steps
+    steps, base_steps = args.mesh_steps, args.mesh_base_steps
+
+    def mesh_run(run_steps: int) -> tuple[float, "MeshEngine", int]:
+        eng = MeshEngine(key, chunk)
+        slot = eng.acquire()
+        eng.load(slot, board, run_steps)
+        t0 = time.perf_counter()
+        while eng.remaining(slot) > 0 or eng.inflight:
+            eng.dispatch_chunk()
+            eng.collect_chunk()
+        eng.settle()
+        return time.perf_counter() - t0, eng, slot
+
+    mesh_run(base_steps)  # warm the compile outside both clocks
+    t_base, _, _ = mesh_run(base_steps)
+    t_full, eng, slot = mesh_run(steps)
+    per_step = max(1e-12, (t_full - t_base) / (steps - base_steps))
+    cells_per_sec = n * n / per_step
+
+    # the solo twin: same board, same step counts, one device — the
+    # denominator of the overhead fraction and the correctness oracle
+    solo = get_backend("jax")
+
+    def solo_run(run_steps: int) -> tuple[float, np.ndarray]:
+        runner = solo.prepare(board, rule)
+        runner.advance(base_steps)  # warm
+        runner.sync()
+        runner = solo.prepare(board, rule)
+        t0 = time.perf_counter()
+        runner.advance(run_steps)
+        runner.sync()
+        return time.perf_counter() - t0, runner.fetch()
+
+    t_solo_base, _ = solo_run(base_steps)
+    t_solo_full, solo_out = solo_run(steps)
+    solo_per_step = max(
+        1e-12, (t_solo_full - t_solo_base) / (steps - base_steps)
+    )
+    # the slice of each mesh step the solo compute does not explain:
+    # halo exchange + duplicated halo lanes (and, on a host mesh, the
+    # multi-device dispatch) — 0 when sharding is free, -> 1 when the
+    # exchange dominates
+    halo_frac = max(0.0, 1.0 - solo_per_step / per_step)
+    mesh_out = eng.fetch(slot)
+    verified = bool(
+        np.allclose(mesh_out, solo_out, atol=1e-4)
+        if np.issubdtype(np.asarray(mesh_out).dtype, np.floating)
+        else np.array_equal(mesh_out, solo_out)
+    )
+
+    # shard-wise durability round trip: spill the finished board's tiles
+    # with CRC sidecars, then re-gather onto a DIFFERENT mesh shape when
+    # one is legal (arXiv 2112.01075) — the migrated-resume wall time
+    tiles, _lag = eng.spill_tiles(slot)
+    radius = max(1, int(getattr(rule, "radius", 1)))
+    alt = (devices, 1) if (devices, 1) != shape and n // devices >= radius else shape
+    if getattr(rule, "boundary", "clamped") == "torus" and n % devices:
+        alt = shape
+    tmp = tempfile.mkdtemp(prefix="tpu-life-mesh-bench-")
+    try:
+        store = SpillStore(tmp)
+        t0 = time.perf_counter()
+        store.save_mesh(
+            "bench", tiles, steps, rule=args.rule, steps_total=steps,
+            seed=None, temperature=None, timeout_s=None,
+            height=n, width=n, mesh=shape,
+        )
+        spill_s = time.perf_counter() - t0
+        rec = read_mesh_session_dir(os.path.join(tmp, "bench"))
+        key2 = compile_key_for(rule, board, mesh_backend_name(alt), "roll")
+        eng2 = MeshEngine(key2, chunk)
+        slot2 = eng2.acquire()
+        t0 = time.perf_counter()
+        eng2.load_tiles(slot2, rec.block_loader(), 1, start_step=steps)
+        regather_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "metric": "mesh_cells_per_sec",
+        "value": cells_per_sec,
+        "unit": "cells/s",
+        "rule": args.rule,
+        "platform": platform,
+        "platform_actual": actual,
+        "platform_pinned": pinned,
+        "backend": mesh_backend_name(shape),
+        "size": n,
+        "steps": steps,
+        "base_steps": base_steps,
+        "devices": devices,
+        "mesh": f"{shape[0]}x{shape[1]}",
+        "cells_per_sec": cells_per_sec,
+        "solo_cells_per_sec": n * n / solo_per_step,
+        "halo_exchange_fraction": halo_frac,
+        "tiles": len(tiles),
+        "spill_seconds": spill_s,
+        "regather_seconds": regather_s,
+        "regather_mesh": f"{alt[0]}x{alt[1]}",
+        "verified": verified,
+        "degraded": degraded,
+    }
+
+
 def run_bench(args, platform: str, degraded: bool) -> dict:
     actual, pinned = _pin_and_verify(args, platform)
 
@@ -1830,6 +2009,22 @@ def main() -> None:
                    "(default lenia:orbium, lenia:mini degraded)")
     p.add_argument("--conv-lenia-size", type=int, default=None,
                    help="Lenia board edge (default 512, 96 degraded)")
+    # the BENCH_mesh capture (docs/SERVING.md "Mega-board sessions"):
+    # one mega-board on the sharded mesh engine tier — cells/s, the
+    # halo-exchange overhead fraction vs the solo path, and the
+    # tile-spill -> cross-shape re-gather wall times, all in one record
+    p.add_argument("--mesh", action="store_true",
+                   help="mega-board bench: a sharded mesh-engine session "
+                   "vs its solo single-device twin (emits "
+                   "mesh_cells_per_sec with halo_exchange_fraction and "
+                   "regather_seconds)")
+    p.add_argument("--mesh-size", type=int, default=None,
+                   help="mega-board edge (default 8192, 96 degraded)")
+    p.add_argument("--mesh-steps", type=int, default=None,
+                   help="steps per timed run (default 128, 12 degraded)")
+    p.add_argument("--mesh-base-steps", type=int, default=None,
+                   help="steps in the baseline run of the delta pair "
+                   "(default 16, 4 degraded)")
     args = p.parse_args()
 
     # fail fast on pure config errors — they must never trigger the
@@ -1911,6 +2106,9 @@ def main() -> None:
         "--mc-steps": args.mc_steps,
         "--mc-base-steps": args.mc_base_steps,
         "--mc-sizes": args.mc_sizes,
+        "--mesh-size": args.mesh_size,
+        "--mesh-steps": args.mesh_steps,
+        "--mesh-base-steps": args.mesh_base_steps,
         "--conv-size": args.conv_size,
         "--conv-steps": args.conv_steps,
         "--conv-base-steps": args.conv_base_steps,
@@ -1951,6 +2149,17 @@ def main() -> None:
         args.mc_base_steps = 40 if on_accel else 8
     if args.mc and args.mc_steps <= args.mc_base_steps:
         p.error("--mc-steps must be greater than --mc-base-steps (delta timing)")
+    # mesh workload knobs: same accel/degraded split; the degraded edge
+    # (96) divides evenly by every factorization of the CI's forced
+    # 8-device host mesh, so torus rules stay legal too
+    if args.mesh_size is None:
+        args.mesh_size = 8192 if on_accel else 96
+    if args.mesh_steps is None:
+        args.mesh_steps = 128 if on_accel else 12
+    if args.mesh_base_steps is None:
+        args.mesh_base_steps = 16 if on_accel else 4
+    if args.mesh and args.mesh_steps <= args.mesh_base_steps:
+        p.error("--mesh-steps must be greater than --mesh-base-steps (delta timing)")
     # conv workload knobs: same accel/degraded split (the roll leg at
     # radius 10 is 42 shifted adds per step — the degraded board must
     # stay small enough for CI smoke)
@@ -1973,7 +2182,8 @@ def main() -> None:
     # (the batched path is the thing being measured).
     if args.backend is None:
         if (args.serve or args.serve_pipeline or args.failover or args.fleet
-                or args.mc or args.conv or args.stream or args.obs):
+                or args.mc or args.conv or args.stream or args.obs
+                or args.mesh):
             # the vmapped/fused single-device XLA path is the thing being
             # measured on both service-shaped benches
             args.backend = "jax"
@@ -2023,6 +2233,8 @@ def main() -> None:
             result = run_obs_bench(args, platform, degraded)
         elif args.serve:
             result = run_serve_bench(args, platform, degraded)
+        elif args.mesh:
+            result = run_mesh_bench(args, platform, degraded)
         elif args.mc:
             result = run_mc_bench(args, platform, degraded)
         elif args.conv:
@@ -2083,6 +2295,9 @@ def main() -> None:
                         "--chaos-seed", str(args.chaos_seed),
                         "--chaos-workers", str(args.chaos_workers),
                         "--chaos-kills", str(args.chaos_kills)]
+            if args.mesh:
+                cmd += ["--mesh",
+                        "--serve-chunk-steps", str(args.serve_chunk_steps)]
             if args.mc:
                 cmd.append("--mc")
                 cmd += ["--mc-temperature", str(args.mc_temperature)]

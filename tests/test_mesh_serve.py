@@ -1,0 +1,384 @@
+"""Mega-board mesh serving (ISSUE 19, docs/SERVING.md "Mega-board
+sessions").
+
+The headline invariants:
+
+- a board the governor would 413 as never-fits is *placed* on a sharded
+  2-D torus mesh slice instead of rejected, and its result is
+  byte-identical to the solo numpy oracle (allclose at FLOAT_ATOL for
+  the continuous tier);
+- durability is shard-wise: tiles + CRC sidecars + a sharded manifest,
+  epoch choice all-or-nothing (one bit-flipped tile demotes the WHOLE
+  set — a resumed mesh session is never a mixed-epoch board), and a
+  resume may re-gather onto a *different* mesh shape without the full
+  board ever being materialized on one host;
+- the 413 a non-mesh worker still answers is machine-readable
+  (``mesh_eligible`` + ``min_devices``) so clients and the fleet router
+  can target a mesh-capable slice instead of giving up.
+"""
+
+import shutil
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_life.models import lenia
+from tpu_life.models.patterns import random_board
+from tpu_life.models.rules import get_rule
+from tpu_life.ops.reference import run_np
+from tpu_life.serve import ServeConfig, SessionState, SimulationService
+from tpu_life.serve import governor
+from tpu_life.serve.engine import compile_key_for
+from tpu_life.serve.errors import InsufficientMemory
+from tpu_life.serve.mesh_engine import (
+    MeshEngine,
+    mesh_backend_name,
+    parse_mesh_backend,
+    plan_mesh_shape,
+)
+from tpu_life.serve.spill import (
+    SpillStore,
+    crc_path,
+    read_mesh_session_dir,
+    read_mesh_sessions,
+    snapshot_path,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs multi-device (fake CPU) platform"
+)
+
+CONWAY = get_rule("conway")
+
+
+def _pump_to_done(eng, slot, board, steps):
+    eng.load(slot, board, steps)
+    while eng.remaining(slot) > 0 or eng.inflight:
+        eng.dispatch_chunk()
+        eng.collect_chunk()
+    eng.settle()
+    return eng.fetch(slot)
+
+
+# -- placement planning ----------------------------------------------------
+def test_plan_mesh_shape_prefers_most_square():
+    # 8 devices: (4,2) beats the stripe factorizations (least halo
+    # perimeter per shard), rows-major on the (4,2)/(2,4) tie
+    assert plan_mesh_shape(8, (64, 64), CONWAY) == (4, 2)
+    assert plan_mesh_shape(4, (64, 64), CONWAY) == (2, 2)
+    assert plan_mesh_shape(2, (64, 64), CONWAY) == (2, 1)
+    # a mesh is at least 2 devices — 1 means "the single-chip tiers own it"
+    assert plan_mesh_shape(1, (64, 64), CONWAY) is None
+    assert plan_mesh_shape(0, (64, 64), CONWAY) is None
+
+
+def test_plan_mesh_shape_respects_torus_divisibility_and_radius():
+    torus = types.SimpleNamespace(radius=1, boundary="torus")
+    # 60 divides by 4 and 2: the square factorization stands
+    assert plan_mesh_shape(8, (60, 60), torus) == (4, 2)
+    # 62x62 admits no exact 8-way split: the closed ring cannot pad
+    assert plan_mesh_shape(8, (62, 62), torus) is None
+    # every shard must span one halo radius per axis
+    wide = types.SimpleNamespace(radius=10, boundary="clamped")
+    assert plan_mesh_shape(8, (16, 16), wide) is None
+
+
+def test_mesh_backend_name_round_trip():
+    assert mesh_backend_name((4, 2)) == "mesh:4x2"
+    assert parse_mesh_backend("mesh:4x2") == (4, 2)
+    assert parse_mesh_backend("jax") is None
+    with pytest.raises(ValueError):
+        parse_mesh_backend("mesh:banana")
+    with pytest.raises(ValueError):
+        parse_mesh_backend("mesh:1x1")  # fewer than 2 devices
+
+
+# -- the engine vs the solo oracle (satellite: stencil thread-through) -----
+@pytest.mark.parametrize("stencil", ["roll", "matmul"])
+def test_mesh_engine_matches_numpy_oracle(stencil, rng_board):
+    # the satellite-1 pin: CompileKey.stencil threads through the sharded
+    # backend, and matmul == roll bit-identically on a 2-shard mesh
+    board = rng_board(32, 48, seed=19).astype(CONWAY.board_dtype)
+    expect = run_np(board, CONWAY, 10)
+    key = compile_key_for(CONWAY, board, "mesh:2x1", stencil)
+    eng = MeshEngine(key, 4)
+    assert eng.capacity == 1 and eng.devices == 2
+    slot = eng.acquire()
+    out = _pump_to_done(eng, slot, board, 10)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_mesh_engine_lenia_close_to_oracle(rng_board):
+    rule = get_rule("lenia:mini")
+    board = lenia.seeded_board(32, 32, seed=9)
+    expect = lenia.run_np(board, rule, 8)
+    key = compile_key_for(rule, board, "mesh:2x2", "roll")
+    eng = MeshEngine(key, 4)
+    slot = eng.acquire()
+    out = _pump_to_done(eng, slot, board, 8)
+    assert out.dtype == np.float32
+    assert np.allclose(out, expect, atol=lenia.FLOAT_ATOL)
+
+
+def test_mesh_engine_rejects_stochastic_and_non_mesh_keys():
+    board = np.zeros((16, 16), np.int8)
+    with pytest.raises(ValueError, match="stochastic"):
+        MeshEngine(compile_key_for(get_rule("ising"), board, "mesh:2x1"), 4)
+    with pytest.raises(ValueError, match="mesh:RxC"):
+        MeshEngine(compile_key_for(CONWAY, board, "jax"), 4)
+
+
+# -- shard-wise spill / cross-shape resume ---------------------------------
+def test_spill_tiles_and_cross_shape_regather(tmp_path, rng_board):
+    # run half on a 2x2 mesh, spill SHARD-WISE, resume the other half on
+    # a 4x2 mesh from the tile set — equal to the uninterrupted oracle.
+    # The tile walk never gathers: 4 tiles, one per source shard.
+    board = rng_board(64, 64, seed=23).astype(CONWAY.board_dtype)
+    expect = run_np(board, CONWAY, 16)
+    eng = MeshEngine(compile_key_for(CONWAY, board, "mesh:2x2"), 4)
+    slot = eng.acquire()
+    _pump_to_done(eng, slot, board, 8)
+    tiles, lag = eng.spill_tiles(slot)
+    assert lag == 0 and len(tiles) == 4
+    assert {(r0, c0) for r0, c0, _ in tiles} == {(0, 0), (0, 32), (32, 0), (32, 32)}
+    assert all(cells.shape == (32, 32) for _, _, cells in tiles)
+
+    store = SpillStore(tmp_path)
+    assert store.save_mesh(
+        "s0", tiles, 8, rule="conway", steps_total=16, seed=None,
+        temperature=None, timeout_s=None, height=64, width=64, mesh=(2, 2),
+    )
+    # every tile published with its own CRC sidecar; no full-board file
+    tile_dirs = sorted(p for p in (tmp_path / "s0").iterdir() if p.is_dir())
+    assert len(tile_dirs) == 4
+    for td in tile_dirs:
+        f = snapshot_path(td, 8)
+        assert f.exists() and crc_path(f).exists()
+    assert not list((tmp_path / "s0").glob("board_*.txt"))
+
+    rec = read_mesh_session_dir(tmp_path / "s0")
+    assert (rec.step, rec.remaining, rec.mesh_shape) == (8, 8, (2, 2))
+    eng2 = MeshEngine(compile_key_for(CONWAY, board, "mesh:4x2"), 4)
+    slot2 = eng2.acquire()
+    eng2.load_tiles(slot2, rec.block_loader(), rec.remaining, start_step=rec.step)
+    while eng2.remaining(slot2) > 0 or eng2.inflight:
+        eng2.dispatch_chunk()
+        eng2.collect_chunk()
+    eng2.settle()
+    np.testing.assert_array_equal(eng2.fetch(slot2), expect)
+
+
+def test_bit_flipped_tile_demotes_whole_set_to_predecessor_epoch(tmp_path):
+    # the satellite-4 pin: one rotted tile at the newest epoch demotes
+    # the WHOLE set — a resumed mesh session is never a mixed-epoch board
+    top4 = np.ones((4, 8), np.int8)
+    bot4 = np.zeros((4, 8), np.int8)
+    top8 = np.eye(4, 8, dtype=np.int8)
+    bot8 = np.ones((4, 8), np.int8)
+    store = SpillStore(tmp_path)
+    common = dict(rule="conway", steps_total=12, seed=None, temperature=None,
+                  timeout_s=None, height=8, width=8, mesh=(2, 1))
+    store.save_mesh("s0", [(0, 0, top4), (4, 0, bot4)], 4, **common)
+    store.save_mesh("s0", [(0, 0, top8), (4, 0, bot8)], 8, **common)
+
+    rec = read_mesh_session_dir(tmp_path / "s0")
+    assert rec.step == 8  # intact: newest epoch wins
+
+    # rot ONE tile of epoch 8 (the sidecar stays truthful to the original
+    # bytes, so the intact check must fail)
+    f = snapshot_path(tmp_path / "s0" / "tile_r000000000_c000000000", 8)
+    data = f.read_bytes()
+    flipped = data.replace(b"1", b"0", 1)
+    assert flipped != data
+    f.write_bytes(flipped)
+
+    rec = read_mesh_session_dir(tmp_path / "s0")
+    assert rec.step == 4  # whole set demoted — NOT tile A@4 + tile B@8
+    got = rec.block_loader()(0, 8, 0, 8)
+    np.testing.assert_array_equal(got, np.vstack([top4, bot4]))
+
+    # rot the predecessor too: the set is corrupt, typed on both faces
+    f4 = snapshot_path(tmp_path / "s0" / "tile_r000000004_c000000000", 4)
+    f4.write_bytes(f4.read_bytes()[:-2])
+    records, corrupt, disabled = read_mesh_sessions(tmp_path)
+    assert (records, corrupt, disabled) == ([], ["s0"], [])
+    with pytest.raises(ValueError, match="no resumable tile set"):
+        read_mesh_session_dir(tmp_path / "s0")
+
+
+# -- the governor's mesh hint (satellite: machine-readable 413) ------------
+def test_mesh_estimators_units():
+    board = np.zeros((64, 64), np.int8)
+    key = compile_key_for(CONWAY, board, "jax")
+    # one board spread over the slice, MESH_COPIES working copies, one
+    # remaining-steps word
+    assert governor.estimate_mesh_bytes(key) == 64 * 64 * governor.MESH_COPIES + 4
+    shards = governor.estimate_mesh_shard_bytes(key, (2, 2))
+    assert set(shards) == {"0x0", "0x1", "1x0", "1x1"}
+    per = (32 * 32 + 2 * 1 * (32 + 32)) * governor.MESH_COPIES
+    assert all(v == per for v in shards.values())
+
+
+def test_never_fits_413_carries_mesh_hint():
+    board = np.zeros((128, 128), np.int8)
+    key = compile_key_for(CONWAY, board, "jax")
+    with pytest.raises(InsufficientMemory) as ei:
+        governor.check_admission(key, {}, 8192, 4)
+    e = ei.value
+    assert not e.transient  # never fits: resubmitting here is hopeless
+    assert e.mesh_eligible is True
+    assert e.min_devices >= 2
+    # a local slice sizes the hint: budget/mesh_devices per device
+    with pytest.raises(InsufficientMemory) as ei:
+        governor.check_admission(key, {}, 8192, 4, mesh_devices=4)
+    assert ei.value.min_devices == governor.mesh_min_devices(key, 8192 // 4)
+
+    # the gateway face: the hint is machine-readable INSIDE the error body
+    from tpu_life.gateway.errors import from_serve_error
+
+    doc = from_serve_error(e).body()
+    assert doc["error"]["mesh_eligible"] is True
+    assert doc["error"]["min_devices"] == e.min_devices
+
+
+def test_mesh_hint_refuses_stochastic_and_mesh_keys():
+    board = np.zeros((128, 128), np.int8)
+    ising = compile_key_for(get_rule("ising"), board, "jax")
+    assert governor.mesh_hint(ising, 8192) == (False, None)
+    # a mesh slice that still overflows is hopeless, not resubmittable
+    mesh_key = compile_key_for(CONWAY, board, "mesh:2x2")
+    assert governor.mesh_hint(mesh_key, 8192) == (False, None)
+    eligible, min_dev = governor.mesh_hint(
+        compile_key_for(CONWAY, board, "jax"), 8192, mesh_devices=4
+    )
+    assert eligible and min_dev >= 2
+
+
+# -- the fleet router's targeted retry -------------------------------------
+def test_router_mesh_candidate_picks_largest_sufficient_slice():
+    from tpu_life.fleet.router import Router
+
+    w = lambda name, devices: types.SimpleNamespace(name=name, devices=devices)
+    small, mid, big = w("w0", 1), w("w1", 4), w("w2", 8)
+    doc = {"error": {"code": "insufficient_memory", "mesh_eligible": True,
+                     "min_devices": 4}}
+    # biggest ready slice clearing min_devices, never the refuser itself
+    pick = Router._mesh_candidate(None, doc, [small, mid, big], small)
+    assert pick is big
+    pick = Router._mesh_candidate(None, doc, [small, mid], small)
+    assert pick is mid
+    # the refuser is excluded even when it is the biggest
+    assert Router._mesh_candidate(None, doc, [small, big], big) is None
+    # no hint, or no slice big enough -> fall through to the honest 413
+    assert Router._mesh_candidate(None, {"error": {"code": "x"}},
+                                  [big], small) is None
+    doc9 = {"error": {"mesh_eligible": True, "min_devices": 9}}
+    assert Router._mesh_candidate(None, doc9, [mid, big], small) is None
+    # a hint with no min_devices defaults to "any real mesh" (2)
+    doc_min = {"error": {"mesh_eligible": True}}
+    assert Router._mesh_candidate(None, doc_min, [small, mid], small) is mid
+
+
+def test_migrator_builds_mesh_resume_request(tmp_path, rng_board):
+    from tpu_life.fleet.migrate import mesh_resume_request
+
+    board = rng_board(32, 32, seed=5).astype(CONWAY.board_dtype)
+    eng = MeshEngine(compile_key_for(CONWAY, board, "mesh:2x1"), 4)
+    slot = eng.acquire()
+    _pump_to_done(eng, slot, board, 4)
+    tiles, _ = eng.spill_tiles(slot)
+    SpillStore(tmp_path).save_mesh(
+        "s7", tiles, 4, rule="conway", steps_total=20, seed=None,
+        temperature=None, timeout_s=7.5, height=32, width=32, mesh=(2, 1),
+        trace_id="t-123",
+    )
+    records, corrupt, disabled = read_mesh_sessions(tmp_path)
+    assert [r.sid for r in records] == ["s7"] and not corrupt and not disabled
+    body = mesh_resume_request(records[0])
+    # the resume pointer rides the wire INSTEAD of board bytes: the
+    # survivor re-gathers tile by tile from the shared filesystem
+    assert body["resume_tiles_dir"] == str(tmp_path / "s7")
+    assert "board" not in body and "b64" not in body
+    assert body["steps"] == 16 and body["start_step"] == 4
+    assert (body["height"], body["width"]) == (32, 32)
+    assert body["timeout_s"] == 7.5 and body["trace_id"] == "t-123"
+
+
+# -- the service end to end ------------------------------------------------
+def test_service_places_never_fits_board_on_mesh_and_spills_shardwise(
+    tmp_path, rng_board
+):
+    board = rng_board(64, 64, seed=19).astype(CONWAY.board_dtype)
+    oracle = run_np(board, CONWAY, 20)
+    spill_a, spill_b = tmp_path / "a", tmp_path / "b"
+    svc = SimulationService(ServeConfig(
+        backend="jax", capacity=8, chunk_steps=4,
+        memory_budget_bytes=20000, mesh_devices=4,
+        spill_dir=str(spill_a), spill_every=1,
+    ))
+    try:
+        # the batched estimate busts the budget; a small session still fits
+        sid = svc.submit(board, CONWAY, 20)
+        small = svc.submit(rng_board(16, 16, seed=3), CONWAY, 8)
+        for _ in range(3):
+            svc.pump()
+        view = svc.poll(sid)
+        assert view.mesh == "2x2"  # really placed on the reserved slice
+        assert view.steps_done == 12
+        # small-session traffic coexists on the remaining capacity
+        assert svc.poll(small).state is SessionState.DONE
+        assert svc.stats()["mesh_sessions"] == 1
+        # shard-wise spill on disk: tiles + sidecars, never a full board
+        records, corrupt, disabled = read_mesh_sessions(spill_a)
+        assert [r.sid for r in records] == [sid]
+        assert not corrupt and not disabled
+        rec = records[0]
+        assert rec.step == 12 and rec.remaining == 8 and rec.mesh_shape == (2, 2)
+        assert not list((spill_a / sid).glob("board_*.txt"))
+        # park the tile set as the "dead worker's" spill root
+        shutil.copytree(spill_a / sid, spill_b / sid)
+    finally:
+        svc.close()
+
+    rec = read_mesh_session_dir(spill_b / rec.sid)
+    svc2 = SimulationService(ServeConfig(
+        backend="jax", capacity=8, chunk_steps=4,
+        memory_budget_bytes=20000, mesh_devices=8,
+        spill_dir=str(tmp_path / "c"), spill_every=1,
+    ))
+    try:
+        # resume onto a DIFFERENT mesh shape from a geometry placeholder —
+        # the survivor never holds the full board
+        sid2 = svc2.submit(
+            np.zeros((64, 64), np.int8), CONWAY, rec.remaining,
+            start_step=rec.step, mesh_resume_dir=str(rec.root),
+        )
+        svc2.drain()
+        view = svc2.poll(sid2)
+        assert view.state is SessionState.DONE and view.mesh == "4x2"
+        np.testing.assert_array_equal(svc2.result(sid2), oracle)
+    finally:
+        svc2.close()
+
+
+def test_service_mesh_resume_rejects_bad_pointers(tmp_path):
+    svc = SimulationService(ServeConfig(
+        backend="jax", capacity=2, chunk_steps=4, mesh_devices=4,
+    ))
+    try:
+        with pytest.raises(ValueError, match="no resumable tile set"):
+            svc.submit(np.zeros((64, 64), np.int8), CONWAY, 8,
+                       mesh_resume_dir=str(tmp_path / "nope"))
+    finally:
+        svc.close()
+    # without a reserved slice the pointer is a typed refusal, not a crash
+    svc = SimulationService(ServeConfig(backend="jax", capacity=2, chunk_steps=4))
+    try:
+        with pytest.raises(ValueError, match="reserved mesh"):
+            svc.submit(np.zeros((64, 64), np.int8), CONWAY, 8,
+                       mesh_resume_dir=str(tmp_path / "nope"))
+    finally:
+        svc.close()

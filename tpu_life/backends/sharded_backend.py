@@ -33,6 +33,7 @@ import jax
 from tpu_life.backends.base import ChunkCallback, register_backend, run_with_runner
 from tpu_life.models.rules import Rule
 from tpu_life.ops import bitlife
+from tpu_life.ops.conv import resolve_stencil, validate_stencil
 from tpu_life.ops.stencil import make_masked_step
 from tpu_life.parallel.halo import make_sharded_run
 from tpu_life.parallel.mesh import (
@@ -63,8 +64,16 @@ class ShardedBackend:
         pallas_block_rows: int = 256,
         pallas_block_cols: int = 512,
         pallas_interpret: bool | None = None,
+        stencil: str = "roll",
         **_,
     ):
+        # the per-shard counting path (docs/RULES.md): "roll" shift-adds
+        # or "matmul" banded matmuls, threaded into the halo scaffold's
+        # local substep — the PR 15 known limit (CompileKey.stencil
+        # stopped at the single-device executors) discharged.  "auto"
+        # resolves per rule at prepare time (_stencil), same as the
+        # single-chip backends.
+        self.stencil = validate_stencil(stencil)
         if mesh_shape is not None and num_devices is not None:
             r, c = mesh_shape
             if r * c != num_devices:
@@ -100,8 +109,21 @@ class ShardedBackend:
         self.pallas_block_cols = ceil_to(max(LANE, pallas_block_cols), LANE)
         self.pallas_interpret = pallas_interpret
 
+    def _cell_dtype(self, rule: Rule):
+        """Element type of the unpacked board: float32 on the continuous
+        tier (a silent int8 cast would quantize a Lenia world to junk —
+        models.lenia.require_float_path), int8 everywhere else."""
+        return np.float32 if getattr(rule, "continuous", False) else np.int8
+
     def _device_put_stream(
-        self, load_block, h: int, w: int, h_pad: int, w_phys: int, use_bits: bool
+        self,
+        load_block,
+        h: int,
+        w: int,
+        h_pad: int,
+        w_phys: int,
+        use_bits: bool,
+        cell_dtype=np.int8,
     ):
         """Build the sharded device array from a rectangular block loader.
 
@@ -114,7 +136,7 @@ class ShardedBackend:
         (Parallel_Life_MPI.cpp:85), and what keeps 65536^2 feasible.
         """
         sharding = board_sharding(self.mesh)
-        dtype = np.uint32 if use_bits else np.int8
+        dtype = np.uint32 if use_bits else cell_dtype
 
         def cb(index):
             rows, cols = index
@@ -137,7 +159,22 @@ class ShardedBackend:
 
         return jax.make_array_from_callback((h_pad, w_phys), sharding, cb)
 
+    def _stencil(self, rule: Rule) -> str:
+        """The rule's resolved counting path (conv.resolve_stencil):
+        explicit modes win, ``auto`` follows the crossover model — except
+        under an explicit Pallas pin, where auto keeps roll (the Pallas
+        kernels do their own counting; only an explicit matmul request
+        contradicts the pin, in _resolve_local_kernel)."""
+        if self.stencil == "auto" and self.local_kernel == "pallas":
+            return "roll"
+        return resolve_stencil(rule, self.stencil)
+
     def _use_bits(self, rule: Rule) -> bool:
+        if getattr(rule, "continuous", False) or self._stencil(rule) == "matmul":
+            # float boards have no bitplane form, and the matmul counting
+            # path operates on the cell layout — both pin the unpacked
+            # board
+            return False
         if rule.boundary == "torus":
             # mirrors _prepare_torus (which rejects local_kernel='pallas'
             # before this matters): life-like torus rules run packed too,
@@ -156,7 +193,7 @@ class ShardedBackend:
 
     def prepare(self, board: np.ndarray, rule: Rule):
         h, w = board.shape
-        board = np.asarray(board, np.int8)
+        board = np.asarray(board, self._cell_dtype(rule))
         return self._prepare_impl(
             lambda r0, r1, c0, c1: board[r0:r1, c0:c1], h, w, rule
         )
@@ -179,27 +216,35 @@ class ShardedBackend:
 
         return self._prepare_impl(load_block, height, width, rule)
 
-    def write_runner_to_file(self, runner, path, height: int, width: int, rule: Rule):
-        """Write the runner's board per addressable shard at contract byte
-        offsets (halo-free, any order) — the ``MPI_File_write_at_all``
-        analogue (Parallel_Life_MPI.cpp:175).  On a 2-D mesh each column
-        shard writes its row *segments* at ``row * (width+1) + col_offset``
-        — the reference's offset scheme (:172-175) generalized to blocks."""
-        from tpu_life.io.sharded import write_block
+    def prepare_from_blocks(self, load_block, height: int, width: int, rule: Rule):
+        """Runner whose board loads from an arbitrary rectangular block
+        loader (``load_block(r0, r1, c0, c1) -> cells``), block by block
+        inside the shard callbacks — the re-gather entry of the serve
+        mesh tier (arXiv 2112.01075's redistribution shape): a spilled
+        tile set re-enters a mesh of ANY shape, each destination shard
+        pulling exactly its own cell rectangle, so the full board is
+        never materialized on one host."""
+        return self._prepare_impl(load_block, height, width, rule)
 
+    def iter_runner_tiles(self, runner, height: int, width: int, rule: Rule):
+        """Yield ``(r0, c0, cells)`` — one logical-cell tile per
+        addressable shard of the runner's board (deduplicated, padding
+        stripped, bitboards unpacked).  Each host only ever touches its
+        own shards' bytes; the serve mesh tier's shard-wise spill and the
+        sharded board writer are both this walk."""
         use_bits = self._use_bits(rule)
         x = runner.x
         jax.block_until_ready(x)
-        written: set[tuple[int, int]] = set()
+        seen: set[tuple[int, int]] = set()
         for shard in x.addressable_shards:
             rows, cols = shard.index
             r0 = rows.start or 0
             c0 = cols.start or 0
             # storage units -> logical cell columns (word-aligned when packed)
             cell0 = c0 * bitlife.WORD if use_bits else c0
-            if (r0, cell0) in written or r0 >= height or cell0 >= width:
+            if (r0, cell0) in seen or r0 >= height or cell0 >= width:
                 continue
-            written.add((r0, cell0))
+            seen.add((r0, cell0))
             r1 = rows.stop if rows.stop is not None else x.shape[0]
             c1 = cols.stop if cols.stop is not None else x.shape[1]
             n = min(r1, height) - r0
@@ -210,6 +255,17 @@ class ShardedBackend:
                 if use_bits
                 else data[:n, : cell1 - cell0]
             )
+            yield r0, cell0, seg
+
+    def write_runner_to_file(self, runner, path, height: int, width: int, rule: Rule):
+        """Write the runner's board per addressable shard at contract byte
+        offsets (halo-free, any order) — the ``MPI_File_write_at_all``
+        analogue (Parallel_Life_MPI.cpp:175).  On a 2-D mesh each column
+        shard writes its row *segments* at ``row * (width+1) + col_offset``
+        — the reference's offset scheme (:172-175) generalized to blocks."""
+        from tpu_life.io.sharded import write_block
+
+        for r0, cell0, seg in self.iter_runner_tiles(runner, height, width, rule):
             write_block(
                 path, r0, cell0, seg, total_rows=height, total_cols=width
             )
@@ -233,6 +289,17 @@ class ShardedBackend:
         VERDICT r3 item 3), on 1-D and 2-D meshes alike.  Both need
         shard_map (gspmd derives its own exchange).
         """
+        if self._stencil(rule) == "matmul":
+            # the banded-matmul counting path is an XLA construction; an
+            # explicit Pallas pin contradicts an explicit matmul request
+            # (auto never reaches here under the pin — _stencil keeps it
+            # on roll)
+            if self.local_kernel == "pallas":
+                raise ValueError(
+                    "stencil='matmul' runs the XLA banded-matmul step; "
+                    "it cannot be combined with local_kernel='pallas'"
+                )
+            return None
         if self.local_kernel == "xla":
             return None
         if self.local_kernel == "pallas":
@@ -394,9 +461,9 @@ class ShardedBackend:
             to_np = lambda x: bitlife.unpack_np(np.asarray(x), w)
             count = bitlife.live_count_packed
         else:
-            # multistate / wide-radius torus rules: the same closed-ring
-            # construction on the int8 board — the seam constraint is
-            # plain cell divisibility
+            # multistate / wide-radius / continuous torus rules: the same
+            # closed-ring construction on the cell board — the seam
+            # constraint is plain cell divisibility
             if w % self.n_cols != 0:
                 raise ValueError(
                     f"2-D-mesh torus needs the width ({w}) divisible by the "
@@ -405,7 +472,13 @@ class ShardedBackend:
                 )
             w_store, col_unit = w, 1
             to_np = lambda x: np.asarray(x)
-            count = bitlife.live_count_cells
+            # float boards have no exact "live" count; the runner's host
+            # fallback covers the metric
+            count = (
+                None
+                if getattr(rule, "continuous", False)
+                else bitlife.live_count_cells
+            )
         shard_h = h // self.n
         block_steps = max(
             1,
@@ -416,12 +489,20 @@ class ShardedBackend:
                 (w_store // self.n_cols) * col_unit // max(1, rule.radius),
             ),
         )
-        x = self._device_put_stream(load_rows, h, w, h, w_store, use_bits)
+        x = self._device_put_stream(
+            load_rows, h, w, h, w_store, use_bits,
+            cell_dtype=self._cell_dtype(rule),
+        )
         return self._blocked_runner(
             x,
             block_steps,
             lambda bs: make_sharded_run_torus_2d(
-                rule, self.mesh, (h, w), block_steps=bs, packed=use_bits
+                rule,
+                self.mesh,
+                (h, w),
+                block_steps=bs,
+                packed=use_bits,
+                stencil=self._stencil(rule),
             ),
             to_np,
             count,
@@ -449,6 +530,15 @@ class ShardedBackend:
 
         use_bits = self._use_bits(rule)
         shard_h = h // self.n
+
+        if getattr(rule, "continuous", False) or self._stencil(rule) == "matmul":
+            # the wrap-cols substep of the 1-D torus scan is an int
+            # roll-path construction; continuous and matmul-stencil rules
+            # instead take the closed-ring 2-D scaffold (exact along both
+            # axes; n_cols == 1 self-wraps the column seam), where the
+            # local substep is the plain clamped-twin step of whichever
+            # counting path the key resolved
+            return self._prepare_torus_2d(load_rows, h, w, rule, use_bits)
 
         if self.n_cols > 1:
             # 2-D mesh torus: every seam is an interior seam of the closed
@@ -542,6 +632,16 @@ class ShardedBackend:
     def _prepare_impl(self, load_rows, h: int, w: int, rule: Rule):
         if rule.boundary == "torus":
             return self._prepare_torus(load_rows, h, w, rule)
+        if getattr(rule, "continuous", False):
+            # the clamped sharded layout pads rows/lanes and re-masks the
+            # padding dead each substep — an int8 construction
+            # (ops.stencil.make_masked_step refuses float boards); the
+            # torus path above runs continuous rules exactly
+            raise ValueError(
+                f"continuous rule {rule.name!r} on the sharded backend "
+                f"needs the torus boundary (exact shapes, no padding "
+                f"mask); the clamped float layout has no masked step"
+            )
         logical = (h, w)
         use_bits = self._use_bits(rule)
         kernel_mode = self._resolve_local_kernel(use_bits, rule)
@@ -636,7 +736,12 @@ class ShardedBackend:
             )
         else:
             make_run = lambda bs: make_sharded_run(
-                rule, self.mesh, logical, block_steps=bs, packed=use_bits
+                rule,
+                self.mesh,
+                logical,
+                block_steps=bs,
+                packed=use_bits,
+                stencil=self._stencil(rule),
             )
 
         gspmd_run = (
@@ -675,7 +780,7 @@ class ShardedBackend:
         masked = (
             bitlife.make_masked_packed_step(rule, logical_shape)
             if use_bits
-            else make_masked_step(rule, logical_shape)
+            else make_masked_step(rule, logical_shape, self._stencil(rule))
         )
 
         @partial(

@@ -50,6 +50,12 @@ def _add_governor_args(p) -> None:
              "after this many seconds marks the service wedged — "
              "finishers salvaged, /readyz answers 500 engine_wedged so a "
              "supervisor recycles the worker (default: off)")
+    p.add_argument(
+        "--mesh-devices", type=int, default=0, metavar="N",
+        help="mega-board tier (docs/SERVING.md): reserve an N-device "
+             "slice so a board the governor would reject as never-fits "
+             "is placed on a sharded 2-D torus mesh instead of 413'd "
+             "(0 = tier off; needs >= 2)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1575,6 +1581,7 @@ def _serve(args) -> int:
             mc_packed=not args.no_bitpack,
             stencil=args.stencil,
             memory_budget_bytes=args.memory_budget_bytes,
+            mesh_devices=args.mesh_devices,
             engine_max_restarts=args.engine_max_restarts,
             settle_deadline_s=args.settle_deadline,
         )
@@ -1788,6 +1795,7 @@ def _sweep(parser, args) -> int:
             mc_packed=not args.no_bitpack,
             stencil=args.stencil,
             memory_budget_bytes=args.memory_budget_bytes,
+            mesh_devices=args.mesh_devices,
             engine_max_restarts=args.engine_max_restarts,
             settle_deadline_s=args.settle_deadline,
         )
@@ -1906,6 +1914,7 @@ def _gateway(args) -> int:
                 mc_packed=not args.no_bitpack,
                 stencil=args.stencil,
                 memory_budget_bytes=args.memory_budget_bytes,
+                mesh_devices=args.mesh_devices,
                 engine_max_restarts=args.engine_max_restarts,
                 settle_deadline_s=args.settle_deadline,
                 series_every_s=args.series_every,
@@ -2070,6 +2079,8 @@ def _fleet(args) -> int:
     # worker enforces its own budget/restart/watchdog knobs
     if args.memory_budget_bytes is not None:
         worker_args += ["--memory-budget-bytes", str(args.memory_budget_bytes)]
+    if args.mesh_devices:
+        worker_args += ["--mesh-devices", str(args.mesh_devices)]
     if args.engine_max_restarts != 3:
         worker_args += ["--engine-max-restarts", str(args.engine_max_restarts)]
     if args.settle_deadline is not None:

@@ -70,7 +70,12 @@ from tpu_life.gateway.errors import backoff_delay
 from tpu_life.gateway.server import ROUTE_SESSIONS
 from tpu_life.io.codec import encode_board
 from tpu_life.runtime.metrics import log
-from tpu_life.serve.spill import SpillRecord, read_spill_sessions
+from tpu_life.serve.spill import (
+    MeshSpillRecord,
+    SpillRecord,
+    read_mesh_sessions,
+    read_spill_sessions,
+)
 
 #: Peer-router 503 codes that mean "definitively not admitted" — the
 #: worker refusal set plus the router's own fleet-level refusal.
@@ -118,6 +123,32 @@ def resume_request(rec: SpillRecord) -> dict:
     # reconnected watcher's numbering stays gapless across the failover)
     if rec.edits:
         body["edits"] = rec.edits
+    if rec.scheduled_edits:
+        body["scheduled_edits"] = rec.scheduled_edits
+    if rec.stream_seq:
+        body["stream_seq"] = rec.stream_seq
+    return body
+
+
+def mesh_resume_request(rec: MeshSpillRecord) -> dict:
+    """The wire body that resumes one mega-board tile-set session (docs/
+    SERVING.md "Mega-board sessions"): a ``resume_tiles_dir`` POINTER
+    instead of ``resume_b64`` — the board never rides the wire, the
+    survivor re-gathers it shard by shard from the shared spill root.
+    This is why mesh rescues are local-plane only: a peer control plane
+    on another host cannot see the directory."""
+    body = {
+        "rule": rec.rule,
+        "steps": rec.remaining,
+        "start_step": rec.step,
+        "resume_tiles_dir": str(rec.root),
+        "height": rec.height,
+        "width": rec.width,
+    }
+    if rec.timeout_s is not None:
+        body["timeout_s"] = rec.timeout_s
+    if rec.trace_id is not None:
+        body["trace_id"] = rec.trace_id
     if rec.scheduled_edits:
         body["scheduled_edits"] = rec.scheduled_edits
     if rec.stream_seq:
@@ -330,11 +361,19 @@ class Migrator:
                 if remote_ns is not None:
                     from tpu_life.serve.spill_http import read_remote_sessions
 
+                    # mesh tile sets never reach the remote store (the
+                    # HTTP backend has no shard-wise contract — the
+                    # worker marked those sessions spill-disabled, which
+                    # lands in ``disabled`` below): nothing extra to read
                     records, corrupt, disabled = read_remote_sessions(
                         self.spill_url, remote_ns
                     )
                 else:
                     records, corrupt, disabled = read_spill_sessions(d)
+                    mrecs, mcorrupt, mdisabled = read_mesh_sessions(d)
+                    records = list(records) + list(mrecs)
+                    corrupt = list(corrupt) + list(mcorrupt)
+                    disabled = list(disabled) + list(mdisabled)
             except Exception:
                 # a read failure must not delete bytes nobody looked at
                 log.exception("fleet: cannot read spills of %s gen %d", name,
@@ -405,6 +444,21 @@ class Migrator:
                 except Exception:
                     log.exception("fleet: resume of %s crashed", fsid)
                     self._record_failure(fsid, "migration_failed")
+                if isinstance(rec, MeshSpillRecord) and rec.root.exists():
+                    with self._lock:
+                        lost = fsid in self._failed
+                    if not lost:
+                        # the survivor admitted the resume but could NOT
+                        # adopt the tiles by rename (no local spill store
+                        # of its own): it will re-gather from THIS
+                        # directory at admission, so the victim dir must
+                        # outlive the run — a bounded disk leak, never a
+                        # truncated re-gather
+                        log.warning(
+                            "fleet: %s resumed without tile adoption; "
+                            "keeping victim spill dir %s", fsid, d,
+                        )
+                        cleanup = False
                 # progress heartbeat: a LIVE run refreshes its watchdog
                 # clock after every record it settles, so stuck_after_s
                 # bounds one record's stall — never the wall time of a
@@ -434,8 +488,11 @@ class Migrator:
                 name, generation, sid
             )
 
-    def _migrate_one(self, fsid: str, rec: SpillRecord) -> None:
-        body = json.dumps(resume_request(rec)).encode()
+    def _migrate_one(self, fsid: str, rec) -> None:
+        is_mesh = isinstance(rec, MeshSpillRecord)
+        body = json.dumps(
+            mesh_resume_request(rec) if is_mesh else resume_request(rec)
+        ).encode()
         deadline = self.clock() + self.timeout_s
         attempt = 0
         while True:
@@ -443,7 +500,7 @@ class Migrator:
             outcome, hint = self._try_candidates(
                 fsid, body, ready, rec.trace_id
             )
-            if outcome == "refused" and self.peers:
+            if outcome == "refused" and self.peers and not is_mesh:
                 # every LOCAL survivor definitively declined (or none is
                 # ready): re-home across the host boundary — the peer
                 # control plane's router speaks the same protocol, and the

@@ -285,6 +285,7 @@ class Router:
         if not ready:
             raise fl_errors.no_ready_workers(len(self.supervisor.workers))
         hint = 1.0
+        mesh_retried = False
         for i, worker in enumerate(self.balancer.candidates(ready)):
             if i > 0:
                 self._c_retry.inc()
@@ -317,30 +318,9 @@ class Router:
                     continue
                 raise fl_errors.upstream_error(worker.name, str(e.cause)) from None
             if status == 201:
-                sid = doc.get("session")
-                if isinstance(sid, str):
-                    doc["session"] = self.sessions.pin(
-                        worker.name, generation, sid
-                    )
-                    # the journey's first control-plane event: which
-                    # fleet sid this trace was routed as, and to whom —
-                    # the join key `tpu-life doctor --sid` resolves with
-                    obs.flight.record(
-                        "route.submit",
-                        sid=doc["session"],
-                        worker_sid=sid,
-                        trace_id=trace_id,
-                        worker=worker.name,
-                        generation=generation,
-                    )
-                if trace_id is not None:
-                    doc.setdefault("trace_id", trace_id)
-                doc["worker"] = worker.name
-                self._c_routed.labels(worker=worker.name).inc()
-                # this worker's queue just grew: re-scrape before routing
-                # the next submit rather than trusting the stale reading
-                self.balancer.invalidate(worker)
-                return status, None, doc
+                return 201, None, self._finish_submit(
+                    worker, generation, doc, trace_id
+                )
             if status == 503 and _error_code(doc) in REFUSAL_CODES:
                 # a definitive refusal — the session was not created
                 log.info(
@@ -352,12 +332,131 @@ class Router:
                 if retry_after:
                     hint = max(hint, retry_after)
                 continue
+            # a mesh-eligible 413 (docs/SERVING.md "Mega-board sessions")
+            # is the one protocol rejection the router does NOT relay
+            # blindly: the refuser volunteered the minimum slice size, so
+            # one targeted retry against the largest ready worker whose
+            # reserved slice clears it is acting on the hint, not the
+            # N-fold deterministic-400 replay the verbatim rule forbids
+            if status == 413 and not mesh_retried:
+                target = self._mesh_candidate(doc, ready, worker)
+                if target is not None:
+                    mesh_retried = True
+                    out = self._mesh_retry(
+                        target, body, api_key, trace_id, worker, doc
+                    )
+                    if out is not None:
+                        return out
             # any other answer (400/413/429/...) is the worker speaking the
             # protocol: relay it verbatim — retrying a deterministic 400 on
             # another worker would just fail N times instead of once
             doc.setdefault("worker", worker.name)
             return status, retry_after, doc
         raise fl_errors.fleet_unavailable(len(ready), retry_after=hint)
+
+    def _finish_submit(
+        self, worker: Worker, generation: int, doc: dict, trace_id: str | None
+    ) -> dict:
+        """The 201 bookkeeping shared by the depth-ranked path and the
+        mesh retry: pin the sid under the generation captured BEFORE the
+        round-trip, stamp the trace, and invalidate the now-staler depth
+        reading."""
+        sid = doc.get("session")
+        if isinstance(sid, str):
+            doc["session"] = self.sessions.pin(worker.name, generation, sid)
+            # the journey's first control-plane event: which
+            # fleet sid this trace was routed as, and to whom —
+            # the join key `tpu-life doctor --sid` resolves with
+            obs.flight.record(
+                "route.submit",
+                sid=doc["session"],
+                worker_sid=sid,
+                trace_id=trace_id,
+                worker=worker.name,
+                generation=generation,
+            )
+        if trace_id is not None:
+            doc.setdefault("trace_id", trace_id)
+        doc["worker"] = worker.name
+        self._c_routed.labels(worker=worker.name).inc()
+        # this worker's queue just grew: re-scrape before routing
+        # the next submit rather than trusting the stale reading
+        self.balancer.invalidate(worker)
+        return doc
+
+    def _mesh_candidate(
+        self, doc: dict, ready: list, rejected_by: Worker
+    ) -> Worker | None:
+        """The worker a mesh-eligible 413 should be retried on: the
+        LARGEST ready slice (most resolved devices) that clears the
+        refuser's ``min_devices`` hint — biggest first, because a board
+        at the edge of one worker's budget fits with the most headroom on
+        the widest mesh.  None when the 413 carries no mesh hint or no
+        ready worker's slice is big enough."""
+        err = doc.get("error")
+        if not isinstance(err, dict) or not err.get("mesh_eligible"):
+            return None
+        need = err.get("min_devices")
+        need = int(need) if isinstance(need, (int, float)) else 2
+        best = None
+        for w in ready:
+            if w is rejected_by:
+                continue
+            dev = getattr(w, "devices", None) or 1
+            if dev >= need and (
+                best is None or dev > (getattr(best, "devices", None) or 1)
+            ):
+                best = w
+        return best
+
+    def _mesh_retry(
+        self,
+        target: Worker,
+        body: bytes,
+        api_key: str | None,
+        trace_id: str | None,
+        rejected_by: Worker,
+        reject_doc: dict,
+    ) -> tuple[int, float | None, dict] | None:
+        """One targeted re-forward of a mesh-eligible 413 to ``target``.
+        Returns the answer to send the client, or None to fall through to
+        relaying the original 413 (the target never saw the request, so
+        no duplicate is possible)."""
+        err = reject_doc.get("error") or {}
+        obs.flight.record(
+            "route.mesh_retry",
+            trace_id=trace_id,
+            rejected_by=rejected_by.name,
+            worker=target.name,
+            devices=getattr(target, "devices", None),
+            min_devices=err.get("min_devices"),
+        )
+        generation = target.generation
+        try:
+            status, retry_after, doc = self.forward(
+                target,
+                "POST",
+                ROUTE_SESSIONS,
+                body=body,
+                api_key=api_key,
+                trace_id=trace_id,
+            )
+        except WorkerUnreachable as e:
+            if e.refused or not target.alive:
+                # the slice never saw it (or died with it): the honest
+                # answer is the original 413 — fall through to the relay
+                self.balancer.invalidate(target)
+                return None
+            raise fl_errors.upstream_error(target.name, str(e.cause)) from None
+        if status == 201:
+            self._c_retry.inc()
+            return 201, None, self._finish_submit(
+                target, generation, doc, trace_id
+            )
+        # the big slice ALSO said no: ITS answer (a 413 with its own
+        # numbers, or a refusal) supersedes the first worker's
+        doc.setdefault("worker", target.name)
+        return status, retry_after, doc
 
     def resolve(self, fsid: str) -> tuple[Worker, str]:
         """Fleet sid -> (live worker of the pinned generation, worker sid);

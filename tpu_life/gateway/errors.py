@@ -162,6 +162,13 @@ def from_serve_error(e: Exception) -> ApiError:
                 503, "insufficient_memory", str(e),
                 retry_after=1.0, extra=extra,
             )
+        # the never-fits 413 carries the mesh hint (docs/SERVING.md
+        # "Mega-board sessions") so clients and the fleet router can
+        # distinguish "resubmit to a mesh-capable fleet of >= min_devices
+        # chips" from "hopeless"
+        extra["mesh_eligible"] = bool(getattr(e, "mesh_eligible", False))
+        if getattr(e, "min_devices", None) is not None:
+            extra["min_devices"] = int(e.min_devices)
         return ApiError(413, "insufficient_memory", str(e), extra=extra)
     if isinstance(e, QueueFull):
         # backpressure: the bounded admission queue is the hard backstop

@@ -102,6 +102,12 @@ class SubmitSpec:
     edits: list | None = None
     scheduled_edits: list | None = None
     stream_seq: int = 0
+    #: shard-wise mega-board resume (docs/SERVING.md "Mega-board
+    #: sessions"): a shared-filesystem pointer to a spilled tile set —
+    #: no board bytes ride the wire; the survivor re-gathers shard by
+    #: shard at admission and ``board`` is a zeros placeholder carrying
+    #: only the geometry.
+    resume_tiles_dir: str | None = None
 
 
 def _require_int(payload: dict, key: str, *, minimum: int = 0) -> int:
@@ -371,6 +377,38 @@ def parse_submit(payload) -> SubmitSpec:
         _require_int(payload, "stream_seq") if "stream_seq" in payload else 0
     )
 
+    if "resume_tiles_dir" in payload:
+        # shard-wise mega-board resume (docs/SERVING.md "Mega-board
+        # sessions"): a shared-filesystem pointer to a spilled tile set.
+        # No board bytes on the wire — a mega-board would not fit a
+        # request body, and must never be materialized on one host; the
+        # placeholder carries only geometry, the service validates the
+        # pointed-at manifest against it.
+        tiles_dir = payload["resume_tiles_dir"]
+        if not isinstance(tiles_dir, str) or not tiles_dir:
+            raise bad_request(
+                "invalid_request",
+                "'resume_tiles_dir' must be a non-empty path string",
+            )
+        height = _require_int(payload, "height", minimum=1)
+        width = _require_int(payload, "width", minimum=1)
+        _check_rule_geometry(rule, (height, width))
+        board = np.zeros((height, width), dtype=rule.board_dtype)
+        return SubmitSpec(
+            board=board,
+            rule=rule_name,
+            steps=steps,
+            timeout_s=timeout_s,
+            seed=seed,
+            temperature=temperature,
+            start_step=start_step,
+            trace_id=trace_id,
+            edits=edits,
+            scheduled_edits=scheduled_edits,
+            stream_seq=stream_seq,
+            resume_tiles_dir=tiles_dir,
+        )
+
     if "resume_b64" in payload:
         # failover resume: byte-exact contract-codec board + the absolute
         # stream position it corresponds to (docs/FLEET.md)
@@ -514,6 +552,11 @@ def render_view(view: SessionView) -> dict:
     # untouched sessions keep their exact prior response shape
     if view.edits:
         out["edits"] = view.edits
+    # mega-board stamp (docs/SERVING.md "Mega-board sessions"): "RxC"
+    # when the board runs sharded over a mesh slice — present only
+    # there, so single-chip responses keep their exact prior shape
+    if view.mesh is not None:
+        out["mesh"] = view.mesh
     return out
 
 

@@ -623,6 +623,7 @@ class _Handler(JsonHandler):
                 edits=spec.edits,
                 scheduled_edits=spec.scheduled_edits,
                 stream_seq=spec.stream_seq,
+                mesh_resume_dir=spec.resume_tiles_dir,
             )
         except Exception as e:  # typed serve errors -> typed HTTP
             raise gw_errors.from_serve_error(e) from e

@@ -45,8 +45,12 @@ from tpu_life.models.rules import Rule
 
 #: Executors carrying the float32 board path.  The single allow-list —
 #: runner factory, serve engine factory and driver pre-check all
-#: consult it (the ``mc.SUPPORTED_BACKENDS`` pattern).
-SUPPORTED_BACKENDS = ("jax", "numpy")
+#: consult it (the ``mc.SUPPORTED_BACKENDS`` pattern).  The sharded
+#: multi-device backend keeps the board float32 end to end (torus
+#: boundary only — backends.sharded_backend raises the precise reason
+#: otherwise), as does the serve mesh tier built on it (its CompileKey
+#: backend is the ``mesh:RxC`` family, checked by prefix below).
+SUPPORTED_BACKENDS = ("jax", "numpy", "sharded")
 
 #: allclose tolerance between float executors (numpy oracle vs the jax
 #: roll/matmul paths).  Stated, tested, and documented in docs/RULES.md:
@@ -59,7 +63,9 @@ def require_float_path(rule: Rule, backend_name: str) -> None:
     """The hard gate: continuous rules only run on float executors.
     A silent int8 cast would quantize the board to junk — worse than
     an error."""
-    if backend_name not in SUPPORTED_BACKENDS:
+    if backend_name not in SUPPORTED_BACKENDS and not backend_name.startswith(
+        "mesh:"
+    ):
         raise ValueError(
             f"continuous rule {rule.name!r} needs the jax or numpy "
             f"backend (float32 boards; {backend_name!r} has no float "

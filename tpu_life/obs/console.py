@@ -162,7 +162,7 @@ def build_view(prev: dict | None, cur: dict, healthz: dict | None = None) -> dic
              "queue": None, "occupancy": None, "watchers": None,
              "est_bytes": None, "budget_bytes": None,
              "steps": 0.0, "packed_steps": 0.0, "matmul_keys": None,
-             "frames_s": None, "gaps_s": None},
+             "mesh": None, "frames_s": None, "gaps_s": None},
         )
 
     def rated(key: str, cur_val: float) -> float | None:
@@ -210,6 +210,8 @@ def build_view(prev: dict | None, cur: dict, healthz: dict | None = None) -> dic
             r["budget_bytes"] = val
         elif name == "serve_matmul_keys":
             r["matmul_keys"] = val
+        elif name == "serve_mesh_sessions":
+            r["mesh"] = (r["mesh"] or 0.0) + val
         elif kind == "counter" and name.endswith("_total"):
             pass  # unrowed counters still merge into fleet totals below
 
@@ -230,6 +232,7 @@ def build_view(prev: dict | None, cur: dict, healthz: dict | None = None) -> dic
             "sessions_s": total("sessions_s"),
             "queue": total("queue"),
             "watchers": total("watchers"),
+            "mesh": total("mesh"),
             "frames_s": total("frames_s"),
             "gaps_s": total("gaps_s"),
         },
@@ -275,7 +278,7 @@ def render_view(view: dict, *, color: bool = True) -> str:
     cols = (
         ("worker", 8), ("steps/s", 10), ("sess/s", 7), ("queue", 6),
         ("occ", 5), ("watch", 6), ("frames/s", 9), ("gaps/s", 7),
-        ("packed", 7), ("mm", 4), ("mem", 14),
+        ("packed", 7), ("mm", 4), ("mesh", 5), ("mem", 14),
     )
     lines.append(" ".join(f"{h:>{w}}" for h, w in cols))
     rows = dict(view["workers"])
@@ -291,14 +294,16 @@ def render_view(view: dict, *, color: bool = True) -> str:
             worker, _fmt_num(r["steps_s"]), _fmt_num(r["sessions_s"]),
             _fmt_num(r["queue"]), _fmt_num(r["occupancy"]),
             _fmt_num(r["watchers"]), _fmt_num(r["frames_s"]),
-            _fmt_num(r["gaps_s"]), packed, _fmt_num(r["matmul_keys"]), mem,
+            _fmt_num(r["gaps_s"]), packed, _fmt_num(r["matmul_keys"]),
+            _fmt_num(r["mesh"]), mem,
         )
         lines.append(" ".join(f"{str(v):>{w}}" for v, (_, w) in zip(vals, cols)))
     if len(rows) > 1:
         vals = (
             "TOTAL", _fmt_num(fleet["steps_s"]), _fmt_num(fleet["sessions_s"]),
             _fmt_num(fleet["queue"]), "-", _fmt_num(fleet["watchers"]),
-            _fmt_num(fleet["frames_s"]), _fmt_num(fleet["gaps_s"]), "-", "-", "-",
+            _fmt_num(fleet["frames_s"]), _fmt_num(fleet["gaps_s"]), "-", "-",
+            _fmt_num(fleet["mesh"]), "-",
         )
         lines.append(" ".join(f"{str(v):>{w}}" for v, (_, w) in zip(vals, cols)))
     slo = view.get("slo") or {}

@@ -171,6 +171,15 @@ def _serve_summary(rounds: list[dict]) -> dict:
         for r in rounds:
             stencil_keys.update(r.get("stencil_keys") or {})
         out["stencil_keys"] = stencil_keys
+    # the mega-board stamp (ISSUE 19): live mesh-placed sessions (a
+    # gauge — the last record is the run's final view, max the peak
+    # concurrent count) — only when the sink carries it, so mesh-less
+    # sinks summarize byte-stable
+    if any("mesh_sessions" in r for r in rounds):
+        out["mesh_sessions"] = last.get("mesh_sessions", 0)
+        out["mesh_sessions_max"] = max(
+            r.get("mesh_sessions", 0) for r in rounds
+        )
     # the live-session stamps (ISSUE 16): frames/gaps are cumulative
     # counters (max = the final reading, robust to a tail round that
     # dropped the gated stamp), watchers is a gauge (max = the peak) —
@@ -274,6 +283,14 @@ def _merge_serve(per_run: dict) -> dict:
         for s in summaries:
             stencil_keys.update(s.get("stencil_keys") or {})
         merged["stencil_keys"] = stencil_keys
+    # mesh-session gauges sum like the fleet's other live-engine views
+    # (concurrent workers each held that many mega-boards at once)
+    mesh = [s["mesh_sessions"] for s in summaries if "mesh_sessions" in s]
+    if mesh:
+        merged["mesh_sessions"] = sum(mesh)
+        merged["mesh_sessions_max"] = sum(
+            s.get("mesh_sessions_max", 0) for s in summaries
+        )
     # streaming merges like the counts: frames and gaps sum across the
     # fleet's workers, watcher peaks sum too (concurrent workers each
     # held that many watchers at once)
@@ -497,6 +514,11 @@ def render(summary: dict) -> str:
                 + " ".join(
                     f"{k}:{v}" for k, v in sorted(paths.items())
                 )
+            )
+        if "mesh_sessions" in serve:
+            lines.append(
+                f"  mesh_sessions={_fmt(serve['mesh_sessions'])} "
+                f"(max {_fmt(serve.get('mesh_sessions_max'))})"
             )
         if "steps_advanced_packed" in serve:
             lines.append(

@@ -244,15 +244,17 @@ def make_conv(xp, shape: tuple[int, int], kernel: np.ndarray, boundary: str):
     ``kernel`` as banded matmuls.  ``xp`` is numpy or jax.numpy; under
     jnp the operators become constants of the compiled program, so XLA
     schedules them straight onto the MXU."""
-    ops = [
-        (xp.asarray(a), xp.asarray(b))
-        for a, b in band_operators(shape, kernel, boundary)
-    ]
+    # keep the operators as HOST numpy arrays and lift them per call:
+    # ``xp.asarray`` inside a traced context mints that trace's own
+    # constant, so a cached conv may serve many separately-traced
+    # programs (the sharded halo scan compiles one per block depth)
+    # without leaking one trace's constants into another
+    ops = band_operators(shape, kernel, boundary)
 
     def conv(x):
         out = None
         for a, b in ops:
-            t = xp.matmul(xp.matmul(a, x), b)
+            t = xp.matmul(xp.matmul(xp.asarray(a), x), xp.asarray(b))
             out = t if out is None else out + t
         return out
 

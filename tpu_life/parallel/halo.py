@@ -57,6 +57,7 @@ def make_sharded_run(
     axis: str = ROW_AXIS,
     block_steps: int = 1,
     packed: bool = False,
+    stencil: str = "roll",
 ) -> Callable[[jax.Array, int], jax.Array]:
     """Build ``run(board, num_blocks)``: ``num_blocks * block_steps`` CA steps
     on a row-sharded board, halos exchanged once per block.
@@ -65,7 +66,9 @@ def make_sharded_run(
     ``P(axis, None)``; ``logical_shape`` is the real board extent, used to
     pin padding/out-of-board cells dead.  With ``packed=True`` the board is
     a uint32 bitboard (``tpu_life.ops.bitlife``) — the ring exchange is
-    identical, just 32x narrower.
+    identical, just 32x narrower.  ``stencil`` routes the per-shard
+    counting path (roll shift-adds vs banded matmuls, docs/RULES.md) —
+    the halo exchange is identical either way.
     """
     # one builder, one halo/scan/jit scaffold: the 1-D stripe is the
     # n_cols=1 special case of the 2-D block decomposition
@@ -76,6 +79,7 @@ def make_sharded_run(
         row_axis=axis,
         block_steps=block_steps,
         packed=packed,
+        stencil=stencil,
     )
 
 
@@ -173,6 +177,7 @@ def make_sharded_run_torus_2d(
     col_axis: str = COL_AXIS,
     block_steps: int = 1,
     packed: bool = True,
+    stencil: str = "roll",
 ) -> Callable[[jax.Array, int], jax.Array]:
     """2-D block decomposition of the TORUS.
 
@@ -210,6 +215,7 @@ def make_sharded_run_torus_2d(
         block_steps=block_steps,
         packed=packed,
         torus=True,
+        stencil=stencil,
     )
 
 
@@ -232,6 +238,7 @@ def make_sharded_run_2d(
     block_steps: int = 1,
     packed: bool = False,
     torus: bool = False,
+    stencil: str = "roll",
 ) -> Callable[[jax.Array, int], jax.Array]:
     """2-D block decomposition: halos exchanged along BOTH mesh axes.
 
@@ -270,8 +277,17 @@ def make_sharded_run_2d(
         # neighbor, so the CLAMPED twin of the rule runs unmasked (packed
         # bit step or plain int8 stencil step alike)
         twin = get_clamped_twin(rule)
+        # the local substep sees only the halo-extended chunk, so the
+        # counting path is free to be the shift-add roll OR the banded
+        # matmul (both shape-lazy: the ext chunk shape is static under
+        # the jit trace) — PR 15's known limit discharged.  Continuous
+        # rules ride the same seam: make_step routes the clamped twin to
+        # the float Lenia step, whose truncated edge contributions only
+        # corrupt the fringe each block crops.
         plain_step = (
-            bitlife.make_packed_step(twin) if packed else make_step(twin)
+            bitlife.make_packed_step(twin)
+            if packed
+            else make_step(twin, stencil)
         )
         masked_step = lambda ext, ro, co: plain_step(ext)  # noqa: E731
         fwd_r = [(i, (i + 1) % n_r) for i in range(n_r)]
@@ -282,7 +298,7 @@ def make_sharded_run_2d(
         masked_step = (
             bitlife.make_masked_packed_step(rule, tuple(logical_shape))
             if packed
-            else make_masked_step(rule, tuple(logical_shape))
+            else make_masked_step(rule, tuple(logical_shape), stencil)
         )
         fwd_r = [(i, i + 1) for i in range(n_r - 1)]
         bwd_r = [(i + 1, i) for i in range(n_r - 1)]

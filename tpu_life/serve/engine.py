@@ -652,6 +652,14 @@ def make_engine(
         from tpu_life.mc.engine import make_mc_engine
 
         return make_mc_engine(key, capacity, chunk_steps, packed=mc_packed)
+    if str(key.backend).startswith("mesh:"):
+        # mega-board tier (serve/mesh_engine.py): the board is sharded
+        # over a mesh:RxC device slice with halo exchange — capacity is
+        # pinned to 1 because the mega-board owns the slice, whatever
+        # the scheduler's batch capacity is for single-chip engines
+        from tpu_life.serve.mesh_engine import MeshEngine
+
+        return MeshEngine(key, chunk_steps)
     if getattr(key.rule, "continuous", False):
         # continuous keys need a float executor (models/lenia.py): the
         # vmapped device batch or the numpy oracle — a slot-loop backend

@@ -48,6 +48,13 @@ class InsufficientMemory(ServeError):
     engine alone can never fit the budget (HTTP 413, never retried).
     ``estimated_bytes`` / ``budget_bytes`` carry the arithmetic so
     clients and tests can see exactly what was refused.
+
+    A permanent (413) rejection additionally carries the mesh hint
+    (docs/SERVING.md "Mega-board sessions"): ``mesh_eligible`` is True
+    when the board has a sharded path (deterministic or continuous) and
+    a multi-device slice could hold it, and ``min_devices`` is the
+    smallest such slice — so clients and the fleet router can
+    distinguish "resubmit to a mesh-capable worker" from "hopeless".
     """
 
     def __init__(
@@ -57,11 +64,15 @@ class InsufficientMemory(ServeError):
         transient: bool,
         estimated_bytes: int,
         budget_bytes: int,
+        mesh_eligible: bool = False,
+        min_devices: int | None = None,
     ):
         super().__init__(message)
         self.transient = transient
         self.estimated_bytes = estimated_bytes
         self.budget_bytes = budget_bytes
+        self.mesh_eligible = mesh_eligible
+        self.min_devices = min_devices
 
 
 class SessionTimeout(ServeError):

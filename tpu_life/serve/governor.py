@@ -63,6 +63,12 @@ BUDGET_PROBE_TIMEOUT_S = float(os.environ.get("TPU_LIFE_BUDGET_PROBE_S", 10.0))
 
 _DEFAULT_LOCK = threading.Lock()
 _DEFAULT_BUDGET: int | None = None
+_DEFAULT_PER_DEVICE: int | None = None
+
+#: Whole-board copies the mesh tier's halo-exchange scan keeps resident
+#: per shard: the board itself plus its halo-extended working copy
+#: (``parallel/halo.py`` pads ``radius x block_steps`` each side).
+MESH_COPIES = 2
 
 
 def estimate_engine_bytes(key, capacity: int, *, mc_packed: bool = True) -> int:
@@ -83,6 +89,11 @@ def estimate_engine_bytes(key, capacity: int, *, mc_packed: bool = True) -> int:
       uint32) and the uint32[5] acceptance table, plus the shared int32
       remaining vector.
     """
+    if str(getattr(key, "backend", "")).startswith("mesh:"):
+        # mega-board tier (serve/mesh_engine.py): capacity is pinned to
+        # 1 — the board owns its slice — so the batched ``capacity``
+        # multiplier never applies, whatever the scheduler's batch size
+        return estimate_mesh_bytes(key)
     h, w = key.shape
     stochastic = bool(getattr(key.rule, "stochastic", False))
     packed = False
@@ -109,6 +120,88 @@ def estimate_engine_bytes(key, capacity: int, *, mc_packed: bool = True) -> int:
         total += capacity * 4 * 3  # k0 / k1 / absolute step counter
         total += capacity * 4 * 5  # the uint32[5] acceptance table
     return total
+
+
+def estimate_mesh_bytes(key) -> int:
+    """Whole-slice footprint of the capacity-1 mesh engine ``key`` would
+    mint (serve/mesh_engine.py): one board spread over the slice, times
+    :data:`MESH_COPIES` for the halo-exchange working set, plus the
+    single remaining-steps word.  The slice total is what admission
+    charges against the worker budget; :func:`estimate_mesh_shard_bytes`
+    breaks the same number into per-shard estimator rows."""
+    import numpy as _np
+
+    h, w = key.shape
+    itemsize = _np.dtype(getattr(key, "dtype", "int8")).itemsize
+    return h * w * itemsize * MESH_COPIES + 4
+
+
+def estimate_mesh_shard_bytes(key, mesh_shape) -> dict[str, int]:
+    """Per-shard estimator rows for a ``mesh_shape`` placement of
+    ``key``: ``{"RxC-shard": bytes}`` — every shard is the same size
+    (the backend pads to divisibility), a ceil-divided block plus its
+    halo ring, :data:`MESH_COPIES` copies.  These are the
+    ``serve_mesh_estimated_bytes{key,shard}`` gauge rows
+    (docs/SERVING.md "Mega-board sessions")."""
+    import numpy as _np
+
+    h, w = key.shape
+    rows, cols = int(mesh_shape[0]), int(mesh_shape[1])
+    itemsize = _np.dtype(getattr(key, "dtype", "int8")).itemsize
+    shard_h = -(-h // rows)
+    shard_w = -(-w // cols)
+    radius = max(1, int(getattr(key.rule, "radius", 1)))
+    per = (shard_h * shard_w + 2 * radius * (shard_h + shard_w)) * itemsize
+    per *= MESH_COPIES
+    return {f"{r}x{c}": per for r in range(rows) for c in range(cols)}
+
+
+def default_per_device_bytes() -> int:
+    """Default memory per resolved device — the denominator of the
+    mesh-eligibility hint when no slice is configured locally.  Memoized
+    alongside :func:`default_budget` (same bounded probe)."""
+    global _DEFAULT_PER_DEVICE
+    with _DEFAULT_LOCK:
+        if _DEFAULT_PER_DEVICE is None:
+            from tpu_life.utils.platform import device_info
+
+            _, kind = device_info(timeout_s=BUDGET_PROBE_TIMEOUT_S)
+            _DEFAULT_PER_DEVICE = DEFAULT_BYTES_PER_DEVICE.get(
+                kind, DEFAULT_BYTES_PER_DEVICE["host"]
+            )
+        return _DEFAULT_PER_DEVICE
+
+
+def mesh_min_devices(key, per_device_bytes: int) -> int:
+    """Smallest mesh slice (device count) whose per-device share holds
+    ``key``'s slice total — the machine-readable "minimum slice size" a
+    never-fits 413 carries so clients and the router can target a
+    mesh-capable fleet instead of giving up."""
+    total = estimate_mesh_bytes(key)
+    per_device_bytes = max(1, int(per_device_bytes))
+    return max(2, -(-total // per_device_bytes))
+
+
+def mesh_hint(key, budget: int | None, mesh_devices: int = 0):
+    """``(mesh_eligible, min_devices)`` for a never-fits rejection.
+
+    Eligible means "a mesh-capable fleet can run this": the rule has a
+    sharded path (deterministic or continuous — the stochastic tier has
+    no sharded Monte-Carlo executor) and the key is not already a mesh
+    key (a mesh slice that still overflows its budget is hopeless, not
+    resubmittable).  ``min_devices`` divides the slice total by the
+    per-device share — the local slice's (``budget / mesh_devices``)
+    when one is configured, the platform default otherwise.
+    """
+    if getattr(key.rule, "stochastic", False):
+        return False, None
+    if str(getattr(key, "backend", "")).startswith("mesh:"):
+        return False, None
+    if mesh_devices and budget:
+        per_device = max(1, int(budget) // int(mesh_devices))
+    else:
+        per_device = default_per_device_bytes()
+    return True, mesh_min_devices(key, per_device)
 
 
 def default_budget() -> int:
@@ -146,6 +239,7 @@ def check_admission(
     capacity: int,
     *,
     mc_packed: bool = True,
+    mesh_devices: int = 0,
 ) -> None:
     """Raise :class:`InsufficientMemory` when admitting a session of
     ``key`` would overflow ``budget``.
@@ -160,15 +254,23 @@ def check_admission(
         return
     need = estimate_engine_bytes(key, capacity, mc_packed=mc_packed)
     if need > budget:
+        eligible, min_dev = mesh_hint(key, budget, mesh_devices)
         raise InsufficientMemory(
             f"session's engine needs ~{need} bytes "
             f"(capacity {capacity}, shape {key.shape[0]}x{key.shape[1]}, "
             f"backend {key.backend}) but the memory budget is {budget} "
             f"bytes — it can never fit; shrink the board or raise "
-            f"--memory-budget-bytes",
+            f"--memory-budget-bytes"
+            + (
+                f" (mesh-eligible: a slice of >= {min_dev} devices holds it)"
+                if eligible
+                else ""
+            ),
             transient=False,
             estimated_bytes=need,
             budget_bytes=budget,
+            mesh_eligible=eligible,
+            min_devices=min_dev,
         )
     held = sum(reserved.values())
     if held + need > budget:

@@ -380,19 +380,38 @@ class Scheduler:
                 deferred.append(s)
                 continue
             try:
-                # seed/temperature are the stochastic per-slot state
-                # (validated at submit); deterministic engines ignore them.
-                # start_step re-enters the counter-based PRNG stream at the
-                # session's absolute position — the resumed-after-failover
-                # case (start_step > 0) is bit-exact by construction
-                engine.load(
-                    slot,
-                    s.board,
-                    self._load_budget(s),
-                    seed=s.seed,
-                    temperature=s.temperature,
-                    start_step=s.start_step + s.steps_done,
-                )
+                loader = getattr(s, "mesh_resume", None)
+                if loader is not None and hasattr(engine, "load_tiles"):
+                    # shard-wise mega-board resume (docs/SERVING.md
+                    # "Mega-board sessions"): the session carries a tile
+                    # block loader instead of a board — each destination
+                    # shard pulls its own rectangle at load, possibly
+                    # onto a different mesh shape than the one that
+                    # spilled (arXiv 2112.01075).  Consumed once: a
+                    # later re-admit (engine recovery) reloads from the
+                    # engine's own salvaged state like any session.
+                    engine.load_tiles(
+                        slot,
+                        loader,
+                        self._load_budget(s),
+                        start_step=s.start_step + s.steps_done,
+                    )
+                    s.mesh_resume = None
+                else:
+                    # seed/temperature are the stochastic per-slot state
+                    # (validated at submit); deterministic engines ignore
+                    # them.  start_step re-enters the counter-based PRNG
+                    # stream at the session's absolute position — the
+                    # resumed-after-failover case (start_step > 0) is
+                    # bit-exact by construction
+                    engine.load(
+                        slot,
+                        s.board,
+                        self._load_budget(s),
+                        seed=s.seed,
+                        temperature=s.temperature,
+                        start_step=s.start_step + s.steps_done,
+                    )
             except recovery.RECOVERABLE as e:
                 engine.release(slot)
                 s.fail(f"load failed: {e}")

@@ -162,6 +162,16 @@ class ServeConfig:
     # hot path then pays one is-None check and nothing else.
     series_every_s: float = 1.0
     series_max_snapshots: int = 512
+    # the mega-board mesh tier (docs/SERVING.md "Mega-board sessions"):
+    # the device count of the slice reserved for sessions whose governor
+    # verdict is "never fits on one chip".  0 disables the tier — those
+    # sessions stay a typed 413 (now carrying the mesh_eligible hint).
+    # When > 0, a never-fits deterministic/continuous session is
+    # converted at submit into a ``mesh:RxC`` CompileKey (shape from
+    # ``serve.mesh_engine.plan_mesh_shape``) and runs capacity-1 on the
+    # sharded halo-exchange backend, coexisting with batched small
+    # sessions on the remaining capacity.
+    mesh_devices: int = 0
 
 
 class SimulationService:
@@ -210,6 +220,11 @@ class SimulationService:
             raise ValueError(
                 f"series_max_snapshots must be >= 1, "
                 f"got {self.config.series_max_snapshots}"
+            )
+        if self.config.mesh_devices < 0:
+            raise ValueError(
+                f"mesh_devices must be >= 0 (0 disables the mesh tier), "
+                f"got {self.config.mesh_devices}"
             )
         from tpu_life.ops.conv import validate_stencil
 
@@ -367,6 +382,24 @@ class SimulationService:
             "live engines whose CompileKey compiled the matmul stencil",
         )
         self._g_matmul_keys.labels()
+        # the mega-board mesh tier (docs/SERVING.md "Mega-board
+        # sessions"): how many live sessions run sharded over a mesh
+        # slice, and the governor's per-shard estimator rows — one gauge
+        # sample per (key bucket, shard) so an operator sees exactly
+        # what each device of the slice is charged with
+        self._g_mesh_sessions = self.registry.gauge(
+            "serve_mesh_sessions",
+            "live sessions sharded over the reserved mesh slice",
+        )
+        self._g_mesh_sessions.labels()
+        self._g_mesh_est_bytes = self.registry.gauge(
+            "serve_mesh_estimated_bytes",
+            "estimated resident bytes per mesh shard of a live mega-board "
+            "engine",
+            labels=("key", "shard"),
+        )
+        # (key bucket, shard) pairs last set (zeroed when the engine goes)
+        self._mesh_est_buckets: set[tuple[str, str]] = set()
         # the span-ring loss counter (docs/OBSERVABILITY.md "Distributed
         # tracing"): events evicted from the bounded trace buffer between
         # scrapes — a nonzero value tells the doctor a journey may have
@@ -539,8 +572,18 @@ class SimulationService:
         edits=None,
         scheduled_edits=None,
         stream_seq: int = 0,
+        mesh_resume_dir: str | None = None,
     ) -> str:
         """Admit one simulation request; returns its session id.
+
+        ``mesh_resume_dir`` is the shard-wise mega-board resume pointer
+        (docs/SERVING.md "Mega-board sessions"): a spilled tile-set
+        directory on a shared filesystem.  ``board`` is then only a
+        geometry-carrying placeholder — the session re-gathers tile by
+        tile at admission through ``MeshEngine.load_tiles`` (possibly
+        onto a different mesh shape than the one that spilled), so the
+        full board is never materialized on this host.  Requires a
+        configured mesh slice (``mesh_devices >= 2``).
 
         ``edits`` / ``scheduled_edits`` / ``stream_seq`` are the steered-
         session resume fields (docs/STREAMING.md): ``edits`` is a prior
@@ -677,6 +720,16 @@ class SimulationService:
             edit_scheduled.append(
                 (step, validate_cells(cells, board.shape, rule))
             )
+        # the mega-board mesh tier's resume pointer: validated against
+        # the tile-set manifest BEFORE anything is stored, and minting
+        # the session's mesh placement up front so the governor check
+        # below runs against the mesh key
+        mesh_shape: tuple[int, int] | None = None
+        mesh_resume_rec = None
+        if mesh_resume_dir is not None:
+            mesh_resume_rec, mesh_shape = self._open_mesh_resume(
+                mesh_resume_dir, rule, board.shape, steps, start_step
+            )
         # admission is a read-modify-write on the queue: everything from the
         # backpressure check to the enqueue happens under the lock, so two
         # racing submits can neither both squeeze past a full queue nor
@@ -693,16 +746,19 @@ class SimulationService:
             # session exists anywhere, so an XLA RESOURCE_EXHAUSTED
             # becomes a typed rejection instead of a dead worker.
             if self._memory_budget is not None:
-                from tpu_life.ops.conv import resolve_stencil
+                if mesh_shape is not None:
+                    key = self._mesh_key(rule, board, mesh_shape)
+                else:
+                    from tpu_life.ops.conv import resolve_stencil
 
-                key = compile_key_for(
-                    rule,
-                    board,
-                    self.config.backend,
-                    resolve_stencil(
-                        rule, self.config.stencil, self.config.backend
-                    ),
-                )
+                    key = compile_key_for(
+                        rule,
+                        board,
+                        self.config.backend,
+                        resolve_stencil(
+                            rule, self.config.stencil, self.config.backend
+                        ),
+                    )
                 sched = self.scheduler
                 reserved = self._governor.reserved_bytes(
                     sched.engines,
@@ -710,15 +766,8 @@ class SimulationService:
                     self.config.capacity,
                     mc_packed=self.config.mc_packed,
                 )
-                try:
-                    self._governor.check_admission(
-                        key,
-                        reserved,
-                        self._memory_budget,
-                        self.config.capacity,
-                        mc_packed=self.config.mc_packed,
-                    )
-                except InsufficientMemory as e:
+
+                def _record_reject(e: InsufficientMemory) -> None:
                     if e.transient:
                         # transient pressure IS backpressure: it joins
                         # the classic rejection counter so the stats
@@ -735,7 +784,53 @@ class SimulationService:
                     obs.flight.record(
                         "rejection", reason=reason, trace_id=trace_id
                     )
-                    raise
+
+                try:
+                    self._governor.check_admission(
+                        key,
+                        reserved,
+                        self._memory_budget,
+                        self.config.capacity,
+                        mc_packed=self.config.mc_packed,
+                        mesh_devices=self.config.mesh_devices,
+                    )
+                except InsufficientMemory as e:
+                    # the mesh tier's conversion point (docs/SERVING.md
+                    # "Mega-board sessions"): a never-fits verdict on a
+                    # worker with a reserved slice is a PLACEMENT, not a
+                    # rejection — re-mint the key as mesh:RxC (capacity
+                    # 1, sharded over the slice) and re-run admission
+                    # against the same reserved set
+                    mesh_key = None
+                    if (
+                        not e.transient
+                        and mesh_shape is None
+                        and self.config.mesh_devices >= 2
+                        and not rule.stochastic
+                    ):
+                        mesh_key, mesh_shape = self._plan_mesh_key(rule, board)
+                    if mesh_key is None:
+                        _record_reject(e)
+                        raise
+                    try:
+                        self._governor.check_admission(
+                            mesh_key,
+                            reserved,
+                            self._memory_budget,
+                            1,
+                            mc_packed=self.config.mc_packed,
+                        )
+                    except InsufficientMemory as e2:
+                        mesh_shape = None
+                        _record_reject(e2)
+                        raise
+                    obs.flight.record(
+                        "mesh.placement",
+                        trace_id=trace_id,
+                        rule=rule.name,
+                        mesh=f"{mesh_shape[0]}x{mesh_shape[1]}",
+                        estimated_bytes=e.estimated_bytes,
+                    )
             # backpressure check BEFORE the session exists anywhere; a bounce
             # is an admission outcome worth counting (rejection rate is the
             # first overload signal), so the counter ticks before the raise
@@ -766,6 +861,26 @@ class SimulationService:
                 scheduled_edits=edit_scheduled,
                 stream_seq=stream_seq,
             )
+            if mesh_shape is not None:
+                # the mega-board stamp: the keyer mints mesh:RxC from it,
+                # the view renders it, the spill pass goes shard-wise
+                s.mesh = mesh_shape
+            if mesh_resume_rec is not None:
+                # ownership transfer by rename (atomic on one filesystem):
+                # the survivor's store adopts the tile set under the NEW
+                # sid, so the session is durable from round one and the
+                # victim-directory cleanup finds nothing left to delete.
+                # A failed rename (cross-device) falls back to reading
+                # the tiles in place.
+                import dataclasses as _dc
+
+                rec = mesh_resume_rec
+                adopt = getattr(self._spill, "adopt_mesh", None)
+                if adopt is not None:
+                    new_root = adopt(s.sid, rec.root)
+                    if new_root is not None:
+                        rec = _dc.replace(rec, root=new_root)
+                s.mesh_resume = rec.block_loader()
             # the admission flight event (docs/OBSERVABILITY.md): one
             # ring append per accepted session — what the doctor joins
             # the journey's start on.  start_step > 0 marks a resumed
@@ -1207,6 +1322,8 @@ class SimulationService:
         from tpu_life.ops.conv import resolve_stencil
 
         def keyer(s) -> CompileKey:
+            if getattr(s, "mesh", None) is not None:
+                return self._mesh_key(s.rule, s.board, s.mesh)
             return compile_key_for(
                 s.rule,
                 s.board,
@@ -1215,6 +1332,81 @@ class SimulationService:
             )
 
         return keyer
+
+    # -- the mega-board mesh tier (docs/SERVING.md "Mega-board sessions") --
+    def _mesh_key(self, rule, board, mesh_shape) -> CompileKey:
+        """The ``mesh:RxC`` CompileKey for a placed mega-board.  The
+        stencil resolves against the device-backend crossover model (the
+        sharded scan compiles the same XLA stencil the jax executor
+        does), so a mega-board Lenia takes the banded-matmul path."""
+        from tpu_life.ops.conv import resolve_stencil
+        from tpu_life.serve.mesh_engine import mesh_backend_name
+
+        return compile_key_for(
+            rule,
+            board,
+            mesh_backend_name(mesh_shape),
+            resolve_stencil(rule, self.config.stencil, "jax"),
+        )
+
+    def _plan_mesh_key(self, rule, board):
+        """``(mesh_key, mesh_shape)`` for a never-fits board on this
+        worker's reserved slice, or ``(None, None)`` when no legal mesh
+        factorization exists (the rejection then stands, carrying the
+        mesh_eligible hint for a bigger fleet)."""
+        from tpu_life.serve.mesh_engine import plan_mesh_shape
+
+        shape = plan_mesh_shape(self.config.mesh_devices, board.shape, rule)
+        if shape is None:
+            return None, None
+        return self._mesh_key(rule, board, shape), shape
+
+    def _open_mesh_resume(self, mesh_resume_dir, rule, board_shape, steps, start_step):
+        """Validate a shard-wise resume pointer against its tile-set
+        manifest and this request; returns ``(record, mesh_shape)``.
+        Raises ValueError (a typed 400 at the gateway) on any mismatch —
+        before anything is stored."""
+        from tpu_life.serve.mesh_engine import plan_mesh_shape
+        from tpu_life.serve.spill import read_mesh_session_dir
+
+        if self.config.mesh_devices < 2:
+            raise ValueError(
+                "mesh_resume_dir needs a worker with a reserved mesh "
+                "slice (mesh_devices >= 2); this worker has "
+                f"{self.config.mesh_devices}"
+            )
+        if rule.stochastic:
+            raise ValueError(
+                f"rule {rule.name!r} is stochastic: the mesh tier has no "
+                "sharded Monte-Carlo path"
+            )
+        if steps < 1:
+            raise ValueError("mesh_resume_dir with steps == 0 has nothing to run")
+        rec = read_mesh_session_dir(mesh_resume_dir)
+        if get_rule(rec.rule).name != rule.name:
+            raise ValueError(
+                f"tile set at {mesh_resume_dir} was spilled under rule "
+                f"{rec.rule!r}, not {rule.name!r}"
+            )
+        if (rec.height, rec.width) != tuple(board_shape):
+            raise ValueError(
+                f"tile set at {mesh_resume_dir} is "
+                f"{rec.height}x{rec.width}, not "
+                f"{board_shape[0]}x{board_shape[1]}"
+            )
+        if int(start_step) != rec.step:
+            raise ValueError(
+                f"tile set's resumable epoch is step {rec.step}; "
+                f"start_step {start_step} does not match"
+            )
+        shape = plan_mesh_shape(self.config.mesh_devices, board_shape, rule)
+        if shape is None:
+            raise ValueError(
+                f"no legal {self.config.mesh_devices}-device mesh "
+                f"factorization for a {board_shape[0]}x{board_shape[1]} "
+                f"{rule.name} board"
+            )
+        return rec, shape
 
     def _pump_locked(self) -> RoundStats:
         with obs.activate(self._tracer), obs.span(
@@ -1470,6 +1662,15 @@ class SimulationService:
             for s, engine, slot in plan:
                 if s.state in TERMINAL or s.spill_disabled:
                     continue
+                if getattr(s, "mesh", None) is not None:
+                    # mega-board sessions spill shard-wise (docs/SERVING.md
+                    # "Mega-board sessions") — never through the
+                    # full-board path, which would gather the one thing
+                    # the tier exists to never materialize
+                    err = self._spill_mesh(s, engine, slot, now)
+                    if err is not None:
+                        failures.append((s, err))
+                    continue
                 if engine is None:
                     board, lag = s.board, 0
                 else:
@@ -1533,6 +1734,67 @@ class SimulationService:
         self._h_snapshot.observe(dt)
         self._snapshot_s_total += dt
         return failures
+
+    def _spill_mesh(self, s, engine, slot, now) -> Exception | None:
+        """Shard-wise spill of one mega-board session (pump thread,
+        unlocked): walk the engine's addressable shards and persist one
+        tile per shard through the store's tile contract — each host
+        writes only its own bytes.  Returns the failure (for the locked
+        degradation tail) instead of raising, like the board path.
+
+        Skips silently while the session is still QUEUED (engine=None):
+        a mesh board only becomes spillable once it is resident on its
+        slice — the submitted copy is either the client's resubmittable
+        request or, on a resume, a geometry placeholder that must never
+        overwrite good tiles."""
+        if engine is None or not hasattr(engine, "spill_tiles"):
+            return None
+        if not getattr(self._spill, "SUPPORTS_MESH", False):
+            # the remote HTTP store has no tile contract (yet): shipping
+            # a gathered mega-board over it would defeat the tier, so
+            # durability degrades for this session alone — the same
+            # contract as a failed write, and just as visible
+            self._spill.mark_disabled(s.sid)
+            return OSError(
+                "spill backend has no shard-wise tile contract "
+                "(mesh sessions need a local spill_dir)"
+            )
+        try:
+            tiles, lag = engine.spill_tiles(slot)
+            abs_step = s.start_step + s.steps_done - lag
+            timeout_s = (
+                None if s.deadline is None else max(0.0, s.deadline - now)
+            )
+            self._spill.save_mesh(
+                s.sid,
+                tiles,
+                abs_step,
+                rule=s.rule.name,
+                steps_total=s.start_step + s.steps,
+                seed=s.seed,
+                temperature=s.temperature,
+                timeout_s=timeout_s,
+                height=int(s.board.shape[0]),
+                width=int(s.board.shape[1]),
+                mesh=s.mesh,
+                trace_id=s.trace_id,
+                edits=render_edit_log(s.edits) or None,
+                scheduled_edits=render_edit_log(s.scheduled_edits) or None,
+                stream_seq=self.hub.seq_snapshot(s.sid, default=s.stream_seq),
+            )
+            obs.instant(
+                "serve.session.spill",
+                sid=s.sid,
+                trace_id=s.trace_id,
+                step=abs_step,
+                mesh=f"{s.mesh[0]}x{s.mesh[1]}",
+                tiles=len(tiles),
+            )
+            s.spill_urgent = False
+            return None
+        except OSError as e:
+            self._spill.mark_disabled(s.sid)
+            return e
 
     def _apply_spill_failures(self, failures: list) -> None:
         """Locked: degrade each failed write's session to spill-disabled —
@@ -1636,6 +1898,33 @@ class SimulationService:
         for bucket in self._est_buckets - live_buckets:
             self._g_est_bytes.labels(key=bucket).set(0.0)
         self._est_buckets = live_buckets
+        # the mesh tier's observability rows (docs/SERVING.md "Mega-board
+        # sessions"): live mesh-sharded sessions, and the governor's
+        # per-shard estimator rows for every live mesh engine — stale
+        # (key, shard) rows zero out when the engine goes, like the
+        # per-key footprint above
+        mesh_sessions = sum(
+            len(slots)
+            for key, slots in self.scheduler.running.items()
+            if str(getattr(key, "backend", "")).startswith("mesh:")
+        )
+        self._g_mesh_sessions.set(float(mesh_sessions))
+        live_mesh = set()
+        for key, e in self.scheduler.engines.items():
+            shape = getattr(e, "mesh_shape", None)
+            if shape is None:
+                continue
+            bucket = _key_bucket(key)
+            for shard, per in self._governor.estimate_mesh_shard_bytes(
+                key, shape
+            ).items():
+                live_mesh.add((bucket, shard))
+                self._g_mesh_est_bytes.labels(key=bucket, shard=shard).set(
+                    float(per)
+                )
+        for bucket, shard in self._mesh_est_buckets - live_mesh:
+            self._g_mesh_est_bytes.labels(key=bucket, shard=shard).set(0.0)
+        self._mesh_est_buckets = live_mesh
         elapsed = self.clock() - self._t0
         qw, lat = self._h_queue_wait, self._h_latency
         self.recorder.record(
@@ -1663,6 +1952,14 @@ class SimulationService:
                     for k, e in self.scheduler.engines.items()
                     if getattr(e, "stencil", None) is not None
                 },
+                # the mesh stamp (docs/SERVING.md "Mega-board sessions"),
+                # present only on workers with a configured slice —
+                # records of mesh-less workers keep their prior shape
+                **(
+                    {"mesh_sessions": mesh_sessions}
+                    if self.config.mesh_devices
+                    else {}
+                ),
                 "sessions_done": self._completed,
                 "sessions_per_sec": self._completed / elapsed
                 if elapsed > 0
@@ -1875,6 +2172,9 @@ class SimulationService:
                 for k, e in self.scheduler.engines.items()
                 if getattr(e, "stencil", None) is not None
             },
+            # the mesh tier (docs/SERVING.md "Mega-board sessions"):
+            # sessions currently sharded over the reserved slice
+            "mesh_sessions": int(self._g_mesh_sessions.value),
             "elapsed_s": elapsed,
             "sessions_per_sec": self._completed / elapsed if elapsed > 0 else 0.0,
             "batch_occupancy_mean": self._occupancy_sum / self._rounds

@@ -120,6 +120,19 @@ class Session:
     # already produced (from the spill manifest), so the survivor's hub
     # continues the same gapless sequence space
     stream_seq: int = 0
+    # mega-board tier (docs/SERVING.md "Mega-board sessions"): the mesh
+    # slice shape ``(rows, cols)`` this session's board is sharded over,
+    # None for single-chip sessions.  Set at submit when the governor's
+    # never-fits verdict is converted into a mesh placement; the keyer
+    # mints a ``mesh:RxC`` CompileKey from it.
+    mesh: tuple[int, int] | None = None
+    # shard-wise resume (arXiv 2112.01075): a rectangular block loader
+    # ``load_block(r0, r1, c0, c1) -> cells`` over a spilled tile set,
+    # consumed once at admission by ``MeshEngine.load_tiles`` — the
+    # session re-gathers shard by shard (possibly onto a different mesh
+    # shape) and ``board`` stays a placeholder, so the full board is
+    # never materialized on this host.  Process-local, never serialized.
+    mesh_resume: object | None = None
 
     @property
     def steps_remaining(self) -> int:
@@ -173,6 +186,9 @@ class SessionView:
     # accumulated (0 for never-steered sessions — the wire render gates
     # on it so unsteered responses stay byte-stable)
     edits: int = 0
+    # mega-board stamp: "RxC" when the session runs on a mesh slice,
+    # None for single-chip sessions (the wire render gates on it)
+    mesh: str | None = None
 
     @property
     def finished(self) -> bool:
@@ -224,6 +240,7 @@ class SessionStore:
             degraded_reason=s.degraded_reason,
             trace_id=s.trace_id,
             edits=len(s.edits) + len(s.scheduled_edits),
+            mesh=(f"{s.mesh[0]}x{s.mesh[1]}" if s.mesh is not None else None),
         )
 
     def result(self, sid: str) -> np.ndarray:

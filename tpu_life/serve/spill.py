@@ -117,6 +117,38 @@ class SpillBackend:
     def spilled_sids(self) -> list[str]:
         raise NotImplementedError
 
+    #: Shard-wise spill capability (docs/SERVING.md "Mega-board
+    #: sessions"): backends that can persist a mega-board session as
+    #: per-shard tiles override :meth:`save_mesh` and flip this True.
+    #: The service checks the flag before a mesh session's spill round —
+    #: a backend without the tile contract (the remote HTTP store, for
+    #: now) degrades that session to spill-disabled rather than
+    #: gathering the full board just to ship it.
+    SUPPORTS_MESH = False
+
+    def save_mesh(
+        self,
+        sid: str,
+        tiles,
+        step: int,
+        *,
+        rule: str,
+        steps_total: int,
+        seed: int | None,
+        temperature: float | None,
+        timeout_s: float | None,
+        height: int,
+        width: int,
+        mesh: tuple[int, int],
+        trace_id: str | None = None,
+        edits: list | None = None,
+        scheduled_edits: list | None = None,
+        stream_seq: int = 0,
+    ) -> bool:
+        raise NotImplementedError(
+            "this spill backend has no shard-wise tile contract"
+        )
+
 
 def make_spill_backend(
     *,
@@ -173,6 +205,78 @@ class SpillRecord:
     @property
     def remaining(self) -> int:
         return max(0, self.steps_total - self.step)
+
+
+def _tile_dirname(r0: int, c0: int) -> str:
+    return f"tile_r{int(r0):09d}_c{int(c0):09d}"
+
+
+@dataclass(frozen=True)
+class MeshSpillRecord:
+    """One resumable mega-board session read back from a tile-set spill
+    (docs/SERVING.md "Mega-board sessions").
+
+    Unlike :class:`SpillRecord` it carries **no board**: the tiles stay
+    on disk and :meth:`block_loader` hands out a rectangular reader the
+    resuming mesh feeds to ``MeshEngine.load_tiles`` — each destination
+    shard pulls exactly its own cell rectangle (possibly on a different
+    mesh shape than the one that spilled; arXiv 2112.01075), so the full
+    board is never materialized on one host on either side.
+    """
+
+    sid: str
+    rule: str
+    step: int  # absolute steps completed at the chosen tile epoch
+    steps_total: int
+    seed: int | None
+    temperature: float | None
+    timeout_s: float | None
+    height: int
+    width: int
+    mesh_shape: tuple[int, int]  # the SPILLING mesh's shape (provenance)
+    tiles: tuple  # ((r0, c0, th, tw), ...) covering the board
+    root: Path  # the session's spill directory (holds the tile dirs)
+    trace_id: str | None = None
+    scheduled_edits: list | None = None
+    stream_seq: int = 0
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.steps_total - self.step)
+
+    def block_loader(self):
+        """``load_block(r0, r1, c0, c1) -> cells`` over the tile set at
+        this record's epoch.  Reads only the tiles the rectangle
+        intersects, one at a time (single-tile cache) — the memory high
+        water is one tile plus the requested block, never the board."""
+        from tpu_life.models.rules import get_rule
+
+        continuous = bool(getattr(get_rule(self.rule), "continuous", False))
+        dtype = np.float32 if continuous else np.int8
+        step = self.step
+        tiles = self.tiles
+        root = self.root
+        cache: dict = {}
+
+        def load_block(r0: int, r1: int, c0: int, c1: int) -> np.ndarray:
+            out = np.zeros((r1 - r0, c1 - c0), dtype=dtype)
+            for tr0, tc0, th, tw in tiles:
+                ir0, ir1 = max(r0, tr0), min(r1, tr0 + th)
+                ic0, ic1 = max(c0, tc0), min(c1, tc0 + tw)
+                if ir0 >= ir1 or ic0 >= ic1:
+                    continue
+                key = (tr0, tc0)
+                if key not in cache:
+                    cache.clear()  # one tile resident at a time
+                    f = snapshot_path(root / _tile_dirname(tr0, tc0), step)
+                    cache[key] = read_board(f, th, tw)
+                tile = cache[key]
+                out[ir0 - r0 : ir1 - r0, ic0 - c0 : ic1 - c0] = tile[
+                    ir0 - tr0 : ir1 - tr0, ic0 - tc0 : ic1 - tc0
+                ]
+            return out
+
+        return load_block
 
 
 class SpillStore(SpillBackend):
@@ -259,6 +363,113 @@ class SpillStore(SpillBackend):
         self._written[sid] = prune_snapshots(d, KEEP_SNAPSHOTS, written)
         return True
 
+    SUPPORTS_MESH = True
+
+    def save_mesh(
+        self,
+        sid: str,
+        tiles,
+        step: int,
+        *,
+        rule: str,
+        steps_total: int,
+        seed: int | None,
+        temperature: float | None,
+        timeout_s: float | None,
+        height: int,
+        width: int,
+        mesh: tuple[int, int],
+        trace_id: str | None = None,
+        edits: list | None = None,
+        scheduled_edits: list | None = None,
+        stream_seq: int = 0,
+    ) -> bool:
+        """Shard-wise spill of one mega-board session: ``tiles`` is the
+        ``(r0, c0, cells)`` walk from ``MeshEngine.spill_tiles`` — one
+        tile per addressable shard, each published atomically into its
+        own ``tile_rNNN_cNNN/`` directory with its own CRC32 sidecar,
+        then the sharded manifest.  The publish order is the recovery
+        contract: the manifest's tile table is only ever written after
+        every tile of ``step`` landed, and ``read_mesh_sessions``
+        demotes the WHOLE set to the predecessor epoch if any single
+        tile of the newest fails its intact check — a resumed mesh
+        session is never a mixed-epoch board."""
+        written = self._written.setdefault(sid, [])
+        edit_count = len(edits or []) + len(scheduled_edits or [])
+        if (
+            written
+            and written[-1] == step
+            and self._edit_counts.get(sid, 0) == edit_count
+        ):
+            return False
+        d = self.root / sid
+        tile_table = []
+        for r0, c0, cells in tiles:
+            td = d / _tile_dirname(r0, c0)
+            # same chaos seams as the single-board path, fired per tile:
+            # each host writes its own shards, so disk-full and disk-rot
+            # strike tile-by-tile (docs/CHAOS.md)
+            chaos.inject("spill.write")
+            save_snapshot(td, step, cells, rule=rule)
+            self._maybe_corrupt(td, step)
+            tile_table.append(
+                [int(r0), int(c0), int(cells.shape[0]), int(cells.shape[1])]
+            )
+        manifest = {
+            "sid": sid,
+            "rule": rule,
+            "steps_total": int(steps_total),
+            "seed": seed,
+            "temperature": temperature,
+            "timeout_s": timeout_s,
+            "trace_id": trace_id,
+            "height": int(height),
+            "width": int(width),
+            "mesh": {
+                "shape": [int(mesh[0]), int(mesh[1])],
+                "tiles": tile_table,
+            },
+        }
+        if edits:
+            manifest["edits"] = edits
+        if scheduled_edits:
+            manifest["scheduled_edits"] = scheduled_edits
+        if stream_seq:
+            manifest["stream_seq"] = int(stream_seq)
+        with atomic_publish(d / MANIFEST) as tmp:
+            tmp.write_text(json.dumps(manifest))
+        if not written or written[-1] != step:
+            written.append(step)
+        self._edit_counts[sid] = edit_count
+        pruned = written
+        for r0, c0, _ in tiles:
+            pruned = prune_snapshots(d / _tile_dirname(r0, c0), KEEP_SNAPSHOTS, written)
+        self._written[sid] = pruned
+        return True
+
+    def adopt_mesh(self, sid: str, src: str | os.PathLike) -> Path | None:
+        """Take ownership of a spilled tile set by renaming it into this
+        store under ``sid`` (atomic on one filesystem) — the resume-time
+        ownership transfer: the survivor's store now holds the tiles, so
+        the victim-directory cleanup finds nothing to delete and the
+        adopted session is durable from its first round (no fresh spill
+        needed before the next crash).  Returns the adopted directory,
+        or None when the rename cannot be done (cross-device, missing
+        source) — the caller then reads the tiles in place."""
+        dest = self.root / sid
+        try:
+            os.replace(os.fspath(src), dest)
+        except OSError:
+            return None
+        # seed retention bookkeeping from the adopted tiles so later
+        # save_mesh rounds prune the inherited epochs too
+        steps: set[int] = set()
+        for td in dest.iterdir():
+            if td.is_dir() and td.name.startswith("tile_"):
+                steps.update(step for step, _ in list_snapshots(td))
+        self._written[sid] = sorted(steps)
+        return dest
+
     def _maybe_corrupt(self, d: Path, step: int) -> None:
         """Chaos seam: bit-flip (or truncate) the just-published snapshot
         bytes — the disk-rot drill.  The CRC sidecar stays truthful to the
@@ -335,7 +546,12 @@ def read_spill_sessions(
     for d in sorted(p for p in rootp.iterdir() if p.is_dir()):
         sid = d.name
         if (d / DISABLED).exists():
-            disabled.append(sid)
+            # ownership split with read_mesh_sessions so a dead worker's
+            # scan never reports the same sid twice: tile sets belong to
+            # the mesh reader, everything else (including a dir whose
+            # manifest is unreadable) lands here
+            if not _is_mesh_dir(d):
+                disabled.append(sid)
             continue
         try:
             # chaos seam: a read failure on the rescue path — the whole
@@ -350,6 +566,11 @@ def read_spill_sessions(
         except (OSError, ValueError, KeyError, TypeError):
             log.warning("spill: %s has no readable manifest; corrupt", d)
             corrupt.append(sid)
+            continue
+        if "mesh" in meta:
+            # a shard-wise tile set (docs/SERVING.md "Mega-board
+            # sessions") — read_mesh_sessions owns those; classifying
+            # the absent top-level board file as corrupt would be wrong
             continue
         chosen = None
         for step, f in list_snapshots(d):  # newest first
@@ -384,6 +605,142 @@ def read_spill_sessions(
                 width=width,
                 trace_id=None if trace_id is None else str(trace_id),
                 edits=meta.get("edits"),
+                scheduled_edits=meta.get("scheduled_edits"),
+                stream_seq=int(meta.get("stream_seq", 0)),
+            )
+        )
+    return records, corrupt, disabled
+
+
+def _is_mesh_dir(d: Path) -> bool:
+    """Whether the session dir's manifest marks a shard-wise tile set —
+    the ownership test splitting disabled dirs between
+    :func:`read_spill_sessions` and :func:`read_mesh_sessions`."""
+    try:
+        return "mesh" in json.loads((d / MANIFEST).read_text())
+    except (OSError, ValueError, TypeError):
+        return False
+
+
+def read_mesh_sessions(
+    root: str | os.PathLike,
+) -> tuple[list[MeshSpillRecord], list[str], list[str]]:
+    """Read every resumable mega-board (tile-set) session under a spill
+    root — the shard-wise twin of :func:`read_spill_sessions`, same
+    ``(records, corrupt_sids, disabled_sids)`` contract.
+
+    Epoch choice is all-or-nothing per step: the newest step at which
+    EVERY tile passes the intact check (size + CRC32) wins; one
+    bit-flipped tile demotes the whole set to the predecessor epoch — a
+    resumed mesh session is never a mixed-epoch board.  No tile bytes
+    are read here: records carry a :meth:`MeshSpillRecord.block_loader`
+    so the resuming mesh pulls rectangles tile-by-tile at admission.
+    """
+    rootp = Path(root)
+    if not rootp.is_dir():
+        return [], [], []
+    return _read_mesh_dirs(sorted(p for p in rootp.iterdir() if p.is_dir()))
+
+
+def read_mesh_session_dir(d: str | os.PathLike) -> MeshSpillRecord:
+    """Read ONE tile-set session directory (the ``resume_tiles_dir``
+    pointer a mesh resume submission carries) — same demotion contract
+    as :func:`read_mesh_sessions`, but a non-resumable set is a typed
+    ValueError (the gateway's 400), because a caller naming a specific
+    directory asked for exactly it."""
+    dp = Path(d)
+    records, corrupt, disabled = _read_mesh_dirs([dp])
+    if disabled:
+        raise ValueError(f"tile set at {dp} is spill-disabled; not resumable")
+    if corrupt or not records:
+        raise ValueError(
+            f"no resumable tile set at {dp} (missing, corrupt, or not a "
+            f"mesh spill)"
+        )
+    return records[0]
+
+
+def _read_mesh_dirs(
+    dirs,
+) -> tuple[list[MeshSpillRecord], list[str], list[str]]:
+    records: list[MeshSpillRecord] = []
+    corrupt: list[str] = []
+    disabled: list[str] = []
+    for d in dirs:
+        sid = d.name
+        if (d / DISABLED).exists():
+            # mirror of read_spill_sessions' ownership split: only claim
+            # the dir when the manifest says it is a tile set
+            if _is_mesh_dir(d):
+                disabled.append(sid)
+            continue
+        try:
+            chaos.inject("spill.read")
+            meta = json.loads((d / MANIFEST).read_text())
+            if "mesh" not in meta:
+                continue  # a single-board spill; read_spill_sessions owns it
+            height = int(meta["height"])
+            width = int(meta["width"])
+            steps_total = int(meta["steps_total"])
+            rule = str(meta["rule"])
+            mesh_shape = tuple(int(v) for v in meta["mesh"]["shape"])
+            tiles = tuple(
+                (int(r0), int(c0), int(th), int(tw))
+                for r0, c0, th, tw in meta["mesh"]["tiles"]
+            )
+        except (OSError, ValueError, KeyError, TypeError):
+            log.warning("spill: %s has no readable mesh manifest; corrupt", d)
+            corrupt.append(sid)
+            continue
+        if not tiles:
+            corrupt.append(sid)
+            continue
+        # candidate epochs: steps present in EVERY tile directory,
+        # newest first (a step missing from any tile never qualifies)
+        step_sets = []
+        for r0, c0, _th, _tw in tiles:
+            td = d / _tile_dirname(r0, c0)
+            step_sets.append({step for step, _ in list_snapshots(td)})
+        common = set.intersection(*step_sets) if step_sets else set()
+        chosen = None
+        for step in sorted(common, reverse=True):
+            ok = True
+            for r0, c0, th, tw in tiles:
+                f = snapshot_path(d / _tile_dirname(r0, c0), step)
+                if not snapshot_intact(f, th, tw):
+                    log.warning(
+                        "spill: %s failed the intact check; demoting the "
+                        "whole tile set past epoch %d",
+                        f,
+                        step,
+                    )
+                    ok = False
+                    break
+            if ok:
+                chosen = step
+                break
+        if chosen is None:
+            corrupt.append(sid)
+            continue
+        seed = meta.get("seed")
+        temperature = meta.get("temperature")
+        timeout_s = meta.get("timeout_s")
+        trace_id = meta.get("trace_id")
+        records.append(
+            MeshSpillRecord(
+                sid=sid,
+                rule=rule,
+                step=chosen,
+                steps_total=steps_total,
+                seed=None if seed is None else int(seed),
+                temperature=None if temperature is None else float(temperature),
+                timeout_s=None if timeout_s is None else float(timeout_s),
+                height=height,
+                width=width,
+                mesh_shape=(mesh_shape[0], mesh_shape[1]),
+                tiles=tiles,
+                root=d,
+                trace_id=None if trace_id is None else str(trace_id),
                 scheduled_edits=meta.get("scheduled_edits"),
                 stream_seq=int(meta.get("stream_seq", 0)),
             )
