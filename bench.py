@@ -982,6 +982,88 @@ def run_governor_bench(args, platform: str, degraded: bool) -> dict:
     }
 
 
+def run_surge_bench(args, platform: str, degraded: bool) -> dict:
+    """The BENCH_surge capture (docs/FLEET.md "Autoscaling" +
+    docs/SERVING.md "Tenant QoS"): the seeded surge drill — a standby-
+    pooled fleet under a live autoscaler riding a surge_factor-x
+    two-tenant burst — as one record.  The headline is sessions/s
+    through the burst; the fields the record exists for are the
+    guaranteed-tenant p99 admission latency at 1x (trickle) vs 10x
+    (burst), the scale reaction time (burst start -> first recruit
+    landing) and release-back time, and the sheds split by tenant
+    class (best-effort sheds are the mechanism, guaranteed sheds are
+    the failure).  Replayable: the record stamps the seed and plan
+    digest like every robustness number.
+
+    Like the chaos bench, the bench process stays jax-free — workers
+    are numpy-engine subprocesses, so the capture runs anywhere CI does.
+    """
+    import tempfile
+
+    from tpu_life.chaos.drill import DrillConfig, run_drill
+
+    workdir = tempfile.mkdtemp(prefix="tpu-life-bench-surge-")
+    try:
+        summary = run_drill(
+            DrillConfig(
+                seed=args.chaos_seed,
+                workers=args.chaos_workers,
+                det_sessions=4,
+                ising_sessions=0,
+                steps=args.serve_steps * 20,
+                kills=0,
+                surge=True,
+                standby=args.surge_standby,
+                surge_factor=args.surge_factor,
+                workdir=workdir,
+            )
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    scale = summary.get("scale", {})
+    qos = summary.get("qos", {})
+    # reaction time: the burst begins after the 1x trickle settles; the
+    # first sampled transition past base strength is the recruit landing
+    reaction = next(
+        (
+            t["t_s"]
+            for t in scale.get("transitions", [])
+            if t["active"] > args.chaos_workers
+        ),
+        None,
+    )
+    return {
+        "metric": "surge_sessions_per_sec",
+        "value": summary["sessions_per_sec"],
+        "unit": "sessions/s",
+        "platform": platform,
+        "backend": "numpy",
+        "workers": args.chaos_workers,
+        "standby": args.surge_standby,
+        "surge_factor": args.surge_factor,
+        # the replay stamp: every robustness number names its adversity
+        "chaos_seed": args.chaos_seed,
+        "plan_digest": summary["plan_digest"],
+        "sessions": summary["sessions"],
+        "delivered": summary["delivered"],
+        "outcomes": summary["outcomes"],
+        "injections": summary["injections"],
+        "peak_active": scale.get("peak_active"),
+        "scale_reaction_s": reaction,
+        "released_back_s": scale.get("released_back_s"),
+        "scale_decisions": scale.get("decisions"),
+        "gold_p99_s_1x": qos.get("gold_p99_trickle_s"),
+        "gold_p99_s_burst": qos.get("gold_p99_burst_s"),
+        "sheds_by_class": {
+            "best_effort": qos.get("sheds", 0),
+            "guaranteed": len(qos.get("gold_refusals", [])),
+        },
+        "elapsed_s": summary["elapsed_s"],
+        "invariants_ok": summary["ok"],
+        "degraded": degraded,
+    }
+
+
 def run_stream_bench(args, platform: str, degraded: bool) -> dict:
     """The BENCH_stream capture (docs/STREAMING.md): live-session
     streaming cost, two legs.
@@ -1946,6 +2028,20 @@ def main() -> None:
                    "wedged settle rescued via unready-recycle + "
                    "migration) vs a fault-free twin — emits "
                    "governor_sessions_per_sec")
+    # the BENCH_surge capture (docs/FLEET.md "Autoscaling"): the surge
+    # drill — autoscale through a 10x two-tenant burst — as one record;
+    # reuses the --chaos-* knobs (seed / workers) for its shape
+    p.add_argument("--surge", action="store_true",
+                   help="autoscale bench: the seeded surge drill (a "
+                   "standby-pooled fleet rides a surge-factor-x "
+                   "two-tenant burst under the live autoscaler) — emits "
+                   "surge_sessions_per_sec with the guaranteed-tenant "
+                   "p99 at 1x vs burst, scale reaction/release times "
+                   "and sheds by tenant class")
+    p.add_argument("--surge-factor", type=int, default=10,
+                   help="burst size as a multiple of the 1x trickle")
+    p.add_argument("--surge-standby", type=int, default=2,
+                   help="parked standby slots the autoscaler may recruit")
     # the BENCH_obs capture (docs/OBSERVABILITY.md "Time series"): what
     # the telemetry snapshot ring costs — sampling overhead per round and
     # scrape bytes per /v1/debug/series tick; rides the --serve-* knobs
@@ -2225,6 +2321,8 @@ def main() -> None:
             result = run_chaos_bench(args, platform, degraded)
         elif args.governor:
             result = run_governor_bench(args, platform, degraded)
+        elif args.surge:
+            result = run_surge_bench(args, platform, degraded)
         elif args.cross_host:
             result = run_cross_host_bench(args, platform, degraded)
         elif args.stream:
@@ -2286,15 +2384,19 @@ def main() -> None:
                     )
                 cmd += ["--serve-capacity", str(args.serve_capacity)]
                 cmd += ["--serve-chunk-steps", str(args.serve_chunk_steps)]
-            if args.chaos or args.cross_host or args.governor:
+            if args.chaos or args.cross_host or args.governor or args.surge:
                 # the retry must re-run the SAME seeded drill: seed and
                 # shape ride along so the replay contract holds
                 mode = ("--cross-host" if args.cross_host
-                        else "--governor" if args.governor else "--chaos")
+                        else "--governor" if args.governor
+                        else "--surge" if args.surge else "--chaos")
                 cmd += [mode,
                         "--chaos-seed", str(args.chaos_seed),
                         "--chaos-workers", str(args.chaos_workers),
                         "--chaos-kills", str(args.chaos_kills)]
+                if args.surge:
+                    cmd += ["--surge-factor", str(args.surge_factor),
+                            "--surge-standby", str(args.surge_standby)]
             if args.mesh:
                 cmd += ["--mesh",
                         "--serve-chunk-steps", str(args.serve_chunk_steps)]
@@ -2331,6 +2433,9 @@ def main() -> None:
             size, steps = args.serve_size, args.serve_steps
         elif args.governor:
             metric, unit = "governor_sessions_per_sec", "sessions/s"
+            size, steps = args.serve_size, args.serve_steps
+        elif args.surge:
+            metric, unit = "surge_sessions_per_sec", "sessions/s"
             size, steps = args.serve_size, args.serve_steps
         elif args.cross_host:
             metric, unit = "cross_host_sessions_per_sec", "sessions/s"
@@ -2371,7 +2476,7 @@ def main() -> None:
             failure["batch_capacity"] = args.serve_capacity
             if args.fleet:
                 failure["workers"] = args.fleet_workers
-        elif args.chaos or args.cross_host or args.governor:
+        elif args.chaos or args.cross_host or args.governor or args.surge:
             # the replay stamp survives even a failed capture
             failure["chaos_seed"] = args.chaos_seed
             failure["workers"] = args.chaos_workers

@@ -1,0 +1,356 @@
+"""Autoscaling (docs/FLEET.md "Autoscaling"): the pure control function,
+the live recruit/release loop, and the doctor join.
+
+The decision layer is a pure function — (signals, state, policy, clock)
+-> verdict — so every hysteresis / idle-grace / cooldown / flap property
+is proved here with synthetic signals and a fake clock, no process tree.
+The e2e then runs the REAL loop: a 2-worker fleet with one parked
+standby rides a queue-depth wave, recruits the slot through the
+supervisor's spawn machinery, releases it back once idle, and the whole
+decision sequence replays from the flight capture via ``scale_report``
+(the ``tpu-life doctor --scale`` join).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tpu_life.fleet.autoscaler import (
+    AutoscaleConfig,
+    Autoscaler,
+    ControlState,
+    Decision,
+    Signals,
+    decide,
+    render_scale_report,
+    scale_report,
+)
+
+
+def sig(**kw) -> Signals:
+    base = dict(
+        active=2,
+        standby=2,
+        ready=2,
+        depth=0.0,
+        queue_age_s=0.0,
+        reject_rate=0.0,
+        mem_fraction=None,
+        breaching=False,
+    )
+    base.update(kw)
+    return Signals(**base)
+
+
+def cfg(**kw) -> AutoscaleConfig:
+    base = dict(
+        min_workers=1,
+        depth_high=4.0,
+        depth_low=0.5,
+        cooldown_up_s=5.0,
+        cooldown_down_s=30.0,
+        idle_grace_s=10.0,
+    )
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+# -- policy validation -----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        dict(min_workers=-1),
+        dict(min_workers=4, max_workers=2),
+        dict(max_workers=0),
+        dict(depth_low=4.0, depth_high=4.0),  # band must be open
+        dict(depth_low=5.0, depth_high=4.0),
+        dict(window_s=0),
+        dict(idle_grace_s=-1),
+        dict(cooldown_up_s=-0.1),
+    ],
+)
+def test_config_rejects_degenerate_policies(bad):
+    with pytest.raises(ValueError):
+        AutoscaleConfig(**bad)
+
+
+# -- scale-up edges --------------------------------------------------------
+
+
+def test_up_on_queue_depth_per_ready_worker():
+    d = decide(sig(depth=10.0, ready=2), ControlState(), cfg(), now=0.0)
+    assert (d.action, d.reason) == ("up", "queue_depth")
+    # the snapshot that justified it rides on the decision
+    assert d.signals["depth_per_ready"] == 5.0
+
+
+@pytest.mark.parametrize(
+    "kw,reason",
+    [
+        (dict(queue_age_s=6.0), "queue_age"),
+        (dict(reject_rate=1.0), "rejections"),
+        (dict(mem_fraction=0.9), "memory_pressure"),
+        (dict(breaching=True), "slo_burn"),
+        (dict(active=0, ready=0), "below_min"),
+    ],
+)
+def test_up_reasons(kw, reason):
+    d = decide(sig(**kw), ControlState(), cfg(), now=0.0)
+    assert (d.action, d.reason) == ("up", reason)
+
+
+def test_scale_on_burn_false_is_burn_blind_both_ways():
+    c = cfg(scale_on_burn=False, min_workers=1)
+    st = ControlState()
+    # breaching alone neither recruits...
+    assert decide(sig(breaching=True), st, c, now=0.0).action == "hold"
+    # ...nor pins the fleet: idle accumulates straight through the burn
+    assert decide(sig(breaching=True), st, c, now=0.0).reason == "settling"
+    d = decide(sig(breaching=True), st, c, now=c.idle_grace_s + 1)
+    assert (d.action, d.reason) == ("down", "idle")
+
+
+def test_demand_holds_when_pool_is_empty_or_at_max():
+    d = decide(sig(depth=99.0, standby=0), ControlState(), cfg(), now=0.0)
+    assert (d.action, d.reason) == ("hold", "no_standby")
+    d = decide(
+        sig(depth=99.0, active=4), ControlState(), cfg(max_workers=4), now=0.0
+    )
+    assert (d.action, d.reason) == ("hold", "at_max")
+
+
+def test_up_cooldown_spaces_recruits():
+    st = ControlState(last_up_at=10.0)
+    c = cfg(cooldown_up_s=5.0)
+    d = decide(sig(depth=99.0), st, c, now=12.0)
+    assert (d.action, d.reason) == ("hold", "cooldown_up")
+    d = decide(sig(depth=99.0), st, c, now=15.0)
+    assert d.action == "up"
+
+
+# -- hysteresis + idle grace ----------------------------------------------
+
+
+def test_hysteresis_band_holds_and_resets_the_idle_clock():
+    c = cfg()  # band: (0.5, 4.0) per ready worker
+    st = ControlState(low_since=0.0)
+    d = decide(sig(depth=4.0, ready=2), st, c, now=5.0)  # 2.0 in-band
+    assert (d.action, d.reason) == ("hold", "steady")
+    assert st.low_since is None  # idle must be CONTINUOUS
+
+
+def test_idle_grace_requires_continuous_idle():
+    c = cfg(idle_grace_s=10.0, cooldown_down_s=0.0)
+    st = ControlState()
+    assert decide(sig(), st, c, now=0.0).reason == "settling"
+    assert decide(sig(), st, c, now=5.0).reason == "settling"
+    # a mid-grace demand blip restarts the clock from zero
+    assert decide(sig(depth=4.0, ready=2), st, c, now=6.0).reason == "steady"
+    assert decide(sig(), st, c, now=7.0).reason == "settling"
+    assert decide(sig(), st, c, now=16.9).reason == "settling"
+    d = decide(sig(), st, c, now=17.0)
+    assert (d.action, d.reason) == ("down", "idle")
+
+
+def test_down_cooldown_covers_fresh_ups_too():
+    # the flap guard: a burst that ends the moment we grew must not
+    # bounce straight back down inside the down cooldown
+    c = cfg(idle_grace_s=1.0, cooldown_down_s=30.0)
+    st = ControlState(last_up_at=100.0)
+    st.low_since = 100.0
+    d = decide(sig(), st, c, now=110.0)  # past grace, inside cooldown
+    assert (d.action, d.reason) == ("hold", "cooldown_down")
+    d = decide(sig(), st, c, now=131.0)
+    assert (d.action, d.reason) == ("down", "idle")
+
+
+def test_never_drains_below_min_workers():
+    st = ControlState(low_since=0.0)
+    d = decide(
+        sig(active=2), st, cfg(min_workers=2, cooldown_down_s=0), now=99.0
+    )
+    assert (d.action, d.reason) == ("hold", "at_min")
+
+
+# -- the live loop against a fake supervisor -------------------------------
+
+
+class _FakeSup:
+    """The Autoscaler's duck-typed supervisor surface: an empty series
+    store (signals fall back to below_min pressure), a scripted recruit
+    outcome per call, and a release ledger."""
+
+    def __init__(self, recruit_script):
+        from tpu_life.obs.timeseries import SeriesStore
+
+        self.series_store = SeriesStore()
+        self._script = list(recruit_script)
+        self.released = []
+
+        class _Slo:
+            def status(self):
+                return {}
+
+        self.slo_engine = _Slo()
+
+    def scale_counts(self):
+        return (0, 2)  # below min_workers=1 -> constant up pressure
+
+    def ready_workers(self):
+        return []
+
+    def recruit(self):
+        return self._script.pop(0)
+
+    def release(self, name):
+        self.released.append(name)
+        return True
+
+
+def test_recruit_failure_holds_without_arming_the_up_cooldown():
+    sup = _FakeSup(recruit_script=[None, "w3"])
+    auto = Autoscaler(cfg(cooldown_up_s=300.0), sup)
+    d = auto.evaluate(now=0.0)
+    assert (d.action, d.reason) == ("hold", "recruit_failed")
+    assert auto.state.last_up_at is None  # no cooldown armed
+    # the very next tick retries and lands the recruit — a refused
+    # standby must not freeze the loop for a whole cooldown window
+    d = auto.evaluate(now=0.1)
+    assert (d.action, d.worker) == ("up", "w3")
+    assert auto.state.last_up_at == 0.1
+
+
+def test_hold_events_record_only_on_reason_edges():
+    from tpu_life.obs import flight
+
+    sup = _FakeSup(recruit_script=[None, None, None])
+    auto = Autoscaler(cfg(), sup)
+    flight.drain()
+    for t in (0.0, 0.1, 0.2):
+        auto.evaluate(now=t)
+    assert auto.decisions == 3
+    holds = [e for e in flight.drain() if e["kind"] == "scale.hold"]
+    assert len(holds) == 1  # steady state must not flood the ring
+
+
+# -- the doctor join -------------------------------------------------------
+
+
+def _ev(ts_us, action, **args):
+    return {"name": f"flight.scale.{action}", "ts": ts_us, "args": args}
+
+
+def test_scale_report_replays_the_decision_sequence():
+    doc = {
+        "traceEvents": [
+            _ev(3_000_000, "down", reason="idle", worker="w3", active=3,
+                standby=0, depth_per_ready=0.0),
+            _ev(1_000_000, "up", reason="queue_depth", worker="w3",
+                active=2, standby=1, depth_per_ready=6.5),
+            _ev(2_000_000, "hold", reason="cooldown_up", active=3,
+                standby=0, depth_per_ready=5.0),
+            {"name": "flight.slo.breach", "ts": 0, "args": {}},  # ignored
+        ]
+    }
+    report = scale_report(doc)
+    assert [d["action"] for d in report["decisions"]] == [
+        "up", "hold", "down",
+    ]  # time-ordered regardless of capture order
+    assert report["counts"] == {"up": 1, "hold": 1, "down": 1}
+    up = report["decisions"][0]
+    assert up["reason"] == "queue_depth" and up["worker"] == "w3"
+    assert up["signals"]["depth_per_ready"] == 6.5
+    text = render_scale_report(report)
+    assert "UP w3" in text and "1 up, 1 down, 1 hold" in text
+    empty = render_scale_report(scale_report({"traceEvents": []}))
+    assert "no scale decisions" in empty
+
+
+# -- e2e: a real fleet recruits and releases -------------------------------
+
+
+def test_fleet_recruits_standby_and_releases_when_idle(tmp_path):
+    """The acceptance arc on a real process tree: 2 workers + 1 parked
+    standby, a queue-depth wave recruits the slot, the drained fleet
+    releases it back, and the flight capture replays every decision."""
+    from tpu_life.fleet import Fleet, FleetConfig
+    from tpu_life.gateway.client import GatewayClient
+    from tpu_life.obs import journey
+
+    fleet = Fleet(
+        FleetConfig(
+            workers=2,
+            standby=1,
+            port=0,
+            worker_args=(
+                "--serve-backend", "numpy",
+                "--capacity", "2",
+                "--chunk-steps", "2",
+                "--max-queue", "64",
+                "--series-every", "0.25",
+            ),
+            autoscale=AutoscaleConfig(
+                min_workers=2,
+                depth_high=2.0,
+                depth_low=0.5,
+                window_s=5.0,
+                cooldown_up_s=0.5,
+                cooldown_down_s=1.0,
+                idle_grace_s=1.0,
+                scale_on_burn=False,
+            ),
+            series_every_s=0.25,
+            probe_interval_s=0.1,
+            backoff_base_s=0.2,
+            log_dir=str(tmp_path / "logs"),
+            trace_dir=str(tmp_path / "trace"),
+        )
+    )
+    fleet.start()
+    try:
+        assert fleet.wait_ready(timeout=90, min_workers=2)
+        assert fleet.supervisor.scale_counts() == (2, 1)
+        client = GatewayClient(f"http://127.0.0.1:{fleet.port}", retries=8)
+        rng = np.random.default_rng(7)
+        sids = [
+            client.submit(
+                board=(rng.random((20, 20)) < 0.45).astype(np.uint8),
+                rule="conway",
+                steps=400,
+            )
+            for _ in range(12)
+        ]
+
+        def wait_active(n, timeout, what):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if fleet.supervisor.scale_counts()[0] == n:
+                    return
+                time.sleep(0.05)
+            pytest.fail(
+                f"{what}: scale_counts stuck at "
+                f"{fleet.supervisor.scale_counts()}"
+            )
+
+        wait_active(3, 30.0, "the wave never recruited the standby")
+        for sid in sids:
+            doc = client.wait(sid, timeout=120.0)
+            assert doc.get("state") == "done", doc
+        wait_active(2, 45.0, "the idle fleet never released the recruit")
+        stats = fleet.stats()
+        assert stats["scale"]["active"] == 2
+        assert stats["scale"]["standby"] == 1
+        assert stats["scale"]["decisions"] > 0
+    finally:
+        fleet.begin_drain()
+        fleet.wait(timeout=30)
+        fleet.close()
+    # the doctor join: the capture replays the recruit AND the release
+    report = scale_report(journey.load_merged(str(tmp_path / "trace")))
+    actions = [d["action"] for d in report["decisions"]]
+    assert "up" in actions and "down" in actions
+    up = next(d for d in report["decisions"] if d["action"] == "up")
+    assert up["worker"] and up["reason"]

@@ -1,0 +1,260 @@
+"""Tenant QoS (docs/SERVING.md "Tenant QoS"): identity, quotas, and
+weighted-fair admission.
+
+The policy layer is pure arithmetic, so fairness under a hog tenant is
+proved deterministically on the DRR interleave; the quota layer is then
+exercised through the real ``SimulationService`` admission path (typed
+``QuotaExceeded`` before anything is stored, per-tenant counters moved)
+and the real scheduler's admit scan."""
+
+import numpy as np
+import pytest
+
+from tpu_life.models.patterns import random_board
+from tpu_life.serve import ServeConfig, SimulationService
+from tpu_life.serve.errors import QuotaExceeded
+from tpu_life.serve.qos import (
+    DEFAULT_TENANT,
+    MAX_LABEL_LEN,
+    QosPolicy,
+    TenantSpec,
+    tenant_label,
+)
+
+
+def policy(**kw) -> QosPolicy:
+    base = dict(
+        tenants={
+            "gold": TenantSpec(
+                name="gold", tier="guaranteed", weight=3, api_keys=("k-gold",)
+            ),
+            "free": TenantSpec(name="free", weight=1, api_keys=("k-free",)),
+        }
+    )
+    base.update(kw)
+    return QosPolicy(**base)
+
+
+# -- identity --------------------------------------------------------------
+
+
+def test_tenant_label_passes_short_names_and_hashes_long_ones():
+    assert tenant_label("gold") == "gold"
+    secret = "sk-" + "a" * 60  # a policy naming tenants by raw key
+    label = tenant_label(secret)
+    assert label.startswith("t-") and len(label) == 14
+    assert secret[3:] not in label  # no secret material leaks
+    assert label == tenant_label(secret)  # stable
+    assert tenant_label("x" * MAX_LABEL_LEN) == "x" * MAX_LABEL_LEN
+
+
+def test_resolve_maps_keys_and_collapses_unknowns_into_default():
+    p = policy()
+    assert p.resolve("k-gold").name == "gold"
+    assert p.resolve("k-free").name == "free"
+    assert p.resolve("never-seen").name == DEFAULT_TENANT
+    assert p.resolve(None).name == DEFAULT_TENANT
+    assert p.resolve("k-gold").guaranteed
+    assert not p.resolve(None).guaranteed
+
+
+# -- strict construction ---------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        dict(tier="platinum"),
+        dict(weight=0),
+        dict(max_sessions=0),
+        dict(memory_fraction=0.0),
+        dict(memory_fraction=1.5),
+        dict(max_watchers=-1),
+    ],
+)
+def test_tenant_spec_rejects_malformed_fields(bad):
+    with pytest.raises(ValueError):
+        TenantSpec(name="t", **bad)
+
+
+def test_policy_rejects_shared_api_keys_and_bad_water():
+    dup = {
+        "a": TenantSpec(name="a", api_keys=("k",)),
+        "b": TenantSpec(name="b", api_keys=("k",)),
+    }
+    with pytest.raises(ValueError, match="claimed by both"):
+        QosPolicy(tenants=dup)
+    with pytest.raises(ValueError, match="best_effort_water"):
+        QosPolicy(best_effort_water=0.0)
+    with pytest.raises(ValueError, match="best_effort_water"):
+        QosPolicy(best_effort_water=1.5)
+
+
+def test_from_dict_roundtrip_and_typed_failures():
+    p = QosPolicy.from_dict(
+        {
+            "tenants": [
+                {
+                    "name": "gold",
+                    "tier": "guaranteed",
+                    "weight": 4,
+                    "api_keys": ["k1", "k2"],
+                    "max_sessions": 8,
+                    "memory_fraction": 0.5,
+                    "max_watchers": 2,
+                }
+            ],
+            "default": {"tier": "best_effort", "weight": 2},
+            "best_effort_water": 0.25,
+        }
+    )
+    gold = p.resolve("k2")
+    assert gold.name == "gold" and gold.max_sessions == 8
+    assert gold.memory_fraction == 0.5 and gold.max_watchers == 2
+    assert p.default.weight == 2 and p.best_effort_water == 0.25
+    assert sorted(p.names()) == ["default", "gold"]
+    for doc, msg in [
+        ([], "JSON object"),
+        ({"tenants": {}}, "'tenants' must be a list"),
+        ({"tenants": ["x"]}, "must be an object"),
+        ({"tenants": [{"tier": "guaranteed"}]}, "non-empty 'name'"),
+        ({"tenants": [{"name": "a"}, {"name": "a"}]}, "duplicate tenant"),
+        ({"tenants": [{"name": "a", "api_keys": [1]}]}, "string list"),
+        # a typo'd field must die loud, not yield an unreachable tenant
+        ({"tenants": [{"name": "a", "keys": ["k"]}]}, "unknown field"),
+        ({"tenants": [], "tenant": []}, "unknown top-level field"),
+    ]:
+        with pytest.raises(ValueError, match=msg):
+            QosPolicy.from_dict(doc)
+
+
+def test_default_tenant_row_cannot_claim_api_keys():
+    # the default is the unknown-key SINK: a policy that hands it keys
+    # would make "unknown" ambiguous, so they are stripped at parse
+    p = QosPolicy.from_dict({"default": {"api_keys": ["k"], "weight": 5}})
+    assert p.resolve("k").name == DEFAULT_TENANT  # via the sink, not a claim
+    assert p.default.api_keys == ()
+
+
+# -- weighted-fair admission (DRR) -----------------------------------------
+
+
+class _S:
+    def __init__(self, tenant, i):
+        self.tenant = tenant
+        self.i = i
+
+    def __repr__(self):
+        return f"{self.tenant}{self.i}"
+
+
+def test_drr_hog_tenant_cannot_starve_the_weighted_peer():
+    p = policy()  # gold weight 3, free weight 1
+    hog = [_S("free", i) for i in range(30)]
+    gold = [_S("gold", i) for i in range(9)]
+    order = p.admission_order(hog + gold, cursor=0)
+    assert len(order) == 39
+    # while both tenants are queued, every DRR pass grants gold 3 for
+    # free's 1 — a 30-deep hog queue cannot starve the 3x-weighted peer
+    head = order[: 12]
+    assert sum(1 for s in head if s.tenant == "gold") == 9
+    # per-tenant FIFO is preserved: only the interleave changes
+    assert [s.i for s in order if s.tenant == "gold"] == list(range(9))
+    assert [s.i for s in order if s.tenant == "free"] == list(range(30))
+    # once gold drains, the hog's tail flows undisturbed
+    assert all(s.tenant == "free" for s in order[12:])
+
+
+def test_drr_cursor_rotates_tie_breaks_and_single_tenant_is_fifo():
+    p = policy()
+    mixed = [_S("free", 0), _S("gold", 0)]
+    first = p.admission_order(mixed, cursor=0)[0]
+    second = p.admission_order(mixed, cursor=1)[0]
+    assert {first.tenant, second.tenant} == {"free", "gold"}
+    only = [_S("free", i) for i in range(4)]
+    assert p.admission_order(only, cursor=3) == only  # untouched FIFO
+
+
+def test_drr_unknown_tenants_bucket_into_default():
+    p = policy()
+    anon = [_S(None, i) for i in range(2)]
+    order = p.admission_order(anon + [_S("gold", 0)], cursor=0)
+    assert len(order) == 3
+
+
+# -- quotas through the real service ---------------------------------------
+
+
+def make_service(**cfg):
+    defaults = dict(capacity=2, chunk_steps=4, max_queue=16, backend="numpy")
+    defaults.update(cfg)
+    return SimulationService(ServeConfig(**defaults))
+
+
+def test_max_sessions_quota_rejects_typed_before_storing():
+    p = QosPolicy.from_dict(
+        {"tenants": [{"name": "gold", "max_sessions": 2,
+                      "api_keys": ["k-gold"]}]}
+    )
+    svc = make_service(qos=p)
+    b = random_board(8, 8, seed=0)
+    svc.submit(b, "conway", 10, tenant="gold")
+    svc.submit(b, "conway", 10, tenant="gold")
+    with pytest.raises(QuotaExceeded) as exc:
+        svc.submit(b, "conway", 10, tenant="gold")
+    assert exc.value.quota == "max_sessions" and exc.value.limit == 2
+    assert len(svc.store) == 2  # the breach left no trace
+    # another tenant is untouched by gold's ceiling
+    svc.submit(b, "conway", 10, tenant="free")
+    assert svc.store.live_by_tenant() == {"gold": 2, "free": 1}
+    # the typed breach moved the per-tenant counter, not backpressure
+    shed = {
+        labels["reason"]: inst.value
+        for labels, inst in svc.registry.counter(
+            "tenant_shed_total", labels=("tenant", "reason")
+        ).series()
+        if labels["tenant"] == "gold"
+    }
+    assert shed.get("quota_sessions") == 1
+    svc.close()
+
+
+def test_quota_free_tenants_unlimited_without_policy():
+    svc = make_service()  # tenant-blind: no policy, no ceilings
+    b = random_board(8, 8, seed=1)
+    for _ in range(4):
+        svc.submit(b, "conway", 10, tenant="gold")
+    assert svc.store.live_by_tenant() == {"gold": 4}
+    svc.close()
+
+
+def test_max_watchers_quota_bounds_stream_buffers():
+    p = QosPolicy.from_dict(
+        {"tenants": [{"name": "free", "max_watchers": 1,
+                      "api_keys": ["k-free"]}]}
+    )
+    svc = make_service(qos=p)
+    b = random_board(8, 8, seed=2)
+    s1 = svc.submit(b, "conway", 200, tenant="free")
+    s2 = svc.submit(b, "conway", 200, tenant="free")
+    svc.stream_subscribe(s1)
+    svc.stream_subscribe(s1)  # same session ring: no new buffer
+    with pytest.raises(QuotaExceeded) as exc:
+        svc.stream_subscribe(s2)  # a SECOND ring breaches the quota
+    assert exc.value.quota == "max_watchers"
+    svc.close()
+
+
+def test_scheduler_admit_scan_is_drr_under_policy():
+    # the integration seam: the scheduler's admit scan hands its queue
+    # to the policy — a flooded free queue still admits gold first when
+    # slots are scarce (capacity 1, one admission per round)
+    p = policy()
+    svc = make_service(qos=p, capacity=1, chunk_steps=2, max_queue=16)
+    sched = svc.scheduler
+    assert sched.qos is p
+    order = sched.qos.admission_order(
+        [_S("free", 0), _S("free", 1), _S("gold", 0)], cursor=1
+    )
+    assert order[0].tenant == "gold"
+    svc.close()

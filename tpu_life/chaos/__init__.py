@@ -93,6 +93,12 @@ POINTS: dict[str, tuple[str, ...]] = {
     # fleet supervisor / migrator
     "probe.skew": ("skew",),  # monitor clock reads skew by up to `seconds`
     "migrate.die": ("die",),  # the migration thread is never started
+    # demand-driven autoscaling (docs/FLEET.md "Autoscaling")
+    "scale.recruit.fail": ("refuse",),  # recruit() launches nobody (standby
+    # failed to start) — the loop holds and retries next evaluation
+    "scale.release.race": ("race",),  # scale-down victim selection grabs a
+    # BUSY worker: the drain races live load; graceful release must
+    # still lose no accepted session
     # cross-host control plane (docs/FLEET.md "Cross-host topology")
     "lease.heartbeat.drop": ("drop",),  # registrar heartbeat never sent
     "lease.register.reset": ("reset",),  # registration POST reset pre-send

@@ -107,6 +107,17 @@ STREAM_POINTS: dict[str, dict] = {
                           "seconds": 0.4},
 }
 
+#: The surge drill's fault mix (``tpu-life chaos --surge``,
+#: docs/FLEET.md "Autoscaling"): one recruit refused at the worst moment
+#: (the control loop must hold WITHOUT arming its cooldown and land the
+#: recruit on the next tick) and one release steered onto the BUSIEST
+#: worker instead of the idlest (graceful drain must still lose no
+#: session).  Both fire in the fleet process — the autoscaler's seams.
+SURGE_POINTS: dict[str, dict] = {
+    "scale.recruit.fail": {"rate": 1.0, "mode": "refuse", "times": 1},
+    "scale.release.race": {"rate": 1.0, "mode": "race", "times": 1},
+}
+
 
 @dataclass
 class DrillConfig:
@@ -144,6 +155,19 @@ class DrillConfig:
     stream: bool = False
     lenia_sessions: int = 1  # stream drill only: continuous-tier sids
     watchers_per_session: int = 2
+    # the surge drill (docs/FLEET.md "Autoscaling" + docs/SERVING.md
+    # "Tenant QoS"): a fleet with a standby pool and a live autoscaler
+    # rides a ``surge_factor``x admission burst split between a
+    # guaranteed and a best-effort tenant, and the drill verifies the
+    # extra ``scale`` invariant (recruited to full strength through the
+    # burst, released back to the base after it, both scale chaos points
+    # fired) and ``qos`` invariant (every refusal typed and best-effort-
+    # only, guaranteed-tenant admission p99 bounded)
+    surge: bool = False
+    standby: int = 2  # parked slots the autoscaler may recruit
+    surge_factor: int = 10  # burst size = surge_factor x det_sessions
+    qos_p99_bound_s: float = 5.0  # guaranteed-tenant submit p99 bound
+    scale_wait_s: float = 90.0  # budget for the post-burst release-back
 
 
 @dataclass
@@ -168,6 +192,10 @@ class WorkItem:
     # compare is allclose (continuous tier) rather than byte-equal
     edits: list = field(default_factory=list)
     continuous: bool = False
+    # surge drill fields: which tenant this item submits as (the API key
+    # carried on its requests) and which traffic phase it belongs to
+    api_key: str | None = None
+    phase: str = ""  # "trickle" | "burst"
 
 
 def _build_stream_items(cfg: DrillConfig) -> list[WorkItem]:
@@ -255,9 +283,55 @@ def _build_stream_items(cfg: DrillConfig) -> list[WorkItem]:
     return items
 
 
+#: The surge drill's tenant API keys (seeded fixtures, not secrets).
+SURGE_GOLD_KEY = "drill-gold-key"
+SURGE_FREE_KEY = "drill-free-key"
+
+
+def _build_surge_items(cfg: DrillConfig) -> list[WorkItem]:
+    """The surge workload: a 1x trickle of guaranteed-tenant sessions,
+    then a ``surge_factor``x burst split between the guaranteed and the
+    best-effort tenant.  All conway with precomputed oracles — the
+    standard bit_identity / no_lost_work invariants apply unchanged."""
+    rule = get_rule("conway")
+    items: list[WorkItem] = []
+
+    def det_item(tag: str, i: int, key: str, phase: str) -> WorkItem:
+        steps = max(
+            cfg.chunk_steps * cfg.min_progress,
+            cfg.steps - (cfg.steps * (i % 7)) // 14,
+        )
+        seed = cfg.seed * 1000 + i
+        board = mc.seeded_board(cfg.size, cfg.size, 0.45, seed=seed)
+        return WorkItem(
+            tag=tag,
+            rule="conway",
+            board=board,
+            steps=steps,
+            seed=seed,
+            temperature=None,
+            oracle=run_np(board, rule, steps).tobytes(),
+            api_key=key,
+            phase=phase,
+        )
+
+    for i in range(cfg.det_sessions):
+        items.append(det_item(f"trickle{i}", i, SURGE_GOLD_KEY, "trickle"))
+    burst = cfg.surge_factor * cfg.det_sessions
+    for i in range(burst):
+        key = SURGE_GOLD_KEY if i % 2 == 0 else SURGE_FREE_KEY
+        tenant = "gold" if i % 2 == 0 else "free"
+        items.append(
+            det_item(f"burst-{tenant}{i}", 100 + i, key, "burst")
+        )
+    return items
+
+
 def _build_items(cfg: DrillConfig) -> list[WorkItem]:
     if cfg.stream:
         return _build_stream_items(cfg)
+    if cfg.surge:
+        return _build_surge_items(cfg)
     items: list[WorkItem] = []
     rule = get_rule("conway")
     for i in range(cfg.det_sessions):
@@ -345,6 +419,8 @@ class _Driller:
             points = GOVERNOR_POINTS
         elif cfg.stream:
             points = STREAM_POINTS
+        elif cfg.surge:
+            points = SURGE_POINTS
         else:
             points = DEFAULT_POINTS
         self.plan = chaos.ChaosPlan(cfg.seed, points)
@@ -356,6 +432,17 @@ class _Driller:
         self.injection_scrapes: dict[str, dict[str, float]] = {}
         self.fleet = None
         self.base_url = ""
+        # surge drill evidence (populated by _surge_submit): typed
+        # best-effort sheds observed, guaranteed-tenant admission
+        # latencies, and any refusal the QoS contract forbids
+        self.surge_sheds: list[dict] = []
+        self.surge_gold_lat_s: list[float] = []
+        # the same latencies keyed by phase: "trickle" is the 1x
+        # baseline, "burst" the surge_factor-x spike — the pair the
+        # BENCH_surge record reports as p99 at 1x vs 10x
+        self.surge_gold_lat_phase: dict[str, list[float]] = {}
+        self.surge_gold_refusals: list[str] = []
+        self.surge_bad_refusals: list[str] = []
 
     # -- plumbing ----------------------------------------------------------
     def violate(self, invariant: str, detail: str) -> None:
@@ -871,6 +958,262 @@ def _check_stream(d: "_Driller", watchers: list[_StreamWatcher]) -> None:
                 break
 
 
+def _write_surge_policy(workdir: str) -> str:
+    """The surge drill's tenant fixture (docs/SERVING.md "Tenant QoS"):
+    a guaranteed ``gold`` tenant at 4x the weight of a best-effort
+    ``free`` tenant, with the soft shed rung pulled LOW so the burst
+    exercises best-effort shedding long before any hard limit — the
+    ladder the qos invariant verifies (free sheds typed, gold never
+    feels the wave)."""
+    policy = {
+        "tenants": [
+            {
+                "name": "gold",
+                "tier": "guaranteed",
+                "weight": 4,
+                "api_keys": [SURGE_GOLD_KEY],
+            },
+            {
+                "name": "free",
+                "tier": "best_effort",
+                "weight": 1,
+                "api_keys": [SURGE_FREE_KEY],
+            },
+        ],
+        "best_effort_water": 0.03,
+    }
+    path = os.path.join(workdir, "qos.json")
+    with open(path, "w") as f:
+        json.dump(policy, f)
+    return path
+
+
+def _surge_submit(d: "_Driller") -> None:
+    """Drive the surge workload AS its tenants: raw (retries=0) clients
+    so every refusal surfaces typed instead of being absorbed by client
+    backoff.  Gold submits are single-attempt with admission latency
+    recorded (the p99 the qos invariant bounds); free submits ride the
+    documented shed recourse — honor Retry-After, resubmit — until
+    admitted or the wait budget runs out."""
+    from tpu_life.gateway.client import GatewayError
+
+    cfg = d.cfg
+    raw = {
+        key: GatewayClient(d.base_url, api_key=key, retries=0)
+        for key in (SURGE_GOLD_KEY, SURGE_FREE_KEY)
+    }
+
+    def attempt(item: WorkItem) -> str:
+        gold = item.api_key == SURGE_GOLD_KEY
+        t0 = time.monotonic()
+        try:
+            item.sid = raw[item.api_key].submit(
+                board=item.board,
+                rule=item.rule,
+                steps=item.steps,
+                seed=item.seed,
+                temperature=item.temperature,
+            )
+        except GatewayError as e:
+            if not gold and e.status == 503 and e.code == "shed_best_effort":
+                d.surge_sheds.append(
+                    {
+                        "tag": item.tag,
+                        "code": e.code,
+                        "retry_after": e.retry_after,
+                    }
+                )
+                return "shed"
+            refusal = f"{item.tag}: {e.status} {e.code}"
+            (d.surge_gold_refusals if gold else d.surge_bad_refusals).append(
+                refusal
+            )
+            item.outcome = "rejected"
+            item.detail = refusal
+            return "refused"
+        except Exception as e:  # noqa: BLE001 - raw client: no retries,
+            # so transport noise at submit is indistinguishable from an
+            # untyped refusal — record it as one (the qos invariant's
+            # "every refusal is typed" is exactly this strict)
+            refusal = f"{item.tag}: {e}"
+            (d.surge_gold_refusals if gold else d.surge_bad_refusals).append(
+                refusal
+            )
+            item.outcome = "rejected"
+            item.detail = refusal
+            return "refused"
+        if gold:
+            lat = time.monotonic() - t0
+            d.surge_gold_lat_s.append(lat)
+            d.surge_gold_lat_phase.setdefault(item.phase, []).append(lat)
+        d.accepted += 1
+        item.outcome = "pending"
+        return "ok"
+
+    for item in d.items:
+        if item.phase == "trickle":
+            attempt(item)
+    time.sleep(1.5)  # let the control loop see the 1x baseline first
+    retry: list[WorkItem] = []
+    for item in d.items:
+        if item.phase == "burst" and attempt(item) == "shed":
+            retry.append(item)
+    deadline = time.monotonic() + cfg.wait_timeout_s
+    while retry and time.monotonic() < deadline:
+        # the documented best-effort recourse: sleep the advertised
+        # Retry-After (bounded — this is a drill, not a backoff study)
+        pause = 0.3
+        hints = [
+            s["retry_after"] for s in d.surge_sheds if s.get("retry_after")
+        ]
+        if hints:
+            pause = min(1.0, max(0.1, float(hints[-1])))
+        time.sleep(pause)
+        retry = [item for item in retry if attempt(item) == "shed"]
+    for item in retry:
+        item.outcome = "rejected"
+        item.detail = "shed_best_effort past the retry deadline"
+
+
+class _ScaleWatch:
+    """Background sampler of the supervisor's (active, standby) split:
+    records every transition with its wall-clock offset plus the peak
+    active strength — the scale invariant's evidence that the fleet
+    actually recruited through the burst and released after it."""
+
+    def __init__(self, supervisor):
+        import threading
+
+        self.sup = supervisor
+        self.transitions: list[dict] = []
+        self.peak_active = 0
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._t = threading.Thread(
+            target=self._run, name="drill-scale-watch", daemon=True
+        )
+
+    def start(self) -> None:
+        self._t0 = time.monotonic()
+        self._t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=5)
+
+    def _run(self) -> None:
+        last = None
+        while not self._stop.wait(0.05):
+            try:
+                active, standby = self.sup.scale_counts()
+            except Exception:  # noqa: BLE001 - sampling must not die
+                continue
+            self.peak_active = max(self.peak_active, active)
+            if (active, standby) != last:
+                last = (active, standby)
+                self.transitions.append(
+                    {
+                        "t_s": round(time.monotonic() - self._t0, 3),
+                        "active": active,
+                        "standby": standby,
+                    }
+                )
+
+
+def _p99(xs: list) -> float | None:
+    if not xs:
+        return None
+    s = sorted(xs)
+    return float(s[min(len(s) - 1, int(0.99 * len(s)))])
+
+
+def _check_scale(
+    d: "_Driller", fleet, watch: _ScaleWatch, released_back_s
+) -> None:
+    """The scale invariant (docs/FLEET.md "Autoscaling"), appended when
+    ``--surge`` is armed:
+
+    - the burst recruited the fleet to FULL strength (base + every
+      standby slot) — a surge the loop slept through certifies nothing;
+    - after the burst drained, the loop released back DOWN to the base
+      strength within ``scale_wait_s`` (hysteresis + idle grace +
+      cooldowns included);
+    - both scale chaos points actually fired: one recruit was refused
+      at the seam (and the loop still reached full strength — no armed
+      cooldown after a failed recruit) and one release was steered onto
+      the busiest worker (and no session was lost — covered by the
+      standard invariants riding along).
+    """
+    d.extra_invariants.append("scale")
+    full = d.cfg.workers + d.cfg.standby
+    if watch.peak_active < full:
+        d.violate(
+            "scale",
+            f"peak active strength {watch.peak_active} never reached "
+            f"{full} (base {d.cfg.workers} + {d.cfg.standby} standby) — "
+            f"the burst did not recruit the pool",
+        )
+    if released_back_s is None:
+        active, standby = fleet.supervisor.scale_counts()
+        d.violate(
+            "scale",
+            f"fleet still at {active} active / {standby} standby "
+            f"{d.cfg.scale_wait_s:.0f}s after the burst drained — "
+            f"never released back to base {d.cfg.workers}",
+        )
+    inj = d.injections_by_point()
+    local = {p: sum(c.values()) for p, c in chaos.counts().items()}
+    for point in ("scale.recruit.fail", "scale.release.race"):
+        if inj.get(point, 0) + local.get(point, 0) < 1:
+            d.violate(
+                "scale",
+                f"{point} never fired (injections: {inj}) — the seam "
+                f"was not exercised; pick a seed that reaches it",
+            )
+
+
+def _check_qos(d: "_Driller") -> None:
+    """The qos invariant (docs/SERVING.md "Tenant QoS"), appended when
+    ``--surge`` is armed:
+
+    - the burst actually reached the shed ladder (at least one typed
+      best-effort shed, each carrying Retry-After);
+    - every refusal the drill saw was TYPED ``shed_best_effort`` and
+      landed on the best-effort tenant ONLY — the guaranteed tenant was
+      never refused, never shed, never rate-limited;
+    - guaranteed-tenant admission latency p99 stayed under
+      ``qos_p99_bound_s`` THROUGH the burst — isolation, not just
+      eventual admission.
+    """
+    d.extra_invariants.append("qos")
+    if not d.surge_sheds:
+        d.violate(
+            "qos",
+            "no best-effort shed ever fired — the burst never reached "
+            "the shed ladder; raise --surge-factor",
+        )
+    for shed in d.surge_sheds:
+        if not shed.get("retry_after"):
+            d.violate(
+                "qos",
+                f"{shed['tag']}: shed_best_effort without a Retry-After "
+                f"hint — the documented recourse is unplayable",
+            )
+            break
+    for refusal in d.surge_gold_refusals:
+        d.violate("qos", f"guaranteed tenant refused: {refusal}")
+    for refusal in d.surge_bad_refusals:
+        d.violate("qos", f"untyped or mis-tiered refusal: {refusal}")
+    p99 = _p99(d.surge_gold_lat_s)
+    if p99 is not None and p99 > d.cfg.qos_p99_bound_s:
+        d.violate(
+            "qos",
+            f"guaranteed-tenant admission p99 {p99:.3f}s exceeds the "
+            f"{d.cfg.qos_p99_bound_s:.1f}s bound — the burst leaked into "
+            f"the guaranteed tier",
+        )
+
+
 class _RecycleWatch:
     """Background sampler of supervisor state: records every observed
     unready-recycle — a worker leaving READY and coming back under a
@@ -952,17 +1295,48 @@ def run_drill(cfg: DrillConfig) -> dict:
     os.environ[chaos.ENV_VAR] = json.dumps(spec)  # workers inherit this
     chaos.arm(d.plan)  # this process: router/supervisor/migrator seams
     workdir = cfg.workdir
+    max_queue = 4 * (cfg.det_sessions + cfg.ising_sessions)
+    if cfg.surge:
+        # headroom above the WHOLE burst: the drill's shed ladder must
+        # be exercised by the soft best-effort rung, never by hard
+        # queue_full — a gold refusal at the hard rung is a qos failure
+        max_queue = 4 * len(d.items)
     worker_args = [
         "--serve-backend", cfg.backend,
         "--capacity", str(cfg.capacity),
         "--chunk-steps", str(cfg.chunk_steps),
-        "--max-queue", str(4 * (cfg.det_sessions + cfg.ising_sessions)),
+        "--max-queue", str(max_queue),
     ]
     if cfg.governor:
         # every worker runs the wedge watchdog: a wedged settle flips its
         # /readyz to 500 engine_wedged, and the supervisor's existing
         # unready-recycle + migration path is what the drill verifies
         worker_args += ["--settle-deadline", str(cfg.settle_deadline_s)]
+    autoscale = None
+    if cfg.surge:
+        from tpu_life.fleet.autoscaler import AutoscaleConfig
+
+        # drill-speed control loop: tight windows and cooldowns so the
+        # whole recruit->release arc fits in CI seconds, burn-driven
+        # scaling OFF (the drill's own sheds light the burn windows for
+        # minutes — wall-clock the release-back must not wait on), and
+        # the ceiling at exactly base + pool so "full strength" is a
+        # deterministic number the scale invariant can assert
+        autoscale = AutoscaleConfig(
+            min_workers=cfg.workers,
+            max_workers=cfg.workers + cfg.standby,
+            depth_high=3.0,
+            depth_low=0.5,
+            window_s=5.0,
+            cooldown_up_s=0.5,
+            cooldown_down_s=2.0,
+            idle_grace_s=1.5,
+            scale_on_burn=False,
+        )
+        worker_args += [
+            "--qos", _write_surge_policy(workdir),
+            "--series-every", "0.25",
+        ]
     fleet = Fleet(
         FleetConfig(
             workers=cfg.workers,
@@ -974,6 +1348,9 @@ def run_drill(cfg: DrillConfig) -> dict:
             probe_interval_s=0.1,
             backoff_base_s=0.2,
             migrate_stuck_after_s=cfg.migrate_stuck_after_s,
+            standby=cfg.standby if cfg.surge else 0,
+            autoscale=autoscale,
+            series_every_s=0.25 if cfg.surge else 1.0,
         )
     )
     d.fleet = fleet
@@ -1000,6 +1377,9 @@ def run_drill(cfg: DrillConfig) -> dict:
         if cfg.governor
         else None
     )
+    scale_watch: _ScaleWatch | None = None
+    released_back_s = None
+    scale_summary: dict = {}
     try:
         fleet.start()
         if not fleet.wait_ready(timeout=120, min_workers=cfg.workers):
@@ -1010,8 +1390,13 @@ def run_drill(cfg: DrillConfig) -> dict:
             watch.start()
         d.base_url = f"http://127.0.0.1:{fleet.port}"
         client = GatewayClient(d.base_url, retries=8)
-        for item in d.items:
-            d.submit_item(client, item)
+        if cfg.surge:
+            scale_watch = _ScaleWatch(fleet.supervisor)
+            scale_watch.start()
+            _surge_submit(d)
+        else:
+            for item in d.items:
+                d.submit_item(client, item)
         watchers: list[_StreamWatcher] = []
         if cfg.stream:
             # hang N live watchers on every accepted sid BEFORE the
@@ -1025,9 +1410,21 @@ def run_drill(cfg: DrillConfig) -> dict:
                     )
             for w in watchers:
                 w.start()
-        d.run_kills(client)
+        if not cfg.surge:
+            # the surge drill's faults are the SCALE seams (a refused
+            # recruit, a raced release) — its workers stay up; SIGKILLs
+            # belong to the other drills
+            d.run_kills(client)
         # poll everything to terminal; play the documented client
         # recourse for typed losses (resubmit from scratch, fresh sid)
+        surge_clients = (
+            {
+                key: GatewayClient(d.base_url, api_key=key, retries=8)
+                for key in (SURGE_GOLD_KEY, SURGE_FREE_KEY)
+            }
+            if cfg.surge
+            else {}
+        )
         for item in d.items:
             if item.sid is None:
                 continue
@@ -1037,7 +1434,10 @@ def run_drill(cfg: DrillConfig) -> dict:
                 and item.resubmits < cfg.resubmit_lost
             ):
                 item.resubmits += 1
-                if not d.submit_item(client, item):
+                # resubmits stay IN tenant: a surge item re-enters as
+                # the tenant it belongs to, never as the default
+                sub = surge_clients.get(item.api_key, client)
+                if not d.submit_item(sub, item):
                     break
                 d.poll_until_terminal(client, item)
         for item in d.items:
@@ -1061,9 +1461,35 @@ def run_drill(cfg: DrillConfig) -> dict:
             _check_governor(d, fleet)
         if cfg.stream:
             _check_stream(d, watchers)
+        if cfg.surge:
+            # the down leg: with every session terminal the demand is
+            # gone — the loop must ride hysteresis + idle grace +
+            # cooldowns back DOWN to base strength on its own
+            rb0 = time.monotonic()
+            while time.monotonic() < rb0 + cfg.scale_wait_s:
+                active, _standby = fleet.supervisor.scale_counts()
+                if active <= cfg.workers:
+                    released_back_s = time.monotonic() - rb0
+                    break
+                time.sleep(0.1)
+            scale_watch.stop()
+            d._scrape_injections()  # the release leg's chaos evidence
+            _check_scale(d, fleet, scale_watch, released_back_s)
+            _check_qos(d)
+            auto = fleet.supervisor.autoscaler
+            scale_summary = {
+                "base": cfg.workers,
+                "standby_slots": cfg.standby,
+                "peak_active": scale_watch.peak_active,
+                "released_back_s": released_back_s,
+                "transitions": scale_watch.transitions,
+                "decisions": auto.decisions if auto is not None else 0,
+            }
     finally:
         if watch is not None:
             watch.stop()
+        if scale_watch is not None:
+            scale_watch.stop()
         try:
             fleet.begin_drain()
             fleet.wait(timeout=60)
@@ -1087,6 +1513,8 @@ def run_drill(cfg: DrillConfig) -> dict:
         kind = "governor_drill"
     elif cfg.stream:
         kind = "stream_drill"
+    elif cfg.surge:
+        kind = "surge_drill"
     else:
         kind = "chaos_drill"
     summary = {
@@ -1118,6 +1546,28 @@ def run_drill(cfg: DrillConfig) -> dict:
                 }
             }
             if cfg.stream
+            else {}
+        ),
+        # surge mode: the recruit->release arc and the tenant-isolation
+        # evidence the scale/qos invariants judged
+        **(
+            {
+                "scale": scale_summary,
+                "qos": {
+                    "sheds": len(d.surge_sheds),
+                    "gold_submits": len(d.surge_gold_lat_s),
+                    "gold_p99_s": _p99(d.surge_gold_lat_s),
+                    "gold_p99_trickle_s": _p99(
+                        d.surge_gold_lat_phase.get("trickle", [])
+                    ),
+                    "gold_p99_burst_s": _p99(
+                        d.surge_gold_lat_phase.get("burst", [])
+                    ),
+                    "gold_refusals": d.surge_gold_refusals,
+                    "bad_refusals": d.surge_bad_refusals,
+                },
+            }
+            if cfg.surge
             else {}
         ),
         "sessions": len(d.items),

@@ -337,6 +337,24 @@ def build_parser() -> argparse.ArgumentParser:
                     "renewed lease, rebind the spill namespace per grant, "
                     "and on a lease_expired fence drop the re-homed "
                     "sessions and re-register fresh")
+    gw.add_argument("--standby", action="store_true",
+                    help="with --register: park in the control plane's "
+                    "standby pool (docs/FLEET.md autoscaling) — leased "
+                    "but out of the rotation until its autoscaler "
+                    "recruits the slot")
+    gw.add_argument("--qos", default=None, metavar="FILE",
+                    help="tenant QoS policy (docs/SERVING.md tenant QoS): "
+                    "a JSON or TOML file of per-tenant quotas, weights "
+                    "and tiers — X-API-Key resolves to a tenant, the "
+                    "scheduler interleaves tenants weighted-fair, and "
+                    "best-effort tenants shed before guaranteed ones "
+                    "feel pressure")
+    gw.add_argument("--spill-replicas", type=int, default=1, metavar="N",
+                    help="replicated spill (docs/FLEET.md durability): "
+                    "fan every --spill-dir write through N replica "
+                    "stores so a torn or lost replica never loses the "
+                    "rescue; reads take the newest intact copy "
+                    "(local-directory spill only)")
     _add_governor_args(gw)
     gw.add_argument("--api-rate", type=float, default=0.0, metavar="TOKENS/S",
                     help="per-API-key token-bucket refill rate; 0 disables "
@@ -430,6 +448,56 @@ def build_parser() -> argparse.ArgumentParser:
                     "--register); an un-renewed lease fires the same "
                     "migration a worker death does, then fences the "
                     "generation")
+    fl.add_argument("--standby", type=int, default=0, metavar="N",
+                    help="standby pool (docs/FLEET.md autoscaling): plan "
+                    "N extra worker slots that stay PARKED — no process, "
+                    "no routing — until the autoscaler (or a wire-"
+                    "registered `gateway --standby`) fills them")
+    fl.add_argument("--autoscale", action="store_true",
+                    help="demand-driven autoscaling (docs/FLEET.md "
+                    "autoscaling): a control loop on the monitor tick "
+                    "reads the fleet series store (queue depth/age, "
+                    "refusal rates, memory pressure) plus SLO burn and "
+                    "recruits standby workers under load / drains idle "
+                    "ones back to the pool, every decision a typed "
+                    "scale.* flight event `tpu-life doctor --scale` "
+                    "replays")
+    fl.add_argument("--scale-min", type=int, default=1, metavar="N",
+                    help="autoscale floor: never drain below N deployed "
+                    "workers")
+    fl.add_argument("--scale-max", type=int, default=None, metavar="N",
+                    help="autoscale ceiling: never recruit past N "
+                    "deployed workers (default: bounded by the pool)")
+    fl.add_argument("--scale-up-depth", type=float, default=4.0,
+                    metavar="DEPTH",
+                    help="mean queue depth per ready worker at which the "
+                    "fleet scales up (the hysteresis band's upper edge)")
+    fl.add_argument("--scale-down-depth", type=float, default=0.5,
+                    metavar="DEPTH",
+                    help="mean queue depth per ready worker at or below "
+                    "which the fleet counts as idle (the band's lower "
+                    "edge; must sit below --scale-up-depth)")
+    fl.add_argument("--scale-idle-grace", type=float, default=10.0,
+                    metavar="SECONDS",
+                    help="the fleet must look idle continuously this long "
+                    "before any scale-down (the structural flap guard)")
+    fl.add_argument("--scale-cooldown-up", type=float, default=5.0,
+                    metavar="SECONDS",
+                    help="minimum seconds between consecutive scale-ups")
+    fl.add_argument("--scale-cooldown-down", type=float, default=30.0,
+                    metavar="SECONDS",
+                    help="minimum seconds between a scale move and the "
+                    "next scale-down")
+    fl.add_argument("--qos", default=None, metavar="FILE",
+                    help="tenant QoS policy file forwarded to every "
+                    "worker (docs/SERVING.md tenant QoS): per-tenant "
+                    "quotas, weighted-fair scheduling, tiered shedding "
+                    "(the router already forwards X-API-Key)")
+    fl.add_argument("--spill-replicas", type=int, default=1, metavar="N",
+                    help="replicated spill for every worker (docs/"
+                    "FLEET.md durability): writes fan through N replica "
+                    "stores under each worker's spill dir; requires "
+                    "--spill-dir")
     _add_governor_args(fl)
     fl.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
                     help="default per-request deadline (per worker)")
@@ -574,6 +642,27 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SECONDS",
                     help="worker wedge-watchdog deadline for --governor "
                          "(forwarded as each worker's --settle-deadline)")
+    ch.add_argument("--surge", action="store_true",
+                    help="the autoscaling + tenant-QoS drill (docs/"
+                    "CHAOS.md surge): a 2-worker fleet with a standby "
+                    "pool and an autoscaler rides a 10x admission burst "
+                    "from a guaranteed and a best-effort tenant — the "
+                    "drill verifies the fleet scaled up through the "
+                    "burst and released back after it, every shed was "
+                    "typed and landed on the best-effort tenant only, "
+                    "and the standard durability invariants held; "
+                    "recruit/release chaos points fire on the seed")
+    ch.add_argument("--surge-factor", type=int, default=10, metavar="N",
+                    help="--surge only: burst size as a multiple of "
+                    "--sessions (the trickle baseline)")
+    ch.add_argument("--surge-standby", type=int, default=2, metavar="N",
+                    help="--surge only: parked standby slots the "
+                    "autoscaler recruits through the burst")
+    ch.add_argument("--qos-p99-bound", type=float, default=5.0,
+                    metavar="SECONDS",
+                    help="--surge only: bound on the guaranteed tenant's "
+                    "admission-latency p99 through the burst (the qos "
+                    "invariant)")
     ch.add_argument("--stream", action="store_true",
                     help="the live-session stream drill (docs/STREAMING.md): "
                          "every session carries pre-scheduled mid-run edits "
@@ -731,6 +820,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "flight event in the capture to its plausible cause "
                     "— a kill, a lease expiry, an injection — with typed "
                     "findings; needs no --sid")
+    dr.add_argument("--scale", action="store_true",
+                    help="autoscaling postmortem (docs/FLEET.md "
+                    "autoscaling): replay the fleet's full scale.* "
+                    "decision sequence from the capture — every up/"
+                    "down/hold with the signal snapshot that justified "
+                    "it ('why did we have 40 workers at 14:02'); needs "
+                    "no --sid")
 
     sm = sub.add_parser(
         "submit",
@@ -1386,6 +1482,10 @@ def _doctor(args) -> int:
 
     from tpu_life.obs import journey
 
+    if args.slo and args.scale:
+        print("doctor: --slo and --scale are separate postmortems; "
+              "pick one", file=sys.stderr)
+        return 2
     if args.slo:
         # SLO postmortem: capture-wide, so no --sid needed — every
         # slo.breach instant is joined to its nearest plausible cause
@@ -1403,6 +1503,22 @@ def _doctor(args) -> int:
             print(obs_slo.render_slo_report(report))
         # breaches are FINDINGS (the postmortem worked), not failures —
         # exit 0 mirrors the journey path where kills are information
+        return 0
+    if args.scale:
+        # scaling postmortem: capture-wide like --slo — the decision
+        # sequence is the evidence, so exit 0 whenever the replay worked
+        from tpu_life.fleet.autoscaler import render_scale_report, scale_report
+
+        try:
+            doc = journey.load_merged(args.capture)
+        except (FileNotFoundError, ValueError, json.JSONDecodeError) as e:
+            print(f"doctor: {e}", file=sys.stderr)
+            return 2
+        report = scale_report(doc)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(render_scale_report(report))
         return 0
     if args.sid is None and args.trace_id is None:
         print("doctor: pass --sid or --trace-id", file=sys.stderr)
@@ -1894,6 +2010,23 @@ def _gateway(args) -> int:
     from tpu_life.serve import ServeConfig, SimulationService
 
     configure_logging(args.verbose)
+    if args.standby and args.register is None:
+        print(
+            "gateway: --standby needs --register (the standby pool is a "
+            "control-plane concept)",
+            file=sys.stderr,
+        )
+        return 2
+    qos = None
+    if args.qos is not None:
+        from tpu_life.serve.qos import QosPolicy
+
+        try:
+            qos = QosPolicy.load(args.qos)
+        except (OSError, ValueError) as e:
+            # typed, before any socket or engine exists
+            print(f"gateway: bad --qos: {e}", file=sys.stderr)
+            return 2
     try:
         svc = SimulationService(
             ServeConfig(
@@ -1911,6 +2044,8 @@ def _gateway(args) -> int:
                 spill_every=args.spill_every,
                 spill_url=args.spill_url,
                 spill_namespace=args.spill_namespace,
+                spill_replicas=args.spill_replicas,
+                qos=qos,
                 mc_packed=not args.no_bitpack,
                 stencil=args.stencil,
                 memory_budget_bytes=args.memory_budget_bytes,
@@ -1933,6 +2068,7 @@ def _gateway(args) -> int:
             api_burst=args.api_burst,
             shed_high_water=args.shed_high_water,
             max_body=args.max_body if args.max_body is not None else MAX_BODY,
+            qos=qos,
         ),
     )
     gw.install_signal_handlers()
@@ -1993,6 +2129,7 @@ def _gateway(args) -> int:
             device_info=lambda: gw.device_info(wait_s=0.0),
             on_grant=_on_grant,
             on_fenced=lambda reason: svc.cancel_live(reason),
+            standby=args.standby,
         )
         registrar.start()
     try:
@@ -2091,6 +2228,26 @@ def _fleet(args) -> int:
         worker_args += ["--platform", args.platform]
     if args.verbose:
         worker_args += ["--verbose"]
+    # tenant QoS rides to every worker as the policy FILE (the workers
+    # parse it themselves; validate here so a typo fails before spawn)
+    if args.qos is not None:
+        from tpu_life.serve.qos import QosPolicy
+
+        try:
+            QosPolicy.load(args.qos)
+        except (OSError, ValueError) as e:
+            print(f"fleet: bad --qos: {e}", file=sys.stderr)
+            return 2
+        worker_args += ["--qos", args.qos]
+    if args.spill_replicas != 1:
+        if args.spill_dir is None:
+            print(
+                "fleet: --spill-replicas needs --spill-dir (replication "
+                "is a local-directory spill feature)",
+                file=sys.stderr,
+            )
+            return 2
+        worker_args += ["--spill-replicas", str(args.spill_replicas)]
     if args.spill_dir is not None and args.spill_url is not None:
         print(
             "fleet: --spill-dir and --spill-url are mutually exclusive "
@@ -2106,6 +2263,19 @@ def _fleet(args) -> int:
                 "--devices-per-worker/--total-devices have no effect "
                 "without --placement auto — pass it explicitly (refusing "
                 "to silently keep the shared spawning env)"
+            )
+        autoscale = None
+        if args.autoscale:
+            from tpu_life.fleet.autoscaler import AutoscaleConfig
+
+            autoscale = AutoscaleConfig(
+                min_workers=args.scale_min,
+                max_workers=args.scale_max,
+                depth_high=args.scale_up_depth,
+                depth_low=args.scale_down_depth,
+                idle_grace_s=args.scale_idle_grace,
+                cooldown_up_s=args.scale_cooldown_up,
+                cooldown_down_s=args.scale_cooldown_down,
             )
         fleet = Fleet(
             FleetConfig(
@@ -2124,6 +2294,8 @@ def _fleet(args) -> int:
                 trace_dir=args.trace_dir,
                 series_every_s=args.series_every,
                 slo_file=args.slo_file,
+                standby=args.standby,
+                autoscale=autoscale,
                 probe_interval_s=args.probe_interval,
                 backoff_base_s=args.restart_backoff,
                 # the flag counts RESTARTS; the breaker counts consecutive
@@ -2212,6 +2384,10 @@ def _fleet(args) -> int:
                     if "migrations" in stats
                     else {}
                 ),
+                # autoscaling evidence (present only when configured):
+                # deployed/parked counts and how many decisions the
+                # control loop took
+                **({"scale": stats["scale"]} if "scale" in stats else {}),
                 # a breaker-open worker is a real failure even though the
                 # drain machinery shut everything down tidily — exit 1
                 "failed_workers": failed,
@@ -2277,17 +2453,18 @@ def _chaos_drill(args) -> int:
         except (ValueError, chaos.ChaosError) as e:
             print(f"chaos: bad --plan: {e}", file=sys.stderr)
             return 2
-    if args.governor and args.stream:
+    if sum((args.governor, args.stream, args.surge)) > 1:
         print(
-            "chaos: --governor and --stream are separate drills; pick one",
+            "chaos: --governor, --stream and --surge are separate drills; "
+            "pick one",
             file=sys.stderr,
         )
         return 2
     if args.cross_host:
-        if args.governor or args.stream:
+        if args.governor or args.stream or args.surge:
             print(
-                "chaos: --governor/--stream and --cross-host are separate "
-                "drills; pick one",
+                "chaos: --governor/--stream/--surge and --cross-host are "
+                "separate drills; pick one",
                 file=sys.stderr,
             )
             return 2
@@ -2314,18 +2491,29 @@ def _chaos_drill(args) -> int:
         stream=args.stream,
         lenia_sessions=args.lenia_sessions,
         watchers_per_session=args.watchers,
+        surge=args.surge,
+        standby=args.surge_standby,
+        surge_factor=args.surge_factor,
+        qos_p99_bound_s=args.qos_p99_bound,
     )
+    if cfg.surge:
+        # the surge drill's faults are the scale seams, not SIGKILLs;
+        # its session count is trickle + burst, both conway
+        cfg.kills = 0
+        cfg.ising_sessions = 0
     print(
         json.dumps(
             {
                 "mode": "chaos",
                 "governor": cfg.governor,
                 "stream": cfg.stream,
+                "surge": cfg.surge,
                 "seed": cfg.seed,
                 "workers": cfg.workers,
                 "sessions": cfg.det_sessions
                 + cfg.ising_sessions
-                + (cfg.lenia_sessions if cfg.stream else 0),
+                + (cfg.lenia_sessions if cfg.stream else 0)
+                + (cfg.surge_factor * cfg.det_sessions if cfg.surge else 0),
                 "kills": cfg.kills,
                 "workdir": cfg.workdir,
             }
@@ -2335,11 +2523,14 @@ def _chaos_drill(args) -> int:
     summary = run_drill(cfg)
     print(json.dumps(summary), flush=True)
     if not summary["ok"]:
-        flag = (
-            " --governor"
-            if cfg.governor
-            else (" --stream" if cfg.stream else "")
-        )
+        if cfg.governor:
+            flag = " --governor"
+        elif cfg.stream:
+            flag = " --stream"
+        elif cfg.surge:
+            flag = " --surge"
+        else:
+            flag = ""
         print(
             f"chaos: INVARIANT FAILURE — replay verbatim with: "
             f"tpu-life chaos{flag} --seed {cfg.seed} "

@@ -170,6 +170,14 @@ class Fleet:
                     "fleet_migrations_total", labels=("outcome",)
                 ).series()
             }
+        # autoscaling evidence (docs/FLEET.md "Autoscaling") — present
+        # only when the loop (or a standby pool) is configured, so
+        # classic fleets keep their summary shape byte-stable
+        if self.config.standby or self.supervisor.autoscaler is not None:
+            active, standby = self.supervisor.scale_counts()
+            out["scale"] = {"active": active, "standby": standby}
+            if self.supervisor.autoscaler is not None:
+                out["scale"]["decisions"] = self.supervisor.autoscaler.decisions
         return out
 
 

@@ -89,6 +89,7 @@ class Registrar:
         device_info=None,  # callable -> (devices, kind) | None
         on_grant=None,  # callable(grant dict) — spill-namespace rebinding
         on_fenced=None,  # callable(reason str) — drop re-homed sessions
+        standby: bool = False,  # park in the standby pool, not the rotation
         timeout_s: float = 5.0,
         backoff_s: float = 0.2,
         max_backoff_s: float = 5.0,
@@ -102,6 +103,10 @@ class Registrar:
         self.device_info = device_info
         self.on_grant = on_grant
         self.on_fenced = on_fenced
+        #: standby membership (docs/FLEET.md "Autoscaling"): registered
+        #: and leased, but PARKED — the control plane keeps us out of
+        #: the rotation until its autoscaler recruits the slot
+        self.standby = standby
         self.timeout_s = timeout_s
         self.backoff_s = backoff_s
         self.max_backoff_s = max_backoff_s
@@ -160,6 +165,8 @@ class Registrar:
                 "url": self.self_url,
                 "run_id": self.run_id,
             }
+            if self.standby:
+                doc["standby"] = True
             if self.worker is not None:
                 # a re-registration claims the prior name: the control
                 # plane bumps the generation on the same slot, exactly

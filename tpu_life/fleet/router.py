@@ -60,7 +60,9 @@ from tpu_life.version import __version__
 
 #: Worker 503 codes that mean "definitively not admitted" — safe to retry
 #: the submission on the next candidate without risking a duplicate.
-REFUSAL_CODES = frozenset({"overloaded", "queue_full", "draining"})
+REFUSAL_CODES = frozenset(
+    {"overloaded", "queue_full", "draining", "shed_best_effort"}
+)
 
 #: Socket read timeout on an upstream worker stream: frames arrive every
 #: scheduling round while a session runs, so a read that blocks this
@@ -286,6 +288,7 @@ class Router:
             raise fl_errors.no_ready_workers(len(self.supervisor.workers))
         hint = 1.0
         mesh_retried = False
+        shed_relay = None  # last typed best-effort shed seen on the walk
         for i, worker in enumerate(self.balancer.candidates(ready)):
             if i > 0:
                 self._c_retry.inc()
@@ -331,6 +334,9 @@ class Router:
                 self.balancer.invalidate(worker)
                 if retry_after:
                     hint = max(hint, retry_after)
+                if _error_code(doc) == "shed_best_effort":
+                    doc.setdefault("worker", worker.name)
+                    shed_relay = doc
                 continue
             # a mesh-eligible 413 (docs/SERVING.md "Mega-board sessions")
             # is the one protocol rejection the router does NOT relay
@@ -352,6 +358,14 @@ class Router:
             # another worker would just fail N times instead of once
             doc.setdefault("worker", worker.name)
             return status, retry_after, doc
+        if shed_relay is not None:
+            # the QoS shed ladder stays TYPED end to end (docs/SERVING.md
+            # "Tenant QoS"): a best-effort submit shed by every candidate
+            # relays a worker's own ``shed_best_effort`` envelope — a
+            # generic ``fleet_unavailable`` would erase the tier the
+            # client's documented recourse (sleep Retry-After, resubmit)
+            # keys on, and only best-effort tenants can draw this code
+            return 503, hint, shed_relay
         raise fl_errors.fleet_unavailable(len(ready), retry_after=hint)
 
     def _finish_submit(

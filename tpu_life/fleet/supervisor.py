@@ -78,6 +78,8 @@ class WorkerState(enum.Enum):
     DRAINING = "draining"  # /readyz answered 503 (worker-side drain)
     DOWN = "down"  # process exited; restart scheduled (or drain done)
     FAILED = "failed"  # circuit breaker open — never respawned
+    STANDBY = "standby"  # parked capacity — no process, out of rotation,
+    # recruitable by the autoscaler (docs/FLEET.md "Autoscaling")
 
 
 @dataclass
@@ -174,6 +176,15 @@ class FleetConfig:
     #: rates on the monitor tick; None = the built-in defaults.  A bad
     #: spec file raises at construction, before any process exists.
     slo_file: str | None = None
+    #: standby pool (docs/FLEET.md "Autoscaling"): this many EXTRA worker
+    #: slots created parked — no process, out of the routing rotation —
+    #: that ``recruit()`` launches on demand and ``release()`` returns
+    #: capacity to.  Under placement auto the plan covers the full
+    #: ``workers + standby`` set, so a recruit enters a reserved slice.
+    standby: int = 0
+    #: the autoscaling policy (an ``AutoscaleConfig``); None = no control
+    #: loop (standby stays parked unless an operator recruits by hand)
+    autoscale: object | None = None
 
 
 @dataclass
@@ -223,6 +234,15 @@ class Worker:
     #: the lease expired (or the fleet drained): this incarnation is
     #: fenced — terminal until the worker re-registers a new generation
     lease_dead: bool = False
+    #: standby-pool membership (docs/FLEET.md "Autoscaling"): this slot
+    #: parks at STANDBY instead of respawning after a release — set at
+    #: construction for the ``--standby`` tail, and stamped onto any
+    #: worker ``release()`` drains (a released base worker IS returned
+    #: capacity; recruit can bring it back)
+    standby: bool = False
+    #: a per-worker scale-down drain is in flight: the next exit re-parks
+    #: this slot at STANDBY instead of scheduling a restart
+    released: bool = False
 
     @property
     def alive(self) -> bool:
@@ -269,16 +289,23 @@ class Supervisor:
         self.log_dir = log_dir
         self.workers = [
             Worker(name=f"w{i}", log_path=log_dir / f"w{i}.log")
-            for i in range(config.workers)
+            for i in range(config.workers + max(0, config.standby))
         ]
+        # the standby tail parks at construction: no process, out of the
+        # rotation, waiting for recruit() (docs/FLEET.md "Autoscaling")
+        for w in self.workers[config.workers:]:
+            w.standby = True
+            w.state = WorkerState.STANDBY
         # device placement (docs/FLEET.md): plan ONCE, at construction —
         # an invalid plan (oversubscribed slice, unknown platform) raises
         # the typed PlacementError here, before any process exists, so a
         # deterministically broken env never burns the restart budget
         self.placements = None
         if config.placement == "auto":
+            # the plan covers the standby tail too: a recruit must enter
+            # a RESERVED disjoint slice, not squat on a live worker's
             self.placements = plan_placements(
-                config.workers,
+                config.workers + max(0, config.standby),
                 platform=config.placement_platform,
                 devices_per_worker=config.devices_per_worker,
                 total_devices=config.total_devices,
@@ -375,6 +402,14 @@ class Supervisor:
             else obs.slo.default_specs()
         )
         self.slo_engine = obs.slo.SloEngine(specs, self.series_store)
+        # demand-driven autoscaling (docs/FLEET.md "Autoscaling"): the
+        # control loop joins the monitor tick at the series cadence —
+        # its data plane IS the series store the tick already fills
+        self.autoscaler = None
+        if config.autoscale is not None:
+            from tpu_life.fleet.autoscaler import Autoscaler
+
+            self.autoscaler = Autoscaler(config.autoscale, self)
         for st in WorkerState:
             self._g_workers.labels(state=st.value).set(0.0)
 
@@ -383,7 +418,8 @@ class Supervisor:
         self._sweep_orphan_spills()
         with self._lock:
             for w in self.workers:
-                self._spawn_worker(w, first=True)
+                if w.state is not WorkerState.STANDBY:
+                    self._spawn_worker(w, first=True)
             self._update_gauges()
         self._thread = threading.Thread(
             target=self._monitor, name="fleet-monitor", daemon=True
@@ -545,7 +581,15 @@ class Supervisor:
             out = {}
             for w in self.workers:
                 st = w.state
-                if st not in (WorkerState.DOWN, WorkerState.FAILED) and not w.alive:
+                if (
+                    st
+                    not in (
+                        WorkerState.DOWN,
+                        WorkerState.FAILED,
+                        WorkerState.STANDBY,  # parked: no process BY DESIGN
+                    )
+                    and not w.alive
+                ):
                     st = WorkerState.DOWN  # dead but not yet reaped by a tick
                 out[w.name] = st.value
             return out
@@ -590,6 +634,107 @@ class Supervisor:
 
     def restarts(self) -> float:
         return self._c_restarts.value
+
+    # -- demand-driven scaling (docs/FLEET.md "Autoscaling") ----------------
+    def scale_counts(self) -> tuple[int, int]:
+        """``(active, standby)``: slots currently deployed (ready,
+        starting, draining, or local-and-restarting) vs parked slots a
+        :meth:`recruit` could launch right now."""
+        with self._lock:
+            active = standby = 0
+            for w in self.workers:
+                if w.state is WorkerState.STANDBY:
+                    if not w.remote or not w.lease_dead:
+                        standby += 1
+                elif w.state in (
+                    WorkerState.STARTING,
+                    WorkerState.READY,
+                    WorkerState.DRAINING,
+                ):
+                    active += 1
+                elif w.state is WorkerState.DOWN and not w.remote:
+                    # a local DOWN worker has a restart scheduled: still
+                    # a deployed slot, just mid-bounce
+                    active += 1
+            return active, standby
+
+    def recruit(self) -> str | None:
+        """Launch one parked standby into the fleet: spawn it (local) or
+        start probing it (a pre-registered remote standby — its gateway
+        is already up, parked out of rotation).  Returns the worker's
+        name, or None when the pool is empty / the fleet is draining /
+        the ``scale.recruit.fail`` chaos point says the launch failed —
+        the caller (the autoscaler) holds and retries next evaluation."""
+        with self._lock:
+            if self._draining:
+                return None
+            cands = [
+                w
+                for w in self.workers
+                if w.state is WorkerState.STANDBY
+                and (not w.remote or not w.lease_dead)
+            ]
+            if not cands:
+                return None
+            if chaos.decide("scale.recruit.fail") is not None:
+                # the "standby failed to launch" drill: no spawn, no
+                # state change — deterministic, and the next evaluation
+                # simply tries again
+                chaos.record_fire("scale.recruit.fail", "refuse")
+                log.warning("fleet: recruit refused (chaos scale.recruit.fail)")
+                return None
+            w = cands[0]
+            if w.remote:
+                # the parked gateway is live and leased: recruiting is
+                # just re-entering the probe rotation
+                w.state = WorkerState.STARTING
+                w.started_at = self.clock()
+                w.unready = 0
+            else:
+                self._spawn_worker(w)
+            obs.flight.record(
+                "scale.recruit",
+                worker=w.name,
+                generation=w.generation,
+                remote=w.remote,
+            )
+            self._update_gauges()
+            return w.name
+
+    def release(self, name: str) -> bool:
+        """Drain ONE worker out of the fleet and return its slot to the
+        standby pool: the graceful per-worker twin of
+        :meth:`begin_drain` — SIGTERM a local worker (its gateway
+        finishes accepted sessions, then exits; the exit re-parks the
+        slot) or drain-fence a remote one (typed 503 heartbeats tell it
+        to finish its sessions and re-register later).  Mesh-slice
+        reservations and sid pins are respected for free: the worker
+        itself retires them as its sessions complete."""
+        with self._lock:
+            w = self.get(name)
+            if w is None or self._draining:
+                return False
+            if w.remote:
+                if w.lease_dead or w.state is WorkerState.STANDBY:
+                    return False
+                self._fence_locked(w)
+                self._drain_fenced.add((w.name, w.generation))
+                w.lease_dead = True
+                w.standby = True
+                w.state = WorkerState.DOWN
+            else:
+                if not w.alive or w.released:
+                    return False
+                w.released = True
+                w.proc.terminate()
+            obs.flight.record(
+                "scale.release",
+                worker=w.name,
+                generation=w.generation,
+                remote=w.remote,
+            )
+            self._update_gauges()
+            return True
 
     # -- the monitor -------------------------------------------------------
     def _monitor(self) -> None:
@@ -675,6 +820,13 @@ class Supervisor:
                 self.slo_engine.evaluate()
             except Exception:  # pragma: no cover - alerting must not kill ticks
                 log.exception("fleet: slo evaluation failed")
+            # the autoscaler rides the same cadence: its inputs are the
+            # windows this very pass just refreshed
+            if self.autoscaler is not None and not self._draining:
+                try:
+                    self.autoscaler.evaluate(now)
+                except Exception:  # pragma: no cover - scaling must not kill ticks
+                    log.exception("fleet: autoscale evaluation failed")
 
     def slo_status(self) -> dict:
         """The live burn gauges (``/healthz`` ``slo`` section, ``top``)."""
@@ -707,6 +859,29 @@ class Supervisor:
         """Lifecycle transitions under the lock; True = probe this worker
         over HTTP (it is alive with a bound URL)."""
         if w.state is WorkerState.FAILED:
+            return False
+        if w.state is WorkerState.STANDBY:
+            # parked capacity: no process to reap, no probe to run.  A
+            # REMOTE standby still holds a heartbeat-renewed lease; one
+            # that goes silent leaves the pool (fenced, so a zombie
+            # reconnect is refused typed) — but held no sessions, so no
+            # migration fires
+            if w.remote and not w.lease_dead and now > w.lease_expires_at:
+                log.warning(
+                    "fleet: standby %s gen %d stopped heartbeating — "
+                    "leaving the pool",
+                    w.name,
+                    w.generation,
+                )
+                self._fence_locked(w)
+                w.lease_dead = True
+                self._c_lease_expired.inc()
+                obs.flight.record(
+                    "lease.expired",
+                    worker=w.name,
+                    generation=w.generation,
+                    standby=True,
+                )
             return False
         if w.remote:
             # wire-registered: liveness is the lease, not a process.  An
@@ -835,10 +1010,12 @@ class Supervisor:
             rc=rc,
             draining=self._draining,
             recycling=w.recycling,
+            released=w.released,
         )
-        if not self._draining:
+        if not self._draining and not w.released:
             # the recovery-time SLO's clock starts at the death edge (a
-            # drain exit is the goal, not an outage)
+            # drain exit — fleet-wide or a scale-down release — is the
+            # goal, not an outage)
             self.slo_engine.note_worker_exit(w.name, w.generation, time.time())
         if self._draining:
             w.state = WorkerState.DOWN
@@ -848,11 +1025,28 @@ class Supervisor:
             # the durability hook: hand this incarnation's spills to the
             # migrator BEFORE any respawn bumps the generation (the hook
             # only records state and spawns a thread — it must stay fast,
-            # we hold the supervisor lock)
+            # we hold the supervisor lock).  A released worker gets it
+            # too, as a safety net: a graceful release finishes its
+            # sessions (nothing to rescue), but one that died MID-drain
+            # leaves spills the migrator must still re-home.
             try:
                 self.on_worker_exit(w.name, w.generation)
             except Exception:  # pragma: no cover - the hook must not kill reaping
                 log.exception("fleet: worker-exit hook failed for %s", w.name)
+        if w.released:
+            # a scale-down release completing: the slot returns to the
+            # standby pool (docs/FLEET.md "Autoscaling") — recruitable
+            # again, never auto-respawned, breaker history cleared (an
+            # intentional exit is not a crash)
+            w.released = False
+            w.standby = True
+            w.failures = 0
+            w.unready_reason = None
+            w.state = WorkerState.STANDBY
+            log.info(
+                "fleet: %s exited rc=%s (released to standby pool)", w.name, rc
+            )
+            return
         if w.env_overlay and not w.ever_ready and not w.recycling:
             # a PLACED worker that died ON ITS OWN without ever answering
             # ready: its device slice is presumed invalid
@@ -1017,10 +1211,23 @@ class Supervisor:
             w.started_at = self.clock()
             w.unready = 0
             w.ever_ready = False
-            w.state = WorkerState.STARTING
+            standby = bool(doc.get("standby"))
+            if standby:
+                # a pre-registered standby (docs/FLEET.md "Autoscaling"):
+                # parked out of the rotation, lease kept warm by its
+                # heartbeats, launched by recruit() when demand calls
+                w.standby = True
+                w.state = WorkerState.STANDBY
+            else:
+                w.standby = False
+                w.state = WorkerState.STARTING
             self._c_registrations.inc()
             obs.flight.record(
-                "register", worker=w.name, generation=w.generation, url=url
+                "register",
+                worker=w.name,
+                generation=w.generation,
+                url=url,
+                standby=standby,
             )
             self._update_gauges()
             grant = {
@@ -1029,6 +1236,8 @@ class Supervisor:
                 "lease_ttl_s": self.config.lease_ttl_s,
                 "heartbeat_every_s": heartbeat_every(self.config.lease_ttl_s),
             }
+            if standby:
+                grant["standby"] = True
             if self.config.spill_url is not None:
                 grant["spill"] = {
                     "url": self.config.spill_url,

@@ -135,15 +135,49 @@ def overloaded(depth: float, high_water: float, retry_after: float) -> ApiError:
     )
 
 
+def shed_best_effort(
+    depth: float, water: float, retry_after: float, *, tenant: str
+) -> ApiError:
+    """The lower rung of the shed ladder (docs/SERVING.md "Tenant QoS"):
+    a best-effort tenant turned away while guaranteed tenants still
+    admit.  Retryable by contract — capacity may return, or the fleet
+    may scale up — so the router treats it as a refusal like
+    ``overloaded``."""
+    return ApiError(
+        503,
+        "shed_best_effort",
+        f"queue depth {depth:g} is past the best-effort shed threshold "
+        f"{water:g}; best-effort tenant {tenant!r} is shed first so "
+        f"guaranteed tenants keep admitting",
+        retry_after=retry_after,
+        extra={"tenant": tenant},
+    )
+
+
 def from_serve_error(e: Exception) -> ApiError:
     """Serving-layer exception -> HTTP semantics (the one mapping table)."""
     from tpu_life.serve.errors import (
         Draining,
         InsufficientMemory,
         QueueFull,
+        QuotaExceeded,
         SessionFailed,
         UnknownSession,
     )
+
+    if isinstance(e, QuotaExceeded):
+        # the tenant's OWN declared ceiling (docs/SERVING.md "Tenant
+        # QoS"), not service overload: 429 like the rate limiter, with
+        # the arithmetic in the extra so clients see WHICH quota and
+        # where the line is.  Retry-After is honest — the tenant's own
+        # earlier work must retire before more admits.
+        return ApiError(
+            429,
+            "quota_exceeded",
+            str(e),
+            retry_after=1.0,
+            extra={"tenant": e.tenant, "quota": e.quota, "limit": e.limit},
+        )
 
     if isinstance(e, InsufficientMemory):
         # the memory governor (docs/SERVING.md "Resource governance"):

@@ -557,6 +557,11 @@ def render_view(view: SessionView) -> dict:
     # there, so single-chip responses keep their exact prior shape
     if view.mesh is not None:
         out["mesh"] = view.mesh
+    # tenant stamp (docs/SERVING.md "Tenant QoS"): the resolved tenant
+    # this session was admitted under — present only when a QoS policy
+    # resolved one, so policy-less responses keep their exact prior shape
+    if view.tenant is not None:
+        out["tenant"] = view.tenant
     return out
 
 

@@ -77,6 +77,11 @@ class GatewayConfig:
     shed_high_water: float | None = None
     max_body: int = protocol.MAX_BODY  # request-body byte bound (413 past it)
     pump_idle_s: float = 0.01  # pump-thread nap when no session is live
+    # tenant QoS (docs/SERVING.md "Tenant QoS"): usually the SAME policy
+    # object as the service's ``ServeConfig.qos`` — identity resolution
+    # and the tiered shed ladder run here at the front door, quotas and
+    # DRR in the service.  None keeps the gateway tenant-blind.
+    qos: object | None = None
 
 
 class Gateway:
@@ -131,6 +136,28 @@ class Gateway:
         # is the shed input, exactly as a Prometheus alert would read it
         depth_gauge = registry.gauge("serve_queue_depth")
         self.shedder = LoadShedder(lambda: depth_gauge.value, high_water)
+        # the shed ladder's lower rung (docs/SERVING.md "Tenant QoS"):
+        # best-effort tenants shed at a fraction of the high-water mark,
+        # so overload degrades the free tier before any guaranteed
+        # tenant feels it.  Policy-less gateways never build the rung.
+        self.qos = self.config.qos or getattr(service.config, "qos", None)
+        self.shedder_soft: LoadShedder | None = None
+        self._c_tenant_shed = None
+        if self.qos is not None:
+            self.shedder_soft = LoadShedder(
+                lambda: depth_gauge.value,
+                self.qos.best_effort_water * high_water,
+            )
+            # the service registers this family first (idempotent): the
+            # gateway's front-door sheds land next to the service's
+            # quota rejections in one per-tenant counter
+            self._c_tenant_shed = registry.counter(
+                "tenant_shed_total",
+                "typed per-tenant sheds and quota rejections by reason "
+                "(quota_sessions / quota_bytes / quota_watchers / "
+                "shed_best_effort)",
+                labels=("tenant", "reason"),
+            )
         self._server = _GatewayHTTPServer(
             (self.config.host, self.config.port), _Handler
         )
@@ -594,6 +621,35 @@ class _Handler(JsonHandler):
         if wait > 0:
             gw._c_limited.inc()
             raise gw_errors.rate_limited(wait)
+        # tenant identity (docs/SERVING.md "Tenant QoS"): the API key
+        # resolves to a named tenant once, here — the name then rides
+        # submit -> session -> view as a typed field
+        tenant = None
+        tenant_spec = None
+        if gw.qos is not None:
+            tenant_spec = gw.qos.resolve(api_key)
+            tenant = tenant_spec.name
+        # the shed ladder: best-effort tenants meet the lower rung
+        # first, so guaranteed tenants only ever see the full high-water
+        # shed (and an autoscaling fleet gets the reaction window the
+        # lower rung buys)
+        if (
+            gw.shedder_soft is not None
+            and tenant_spec is not None
+            and not tenant_spec.guaranteed
+        ):
+            shed = gw.shedder_soft.check()
+            if shed is not None:
+                gw._c_shed.inc()
+                gw._c_tenant_shed.labels(
+                    tenant=tenant_spec.label, reason="shed_best_effort"
+                ).inc()
+                raise gw_errors.shed_best_effort(
+                    shed[0],
+                    gw.shedder_soft.high_water,
+                    shed[1],
+                    tenant=tenant,
+                )
         shed = gw.shedder.check()
         if shed is not None:
             gw._c_shed.inc()
@@ -624,6 +680,7 @@ class _Handler(JsonHandler):
                 scheduled_edits=spec.scheduled_edits,
                 stream_seq=spec.stream_seq,
                 mesh_resume_dir=spec.resume_tiles_dir,
+                tenant=tenant,
             )
         except Exception as e:  # typed serve errors -> typed HTTP
             raise gw_errors.from_serve_error(e) from e

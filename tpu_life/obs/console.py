@@ -170,6 +170,15 @@ def build_view(prev: dict | None, cur: dict, healthz: dict | None = None) -> dic
             return None
         return _delta(prev_scalars.get(key), cur_val) / dt
 
+    # the tenants pane (docs/SERVING.md "Tenant QoS"): live sessions and
+    # typed sheds per tenant label, summed across workers
+    tenants: dict[str, dict] = {}
+
+    def tenant_row(t: str) -> dict:
+        return tenants.setdefault(
+            t, {"sessions": None, "sheds_s": None, "sheds": 0.0}
+        )
+
     for name, labels, val in cur["scalars"]:
         worker, rest = _split_worker(labels)
         kind = cur["types"].get(name)
@@ -212,6 +221,15 @@ def build_view(prev: dict | None, cur: dict, healthz: dict | None = None) -> dic
             r["matmul_keys"] = val
         elif name == "serve_mesh_sessions":
             r["mesh"] = (r["mesh"] or 0.0) + val
+        elif name == "serve_tenant_sessions":
+            tr = tenant_row(rest.get("tenant", "<none>"))
+            tr["sessions"] = (tr["sessions"] or 0.0) + val
+        elif name == "tenant_shed_total":
+            tr = tenant_row(rest.get("tenant", "<none>"))
+            tr["sheds"] += val
+            rate = rated(key, val)
+            if rate is not None:
+                tr["sheds_s"] = (tr["sheds_s"] or 0.0) + rate
         elif kind == "counter" and name.endswith("_total"):
             pass  # unrowed counters still merge into fleet totals below
 
@@ -238,6 +256,7 @@ def build_view(prev: dict | None, cur: dict, healthz: dict | None = None) -> dic
         },
         "slo": (healthz or {}).get("slo") or {},
         "states": (healthz or {}).get("workers") or {},
+        "tenants": {k: tenants[k] for k in sorted(tenants)},
     }
     return view
 
@@ -306,6 +325,16 @@ def render_view(view: dict, *, color: bool = True) -> str:
             _fmt_num(fleet["mesh"]), "-",
         )
         lines.append(" ".join(f"{str(v):>{w}}" for v, (_, w) in zip(vals, cols)))
+    tenants = view.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append(f"{'tenant':>16} {'sessions':>9} {'sheds/s':>8} {'sheds':>8}")
+        for t in sorted(tenants):
+            tr = tenants[t]
+            lines.append(
+                f"{t:>16} {_fmt_num(tr.get('sessions')):>9} "
+                f"{_fmt_num(tr.get('sheds_s')):>8} {_fmt_num(tr.get('sheds')):>8}"
+            )
     slo = view.get("slo") or {}
     if slo:
         lines.append("")

@@ -79,6 +79,18 @@ def _labels_id(labels: dict) -> str:
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
 
 
+def _labels_from_id(labels_id: str) -> dict:
+    """Invert :func:`_labels_id` — the tenant families carry two labels,
+    so the single-label ``partition`` trick the older families use is
+    not enough."""
+    out: dict = {}
+    for part in labels_id.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            out[k] = v
+    return out
+
+
 def _group_by_run(records: list[dict]) -> dict:
     """Records bucketed by ``run_id`` (insertion-ordered; None = unstamped)."""
     groups: dict = {}
@@ -429,6 +441,32 @@ def summarize(records: list[dict]) -> dict:
                 by_label[label] = by_label.get(label, 0.0) + v
             if by_label:
                 summary.setdefault("serve", {})[out_key] = by_label
+        # the tenant families (ISSUE 20): per-tenant live sessions (a
+        # gauge — concurrent workers each held that many, so the fleet
+        # sums) and typed sheds keyed (tenant, reason), summed across
+        # workers; absent families leave older sinks byte-stable
+        tenant_sessions: dict = {}
+        tenant_sheds: dict = {}
+        for (name, labels_id, _), v in counters.items():
+            if not v:
+                continue
+            labels = _labels_from_id(labels_id)
+            if name == "serve_tenant_sessions":
+                t = labels.get("tenant", "<none>")
+                tenant_sessions[t] = tenant_sessions.get(t, 0.0) + v
+            elif name == "tenant_shed_total":
+                key = (labels.get("tenant", "<none>"),
+                       labels.get("reason", "<none>"))
+                tenant_sheds[key] = tenant_sheds.get(key, 0.0) + v
+        if tenant_sessions or tenant_sheds:
+            tenants: dict = {}
+            for t, v in tenant_sessions.items():
+                tenants.setdefault(t, {})["sessions"] = v
+            for (t, reason), v in tenant_sheds.items():
+                tenants.setdefault(t, {}).setdefault("sheds", {})[reason] = v
+            summary.setdefault("serve", {})["tenants"] = {
+                t: tenants[t] for t in sorted(tenants)
+            }
         if budget_by_worker:
             # fleet budget = sum of the workers' budgets (each governs
             # its own engines); a single sink reports its own value
@@ -552,6 +590,16 @@ def render(summary: dict) -> str:
                 for k, v in sorted(serve["watcher_shed_by_reason"].items())
             )
             lines.append(f"  watcher_shed: {detail}")
+        if "tenants" in serve:
+            for t, info in serve["tenants"].items():
+                sheds = info.get("sheds") or {}
+                detail = " ".join(
+                    f"{k}={_fmt(v)}" for k, v in sorted(sheds.items())
+                )
+                lines.append(
+                    f"  tenant {t}: sessions={_fmt(info.get('sessions', 0))}"
+                    + (f"  shed: {detail}" if detail else "")
+                )
         if "memory_budget_bytes" in serve:
             lines.append(
                 f"  memory_budget_bytes={_fmt(serve['memory_budget_bytes'])}"

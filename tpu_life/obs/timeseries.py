@@ -401,6 +401,50 @@ class SeriesStore:
             return None
         return sum(per_worker.values()), per_worker
 
+    def fleet_gauge(
+        self, key: str, max_age_s: float | None = None, now: float | None = None
+    ) -> tuple[float, dict[str, float]] | None:
+        """Summed *latest* gauge value across the fleet, plus per-worker
+        contributions — the instantaneous-load read the autoscaler keys
+        on (queue depth, estimated bytes).  Only each worker's newest
+        generation counts (a dead incarnation's final gauge must not
+        double-count against its successor), and with ``max_age_s`` set,
+        series whose newest snapshot is older than that are skipped —
+        a wedged worker's stale gauge is not demand.  ``None`` when no
+        live series carries the key."""
+        newest_gen: dict[str, int] = {}
+        for worker, gen in self.series_keys():
+            if gen >= newest_gen.get(worker, gen):
+                newest_gen[worker] = gen
+        per_worker: dict[str, float] = {}
+        any_hit = False
+        t_ref = now
+        if t_ref is None and max_age_s is not None:
+            stamps = [
+                snaps[-1]["t"]
+                for snaps in self.all_series().values()
+                if snaps
+            ]
+            t_ref = max(stamps) if stamps else None
+        for (worker, gen), snaps in self.all_series().items():
+            if gen != newest_gen.get(worker) or not snaps:
+                continue
+            last = snaps[-1]
+            if (
+                max_age_s is not None
+                and t_ref is not None
+                and last["t"] < t_ref - max_age_s
+            ):
+                continue
+            v = last.get("g", {}).get(key)
+            if v is None:
+                continue
+            any_hit = True
+            per_worker[worker] = per_worker.get(worker, 0.0) + float(v)
+        if not any_hit:
+            return None
+        return sum(per_worker.values()), per_worker
+
     def fleet_quantile(
         self, key: str, q: float, window_s: float, now: float | None = None
     ) -> tuple[float, dict[str, int]] | None:

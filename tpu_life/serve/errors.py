@@ -75,6 +75,26 @@ class InsufficientMemory(ServeError):
         self.min_devices = min_devices
 
 
+class QuotaExceeded(ServeError):
+    """Tenant QoS (docs/SERVING.md "Tenant QoS"): admitting this request
+    would push its tenant past a declared quota.
+
+    Raised by ``submit`` (``max_sessions`` / ``memory_fraction``) and
+    ``stream_subscribe`` (``max_watchers``) *synchronously* — nothing is
+    stored, exactly the QueueFull discipline.  Front-ends map it to 429
+    ``quota_exceeded`` with Retry-After: the tenant's own earlier work
+    must finish before more admits, so the wait is real, not overload.
+    ``tenant`` / ``quota`` / ``limit`` carry the arithmetic for clients
+    branching beyond the code.
+    """
+
+    def __init__(self, message: str, *, tenant: str, quota: str, limit):
+        super().__init__(message)
+        self.tenant = tenant
+        self.quota = quota
+        self.limit = limit
+
+
 class SessionTimeout(ServeError):
     """A session exceeded its per-request deadline.
 

@@ -108,6 +108,12 @@ class Scheduler:
     # the stochastic tier's bitplane knob (ServeConfig.mc_packed): ising
     # batches run on the packed device engine unless pinned off
     mc_packed: bool = True
+    # tenant QoS (docs/SERVING.md "Tenant QoS"): when set (duck-typed —
+    # anything with ``admission_order(sessions, cursor)``), the admit
+    # scan orders the queue by deficit-round-robin over tenants instead
+    # of plain FIFO, so one hog tenant cannot starve the rest of batch
+    # slots.  None keeps the exact FIFO scan, byte for byte.
+    qos: object | None = None
     # in-place recovery budget (docs/SERVING.md "Resource governance"):
     # how many chunk-level RECOVERABLE faults per CompileKey are masked
     # by rebuild-and-replay before falling back to the typed failure.
@@ -347,10 +353,21 @@ class Scheduler:
                     stats.evicted += 1
                     log.info("serve: session %s evicted (deadline)", s.sid)
 
+    def _admit_order(self) -> list:
+        """Drain the queue into this round's admission scan order: FIFO
+        without a QoS policy; deficit-round-robin over tenants with one
+        (per-tenant FIFO preserved — only the interleave changes).  The
+        rotation cursor reuses the dispatch rotation counter so tenant
+        ties don't always break toward the same name."""
+        order = list(self.queue)
+        self.queue.clear()
+        if self.qos is not None and order:
+            order = self.qos.admission_order(order, cursor=self._rotation)
+        return order
+
     def _admit(self, keyer, stats: RoundStats) -> None:
         deferred = []
-        while self.queue:
-            s = self.queue.popleft()
+        for s in self._admit_order():
             key = keyer(s)
             engine = self.engines.get(key)
             if engine is None:

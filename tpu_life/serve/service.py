@@ -72,7 +72,13 @@ from tpu_life.runtime.checkpoint import atomic_publish as ckpt_atomic_publish
 from tpu_life.runtime.metrics import MetricsRecorder, log
 from tpu_life.runtime.profiling import maybe_profile
 from tpu_life.serve.engine import CompileKey, compile_key_for
-from tpu_life.serve.errors import Draining, InsufficientMemory, QueueFull
+from tpu_life.serve.errors import (
+    Draining,
+    InsufficientMemory,
+    QueueFull,
+    QuotaExceeded,
+)
+from tpu_life.serve.qos import QosPolicy, tenant_label
 from tpu_life.serve.scheduler import RoundStats, Scheduler
 from tpu_life.serve.stream import (
     StreamHub,
@@ -124,6 +130,10 @@ class ServeConfig:
     # ``spill_dir`` (typed error at construction).
     spill_url: str | None = None
     spill_namespace: str | None = None  # default: this service's run_id
+    # replicated local spill (docs/FLEET.md): > 1 fans every spill write
+    # through N replica sub-stores under spill_dir (reads-any with
+    # demotion on the rescue path); 1 = the plain single store
+    spill_replicas: int = 1
     # the stochastic tier's bitplane knob (docs/STOCHASTIC.md packed
     # tier): ising batches run on the bitplane-packed device engine (32
     # spins per uint32 lane, bit-identical to the roll path).  False
@@ -162,6 +172,11 @@ class ServeConfig:
     # hot path then pays one is-None check and nothing else.
     series_every_s: float = 1.0
     series_max_snapshots: int = 512
+    # tenant QoS (docs/SERVING.md "Tenant QoS"): the declarative
+    # per-tenant policy — identity, quotas, DRR weights, shed tiers.
+    # None (the default) keeps the whole stack tenant-blind: no quota
+    # checks, FIFO admission, zero per-tenant label cardinality.
+    qos: QosPolicy | None = None
     # the mega-board mesh tier (docs/SERVING.md "Mega-board sessions"):
     # the device count of the slice reserved for sessions whose governor
     # verdict is "never fits on one chip".  0 disables the tier — those
@@ -237,6 +252,7 @@ class SimulationService:
             chunk_steps=self.config.chunk_steps,
             max_queue=self.config.max_queue,
             mc_packed=self.config.mc_packed,
+            qos=self.config.qos,
             engine_max_restarts=self.config.engine_max_restarts,
             clock=clock,
             observer=self,
@@ -400,6 +416,28 @@ class SimulationService:
         )
         # (key bucket, shard) pairs last set (zeroed when the engine goes)
         self._mesh_est_buckets: set[tuple[str, str]] = set()
+        # tenant QoS observability (docs/SERVING.md "Tenant QoS"): live
+        # sessions per tenant, and every typed per-tenant shed / quota
+        # rejection by reason.  Label cardinality is bounded by the
+        # policy (unknown keys collapse into one default tenant; long
+        # names hash through tenant_label), and a policy-less service
+        # never mints a single series.
+        self._qos = self.config.qos
+        self._g_tenant_sessions = self.registry.gauge(
+            "serve_tenant_sessions",
+            "live sessions per tenant",
+            labels=("tenant",),
+        )
+        self._c_tenant_shed = self.registry.counter(
+            "tenant_shed_total",
+            "typed per-tenant sheds and quota rejections by reason "
+            "(quota_sessions / quota_bytes / quota_watchers / "
+            "shed_best_effort)",
+            labels=("tenant", "reason"),
+        )
+        # tenant label buckets last set (stale buckets zero out, the
+        # _est_buckets discipline)
+        self._tenant_buckets: set[str] = set()
         # the span-ring loss counter (docs/OBSERVABILITY.md "Distributed
         # tracing"): events evicted from the bounded trace buffer between
         # scrapes — a nonzero value tells the doctor a journey may have
@@ -484,6 +522,7 @@ class SimulationService:
                 spill_dir=self.config.spill_dir,
                 spill_url=self.config.spill_url,
                 namespace=self.config.spill_namespace or self.run_id,
+                replicas=self.config.spill_replicas,
             )
         else:
             self._spill = None
@@ -573,8 +612,18 @@ class SimulationService:
         scheduled_edits=None,
         stream_seq: int = 0,
         mesh_resume_dir: str | None = None,
+        tenant: str | None = None,
     ) -> str:
         """Admit one simulation request; returns its session id.
+
+        ``tenant`` is the resolved tenant name (docs/SERVING.md "Tenant
+        QoS") the gateway derived from ``X-API-Key`` through the
+        :class:`~tpu_life.serve.qos.QosPolicy`.  With a configured
+        policy the tenant's declared quotas are enforced here — typed
+        :class:`QuotaExceeded` BEFORE anything is stored, the QueueFull
+        discipline — and the admission scan orders the queue by
+        deficit-round-robin over tenants.  None (the library default)
+        admits tenant-blind, exactly as before.
 
         ``mesh_resume_dir`` is the shard-wise mega-board resume pointer
         (docs/SERVING.md "Mega-board sessions"): a spilled tile-set
@@ -739,6 +788,65 @@ class SimulationService:
                 raise Draining(
                     "service is draining: no new sessions are admitted"
                 )
+            # tenant quotas (docs/SERVING.md "Tenant QoS"): the tenant's
+            # own declared ceilings, checked before anything is stored.
+            # A quota breach is the TENANT's limit, not service overload
+            # — it stays out of the backpressure rejection counter and
+            # lands in the per-tenant shed counter instead.
+            if self._qos is not None and tenant is not None:
+                spec = self._qos.spec(tenant)
+                mine = self.store.live_by_tenant().get(tenant, 0)
+                if (
+                    spec.max_sessions is not None
+                    and mine >= spec.max_sessions
+                ):
+                    self._quota_reject(tenant, "quota_sessions", trace_id)
+                    raise QuotaExceeded(
+                        f"tenant {tenant!r} already has {mine} live "
+                        f"sessions; its max_sessions quota is "
+                        f"{spec.max_sessions}",
+                        tenant=tenant,
+                        quota="max_sessions",
+                        limit=spec.max_sessions,
+                    )
+                if (
+                    spec.memory_fraction is not None
+                    and self._memory_budget is not None
+                ):
+                    # the tenant's slice of the governor budget, charged
+                    # per session at this session's engine estimate over
+                    # capacity (a slot's share of its batch)
+                    if mesh_shape is not None:
+                        qkey = self._mesh_key(rule, board, mesh_shape)
+                    else:
+                        from tpu_life.ops.conv import resolve_stencil
+
+                        qkey = compile_key_for(
+                            rule,
+                            board,
+                            self.config.backend,
+                            resolve_stencil(
+                                rule, self.config.stencil, self.config.backend
+                            ),
+                        )
+                    per = self._governor.estimate_engine_bytes(
+                        qkey,
+                        self.config.capacity,
+                        mc_packed=self.config.mc_packed,
+                    ) / max(1, self.config.capacity)
+                    slice_bytes = spec.memory_fraction * self._memory_budget
+                    if per * (mine + 1) > slice_bytes:
+                        self._quota_reject(tenant, "quota_bytes", trace_id)
+                        raise QuotaExceeded(
+                            f"tenant {tenant!r} would hold "
+                            f"~{int(per * (mine + 1))} estimated bytes; "
+                            f"its budget slice is {int(slice_bytes)} "
+                            f"({spec.memory_fraction:g} of "
+                            f"{self._memory_budget})",
+                            tenant=tenant,
+                            quota="memory_fraction",
+                            limit=int(slice_bytes),
+                        )
             # the memory governor (docs/SERVING.md "Resource governance"):
             # would this session's CompileKey overflow the budget?  An
             # existing (or already-queued) key admits for free; a new key
@@ -860,6 +968,7 @@ class SimulationService:
                 edits=edit_history,
                 scheduled_edits=edit_scheduled,
                 stream_seq=stream_seq,
+                tenant=tenant,
             )
             if mesh_shape is not None:
                 # the mega-board stamp: the keyer mints mesh:RxC from it,
@@ -932,6 +1041,18 @@ class SimulationService:
                     )
         log.debug("serve: submitted %s (%s, %d steps)", s.sid, rule.name, steps)
         return s.sid
+
+    def _quota_reject(self, tenant: str, reason: str, trace_id) -> None:
+        """Account one typed tenant-quota rejection (docs/SERVING.md
+        "Tenant QoS"): the admission-rejection reason row, the
+        per-tenant shed counter, and the flight event the doctor joins."""
+        self._c_adm_rejected.labels(reason=reason).inc()
+        self._c_tenant_shed.labels(
+            tenant=tenant_label(tenant), reason=reason
+        ).inc()
+        obs.flight.record(
+            "rejection", reason=reason, tenant=tenant, trace_id=trace_id
+        )
 
     def sweep(
         self,
@@ -1052,6 +1173,30 @@ class SimulationService:
         with self._lock:
             s = self.store.get(sid)  # UnknownSession -> 404 upstream
             if sid not in self._stream_charged:
+                # tenant watcher-buffer quota (docs/SERVING.md "Tenant
+                # QoS"): a NEW session ring counts against its tenant's
+                # max_watchers before any bytes are charged
+                if self._qos is not None and s.tenant is not None:
+                    spec = self._qos.spec(s.tenant)
+                    if spec.max_watchers is not None:
+                        mine = 0
+                        for other in self._stream_charged:
+                            o = self.store._sessions.get(other)
+                            if o is not None and o.tenant == s.tenant:
+                                mine += 1
+                        if mine >= spec.max_watchers:
+                            self._quota_reject(
+                                s.tenant, "quota_watchers", s.trace_id
+                            )
+                            raise QuotaExceeded(
+                                f"tenant {s.tenant!r} already holds "
+                                f"{mine} watcher buffers; its "
+                                f"max_watchers quota is "
+                                f"{spec.max_watchers}",
+                                tenant=s.tenant,
+                                quota="max_watchers",
+                                limit=spec.max_watchers,
+                            )
                 est = estimate_stream_bytes(
                     s.board.shape, str(s.board.dtype), self.hub.ring_frames
                 )
@@ -1925,6 +2070,19 @@ class SimulationService:
         for bucket, shard in self._mesh_est_buckets - live_mesh:
             self._g_mesh_est_bytes.labels(key=bucket, shard=shard).set(0.0)
         self._mesh_est_buckets = live_mesh
+        # the per-tenant session rows (docs/SERVING.md "Tenant QoS"):
+        # live counts per tenant label, stale buckets zeroed like the
+        # governor footprint above.  Policy-less services skip the walk
+        # entirely — zero label cardinality, zero cost.
+        if self._qos is not None:
+            live_tenants = set()
+            for name, n in self.store.live_by_tenant().items():
+                lbl = tenant_label(name)
+                live_tenants.add(lbl)
+                self._g_tenant_sessions.labels(tenant=lbl).set(float(n))
+            for lbl in self._tenant_buckets - live_tenants:
+                self._g_tenant_sessions.labels(tenant=lbl).set(0.0)
+            self._tenant_buckets = live_tenants
         elapsed = self.clock() - self._t0
         qw, lat = self._h_queue_wait, self._h_latency
         self.recorder.record(
@@ -2175,6 +2333,22 @@ class SimulationService:
             # the mesh tier (docs/SERVING.md "Mega-board sessions"):
             # sessions currently sharded over the reserved slice
             "mesh_sessions": int(self._g_mesh_sessions.value),
+            # tenant QoS (docs/SERVING.md "Tenant QoS"): live sessions
+            # and typed sheds per tenant — {} on policy-less services,
+            # so the stats shape only grows when a policy exists
+            **(
+                {
+                    "tenants": self.store.live_by_tenant(),
+                    "tenant_sheds": {
+                        f"{labels['tenant']}:{labels['reason']}": int(
+                            inst.value
+                        )
+                        for labels, inst in self._c_tenant_shed.series()
+                    },
+                }
+                if self._qos is not None
+                else {}
+            ),
             "elapsed_s": elapsed,
             "sessions_per_sec": self._completed / elapsed if elapsed > 0 else 0.0,
             "batch_occupancy_mean": self._occupancy_sum / self._rounds

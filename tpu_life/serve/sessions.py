@@ -120,6 +120,13 @@ class Session:
     # already produced (from the spill manifest), so the survivor's hub
     # continues the same gapless sequence space
     stream_seq: int = 0
+    # tenant identity (docs/SERVING.md "Tenant QoS"): the resolved
+    # tenant name this session was admitted under — set by the gateway
+    # from X-API-Key through the QosPolicy, None for library callers
+    # and policy-less deployments.  Rides submit -> router -> worker as
+    # a typed field: quota checks, DRR fairness, and the per-tenant
+    # observability rows all key on it.
+    tenant: str | None = None
     # mega-board tier (docs/SERVING.md "Mega-board sessions"): the mesh
     # slice shape ``(rows, cols)`` this session's board is sharded over,
     # None for single-chip sessions.  Set at submit when the governor's
@@ -189,6 +196,10 @@ class SessionView:
     # mega-board stamp: "RxC" when the session runs on a mesh slice,
     # None for single-chip sessions (the wire render gates on it)
     mesh: str | None = None
+    # tenant stamp (docs/SERVING.md "Tenant QoS"): the resolved tenant
+    # name, None for policy-less deployments (the wire render gates on
+    # it so prior response shapes stay byte-identical)
+    tenant: str | None = None
 
     @property
     def finished(self) -> bool:
@@ -241,6 +252,7 @@ class SessionStore:
             trace_id=s.trace_id,
             edits=len(s.edits) + len(s.scheduled_edits),
             mesh=(f"{s.mesh[0]}x{s.mesh[1]}" if s.mesh is not None else None),
+            tenant=s.tenant,
         )
 
     def result(self, sid: str) -> np.ndarray:
@@ -271,6 +283,17 @@ class SessionStore:
     def live(self) -> list[Session]:
         """Sessions not yet in a terminal state, in submission order."""
         return [s for s in self._sessions.values() if s.state not in TERMINAL]
+
+    def live_by_tenant(self) -> dict[str, int]:
+        """Live-session counts keyed by tenant name (sessions without a
+        tenant stamp are skipped) — the quota check's and the per-tenant
+        gauge's shared input."""
+        out: dict[str, int] = {}
+        for s in self._sessions.values():
+            if s.state in TERMINAL or s.tenant is None:
+                continue
+            out[s.tenant] = out.get(s.tenant, 0) + 1
+        return out
 
     def __len__(self) -> int:
         return len(self._sessions)

@@ -155,21 +155,33 @@ def make_spill_backend(
     spill_dir: str | None = None,
     spill_url: str | None = None,
     namespace: str | None = None,
+    replicas: int = 1,
 ) -> "SpillBackend":
     """The one place a serve config becomes a backend: a ``spill_url``
     selects the remote HTTP store (``namespace`` names this worker
-    incarnation's slice of it), otherwise the local directory.  Both at
-    once is a typed config error — the session would be split across two
-    stores and neither would hold a resumable whole."""
+    incarnation's slice of it), otherwise the local directory —
+    replicated across ``replicas`` sub-stores when > 1
+    (``--spill-replicas``).  Both stores at once is a typed config error
+    — the session would be split across two stores and neither would
+    hold a resumable whole."""
     if spill_url is not None and spill_dir is not None:
         raise ValueError(
             "spill_dir and spill_url are mutually exclusive — a session "
             "spilled half-local, half-remote could never be resumed whole"
         )
+    if replicas < 1:
+        raise ValueError(f"spill replicas must be >= 1, got {replicas}")
     if spill_url is not None:
+        if replicas > 1:
+            raise ValueError(
+                "spill replication is a local-directory feature; the "
+                "remote HTTP store owns its own durability"
+            )
         from tpu_life.serve.spill_http import HttpSpillBackend
 
         return HttpSpillBackend(spill_url, namespace or "default")
+    if replicas > 1:
+        return ReplicatedSpillBackend(spill_dir, replicas)
     return SpillStore(spill_dir)
 
 
@@ -521,6 +533,131 @@ class SpillStore(SpillBackend):
         return list(self._written)
 
 
+#: Replica sub-directory prefix under a replicated spill root:
+#: ``<root>/replica-0`` .. ``replica-N-1``, each a complete
+#: :class:`SpillStore` layout of its own.
+REPLICA_PREFIX = "replica-"
+
+
+class ReplicatedSpillBackend(SpillBackend):
+    """N-way replicated local spill: every write fans through N
+    :class:`SpillStore` instances rooted at ``<root>/replica-i`` — same
+    atomic publish, same CRC32 witness, N independent copies.
+
+    The failure contract is majority-free reads-any: a write that lands
+    on AT LEAST ONE replica is durable (a dead replica disk degrades
+    redundancy, not the session), and only when EVERY replica refuses
+    does the save raise — the service then degrades that session to
+    spill-disabled exactly as with a single store.  The read side
+    (:func:`read_spill_sessions` / :func:`read_mesh_sessions`) detects
+    the replica layout under a worker's spill dir and merges per sid:
+    the intact record with the highest step wins, a torn or bit-rotted
+    replica silently demotes to its peers, and a sid is only ``corrupt``
+    when NO replica yields a resumable record.  The migrator and the
+    mesh resume path are unchanged — they keep calling the same readers
+    on the same worker spill directory.
+    """
+
+    SUPPORTS_MESH = True
+
+    def __init__(self, root: str | os.PathLike, replicas: int):
+        if replicas < 2:
+            raise ValueError(
+                f"a replicated spill needs >= 2 replicas, got {replicas}"
+            )
+        self.root = Path(root)
+        self.stores = [
+            SpillStore(self.root / f"{REPLICA_PREFIX}{i}")
+            for i in range(replicas)
+        ]
+
+    def _fan_save(self, op: str, sid: str, args, kw) -> bool:
+        wrote = False
+        errors: list[OSError] = []
+        for s in self.stores:
+            try:
+                wrote = getattr(s, op)(sid, *args, **kw) or wrote
+            except OSError as e:
+                errors.append(e)
+        if errors:
+            if len(errors) == len(self.stores):
+                # every copy refused: this IS a spill failure — the
+                # caller degrades the session like a single-store error
+                raise errors[0]
+            log.warning(
+                "spill: %d/%d replicas failed the %s for %s (%s) — "
+                "redundancy degraded, session still durable",
+                len(errors),
+                len(self.stores),
+                op,
+                sid,
+                errors[0],
+            )
+        return wrote
+
+    def save(self, sid, board, step, **kw) -> bool:
+        return self._fan_save("save", sid, (board, step), kw)
+
+    def save_mesh(self, sid, tiles, step, **kw) -> bool:
+        # tiles may be a generator (the mesh spill walk): materialize
+        # once so every replica writes the same epoch
+        return self._fan_save("save_mesh", sid, (list(tiles), step), kw)
+
+    def mark_disabled(self, sid: str) -> None:
+        for s in self.stores:
+            s.mark_disabled(sid)
+
+    def delete(self, sid: str) -> None:
+        for s in self.stores:
+            s.delete(sid)
+
+    def spilled_count(self) -> int:
+        return len(self.spilled_sids())
+
+    def spilled_sids(self) -> list[str]:
+        sids: set[str] = set()
+        for s in self.stores:
+            sids.update(s.spilled_sids())
+        return sorted(sids)
+
+
+def _replica_roots(rootp: Path) -> list[Path]:
+    """The replica sub-stores under a replicated spill root (empty for a
+    plain single-store layout), numerically ordered."""
+    if not rootp.is_dir():
+        return []
+    reps = [
+        p
+        for p in rootp.iterdir()
+        if p.is_dir()
+        and p.name.startswith(REPLICA_PREFIX)
+        and p.name[len(REPLICA_PREFIX):].isdigit()
+    ]
+    return sorted(reps, key=lambda p: int(p.name[len(REPLICA_PREFIX):]))
+
+
+def _merge_replica_reads(outcomes):
+    """Fold per-replica ``(records, corrupt, disabled)`` triples into one
+    reads-any verdict per sid: best intact record (highest step) wins; a
+    disabled marker anywhere wins over stale records (the worker dropped
+    those bytes on purpose); ``corrupt`` only when no replica resumes."""
+    best: dict[str, object] = {}
+    corrupt_sids: set[str] = set()
+    disabled_sids: set[str] = set()
+    for records, corrupt, disabled in outcomes:
+        for rec in records:
+            prev = best.get(rec.sid)
+            if prev is None or rec.step > prev.step:
+                best[rec.sid] = rec
+        corrupt_sids.update(corrupt)
+        disabled_sids.update(disabled)
+    merged = [best[sid] for sid in sorted(best) if sid not in disabled_sids]
+    corrupt = sorted(
+        s for s in corrupt_sids if s not in best and s not in disabled_sids
+    )
+    return merged, corrupt, sorted(disabled_sids)
+
+
 def read_spill_sessions(
     root: str | os.PathLike,
 ) -> tuple[list[SpillRecord], list[str], list[str]]:
@@ -538,6 +675,11 @@ def read_spill_sessions(
     directory resume).
     """
     rootp = Path(root)
+    reps = _replica_roots(rootp)
+    if reps:
+        # a replicated layout (docs/FLEET.md): merge per-replica reads —
+        # the migrator's call site is unchanged, reads-any happens here
+        return _merge_replica_reads([read_spill_sessions(r) for r in reps])
     records: list[SpillRecord] = []
     corrupt: list[str] = []
     disabled: list[str] = []
@@ -637,9 +779,14 @@ def read_mesh_sessions(
     so the resuming mesh pulls rectangles tile-by-tile at admission.
     """
     rootp = Path(root)
+    reps = _replica_roots(rootp)
+    if reps:
+        return _merge_replica_reads([read_mesh_sessions(r) for r in reps])
     if not rootp.is_dir():
         return [], [], []
-    return _read_mesh_dirs(sorted(p for p in rootp.iterdir() if p.is_dir()))
+    return _read_mesh_dirs(
+        sorted(p for p in rootp.iterdir() if p.is_dir())
+    )
 
 
 def read_mesh_session_dir(d: str | os.PathLike) -> MeshSpillRecord:
